@@ -1,0 +1,24 @@
+"""foundationdb_tpu — a TPU-native transactional key-value framework.
+
+A ground-up rebuild of FoundationDB's capabilities (reference:
+apple/foundationdb fork `dlambrig/foundationdb`) designed TPU-first:
+
+- The MVCC conflict-resolution hot path (reference: fdbserver/SkipList.cpp,
+  fdbserver/Resolver.actor.cpp) is a batched, vectorized interval-overlap
+  kernel under ``jax.jit`` (:mod:`foundationdb_tpu.models.conflict_set`).
+- Multi-resolver deployments shard the keyspace over a ``jax.sharding.Mesh``
+  and combine per-shard conflict bitmasks with ``psum``
+  (:mod:`foundationdb_tpu.parallel`).
+- The surrounding runtime — sequencer, proxies, transaction logs, storage
+  servers, simulation — is ordinary host code (Python + C++), mirroring the
+  reference's role decomposition (fdbserver/*.actor.cpp) without its Flow
+  actor DSL.
+"""
+
+__version__ = "0.1.0"
+
+from foundationdb_tpu.core.errors import (  # noqa: F401
+    FdbError,
+    NotCommitted,
+    TransactionTooOld,
+)

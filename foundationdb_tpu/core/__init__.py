@@ -1,0 +1,2 @@
+from foundationdb_tpu.core.keypack import KeyCodec  # noqa: F401
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict  # noqa: F401

@@ -1,0 +1,80 @@
+"""Error model, mirroring the reference's flow/Error.h + fdbclient error codes.
+
+Only the codes the client/runtime actually raise are defined; the numeric
+values match the reference's error_code_* constants so users of fdb bindings
+recognise them (reference: flow/include/flow/error_definitions.h).
+"""
+
+from __future__ import annotations
+
+
+class FdbError(Exception):
+    """Base error with an fdb-compatible numeric code."""
+
+    code: int = 1500  # internal_error
+
+    def __init__(self, message: str = "", code: int | None = None):
+        super().__init__(message or type(self).__name__)
+        if code is not None:
+            self.code = code
+
+    @property
+    def retryable(self) -> bool:
+        return self.code in _RETRYABLE
+
+
+class NotCommitted(FdbError):
+    """Transaction conflicted with another transaction (error 1020)."""
+
+    code = 1020
+
+
+class TransactionTooOld(FdbError):
+    """Read version is older than the MVCC window (error 1007)."""
+
+    code = 1007
+
+
+class FutureVersion(FdbError):
+    """Storage server has not yet caught up to the read version (1009)."""
+
+    code = 1009
+
+
+class CommitUnknownResult(FdbError):
+    """Commit outcome unknown (e.g. proxy died mid-commit) (1021)."""
+
+    code = 1021
+
+
+class KeyOutsideLegalRange(FdbError):
+    code = 2003
+
+
+class InvertedRange(FdbError):
+    code = 2005
+
+
+class KeyTooLarge(FdbError):
+    code = 2102
+
+
+class ValueTooLarge(FdbError):
+    code = 2103
+
+
+class TransactionTooLarge(FdbError):
+    code = 2101
+
+
+class UsedDuringCommit(FdbError):
+    code = 2017
+
+
+class ProcessKilled(FdbError):
+    """Simulation-only: the role's process was killed mid-operation."""
+
+    code = 1211  # cluster_version_changed stand-in for injected kills
+
+
+_RETRYABLE = {1007, 1009, 1020, 1021, 1211}

@@ -1,0 +1,7 @@
+from foundationdb_tpu.ops.lex import (  # noqa: F401
+    lex_le,
+    lex_lt,
+    searchsorted_words,
+    sort_keys_with_payload,
+)
+from foundationdb_tpu.ops.rmq import range_max, sparse_table  # noqa: F401

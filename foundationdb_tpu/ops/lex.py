@@ -1,0 +1,82 @@
+"""Vectorized lexicographic primitives over packed multi-word keys.
+
+Keys are ``[..., W]`` int32 vectors (see core/keypack.py); order is
+column-lexicographic. These are the device-side replacements for the
+reference's StringRef::compare inner loops (fdbserver/SkipList.cpp uses SSE
+memcmp; here the VPU compares all words of many keys at once, and binary
+search is a static-trip-count ``fori_loop`` of gathers).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def lex_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a < b lexicographically on the trailing word axis (broadcasting)."""
+    eq = (a == b).astype(jnp.int32)
+    lt = a < b
+    # eq_prefix[..., k] = all words before k equal → word k is the decider.
+    inc = jnp.cumprod(eq, axis=-1)
+    eq_prefix = jnp.concatenate(
+        [jnp.ones_like(inc[..., :1]), inc[..., :-1]], axis=-1
+    )
+    return jnp.any((eq_prefix == 1) & lt, axis=-1)
+
+
+def lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
+    return ~lex_lt(b, a)
+
+
+def searchsorted_words(
+    sorted_keys: jax.Array, queries: jax.Array, side: str = "left"
+) -> jax.Array:
+    """Vectorized binary search of [..., W] queries into a sorted [N, W] array.
+
+    Returns int32 insertion indices with numpy.searchsorted semantics.
+    Static trip count ceil(log2(N+1)) so the whole search stays inside jit
+    with no dynamic shapes.
+    """
+    sorted_keys = jnp.asarray(sorted_keys)
+    queries = jnp.asarray(queries)
+    n = sorted_keys.shape[0]
+    if n == 0:
+        return jnp.zeros(queries.shape[:-1], dtype=jnp.int32)
+    steps = max(1, math.ceil(math.log2(n + 1)))
+    shape = queries.shape[:-1]
+    lo = jnp.zeros(shape, dtype=jnp.int32)
+    hi = jnp.full(shape, n, dtype=jnp.int32)
+
+    def body(_, lh):
+        lo, hi = lh
+        mid = (lo + hi) >> 1
+        a = sorted_keys[mid]  # gather [..., W]
+        if side == "left":
+            go_right = lex_lt(a, queries)
+        else:
+            go_right = lex_le(a, queries)
+        active = lo < hi
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def sort_keys_with_payload(
+    keys: jax.Array, *payloads: jax.Array
+) -> tuple[jax.Array, ...]:
+    """Stable lexicographic sort of [N, W] keys, carrying payload columns.
+
+    Returns (sorted_keys, *sorted_payloads). Uses lax.sort's multi-operand
+    lexicographic ordering over the W word columns.
+    """
+    w = keys.shape[-1]
+    cols = tuple(keys[:, i] for i in range(w))
+    res = jax.lax.sort(cols + tuple(payloads), num_keys=w, is_stable=True)
+    sorted_keys = jnp.stack(res[:w], axis=-1)
+    return (sorted_keys,) + tuple(res[w:])

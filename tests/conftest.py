@@ -1,0 +1,23 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+Must set the env vars before jax is imported anywhere (mirrors the driver's
+dryrun harness, which uses xla_force_host_platform_device_count to validate
+multi-chip sharding without real chips).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,94 @@
+"""Key packing: packed int32 order must equal raw bytes order."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.keypack import INT32_MAX, KeyCodec
+
+
+def np_lex_lt(a, b):
+    """Lexicographic < on 1-D int32 vectors."""
+    for x, y in zip(a.tolist(), b.tolist()):
+        if x != y:
+            return x < y
+    return False
+
+
+def random_key(rng, max_len=40):
+    n = int(rng.integers(0, max_len + 1))
+    # Bias toward structured keys: low-entropy alphabets produce shared
+    # prefixes, the hard case for lexicographic packing.
+    alphabet = rng.choice([2, 4, 256])
+    return bytes(rng.integers(0, alphabet, size=n, dtype=np.uint8))
+
+
+def test_order_preservation_random(rng):
+    codec = KeyCodec(max_key_bytes=32)
+    keys = [random_key(rng, max_len=32) for _ in range(300)]
+    packed = codec.pack(keys, "begin")
+    for _ in range(2000):
+        i, j = rng.integers(0, len(keys), size=2)
+        assert (keys[i] < keys[j]) == np_lex_lt(packed[i], packed[j]), (
+            keys[i],
+            keys[j],
+        )
+
+
+def test_prefix_extension_order():
+    codec = KeyCodec(max_key_bytes=8)
+    a, b, c = b"a", b"a\x00", b"a\x01"
+    pa, pb, pc = codec.pack([a, b, c], "begin")
+    assert np_lex_lt(pa, pb) and np_lex_lt(pb, pc)
+
+
+def test_roundtrip(rng):
+    codec = KeyCodec(max_key_bytes=32)
+    keys = [random_key(rng, max_len=32) for _ in range(100)]
+    packed = codec.pack(keys, "begin")
+    for k, p in zip(keys, packed):
+        assert codec.unpack(p) == k
+
+
+def test_sentinels():
+    codec = KeyCodec(max_key_bytes=8)
+    keys = [b"", b"\x00", b"\xff" * 8, b"zzz"]
+    packed = codec.pack(keys, "begin")
+    for p in packed:
+        assert np_lex_lt(p, codec.inf_key)
+    # b"" is the minimum.
+    for p in packed[1:]:
+        assert np_lex_lt(packed[0], p)
+
+
+def test_overlong_truncation_is_conservative():
+    codec = KeyCodec(max_key_bytes=8)
+    long_begin = b"abcdefgh-tail1"
+    long_end = b"abcdefgh-tail2"
+    pb = codec.pack([long_begin], "begin")[0]
+    pe = codec.pack([long_end], "end")[0]
+    # Widened range: packed begin ≤ true begin, packed end ≥ true end,
+    # and the widened range is non-empty (no false negatives possible).
+    exact_b = codec.pack([b"abcdefgh"], "begin")[0]
+    assert (pb == exact_b).all()
+    assert np_lex_lt(pb, pe)
+    # End rounded up past every key sharing the 8-byte prefix: pe >= probe.
+    probe = codec.pack([b"abcdefgi"], "begin")[0]
+    assert not np_lex_lt(pe, probe)
+    assert (pe == probe).all()  # exactly the prefix-successor
+
+
+def test_overlong_all_ff_end_becomes_inf():
+    codec = KeyCodec(max_key_bytes=8)
+    p = codec.pack([b"\xff" * 12], "end")[0]
+    assert (p == np.full(codec.width, INT32_MAX, np.int32)).all()
+
+
+def test_pack_ranges_shapes():
+    codec = KeyCodec(max_key_bytes=16)
+    b, e = codec.pack_ranges([(b"a", b"b"), (b"c", b"d\x00")])
+    assert b.shape == (2, codec.width) and e.shape == (2, codec.width)
+
+
+def test_bad_width():
+    with pytest.raises(ValueError):
+        KeyCodec(max_key_bytes=10)

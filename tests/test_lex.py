@@ -1,0 +1,68 @@
+"""Device lexicographic ops vs numpy oracles."""
+
+import bisect
+
+import numpy as np
+
+from foundationdb_tpu.core.keypack import KeyCodec
+from foundationdb_tpu.ops.lex import (
+    lex_le,
+    lex_lt,
+    searchsorted_words,
+    sort_keys_with_payload,
+)
+from tests.test_keypack import np_lex_lt, random_key
+
+
+def make_packed(rng, n, codec):
+    keys = [random_key(rng, max_len=codec.max_key_bytes) for _ in range(n)]
+    return keys, codec.pack(keys, "begin")
+
+
+def test_lex_lt_matches_bytes(rng):
+    codec = KeyCodec(16)
+    keys, packed = make_packed(rng, 200, codec)
+    i = rng.integers(0, 200, size=500)
+    j = rng.integers(0, 200, size=500)
+    got = np.asarray(lex_lt(packed[i], packed[j]))
+    want = np.array([keys[a] < keys[b] for a, b in zip(i, j)])
+    assert (got == want).all()
+    got_le = np.asarray(lex_le(packed[i], packed[j]))
+    want_le = np.array([keys[a] <= keys[b] for a, b in zip(i, j)])
+    assert (got_le == want_le).all()
+
+
+def test_searchsorted_matches_numpy(rng):
+    codec = KeyCodec(16)
+    keys, _ = make_packed(rng, 300, codec)
+    keys = sorted(set(keys))
+    packed = codec.pack(keys, "begin")
+    qkeys, qpacked = make_packed(rng, 400, codec)
+    # NB: numpy 'S'-dtype comparisons drop trailing nulls, so the oracle is
+    # Python bisect over real bytes objects.
+    for side, fn in (("left", bisect.bisect_left), ("right", bisect.bisect_right)):
+        got = np.asarray(searchsorted_words(packed, qpacked, side))
+        want = np.array([fn(keys, q) for q in qkeys])
+        assert (got == want).all(), side
+
+
+def test_searchsorted_with_duplicates(rng):
+    codec = KeyCodec(8)
+    keys = [b"a", b"a", b"b", b"b", b"b", b"c"]
+    packed = codec.pack(keys, "begin")
+    q = codec.pack([b"a", b"b", b"c", b"", b"d"], "begin")
+    assert np.asarray(searchsorted_words(packed, q, "left")).tolist() == [0, 2, 5, 0, 6]
+    assert np.asarray(searchsorted_words(packed, q, "right")).tolist() == [2, 5, 6, 0, 6]
+
+
+def test_sort_keys_with_payload(rng):
+    codec = KeyCodec(16)
+    keys, packed = make_packed(rng, 128, codec)
+    payload = np.arange(128, dtype=np.int32)
+    skeys, spay = sort_keys_with_payload(packed, payload)
+    order = sorted(range(128), key=lambda i: keys[i])
+    want = codec.pack([keys[i] for i in order], "begin")
+    assert (np.asarray(skeys) == want).all()
+    # Stable: payloads of equal keys keep original order.
+    got_keys = [keys[i] for i in np.asarray(spay)]
+    assert got_keys == [keys[i] for i in order]

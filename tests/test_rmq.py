@@ -1,0 +1,27 @@
+"""Sparse-table range-max vs numpy oracle."""
+
+import numpy as np
+
+from foundationdb_tpu.ops.rmq import range_max, sparse_table
+
+NEG = -(2**31) + 1
+
+
+def test_range_max_random(rng):
+    for n in (1, 2, 3, 7, 64, 100, 257):
+        vals = rng.integers(-100, 100, size=n).astype(np.int32)
+        st = sparse_table(vals)
+        lo = rng.integers(0, n, size=200).astype(np.int32)
+        hi = rng.integers(0, n + 1, size=200).astype(np.int32)
+        got = np.asarray(range_max(st, lo, hi, NEG))
+        for l, h, g in zip(lo, hi, got):
+            want = vals[l:h].max() if h > l else NEG
+            assert g == want, (n, l, h, g, want)
+
+
+def test_range_max_full_and_empty(rng):
+    vals = rng.integers(0, 10, size=33).astype(np.int32)
+    st = sparse_table(vals)
+    assert int(range_max(st, np.int32(0), np.int32(33), NEG)) == vals.max()
+    assert int(range_max(st, np.int32(5), np.int32(5), NEG)) == NEG
+    assert int(range_max(st, np.int32(7), np.int32(3), NEG)) == NEG
