@@ -1,0 +1,188 @@
+"""Host-side ConflictSet API over the jitted kernel.
+
+This is the seam the reference exposes as ``newConflictSet()`` /
+``ConflictBatch`` (fdbserver/ConflictSet.h): the runtime's Resolver role
+(runtime/resolver.py) talks to this class and never sees device tensors.
+Responsibilities here: pad/pack byte-range batches into static-shape tensors,
+chunk oversized batches (sub-batches at the same commit version are exactly
+equivalent — earlier chunks' writes are painted at cv before later chunks
+resolve, which reproduces in-batch ordering), coalesce per-txn conflict
+ranges beyond the padded width (conservative covering ranges: false
+conflicts possible, missed conflicts impossible), and manage the
+absolute↔relative version mapping with periodic device rebase.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from foundationdb_tpu.core.keypack import INT32_MAX, KeyCodec
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.models import conflict_kernel as ck
+
+DEFAULT_WINDOW_VERSIONS = 5_000_000  # ~5s at 1M versions/sec, reference MVCC window
+_REBASE_THRESHOLD = 1 << 30
+
+
+class TPUConflictSet:
+    """Drop-in conflict engine: resolve(txns, commit_version) → verdicts."""
+
+    def __init__(
+        self,
+        capacity: int = 1 << 16,
+        batch_size: int = 512,
+        max_read_ranges: int = 8,
+        max_write_ranges: int = 8,
+        max_key_bytes: int = 32,
+        window_versions: int = DEFAULT_WINDOW_VERSIONS,
+    ):
+        self.codec = KeyCodec(max_key_bytes)
+        self.capacity = capacity
+        self.batch_size = batch_size
+        self.max_read_ranges = max_read_ranges
+        self.max_write_ranges = max_write_ranges
+        self.window_versions = window_versions
+        self.state = ck.init_state(capacity, self.codec.width, self.codec.min_key)
+        self.base_version: int | None = None
+        self.oldest_version: int = 0  # absolute; advances monotonically
+        self._last_commit: int = 0
+
+    # -- public API ---------------------------------------------------------
+
+    def resolve(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> list[Verdict]:
+        if commit_version <= self._last_commit:
+            raise ValueError(
+                f"commit versions must advance: {commit_version} <= {self._last_commit}"
+            )
+        if self.base_version is None:
+            self.base_version = max(0, commit_version - self.window_versions)
+        if oldest_version is not None:
+            self.oldest_version = max(self.oldest_version, oldest_version)
+        self.oldest_version = max(
+            self.oldest_version, commit_version - self.window_versions
+        )
+        self._maybe_rebase(commit_version)
+        self._last_commit = commit_version
+
+        out: list[Verdict] = []
+        for i in range(0, len(txns), self.batch_size):
+            out.extend(self._resolve_chunk(txns[i : i + self.batch_size], commit_version))
+        return out
+
+    @property
+    def overflowed(self) -> bool:
+        return bool(np.asarray(self.state.overflow))
+
+    # -- internals ----------------------------------------------------------
+
+    def _rel(self, v: int) -> int:
+        assert self.base_version is not None
+        rel = v - self.base_version
+        if rel < 0:
+            raise ValueError(f"version {v} below base {self.base_version}")
+        return rel
+
+    def _rel_read(self, v: int) -> int:
+        """Read versions may legitimately predate the base (ancient readers):
+        clamp to -1, which is strictly below every window floor → TOO_OLD for
+        readers, irrelevant for blind writers."""
+        assert self.base_version is not None
+        return max(-1, v - self.base_version)
+
+    def _maybe_rebase(self, commit_version: int) -> None:
+        assert self.base_version is not None
+        if commit_version - self.base_version < _REBASE_THRESHOLD:
+            return
+        delta = self.oldest_version - self.base_version
+        if delta <= 0:
+            return
+        # Device versions < delta are all expired; the kernel clamps them to
+        # the sentinel, so saturating the device delta at int32 max is exact
+        # even for astronomically large jumps.
+        self.state = ck._rebase_jit(self.state, np.int32(min(delta, 2**31 - 1)))
+        self.base_version += delta
+
+    def _resolve_chunk(
+        self, txns: list[TxnConflictInfo], commit_version: int
+    ) -> list[Verdict]:
+        batch = self._pack(txns)
+        cv = np.int32(self._rel(commit_version))
+        oldest = np.int32(self._rel(self.oldest_version))
+        verdicts, self.state = ck._resolve_jit(self.state, batch, cv, oldest)
+        v = np.asarray(verdicts)[: len(txns)]
+        return [Verdict(int(x)) for x in v]
+
+    def _pack(self, txns: list[TxnConflictInfo]) -> ck.BatchTensors:
+        b = self.batch_size
+        r, q = self.max_read_ranges, self.max_write_ranges
+        w = self.codec.width
+
+        read_begin = np.full((b, r, w), INT32_MAX, np.int32)
+        read_end = np.full((b, r, w), INT32_MAX, np.int32)
+        read_mask = np.zeros((b, r), bool)
+        write_begin = np.full((b, q, w), INT32_MAX, np.int32)
+        write_end = np.full((b, q, w), INT32_MAX, np.int32)
+        write_mask = np.zeros((b, q), bool)
+        read_version = np.zeros((b,), np.int32)
+        txn_mask = np.zeros((b,), bool)
+
+        # One vectorized pack per endpoint kind across the whole batch (the
+        # per-txn Python work is just index bookkeeping).
+        r_rows, r_cols, r_pairs = [], [], []
+        w_rows, w_cols, w_pairs = [], [], []
+        for i, t in enumerate(txns):
+            txn_mask[i] = True
+            read_version[i] = self._rel_read(t.read_version)
+            for c, x in enumerate(_coalesce(t.read_ranges, r)):
+                r_rows.append(i)
+                r_cols.append(c)
+                r_pairs.append((x.begin, x.end))
+            for c, x in enumerate(_coalesce(t.write_ranges, q)):
+                w_rows.append(i)
+                w_cols.append(c)
+                w_pairs.append((x.begin, x.end))
+        if r_pairs:
+            rb, re_ = self.codec.pack_ranges(r_pairs)
+            read_begin[r_rows, r_cols] = rb
+            read_end[r_rows, r_cols] = re_
+            read_mask[r_rows, r_cols] = True
+        if w_pairs:
+            wb, we = self.codec.pack_ranges(w_pairs)
+            write_begin[w_rows, w_cols] = wb
+            write_end[w_rows, w_cols] = we
+            write_mask[w_rows, w_cols] = True
+
+        return ck.BatchTensors(
+            read_begin=read_begin,
+            read_end=read_end,
+            read_mask=read_mask,
+            write_begin=write_begin,
+            write_end=write_end,
+            write_mask=write_mask,
+            read_version=read_version,
+            txn_mask=txn_mask,
+        )
+
+
+def _coalesce(ranges: list[KeyRange], limit: int) -> list[KeyRange]:
+    """At most `limit` ranges covering the input (conservative widening).
+
+    Sorts by begin and covers even-sized groups — the analogue of the
+    reference's combineWriteConflictRanges merging adjacent/overlapping
+    ranges, extended to force a static width.
+    """
+    live = [x for x in ranges if not x.empty]
+    if len(live) <= limit:
+        return live
+    live.sort(key=lambda x: x.begin)
+    out = []
+    step = -(-len(live) // limit)
+    for i in range(0, len(live), step):
+        grp = live[i : i + step]
+        out.append(KeyRange(grp[0].begin, max(g.end for g in grp)))
+    return out

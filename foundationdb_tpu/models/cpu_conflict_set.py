@@ -1,0 +1,105 @@
+"""Python wrapper over the C++ skiplist ConflictSet (the CPU baseline).
+
+Same resolve() contract as models.conflict_set.TPUConflictSet, so the
+runtime's Resolver can be configured with either engine (the reference's
+``newConflictSet()`` factory seam) and bench.py can race them head-to-head.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
+from foundationdb_tpu.native import load_library
+
+
+class CPUSkipListConflictSet:
+    def __init__(self) -> None:
+        self._lib = load_library("skiplist")
+        self._lib.cs_create.restype = ctypes.c_void_p
+        self._lib.cs_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.cs_node_count.argtypes = [ctypes.c_void_p]
+        self._lib.cs_node_count.restype = ctypes.c_int64
+        self._lib.cs_resolve.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,  # blob
+            ctypes.POINTER(ctypes.c_int64),  # ranges
+            ctypes.POINTER(ctypes.c_int32),  # read counts
+            ctypes.POINTER(ctypes.c_int32),  # write counts
+            ctypes.POINTER(ctypes.c_int64),  # read versions
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int8),  # verdicts out
+        ]
+        self._ptr = self._lib.cs_create()
+        self.oldest_version = 0
+        self._last_commit = 0
+
+    def __del__(self):
+        if getattr(self, "_ptr", None):
+            self._lib.cs_destroy(self._ptr)
+            self._ptr = None
+
+    @property
+    def node_count(self) -> int:
+        return int(self._lib.cs_node_count(self._ptr))
+
+    def resolve(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> list[Verdict]:
+        if commit_version <= self._last_commit:
+            raise ValueError("commit versions must advance")
+        self._last_commit = commit_version
+        if oldest_version is not None:
+            self.oldest_version = max(self.oldest_version, oldest_version)
+
+        blob, ranges, rc, wc, rv = self._marshal(txns)
+        n = len(txns)
+        verdicts = np.zeros(n, np.int8)
+        self._lib.cs_resolve(
+            self._ptr,
+            blob,
+            ranges.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            rc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            wc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rv.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            np.int32(n),
+            np.int64(commit_version),
+            np.int64(self.oldest_version),
+            verdicts.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        )
+        return [Verdict(int(x)) for x in verdicts]
+
+    @staticmethod
+    def _marshal(txns: list[TxnConflictInfo]):
+        parts: list[bytes] = []
+        offsets: list[int] = []
+        pos = 0
+
+        def add(key: bytes) -> tuple[int, int]:
+            nonlocal pos
+            parts.append(key)
+            off = pos
+            pos += len(key)
+            return off, len(key)
+
+        rows: list[int] = []
+        rc = np.zeros(len(txns), np.int32)
+        wc = np.zeros(len(txns), np.int32)
+        rv = np.zeros(len(txns), np.int64)
+        for i, t in enumerate(txns):
+            rv[i] = t.read_version
+            rc[i] = len(t.read_ranges)
+            wc[i] = len(t.write_ranges)
+            for r in list(t.read_ranges) + list(t.write_ranges):
+                bo, bl = add(r.begin)
+                eo, el = add(r.end)
+                rows += [bo, bl, eo, el]
+        ranges = np.asarray(rows, np.int64).reshape(-1, 4)
+        return b"".join(parts), np.ascontiguousarray(ranges), rc, wc, rv

@@ -1,0 +1,38 @@
+"""Native (C++) components, loaded via ctypes with build-on-first-use.
+
+The reference keeps its hot CPU paths in hand-tuned C++ (fdbserver/SkipList.cpp,
+flow's Arena); here the C++ side is the CPU-baseline conflict engine and the
+batch key packer. Libraries are compiled once into native/_build/ with g++
+(no pip deps), then dlopened.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_LIBS: dict[str, ctypes.CDLL] = {}
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if stale) and load native/<name>.cpp as lib<name>.so."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        out = os.path.join(_BUILD, f"lib{name}.so")
+        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            os.makedirs(_BUILD, exist_ok=True)
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                "-march=native", src, "-o", out,
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        lib = ctypes.CDLL(out)
+        _LIBS[name] = lib
+        return lib

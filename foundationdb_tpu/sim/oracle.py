@@ -1,0 +1,56 @@
+"""Brute-force MVCC conflict oracle.
+
+The O(n²) reference model the simulation workloads compare the real engine
+against — the same role ConflictRange.actor.cpp's in-memory model plays for
+the reference's simulation tests. Semantics mirror
+fdbserver/ConflictSet.h exactly:
+
+- a txn with reads and read_version < oldestVersion is TOO_OLD
+  (write-only txns are never too old);
+- a txn conflicts if any non-empty read range overlaps a historical write
+  with version > read_version, or overlaps a write range of an EARLIER
+  ACCEPTED txn in the same batch;
+- accepted txns' write ranges enter the history at the batch commit version.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+
+
+class OracleConflictSet:
+    def __init__(self) -> None:
+        self.history: list[tuple[KeyRange, int]] = []
+        self.oldest_version = 0
+
+    def resolve(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> list[Verdict]:
+        if oldest_version is not None:
+            self.oldest_version = max(self.oldest_version, oldest_version)
+        verdicts: list[Verdict] = []
+        accepted_writes: list[KeyRange] = []
+        for t in txns:
+            reads = [r for r in t.read_ranges if not r.empty]
+            if reads and t.read_version < self.oldest_version:
+                verdicts.append(Verdict.TOO_OLD)
+                continue
+            conflict = any(
+                r.overlaps(w) and v > t.read_version
+                for (w, v) in self.history
+                for r in reads
+            ) or any(r.overlaps(w) for w in accepted_writes for r in reads)
+            if conflict:
+                verdicts.append(Verdict.CONFLICT)
+                continue
+            verdicts.append(Verdict.COMMITTED)
+            accepted_writes.extend(w for w in t.write_ranges if not w.empty)
+        self.history.extend((w, commit_version) for w in accepted_writes)
+        # GC below the window floor (matches the kernel's clamp-to-sentinel).
+        self.history = [
+            (w, v) for (w, v) in self.history if v > self.oldest_version
+        ]
+        return verdicts

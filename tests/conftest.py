@@ -7,12 +7,29 @@ multi-chip sharding without real chips).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session env pins JAX_PLATFORMS=axon (the real TPU):
+# tests validate semantics + multi-device sharding on a virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon (remote TPU tunnel) PJRT plugin registers itself at interpreter
+# start via sitecustomize and can wedge even CPU-backend init when the
+# tunnel is unhealthy. Tests are CPU-only by design — drop the factory and
+# force the platform config directly (a pytest plugin may have imported jax
+# before this file ran, freezing the env-var snapshot).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # private JAX internal — degrade gracefully if it moves
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):
+    pass
 
 import numpy as np
 import pytest

@@ -1,0 +1,68 @@
+"""C++ skiplist baseline vs brute-force oracle (no JAX involved)."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
+from foundationdb_tpu.sim.oracle import OracleConflictSet
+from tests.test_conflict_oracle import rand_txn
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    cs = CPUSkipListConflictSet()
+    oracle = OracleConflictSet()
+    cv = 1000
+    for batch_i in range(15):
+        cv += int(rng.integers(1, 50))
+        txns = [
+            rand_txn(rng, read_version=int(rng.integers(max(0, cv - 300), cv)))
+            for _ in range(int(rng.integers(1, 50)))
+        ]
+        oldest = cv - 200
+        got = cs.resolve(txns, cv, oldest_version=oldest)
+        oracle.oldest_version = max(oracle.oldest_version, oldest)
+        want = oracle.resolve(txns, cv)
+        assert got == want, f"batch {batch_i}"
+
+
+def test_basic_and_sweep():
+    cs = CPUSkipListConflictSet()
+    pt = lambda k: KeyRange(k, k + b"\x00")
+    t = TxnConflictInfo
+    assert cs.resolve([t(5, [], [pt(b"a")])], 10) == [Verdict.COMMITTED]
+    got = cs.resolve([t(5, [pt(b"a")], []), t(15, [pt(b"a")], [])], 20)
+    assert got == [Verdict.CONFLICT, Verdict.COMMITTED]
+
+    # Many disjoint writes then a sliding window: sweep must bound nodes.
+    cv = 100
+    for i in range(200):
+        cv += 10
+        cs.resolve(
+            [t(cv - 1, [], [pt(b"k%05d" % (i * 4 + j))]) for j in range(4)],
+            cv,
+            oldest_version=cv - 100,
+        )
+    assert cs.node_count < 400, cs.node_count
+
+
+def test_range_paint_and_restore():
+    cs = CPUSkipListConflictSet()
+    t = TxnConflictInfo
+    # Paint a wide range at v10, then a narrow interior range at v20.
+    cs.resolve([t(5, [], [KeyRange(b"b", b"y")])], 10)
+    cs.resolve([t(15, [], [KeyRange(b"g", b"h")])], 20)
+    # Reads at rv=15: interior [g,h) conflicts (v20), rest of [b,y) is v10 ≤ 15.
+    got = cs.resolve(
+        [
+            t(15, [KeyRange(b"g", b"g\x00")], []),
+            t(15, [KeyRange(b"c", b"d")], []),
+            t(15, [KeyRange(b"h", b"i")], []),  # after interior range → v10
+            t(5, [KeyRange(b"c", b"d")], []),  # v10 > 5 → conflict
+        ],
+        30,
+    )
+    assert got == [Verdict.CONFLICT, Verdict.COMMITTED, Verdict.COMMITTED,
+                   Verdict.CONFLICT]
