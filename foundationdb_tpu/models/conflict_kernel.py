@@ -35,7 +35,13 @@ import jax
 import jax.numpy as jnp
 
 from foundationdb_tpu.core.keypack import INT32_MAX
-from foundationdb_tpu.ops.lex import lex_lt, searchsorted_words, sort_keys_with_payload
+from foundationdb_tpu.ops.lex import (
+    lex_lt,
+    lex_max,
+    lex_min,
+    searchsorted_words,
+    sort_keys_with_payload,
+)
 from foundationdb_tpu.ops.rmq import range_max, sparse_table
 
 NEG_VERSION = -(2**31) + 1
@@ -138,20 +144,28 @@ def _endpoint_ranks(batch: BatchTensors) -> tuple[jax.Array, ...]:
     return rb, re_, wb, we
 
 
-def _pairwise_overlap(batch: BatchTensors, block: int = 512) -> jax.Array:
-    """M[i, j] (bool [B, B]): some read range of txn i overlaps some write
-    range of txn j. Computed blockwise over i to bound memory."""
-    b, r, _ = batch.read_begin.shape
-    rb, re_, wb, we = _endpoint_ranks(batch)
-    read_live = batch.read_mask & (rb < re_)  # [B, R]
-    write_live = batch.write_mask & (wb < we)  # [B, Q]
+def _overlap_rows(
+    rows_rb: jax.Array,
+    rows_re: jax.Array,
+    rows_live: jax.Array,
+    wb: jax.Array,
+    we: jax.Array,
+    write_live: jax.Array,
+    block: int = 512,
+) -> jax.Array:
+    """M rows [N, B] for a slice of reader txns vs ALL writer txns.
 
-    block = min(block, b)
-    n_blocks = -(-b // block)
-    pad = n_blocks * block - b
-    rb_p = jnp.pad(rb, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
-    re_p = jnp.pad(re_, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
-    live_p = jnp.pad(read_live, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
+    rows_*: [N, R] rank-space read intervals; wb/we/write_live: [B, Q].
+    Blockwise over the row slice to bound the [block, R, B, Q] intermediate.
+    """
+    n, r = rows_rb.shape
+    b = wb.shape[0]
+    block = min(block, n)
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    rb_p = jnp.pad(rows_rb, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
+    re_p = jnp.pad(rows_re, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
+    live_p = jnp.pad(rows_live, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
 
     def one_block(args):
         brb, bre, blive = args  # [block, R]
@@ -163,7 +177,16 @@ def _pairwise_overlap(batch: BatchTensors, block: int = 512) -> jax.Array:
         return jnp.any(o, axis=(1, 3))  # [block, B]
 
     m = jax.lax.map(one_block, (rb_p, re_p, live_p))
-    return m.reshape(n_blocks * block, b)[:b]
+    return m.reshape(n_blocks * block, b)[:n]
+
+
+def _pairwise_overlap(batch: BatchTensors, block: int = 512) -> jax.Array:
+    """M[i, j] (bool [B, B]): some read range of txn i overlaps some write
+    range of txn j."""
+    rb, re_, wb, we = _endpoint_ranks(batch)
+    read_live = batch.read_mask & (rb < re_)  # [B, R]
+    write_live = batch.write_mask & (wb < we)  # [B, Q]
+    return _overlap_rows(rb, re_, read_live, wb, we, write_live, block)
 
 
 def _wave_accept(base: jax.Array, m: jax.Array) -> jax.Array:
@@ -285,9 +308,58 @@ def _paint_and_compact(
     )
 
 
+def clip_batch(batch: BatchTensors, lo: jax.Array, hi: jax.Array) -> BatchTensors:
+    """Restrict every range to the keyspace shard [lo, hi).
+
+    The device-side analogue of the reference CommitProxy's per-resolver
+    conflict-range split (CommitProxyServer.actor.cpp: ranges are routed to
+    resolvers by keyRange shard). Ranges outside the shard become empty and
+    drop out of their masks; read_version/txn_mask are untouched (TOO_OLD is
+    judged on the unclipped batch so all shards agree).
+    """
+    rb = lex_max(batch.read_begin, lo)
+    re_ = lex_min(batch.read_end, hi)
+    wb = lex_max(batch.write_begin, lo)
+    we = lex_min(batch.write_end, hi)
+    return batch._replace(
+        read_begin=rb,
+        read_end=re_,
+        read_mask=batch.read_mask & lex_lt(rb, re_),
+        write_begin=wb,
+        write_end=we,
+        write_mask=batch.write_mask & lex_lt(wb, we),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Entry: full resolve step
 # ---------------------------------------------------------------------------
+
+
+def too_old_mask(
+    state: ConflictState, batch: BatchTensors, new_oldest: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(floor, too_old[B]). The window floor advances BEFORE resolution
+    (reference: Resolver sets ConflictSet::oldestVersion from the request,
+    then detects conflicts) and never regresses — a caller passing a
+    regressed new_oldest must not reopen a window whose writes were GC'd.
+    Write-only transactions are never too old."""
+    has_reads = jnp.any(
+        batch.read_mask & lex_lt(batch.read_begin, batch.read_end), axis=1
+    )
+    floor = jnp.maximum(state.oldest, new_oldest)
+    too_old = batch.txn_mask & has_reads & (batch.read_version < floor)
+    return floor, too_old
+
+
+def assemble_verdicts(
+    too_old: jax.Array, txn_mask: jax.Array, accepted: jax.Array
+) -> jax.Array:
+    return jnp.where(
+        too_old,
+        jnp.int8(V_TOO_OLD),
+        jnp.where(txn_mask & ~accepted, jnp.int8(V_CONFLICT), jnp.int8(V_COMMITTED)),
+    )
 
 
 def resolve_batch(
@@ -302,28 +374,12 @@ def resolve_batch(
     sequence ConflictBatch::detectConflicts → combineWriteConflictRanges →
     SkipList::addConflictRanges, as one compiled program.
     """
-    has_reads = jnp.any(
-        batch.read_mask & lex_lt(batch.read_begin, batch.read_end), axis=1
-    )
-    # The window floor advances BEFORE resolution (reference: Resolver sets
-    # ConflictSet::oldestVersion from the request, then detects conflicts).
-    floor = jnp.maximum(state.oldest, new_oldest)
-    too_old = batch.txn_mask & has_reads & (batch.read_version < floor)
-
+    floor, too_old = too_old_mask(state, batch, new_oldest)
     hist_conflict = _history_conflicts(state, batch)
     m = _pairwise_overlap(batch)
     base = batch.txn_mask & ~too_old & ~hist_conflict
     accepted = _wave_accept(base, m)
-
-    verdicts = jnp.where(
-        too_old,
-        jnp.int8(V_TOO_OLD),
-        jnp.where(
-            batch.txn_mask & ~accepted, jnp.int8(V_CONFLICT), jnp.int8(V_COMMITTED)
-        ),
-    )
-    # Store the clamped floor: a caller passing a regressed new_oldest must
-    # not reopen a window whose writes were already GC'd.
+    verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     new_state = _paint_and_compact(state, batch, accepted, commit_version, floor)
     return verdicts, new_state
 

@@ -42,10 +42,17 @@ class TPUConflictSet:
         self.max_read_ranges = max_read_ranges
         self.max_write_ranges = max_write_ranges
         self.window_versions = window_versions
-        self.state = ck.init_state(capacity, self.codec.width, self.codec.min_key)
         self.base_version: int | None = None
         self.oldest_version: int = 0  # absolute; advances monotonically
         self._last_commit: int = 0
+        self._init_engine()
+
+    def _init_engine(self) -> None:
+        """Build device state + entry points. Subclasses (the mesh-sharded
+        engine) override this; all host-side logic is shared."""
+        self.state = ck.init_state(self.capacity, self.codec.width, self.codec.min_key)
+        self._resolve_fn = ck._resolve_jit
+        self._rebase_fn = ck._rebase_jit
 
     # -- public API ---------------------------------------------------------
 
@@ -76,7 +83,7 @@ class TPUConflictSet:
 
     @property
     def overflowed(self) -> bool:
-        return bool(np.asarray(self.state.overflow))
+        return bool(np.asarray(self.state.overflow).any())
 
     # -- internals ----------------------------------------------------------
 
@@ -104,7 +111,7 @@ class TPUConflictSet:
         # Device versions < delta are all expired; the kernel clamps them to
         # the sentinel, so saturating the device delta at int32 max is exact
         # even for astronomically large jumps.
-        self.state = ck._rebase_jit(self.state, np.int32(min(delta, 2**31 - 1)))
+        self.state = self._rebase_fn(self.state, np.int32(min(delta, 2**31 - 1)))
         self.base_version += delta
 
     def _resolve_chunk(
@@ -113,7 +120,7 @@ class TPUConflictSet:
         batch = self._pack(txns)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
-        verdicts, self.state = ck._resolve_jit(self.state, batch, cv, oldest)
+        verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
         v = np.asarray(verdicts)[: len(txns)]
         return [Verdict(int(x)) for x in v]
 

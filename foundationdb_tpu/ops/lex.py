@@ -31,6 +31,17 @@ def lex_le(a: jax.Array, b: jax.Array) -> jax.Array:
     return ~lex_lt(b, a)
 
 
+def lex_max(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Elementwise lexicographic max of [..., W] key vectors (broadcasting)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    return jnp.where(lex_lt(a, b)[..., None], b, a)
+
+
+def lex_min(a: jax.Array, b: jax.Array) -> jax.Array:
+    a, b = jnp.broadcast_arrays(a, b)
+    return jnp.where(lex_lt(a, b)[..., None], a, b)
+
+
 def searchsorted_words(
     sorted_keys: jax.Array, queries: jax.Array, side: str = "left"
 ) -> jax.Array:

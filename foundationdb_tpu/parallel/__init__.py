@@ -1,0 +1,4 @@
+from foundationdb_tpu.parallel.sharded_resolver import (  # noqa: F401
+    ShardedConflictSet,
+    uniform_splits,
+)

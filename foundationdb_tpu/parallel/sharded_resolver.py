@@ -1,0 +1,174 @@
+"""Multi-resolver conflict detection over a TPU device mesh.
+
+The reference scales resolution by sharding the keyspace across Resolver
+processes (CommitProxyServer.actor.cpp splits each txn's conflict ranges by
+resolver key shard; a txn commits only if EVERY resolver reports no
+conflict). Here the same design is one SPMD program over
+``Mesh(('resolvers',))``:
+
+- each device owns a keyspace shard ``[split_d, split_{d+1})`` and holds its
+  own step-function history (state arrays carry a leading device axis,
+  sharded over the mesh);
+- the batch is replicated; each device clips ranges to its shard
+  (clip_batch), checks reads against its local history, and contributes
+  conflict bits via ``psum`` — the tensor analogue of the proxy ANDing
+  per-resolver verdicts;
+- the intra-batch overlap matrix is row-sharded across devices and
+  ``all_gather``ed (it depends only on the batch, so work — not state — is
+  what's being split);
+- the wave acceptance runs replicated (tiny matvecs; a per-round collective
+  would cost more than it saves) and every device paints its own shard's
+  accepted writes.
+
+All host-side logic (packing, chunking, rebase bookkeeping) is inherited
+from TPUConflictSet; only the device entry points differ (_init_engine).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from foundationdb_tpu.core.keypack import KeyCodec
+from foundationdb_tpu.core.types import TxnConflictInfo
+from foundationdb_tpu.models import conflict_kernel as ck
+from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+AXIS = "resolvers"
+
+
+def uniform_splits(codec: KeyCodec, n_shards: int) -> np.ndarray:
+    """[n_shards+1, W] shard bounds: uniform first-byte split of the keyspace.
+
+    bounds[0] = b"" (keyspace min), bounds[-1] = +inf sentinel. Production
+    deployments would derive splits from observed key density (the
+    reference's DataDistribution keeps resolver shards balanced); uniform
+    prefixes are the bootstrap default.
+    """
+    bounds = [b""]
+    for d in range(1, n_shards):
+        bounds.append(bytes([(d * 256) // n_shards]))
+    packed = codec.pack(bounds, "begin")
+    return np.concatenate([packed, codec.inf_key[None, :]], axis=0)
+
+
+def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi, n_shards):
+    """Per-device body (runs under shard_map; state/lo/hi are the local shard,
+    batch is replicated)."""
+    state = jax.tree.map(lambda x: x[0], state)  # drop leading device axis
+    lo = lo[0]
+    hi = hi[0]
+
+    b = batch.txn_mask.shape[0]
+    floor, too_old = ck.too_old_mask(state, batch, new_oldest)
+
+    local = ck.clip_batch(batch, lo, hi)
+    hist_local = ck._history_conflicts(state, local)
+    hist_conflict = jax.lax.psum(hist_local.astype(jnp.int32), AXIS) > 0
+
+    # Row-sharded intra-batch overlap: this device computes M rows for its
+    # slice of reader txns against ALL writers (unclipped: M is a pure
+    # function of the batch), then all-gathers the rows.
+    rb, re_, wb, we = ck._endpoint_ranks(batch)
+    read_live = batch.read_mask & (rb < re_)
+    write_live = batch.write_mask & (wb < we)
+    rows_per = b // n_shards
+    i0 = jax.lax.axis_index(AXIS) * rows_per
+    my_rows = ck._overlap_rows(
+        jax.lax.dynamic_slice_in_dim(rb, i0, rows_per),
+        jax.lax.dynamic_slice_in_dim(re_, i0, rows_per),
+        jax.lax.dynamic_slice_in_dim(read_live, i0, rows_per),
+        wb,
+        we,
+        write_live,
+    )
+    m = jax.lax.all_gather(my_rows, AXIS, axis=0, tiled=True)  # [B, B]
+
+    base = batch.txn_mask & ~too_old & ~hist_conflict
+    accepted = ck._wave_accept(base, m)
+    verdicts = ck.assemble_verdicts(too_old, batch.txn_mask, accepted)
+
+    new_state = ck._paint_and_compact(state, local, accepted, commit_version, floor)
+    new_state = jax.tree.map(lambda x: x[None], new_state)
+    return verdicts, new_state
+
+
+class ShardedConflictSet(TPUConflictSet):
+    """TPUConflictSet resolving over an n-shard mesh of devices.
+
+    capacity is per shard. Only the device program differs from the
+    single-chip engine; every host-side behavior is inherited.
+    """
+
+    def __init__(self, mesh: Mesh | None = None, n_shards: int | None = None, **kw):
+        if mesh is None:
+            devs = jax.devices()
+            n_shards = n_shards or len(devs)
+            if n_shards > len(devs):
+                raise ValueError(
+                    f"n_shards={n_shards} > {len(devs)} available devices"
+                )
+            mesh = Mesh(np.asarray(devs[:n_shards]), (AXIS,))
+        self.mesh = mesh
+        self.n_shards = n_shards or mesh.devices.size
+        if self.n_shards != mesh.devices.size:
+            raise ValueError(
+                f"n_shards={self.n_shards} != mesh size {mesh.devices.size}"
+            )
+        super().__init__(**kw)
+
+    def _init_engine(self) -> None:
+        if self.batch_size % self.n_shards:
+            raise ValueError("batch_size must be divisible by n_shards")
+        codec = self.codec
+        bounds = uniform_splits(codec, self.n_shards)
+        self._lo = np.ascontiguousarray(bounds[:-1])  # [D, W]
+        self._hi = np.ascontiguousarray(bounds[1:])  # [D, W]
+
+        # Per-shard states stacked on a leading device axis.
+        states = [
+            ck.init_state(self.capacity, codec.width, self._lo[d])
+            for d in range(self.n_shards)
+        ]
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *states)
+
+        shard = NamedSharding(self.mesh, P(AXIS))
+        self.state = jax.tree.map(
+            lambda x: jax.device_put(x, shard), ck.ConflictState(*stacked)
+        )
+        lo_dev = jax.device_put(self._lo, shard)
+        hi_dev = jax.device_put(self._hi, shard)
+
+        state_specs = ck.ConflictState(*(P(AXIS) for _ in ck.ConflictState._fields))
+        batch_specs = ck.BatchTensors(*(P() for _ in ck.BatchTensors._fields))
+        body = jax.shard_map(
+            lambda s, bt, cv, old, lo, hi: _sharded_resolve(
+                s, bt, cv, old, lo, hi, self.n_shards
+            ),
+            mesh=self.mesh,
+            in_specs=(state_specs, batch_specs, P(), P(), P(AXIS), P(AXIS)),
+            out_specs=(P(), state_specs),
+            check_vma=False,
+        )
+        jitted = jax.jit(body, donate_argnums=(0,))
+        self._resolve_fn = lambda s, bt, cv, old: jitted(
+            s, bt, cv, old, lo_dev, hi_dev
+        )
+        self._rebase_fn = jax.jit(
+            jax.shard_map(
+                lambda s, d: jax.tree.map(
+                    lambda x: x[None],
+                    ck.rebase(jax.tree.map(lambda x: x[0], s), d),
+                ),
+                mesh=self.mesh,
+                in_specs=(state_specs, P()),
+                out_specs=state_specs,
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+
+__all__ = ["ShardedConflictSet", "uniform_splits", "TxnConflictInfo"]

@@ -16,7 +16,9 @@ from foundationdb_tpu.sim.oracle import OracleConflictSet
 
 def rand_key(rng, alphabet=4, max_len=6):
     n = int(rng.integers(0, max_len + 1))
-    return bytes(rng.integers(97, 97 + alphabet, size=n, dtype=np.uint8))
+    lo = 0 if alphabet > 128 else 97  # wide alphabets span the full byte space
+    vals = rng.integers(lo, lo + alphabet, size=n) % 256
+    return bytes(vals.astype(np.uint8))
 
 
 def rand_range(rng, **kw):
