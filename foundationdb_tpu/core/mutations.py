@@ -1,0 +1,193 @@
+"""Mutation types and atomic-op application.
+
+Mirrors the reference's MutationRef type enum (fdbclient/CommitTransaction.h)
+and the atomic-op application semantics (fdbclient/Atomic.h): little-endian
+arithmetic ops sized to the operand, lexicographic byte min/max, append with
+a size limit, compare-and-clear, and versionstamped key/value substitution.
+The "V2" semantics are used throughout (missing value behaves as documented
+for the modern API: AND/MIN/MAX/BYTE_* store the operand when the key is
+absent).
+
+These run host-side on the storage/commit path; they are byte-string
+transforms, not device math.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.types import MAX_VALUE_SIZE
+
+
+class MutationType(enum.IntEnum):
+    """Numeric values match the reference MutationRef::Type enum where the
+    operation exists there (fdbclient/CommitTransaction.h)."""
+
+    SET_VALUE = 0
+    CLEAR_RANGE = 1
+    ADD = 2
+    # 3-5 are DebugKeyRange/DebugKey/NoOp in the reference; unused here.
+    AND = 6
+    OR = 7
+    XOR = 8
+    APPEND_IF_FITS = 9
+    MAX = 12
+    MIN = 13
+    SET_VERSIONSTAMPED_KEY = 14
+    SET_VERSIONSTAMPED_VALUE = 15
+    BYTE_MIN = 16
+    BYTE_MAX = 17
+    MIN_V2 = 18
+    AND_V2 = 19
+    COMPARE_AND_CLEAR = 20
+
+
+# Ops whose param is combined with the existing value via apply_atomic().
+# SET_VERSIONSTAMPED_* are NOT here: they are rewritten to SET_VALUE by the
+# commit proxy (resolve_versionstamps) before reaching storage.
+ATOMIC_OPS = frozenset(
+    {
+        MutationType.ADD,
+        MutationType.AND,
+        MutationType.OR,
+        MutationType.XOR,
+        MutationType.APPEND_IF_FITS,
+        MutationType.MAX,
+        MutationType.MIN,
+        MutationType.BYTE_MIN,
+        MutationType.BYTE_MAX,
+        MutationType.MIN_V2,
+        MutationType.AND_V2,
+        MutationType.COMPARE_AND_CLEAR,
+    }
+)
+
+
+@dataclass(frozen=True)
+class Mutation:
+    """(type, param1, param2): for CLEAR_RANGE param1/param2 are [begin, end);
+    otherwise param1 is the key and param2 the value/operand."""
+
+    type: MutationType
+    param1: bytes
+    param2: bytes = b""
+
+    @property
+    def key(self) -> bytes:
+        return self.param1
+
+
+def _le_int(v: bytes) -> int:
+    return int.from_bytes(v, "little")
+
+
+def _le_bytes(x: int, n: int) -> bytes:
+    return (x & ((1 << (8 * n)) - 1)).to_bytes(n, "little") if n else b""
+
+
+def _fit(existing: bytes, n: int) -> bytes:
+    """Zero-extend or truncate the existing value to n bytes (the reference
+    sizes arithmetic results to the operand)."""
+    return existing[:n] + b"\x00" * (n - len(existing))
+
+
+def apply_atomic(
+    op: MutationType, existing: bytes | None, param: bytes
+) -> bytes | None:
+    """Combine an existing value (None = key absent) with the operand.
+
+    Returns the new value, or None to clear the key (COMPARE_AND_CLEAR).
+    Semantics per fdbclient/Atomic.h (V2 variants).
+    """
+    if op == MutationType.ADD:
+        n = len(param)
+        base = _le_int(_fit(existing or b"", n))
+        return _le_bytes(base + _le_int(param), n)
+    if op in (MutationType.AND, MutationType.AND_V2):
+        if existing is None:
+            return param
+        n = len(param)
+        return _le_bytes(_le_int(_fit(existing, n)) & _le_int(param), n)
+    if op == MutationType.OR:
+        n = len(param)
+        return _le_bytes(_le_int(_fit(existing or b"", n)) | _le_int(param), n)
+    if op == MutationType.XOR:
+        n = len(param)
+        return _le_bytes(_le_int(_fit(existing or b"", n)) ^ _le_int(param), n)
+    if op == MutationType.APPEND_IF_FITS:
+        cur = existing or b""
+        return cur + param if len(cur) + len(param) <= MAX_VALUE_SIZE else cur
+    if op == MutationType.MAX:
+        if existing is None:
+            return param
+        n = len(param)
+        cur = _fit(existing, n)
+        return cur if _le_int(cur) > _le_int(param) else param
+    if op in (MutationType.MIN, MutationType.MIN_V2):
+        if existing is None:
+            return param
+        n = len(param)
+        cur = _fit(existing, n)
+        return cur if _le_int(cur) < _le_int(param) else param
+    if op == MutationType.BYTE_MIN:
+        if existing is None:
+            return param
+        return min(existing, param)
+    if op == MutationType.BYTE_MAX:
+        if existing is None:
+            return param
+        return max(existing, param)
+    if op == MutationType.COMPARE_AND_CLEAR:
+        return None if existing == param else existing
+    raise ValueError(f"not an atomic value op: {op!r}")
+
+
+# -- versionstamps -----------------------------------------------------------
+
+VERSIONSTAMP_SIZE = 10  # 8-byte commit version (BE) + 2-byte batch order (BE)
+INCOMPLETE_VERSIONSTAMP = b"\xff" * VERSIONSTAMP_SIZE
+
+
+def make_versionstamp(commit_version: int, batch_order: int = 0) -> bytes:
+    return struct.pack(">QH", commit_version, batch_order)
+
+
+def resolve_versionstamp(param: bytes, stamp: bytes) -> bytes:
+    """Substitute the 10-byte versionstamp into `param`.
+
+    The last 4 bytes of `param` are a little-endian offset at which the stamp
+    is written; they are stripped from the result (the modern API encoding —
+    reference: transformVersionstampMutation / MutationRef versionstamp ops).
+    """
+    if len(param) < 4:
+        raise ValueError("versionstamped operand shorter than its offset suffix")
+    (off,) = struct.unpack("<I", param[-4:])
+    body = param[:-4]
+    if off + VERSIONSTAMP_SIZE > len(body):
+        raise ValueError(
+            f"versionstamp offset {off} out of bounds for {len(body)}-byte operand"
+        )
+    return body[:off] + stamp + body[off + VERSIONSTAMP_SIZE : ]
+
+
+def resolve_versionstamps(
+    mutations: list[Mutation], commit_version: int, batch_order: int = 0
+) -> list[Mutation]:
+    """Rewrite SET_VERSIONSTAMPED_KEY/VALUE into plain SET_VALUE at commit
+    time (done by the commit proxy once the batch version is known)."""
+    stamp = make_versionstamp(commit_version, batch_order)
+    out: list[Mutation] = []
+    for m in mutations:
+        if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+            out.append(
+                Mutation(MutationType.SET_VALUE, resolve_versionstamp(m.param1, stamp), m.param2)
+            )
+        elif m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+            out.append(
+                Mutation(MutationType.SET_VALUE, m.param1, resolve_versionstamp(m.param2, stamp))
+            )
+        else:
+            out.append(m)
+    return out

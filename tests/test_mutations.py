@@ -1,0 +1,134 @@
+"""Atomic-op semantics tests (reference model: fdbclient/Atomic.h)."""
+
+import struct
+
+import pytest
+
+from foundationdb_tpu.core.mutations import (
+    INCOMPLETE_VERSIONSTAMP,
+    Mutation,
+    MutationType as M,
+    apply_atomic,
+    make_versionstamp,
+    resolve_versionstamp,
+    resolve_versionstamps,
+)
+from foundationdb_tpu.core.types import MAX_VALUE_SIZE
+
+
+def le(x, n):
+    return x.to_bytes(n, "little")
+
+
+class TestArithmetic:
+    def test_add_basic(self):
+        assert apply_atomic(M.ADD, le(5, 8), le(3, 8)) == le(8, 8)
+
+    def test_add_missing_is_zero(self):
+        assert apply_atomic(M.ADD, None, le(7, 4)) == le(7, 4)
+
+    def test_add_wraps_at_operand_width(self):
+        assert apply_atomic(M.ADD, le(255, 1), le(1, 1)) == le(0, 1)
+
+    def test_add_result_sized_to_operand(self):
+        # Existing 8 bytes, operand 2 bytes → result 2 bytes (truncating).
+        assert apply_atomic(M.ADD, le(0x010203, 8), le(1, 2)) == le(0x0204, 2)
+
+    def test_add_negative_delta_twos_complement(self):
+        minus_one = (2**64 - 1).to_bytes(8, "little")
+        assert apply_atomic(M.ADD, le(10, 8), minus_one) == le(9, 8)
+
+    @pytest.mark.parametrize(
+        "op,a,b,expect",
+        [
+            (M.AND, 0b1100, 0b1010, 0b1000),
+            (M.OR, 0b1100, 0b1010, 0b1110),
+            (M.XOR, 0b1100, 0b1010, 0b0110),
+        ],
+    )
+    def test_bitwise(self, op, a, b, expect):
+        assert apply_atomic(op, le(a, 4), le(b, 4)) == le(expect, 4)
+
+    def test_and_missing_stores_param(self):
+        # V2 semantics: AND on an absent key stores the operand.
+        assert apply_atomic(M.AND, None, le(0xFF, 2)) == le(0xFF, 2)
+        assert apply_atomic(M.AND_V2, None, le(0xFF, 2)) == le(0xFF, 2)
+
+    def test_or_xor_missing_is_zero(self):
+        assert apply_atomic(M.OR, None, le(0b101, 1)) == le(0b101, 1)
+        assert apply_atomic(M.XOR, None, le(0b101, 1)) == le(0b101, 1)
+
+
+class TestMinMax:
+    def test_max(self):
+        assert apply_atomic(M.MAX, le(5, 4), le(9, 4)) == le(9, 4)
+        assert apply_atomic(M.MAX, le(9, 4), le(5, 4)) == le(9, 4)
+
+    def test_min(self):
+        assert apply_atomic(M.MIN, le(5, 4), le(9, 4)) == le(5, 4)
+        assert apply_atomic(M.MIN_V2, le(9, 4), le(5, 4)) == le(5, 4)
+
+    def test_missing_stores_param(self):
+        assert apply_atomic(M.MAX, None, le(3, 4)) == le(3, 4)
+        assert apply_atomic(M.MIN, None, le(3, 4)) == le(3, 4)
+
+    def test_unsigned_little_endian_compare(self):
+        # 0x0100 (LE: 00 01) > 0xff (LE: ff 00) as unsigned ints, though
+        # lexicographically the byte strings order the other way.
+        assert apply_atomic(M.MAX, le(0x0100, 2), le(0xFF, 2)) == le(0x0100, 2)
+
+    def test_byte_min_max_lexicographic(self):
+        assert apply_atomic(M.BYTE_MIN, b"abc", b"abd") == b"abc"
+        assert apply_atomic(M.BYTE_MAX, b"abc", b"abcd") == b"abcd"
+        assert apply_atomic(M.BYTE_MIN, None, b"zz") == b"zz"
+        assert apply_atomic(M.BYTE_MAX, None, b"zz") == b"zz"
+
+
+class TestAppendCompareClear:
+    def test_append(self):
+        assert apply_atomic(M.APPEND_IF_FITS, b"foo", b"bar") == b"foobar"
+        assert apply_atomic(M.APPEND_IF_FITS, None, b"bar") == b"bar"
+
+    def test_append_overflow_keeps_existing(self):
+        big = b"x" * MAX_VALUE_SIZE
+        assert apply_atomic(M.APPEND_IF_FITS, big, b"y") == big
+
+    def test_compare_and_clear(self):
+        assert apply_atomic(M.COMPARE_AND_CLEAR, b"v", b"v") is None
+        assert apply_atomic(M.COMPARE_AND_CLEAR, b"v", b"w") == b"v"
+        assert apply_atomic(M.COMPARE_AND_CLEAR, None, b"w") is None
+
+
+class TestVersionstamps:
+    def test_stamp_layout(self):
+        s = make_versionstamp(0x0102030405060708, 9)
+        assert s == struct.pack(">QH", 0x0102030405060708, 9)
+        assert len(s) == 10
+
+    def test_resolve_at_offset(self):
+        stamp = make_versionstamp(7, 1)
+        param = b"pfx" + INCOMPLETE_VERSIONSTAMP + b"sfx" + struct.pack("<I", 3)
+        assert resolve_versionstamp(param, stamp) == b"pfx" + stamp + b"sfx"
+
+    def test_offset_out_of_bounds(self):
+        with pytest.raises(ValueError):
+            resolve_versionstamp(b"short" + struct.pack("<I", 2), b"\x00" * 10)
+
+    def test_rewrite_mutations(self):
+        stamp = make_versionstamp(42, 0)
+        key = INCOMPLETE_VERSIONSTAMP + struct.pack("<I", 0)
+        ms = resolve_versionstamps(
+            [
+                Mutation(M.SET_VERSIONSTAMPED_KEY, key, b"v"),
+                Mutation(M.SET_VALUE, b"k", b"v2"),
+            ],
+            42,
+        )
+        assert ms[0] == Mutation(M.SET_VALUE, stamp, b"v")
+        assert ms[1] == Mutation(M.SET_VALUE, b"k", b"v2")
+
+    def test_stamps_order_by_version_then_batch(self):
+        a = make_versionstamp(1, 5)
+        b = make_versionstamp(2, 0)
+        c = make_versionstamp(2, 1)
+        assert a < b < c
