@@ -1,0 +1,137 @@
+"""Simulated RPC network with fault injection.
+
+The reference's fdbrpc/FlowTransport + flow/sim2 pair: endpoints route
+requests between named processes, every hop takes seeded-random virtual
+latency, and the harness can kill processes or partition pairs at any point.
+A request whose destination is dead or unreachable fails the caller with
+BrokenPromise after the failure-detection delay — the same observable
+behavior as the reference's broken_promise on connection failure
+(fdbrpc/FlowTransport.actor.cpp), which is what drives client retry loops
+and recovery.
+
+All randomness comes from the loop's seeded RNG: identical seeds replay
+identical histories, including message interleavings and failures.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from foundationdb_tpu.runtime.flow import BrokenPromise, Future, Loop, Promise
+
+
+class Endpoint:
+    """Callable proxy to a role hosted on some process.
+
+    ``await ep.method(args)`` issues an RPC through the simulated network;
+    attribute access returns a stub, so role interfaces read like the
+    reference's RequestStream fields."""
+
+    def __init__(self, net: "SimNetwork", process: str, name: str):
+        self._net = net
+        self.process = process
+        self.name = name
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return lambda *a, **kw: self._net.call(self, method, a, kw)
+
+    def __repr__(self) -> str:
+        return f"<Endpoint {self.name}@{self.process}>"
+
+
+class SimNetwork:
+    FAILURE_DETECTION_DELAY = 1.0  # virtual seconds until a lost RPC breaks
+
+    def __init__(
+        self,
+        loop: Loop,
+        min_latency: float = 0.0002,
+        max_latency: float = 0.002,
+    ):
+        self.loop = loop
+        self.min_latency = min_latency
+        self.max_latency = max_latency
+        self._objects: dict[str, Any] = {}  # endpoint name -> role object
+        self._partitions: set[frozenset] = set()
+
+    # -- topology -------------------------------------------------------------
+
+    def host(self, process: str, name: str, obj: Any) -> Endpoint:
+        """Register a role object as `name` on `process`; returns its endpoint."""
+        self._objects[(process, name)] = obj
+        return Endpoint(self, process, name)
+
+    def kill(self, process: str) -> None:
+        self.loop.kill_process(process)
+
+    def reboot(self, process: str) -> None:
+        """Clears the dead flag; the harness re-hosts/restarts role actors."""
+        self.loop.revive_process(process)
+
+    def partition(self, a: str, b: str) -> None:
+        self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str, b: str) -> None:
+        self._partitions.discard(frozenset((a, b)))
+
+    def heal_all(self) -> None:
+        self._partitions.clear()
+
+    def _unreachable(self, src: str, dst: str) -> bool:
+        return (
+            dst in self.loop.dead_processes
+            or (src != dst and frozenset((src, dst)) in self._partitions)
+        )
+
+    def _latency(self) -> float:
+        return self.loop.rng.uniform(self.min_latency, self.max_latency)
+
+    # -- RPC ------------------------------------------------------------------
+
+    def call(self, ep: Endpoint, method: str, args: tuple, kwargs: dict) -> Future:
+        loop = self.loop
+        src = loop._current.process if loop._current else "<main>"
+        reply = Promise()
+
+        def fail_later(_f=None) -> None:
+            loop.sleep(self.FAILURE_DETECTION_DELAY).add_done_callback(
+                lambda _: reply.fail(
+                    BrokenPromise(f"{ep.name}.{method} unreachable from {src}")
+                )
+            )
+
+        def deliver(_f) -> None:
+            if self._unreachable(src, ep.process):
+                fail_later()
+                return
+            obj = self._objects.get((ep.process, ep.name))
+            if obj is None:
+                fail_later()
+                return
+            try:
+                coro = getattr(obj, method)(*args, **kwargs)
+            except Exception as e:  # bad method/signature fails this RPC only
+                reply.fail(e)
+                return
+            task = loop.spawn(coro, process=ep.process, name=f"{ep.name}.{method}")
+            task.add_done_callback(send_reply)
+
+        def send_reply(task) -> None:
+            err = task.exception()
+
+            def finish(_f) -> None:
+                # The requesting side may itself be dead/partitioned by now;
+                # a reply into a partition is simply lost.
+                if self._unreachable(ep.process, src):
+                    fail_later()
+                elif err is not None:
+                    reply.fail(err)
+                else:
+                    reply.send(task.result())
+
+            loop.sleep(self._latency()).add_done_callback(finish)
+
+        loop.sleep(self._latency()).add_done_callback(deliver)
+        return reply.future

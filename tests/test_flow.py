@@ -1,0 +1,240 @@
+"""Event loop + sim network: determinism, combinators, fault injection."""
+
+import pytest
+
+from foundationdb_tpu.runtime.flow import (
+    BrokenPromise,
+    Future,
+    Loop,
+    Promise,
+    all_of,
+    any_of,
+    ready,
+)
+from foundationdb_tpu.sim.network import SimNetwork
+
+
+class TestLoop:
+    def test_virtual_time_sleep(self):
+        loop = Loop()
+
+        async def main():
+            t0 = loop.now
+            await loop.sleep(5.0)
+            return loop.now - t0
+
+        assert loop.run(main()) == pytest.approx(5.0)
+
+    def test_spawn_and_await(self):
+        loop = Loop()
+
+        async def child(x):
+            await loop.sleep(1.0)
+            return x * 2
+
+        async def main():
+            a = loop.spawn(child(3))
+            b = loop.spawn(child(4))
+            return await a + await b
+
+        assert loop.run(main()) == 14
+
+    def test_error_propagates_to_awaiter(self):
+        loop = Loop()
+
+        async def boom():
+            raise ValueError("x")
+
+        async def main():
+            with pytest.raises(ValueError):
+                await loop.spawn(boom())
+            return "ok"
+
+        assert loop.run(main()) == "ok"
+
+    def test_promise_future(self):
+        loop = Loop()
+        p = Promise()
+
+        async def producer():
+            await loop.sleep(2.0)
+            p.send(42)
+
+        async def main():
+            loop.spawn(producer())
+            return await p.future
+
+        assert loop.run(main()) == 42
+
+    def test_deadlock_detected(self):
+        loop = Loop()
+
+        async def main():
+            await Future()
+
+        with pytest.raises(RuntimeError, match="deadlock"):
+            loop.run(main())
+
+    def test_timeout(self):
+        loop = Loop()
+
+        async def main():
+            await loop.sleep(100.0)
+
+        with pytest.raises(TimeoutError):
+            loop.run(main(), timeout=10.0)
+
+    def test_kill_process_cancels_tasks(self):
+        loop = Loop()
+        log = []
+
+        async def worker():
+            log.append("start")
+            await loop.sleep(10.0)
+            log.append("never")
+
+        async def main():
+            t = loop.spawn(worker(), process="p1")
+            await loop.sleep(1.0)
+            loop.kill_process("p1")
+            with pytest.raises(BrokenPromise):
+                await t
+            return log
+
+        assert loop.run(main()) == ["start"]
+
+    def test_combinators(self):
+        loop = Loop()
+
+        async def slow(x, dt):
+            await loop.sleep(dt)
+            return x
+
+        async def main():
+            allr = await all_of([loop.spawn(slow(1, 3)), loop.spawn(slow(2, 1)), ready(9)])
+            idx, first = await any_of([loop.spawn(slow("a", 5)), loop.spawn(slow("b", 2))])
+            return allr, idx, first
+
+        assert loop.run(main()) == ([1, 2, 9], 1, "b")
+
+    def test_determinism_same_seed_same_trace(self):
+        def trace(seed):
+            loop = Loop(seed=seed)
+            events = []
+
+            async def jittery(name):
+                for i in range(3):
+                    await loop.sleep(loop.rng.uniform(0, 1))
+                    events.append((round(loop.now, 9), name, i))
+
+            async def main():
+                ts = [loop.spawn(jittery(n)) for n in "abc"]
+                await all_of(ts)
+
+            loop.run(main())
+            return events
+
+        assert trace(7) == trace(7)
+        assert trace(7) != trace(8)
+
+
+class Echo:
+    def __init__(self, loop):
+        self.loop = loop
+        self.calls = 0
+
+    async def echo(self, x):
+        self.calls += 1
+        await self.loop.sleep(0.01)
+        return x
+
+    async def fail(self):
+        raise ValueError("server-side error")
+
+
+class TestSimNetwork:
+    def make(self, seed=0):
+        loop = Loop(seed=seed)
+        net = SimNetwork(loop)
+        ep = net.host("server", "echo", Echo(loop))
+        return loop, net, ep
+
+    def test_rpc_roundtrip_takes_latency(self):
+        loop, net, ep = self.make()
+
+        async def main():
+            t0 = loop.now
+            r = await ep.echo(5)
+            return r, loop.now - t0
+
+        r, dt = loop.run(main())
+        assert r == 5
+        assert dt >= 0.01  # two latency hops + server work
+
+    def test_server_error_propagates(self):
+        loop, net, ep = self.make()
+
+        async def main():
+            with pytest.raises(ValueError, match="server-side"):
+                await ep.fail()
+            return "ok"
+
+        assert loop.run(main()) == "ok"
+
+    def test_dead_process_breaks_promise(self):
+        loop, net, ep = self.make()
+
+        async def main():
+            net.kill("server")
+            with pytest.raises(BrokenPromise):
+                await ep.echo(1)
+            return loop.now
+
+        t = loop.run(main())
+        assert t >= SimNetwork.FAILURE_DETECTION_DELAY
+
+    def test_kill_mid_request_breaks_promise(self):
+        loop, net, ep = self.make()
+
+        async def killer():
+            await loop.sleep(0.005)  # while the server actor is sleeping
+            net.kill("server")
+
+        async def main():
+            loop.spawn(killer())
+            with pytest.raises(BrokenPromise):
+                await ep.echo(1)
+            return "ok"
+
+        assert loop.run(main()) == "ok"
+
+    def test_partition_and_heal(self):
+        loop, net, ep = self.make()
+
+        async def main():
+            net.partition("<main>", "server")
+            with pytest.raises(BrokenPromise):
+                await ep.echo(1)
+            net.heal("<main>", "server")
+            return await ep.echo(2)
+
+        assert loop.run(main()) == 2
+
+    def test_rpc_interleaving_deterministic(self):
+        def run(seed):
+            loop, net, ep = self.make(seed)
+            order = []
+
+            async def client(i):
+                await ep.echo(i)
+                order.append((i, round(loop.now, 9)))
+
+            async def main():
+                from foundationdb_tpu.runtime.flow import all_of
+
+                await all_of([loop.spawn(client(i)) for i in range(5)])
+
+            loop.run(main())
+            return order
+
+        assert run(3) == run(3)
