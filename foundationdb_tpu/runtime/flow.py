@@ -190,7 +190,9 @@ class Loop:
         return p.future
 
     # -- spawning
-    def spawn(self, coro: Coroutine, process: str | None = None, name: str = "?") -> Task:
+    def spawn(self, coro: Coroutine | Future, process: str | None = None, name: str = "?") -> Task:
+        if isinstance(coro, Future):  # allow spawning RPC futures directly
+            coro = _await_future(coro)
         if process is None:
             process = self._current.process if self._current else "<main>"
         t = Task(self, coro, process, name)
@@ -236,6 +238,10 @@ class Loop:
 
     def run(self, coro: Coroutine, timeout: float = 1e9) -> Any:
         return self.run_until(self.spawn(coro, process="<main>"), timeout)
+
+
+async def _await_future(f: Future):
+    return await f
 
 
 # -- combinators (reference: flow genericactors.actor.h) ----------------------

@@ -1,0 +1,69 @@
+"""GRV proxy: batched read-version handout with ratekeeper admission.
+
+Reference: fdbserver/GrvProxyServer.actor.cpp — clients' getReadVersion
+requests queue up, a batch loop drains them every interval (one sequencer
+round-trip serves the whole batch), and the reply is the cluster's live
+committed version. Admission: a token bucket refilled from the
+ratekeeper's tps budget; when empty, waiters simply stay queued, which is
+exactly how the reference applies back-pressure.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.flow import Loop, Promise
+
+
+class GrvProxy:
+    BATCH_INTERVAL = 0.001
+    RATE_POLL_INTERVAL = 0.1
+    MAX_TOKENS = 2000.0
+
+    def __init__(self, loop: Loop, sequencer_ep, ratekeeper_ep=None):
+        self.loop = loop
+        self.sequencer = sequencer_ep
+        self.ratekeeper = ratekeeper_ep
+        self._queue: list[Promise] = []
+        self._tokens = self.MAX_TOKENS
+        self._rate = float("inf") if ratekeeper_ep is None else 0.0
+        self.grvs_served = 0
+
+    async def get_read_version(self) -> int:
+        p = Promise()
+        self._queue.append(p)
+        return await p.future
+
+    async def run(self) -> None:
+        self.loop.spawn(self._rate_poller(), name="grv.rate_poller")
+        while True:
+            await self.loop.sleep(self.BATCH_INTERVAL)
+            self._tokens = min(
+                self.MAX_TOKENS, self._tokens + self._rate * self.BATCH_INTERVAL
+            )
+            if not self._queue:
+                continue
+            admit = len(self._queue) if self._tokens == float("inf") else int(
+                min(len(self._queue), self._tokens)
+            )
+            if admit == 0:
+                continue
+            batch, self._queue = self._queue[:admit], self._queue[admit:]
+            self._tokens -= admit
+            try:
+                version = await self.sequencer.get_live_committed_version()
+            except Exception as e:
+                for p in batch:
+                    p.fail(e)
+                continue
+            self.grvs_served += len(batch)
+            for p in batch:
+                p.send(version)
+
+    async def _rate_poller(self) -> None:
+        if self.ratekeeper is None:
+            return
+        while True:
+            try:
+                self._rate = await self.ratekeeper.get_rate()
+            except Exception:
+                pass  # keep last known rate while ratekeeper is unreachable
+            await self.loop.sleep(self.RATE_POLL_INTERVAL)

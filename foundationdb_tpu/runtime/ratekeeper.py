@@ -1,0 +1,51 @@
+"""Ratekeeper: admission control from storage lag.
+
+Reference: fdbserver/Ratekeeper.actor.cpp — polls storage queuing metrics,
+computes a cluster-wide transactions-per-second budget that shrinks as
+storage falls behind the tlogs, and leases per-interval transaction budgets
+to the GRV proxies, which block getReadVersion batches once the lease is
+exhausted (that back-pressure is what keeps the MVCC window bounded).
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.flow import Loop, all_of
+from foundationdb_tpu.runtime.sequencer import VERSIONS_PER_SECOND
+
+
+class Ratekeeper:
+    POLL_INTERVAL = 0.1
+    BASE_TPS = 200_000.0
+    # Storage lag (versions) where throttling starts / where admission stops.
+    LAG_SOFT = 1 * VERSIONS_PER_SECOND
+    LAG_HARD = 4 * VERSIONS_PER_SECOND
+
+    def __init__(self, loop: Loop, storage_eps: list):
+        self.loop = loop
+        self.storages = storage_eps
+        self.tps_limit = self.BASE_TPS
+        self.worst_lag = 0
+
+    async def run(self) -> None:
+        while True:
+            try:
+                metrics = await all_of([s.metrics() for s in self.storages])
+                self.worst_lag = max((m["version_lag"] for m in metrics), default=0)
+                self.tps_limit = self.BASE_TPS * self._scale(self.worst_lag)
+            except Exception:
+                # A dead storage server shows up as a broken metrics RPC;
+                # keep the last limit until it is replaced (reference keeps
+                # serving with stale smoothed metrics too).
+                pass
+            await self.loop.sleep(self.POLL_INTERVAL)
+
+    def _scale(self, lag: int) -> float:
+        if lag <= self.LAG_SOFT:
+            return 1.0
+        if lag >= self.LAG_HARD:
+            return 0.0
+        return 1.0 - (lag - self.LAG_SOFT) / (self.LAG_HARD - self.LAG_SOFT)
+
+    async def get_rate(self) -> float:
+        """GRV proxies poll this as their admission budget (txns/sec)."""
+        return self.tps_limit

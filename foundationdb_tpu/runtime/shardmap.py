@@ -1,0 +1,71 @@
+"""Keyspace shard map: key → storage tag / team.
+
+The reference keeps this in the system keyspace (`\\xff/keyServers/`,
+fdbclient/SystemData.cpp) maintained by data distribution; commit proxies
+use it to tag mutations and clients to route reads. Here it is a static
+sorted-boundary table shared by both sides; data-distribution-driven shard
+movement is out of scope for the core pipeline (the map can be swapped
+wholesale on recovery).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.types import KeyRange
+
+MAX_KEY = b"\xff\xff"  # end of the user+system keyspace
+
+
+@dataclass(frozen=True)
+class Shard:
+    range: KeyRange
+    tag: int
+
+
+class KeyShardMap:
+    """Static partition of [b"", MAX_KEY) into contiguous tagged shards."""
+
+    def __init__(self, boundaries: list[bytes], tags: list[int] | None = None):
+        """boundaries: interior split points (sorted, unique). Shard i covers
+        [b_i, b_{i+1}) with b_0 = b"" and b_last = MAX_KEY."""
+        assert boundaries == sorted(boundaries), "boundaries must be sorted"
+        self._bounds = [b""] + list(boundaries) + [MAX_KEY]
+        n = len(self._bounds) - 1
+        self._tags = list(tags) if tags is not None else list(range(n))
+        assert len(self._tags) == n
+
+    @classmethod
+    def uniform(cls, n_shards: int) -> "KeyShardMap":
+        """Evenly split the byte keyspace by first-byte prefix."""
+        bounds = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
+        return cls(bounds)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._tags)
+
+    @property
+    def shards(self) -> list[Shard]:
+        return [
+            Shard(KeyRange(self._bounds[i], self._bounds[i + 1]), self._tags[i])
+            for i in range(self.n_shards)
+        ]
+
+    def tag_for_key(self, key: bytes) -> int:
+        i = bisect.bisect_right(self._bounds, key, 1, len(self._bounds) - 1) - 1
+        return self._tags[i]
+
+    def split_range(self, r: KeyRange) -> list[tuple[KeyRange, int]]:
+        """Intersect a range with the shard partition → [(subrange, tag)]."""
+        out: list[tuple[KeyRange, int]] = []
+        for i in range(self.n_shards):
+            lo = max(r.begin, self._bounds[i])
+            hi = min(r.end, self._bounds[i + 1])
+            if lo < hi:
+                out.append((KeyRange(lo, hi), self._tags[i]))
+        return out
+
+    def tags_for_range(self, r: KeyRange) -> list[int]:
+        return [t for _, t in self.split_range(r)]

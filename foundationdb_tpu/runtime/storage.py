@@ -1,0 +1,221 @@
+"""Storage server: versioned reads over the MVCC window, tlog pull, watches.
+
+Reference: fdbserver/storageserver.actor.cpp — each storage server owns a
+tag, pulls that tag's mutations from the tlogs, applies them in version
+order to a versioned map (the reference's PTree; here per-key version
+chains over a sorted key index), serves getValue/getKeyValues at a read
+version within the ~5s MVCC window, fires watches on value change, and
+pops the tlog as it becomes durable.
+
+Reads behave like the reference's: a version newer than what has been
+applied raises FutureVersion (the client waits and retries, reference
+error 1009); a version below the window floor raises TransactionTooOld
+(1007).
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from foundationdb_tpu.core.errors import FutureVersion, TransactionTooOld
+from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
+from foundationdb_tpu.runtime.flow import Loop, Promise, any_of
+from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
+
+
+class VersionedMap:
+    """Per-key version chains over a sorted key index (the PTree analogue)."""
+
+    def __init__(self) -> None:
+        self._keys: list[bytes] = []  # sorted; includes tombstoned keys
+        self._chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
+
+    def latest(self, key: bytes) -> bytes | None:
+        chain = self._chains.get(key)
+        return chain[-1][1] if chain else None
+
+    def at(self, key: bytes, version: int) -> bytes | None:
+        chain = self._chains.get(key)
+        if not chain:
+            return None
+        i = bisect.bisect_right(chain, version, key=lambda e: e[0]) - 1
+        if i < 0:
+            return None
+        return chain[i][1]
+
+    def write(self, key: bytes, version: int, value: bytes | None) -> None:
+        chain = self._chains.get(key)
+        if chain is None:
+            self._chains[key] = [(version, value)]
+            bisect.insort(self._keys, key)
+        elif chain[-1][0] == version:
+            chain[-1] = (version, value)
+        else:
+            assert chain[-1][0] < version, "writes must arrive in version order"
+            chain.append((version, value))
+
+    def range_keys(self, begin: bytes, end: bytes) -> list[bytes]:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        return self._keys[lo:hi]
+
+    def gc(self, floor: int) -> None:
+        """Drop chain entries superseded before `floor`; fully remove keys
+        whose only surviving state is an old tombstone."""
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = bisect.bisect_right(chain, floor, key=lambda e: e[0]) - 1
+            if i > 0:
+                del chain[:i]
+            if len(chain) == 1 and chain[0][1] is None and chain[0][0] <= floor:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+
+
+class StorageServer:
+    PULL_INTERVAL = 0.001
+    GC_INTERVAL = 0.5
+
+    def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0):
+        self.loop = loop
+        self.tag = tag
+        self.tlog = tlog_ep
+        self.map = VersionedMap()
+        self._version = init_version  # applied through this version
+        self.oldest_version = 0  # MVCC window floor
+        self._version_waiters: list[tuple[int, Promise]] = []
+        self._watches: dict[bytes, list[tuple[bytes | None, Promise]]] = {}
+        self._running = False
+
+    # -- write path (tlog pull) ----------------------------------------------
+
+    async def run(self) -> None:
+        """Main pull loop actor; also drives MVCC GC."""
+        self._running = True
+        last_gc = self.loop.now
+        while True:
+            entries, end_version = await self.tlog.peek(self.tag, self._version + 1)
+            for version, mutations in entries:
+                self._apply(version, mutations)
+            if end_version > self._version:
+                self._advance(end_version)  # mutation-free versions (idle tag)
+            if entries:
+                await self.tlog.pop(self.tag, self._version)
+            if self.loop.now - last_gc >= self.GC_INTERVAL:
+                self._gc()
+                last_gc = self.loop.now
+            await self.loop.sleep(self.PULL_INTERVAL)
+
+    def _apply(self, version: int, mutations: list[Mutation]) -> None:
+        assert version > self._version
+        for m in mutations:
+            if m.type == MutationType.SET_VALUE:
+                self._write(m.param1, version, m.param2)
+            elif m.type == MutationType.CLEAR_RANGE:
+                for k in self.map.range_keys(m.param1, m.param2):
+                    if self.map.latest(k) is not None:
+                        self._write(k, version, None)
+            elif m.type in ATOMIC_OPS:
+                self._write(
+                    m.param1, version, apply_atomic(m.type, self.map.latest(m.param1), m.param2)
+                )
+            else:
+                raise ValueError(f"storage cannot apply mutation {m.type!r}")
+        self._advance(version)
+
+    def _advance(self, version: int) -> None:
+        self._version = version
+        self.oldest_version = max(self.oldest_version, version - MVCC_WINDOW_VERSIONS)
+        still = []
+        for want, p in self._version_waiters:
+            (p.send(None) if want <= version else still.append((want, p)))
+        self._version_waiters = still
+
+    def _write(self, key: bytes, version: int, value: bytes | None) -> None:
+        self.map.write(key, version, value)
+        watchers = self._watches.pop(key, None)
+        if watchers:
+            keep = []
+            for expect, p in watchers:
+                (p.send(version) if value != expect else keep.append((expect, p)))
+            if keep:
+                self._watches[key] = keep
+
+    def _gc(self) -> None:
+        self.map.gc(self.oldest_version)
+
+    # -- read path ------------------------------------------------------------
+
+    VERSION_WAIT_TIMEOUT = 1.0  # virtual s to wait for lagging apply loop
+
+    async def _check_version(self, version: int) -> None:
+        if version < self.oldest_version:
+            raise TransactionTooOld(f"read at {version} < floor {self.oldest_version}")
+        if version > self._version:
+            # Wait briefly for the pull loop to catch up (the reference's
+            # waitForVersion); past the timeout the client sees
+            # FutureVersion and retries at a fresh GRV.
+            p = Promise()
+            entry = (version, p)
+            self._version_waiters.append(entry)
+            await any_of([p.future, self.loop.sleep(self.VERSION_WAIT_TIMEOUT)])
+            if version > self._version:
+                if entry in self._version_waiters:  # lost the race: un-park
+                    self._version_waiters.remove(entry)
+                raise FutureVersion(f"read at {version} > applied {self._version}")
+
+    async def get(self, key: bytes, version: int) -> bytes | None:
+        await self._check_version(version)
+        return self.map.at(key, version)
+
+    async def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        version: int,
+        limit: int = 10_000,
+        reverse: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        await self._check_version(version)
+        keys = self.map.range_keys(begin, end)
+        if reverse:
+            keys = reversed(keys)
+        out: list[tuple[bytes, bytes]] = []
+        for k in keys:
+            v = self.map.at(k, version)
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    break
+        return out
+
+    async def wait_for_version(self, version: int) -> None:
+        """Park until the pull loop has applied through `version`."""
+        if version <= self._version:
+            return
+        p = Promise()
+        self._version_waiters.append((version, p))
+        await p.future
+
+    async def watch(self, key: bytes, value: bytes | None) -> int:
+        """Resolves (with the triggering version) once the key's value is
+        observed ≠ `value` (reference: storage watch at the latest version)."""
+        current = self.map.latest(key)
+        if current != value:
+            return self._version
+        p = Promise()
+        self._watches.setdefault(key, []).append((value, p))
+        return await p.future
+
+    async def metrics(self) -> dict:
+        """Ratekeeper inputs (reference: StorageQueuingMetricsReply)."""
+        tlog_version = await self.tlog.get_version()
+        return {
+            "tag": self.tag,
+            "durable_version": self._version,
+            "version_lag": max(0, tlog_version - self._version),
+            "keys": len(self.map._keys),
+        }

@@ -1,0 +1,109 @@
+"""Transaction log: the durability point of the commit path.
+
+Reference: fdbserver/TLogServer.actor.cpp — commit proxies push each batch's
+mutations tagged by destination storage server; the push is acknowledged
+only after fsync; storage servers pull their tag with peek/pop and the log
+trims below the popped version. Pushes carry (prev_version, version) and
+are applied in chain order, like the resolver. Recovery locks the log,
+freezing its end version.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.mutations import Mutation
+from foundationdb_tpu.runtime.flow import Loop, Promise
+
+
+@dataclass(frozen=True)
+class TLogEntry:
+    version: int
+    # tag -> mutations bound for that storage server
+    tagged: dict[int, list[Mutation]]
+
+
+class TLogLocked(Exception):
+    """Pushed after recovery locked this log (reference: tlog_stopped)."""
+
+
+class TLog:
+    FSYNC_SECONDS = 0.0005  # simulated durable-write latency per push
+
+    def __init__(self, loop: Loop, init_version: int = 0):
+        self.loop = loop
+        self._log: list[TLogEntry] = []
+        self._version = init_version  # end of applied chain
+        self._waiters: dict[int, Promise] = {}
+        self._popped: dict[int, int] = {}  # tag -> trimmed-below version
+        self._tags_seen: set[int] = set()  # tags with entries ever pushed
+        self.locked = False
+
+    async def push(
+        self, prev_version: int, version: int, tagged: dict[int, list[Mutation]]
+    ) -> int:
+        """Append one batch; ack (returning the durable version) after fsync.
+
+        Idempotent under retransmit: a push whose version is already in the
+        chain (its ack was lost to a partition) re-acks without re-appending."""
+        while self._version != prev_version and not self.locked:
+            if version <= self._version:
+                return version  # duplicate of an already-durable batch
+            if prev_version < self._version:
+                raise ValueError(
+                    f"gap in tlog chain: prev={prev_version} < applied={self._version}"
+                )
+            p = self._waiters.setdefault(prev_version, Promise())
+            await p.future
+        if self.locked:
+            raise TLogLocked(f"push v{version} after lock at v{self._version}")
+        await self.loop.sleep(self.FSYNC_SECONDS)
+        if self.locked:  # lock won the race while we were "fsyncing"
+            raise TLogLocked(f"push v{version} after lock at v{self._version}")
+        self._log.append(TLogEntry(version, tagged))
+        self._tags_seen.update(tagged)
+        self._version = version
+        w = self._waiters.pop(version, None)
+        if w is not None:
+            w.send(None)
+        return version
+
+    async def peek(
+        self, tag: int, begin_version: int, limit: int = 1000
+    ) -> tuple[list[tuple[int, list[Mutation]]], int]:
+        """→ (entries for `tag` with version >= begin_version, end_version).
+
+        end_version is the version the puller may advance to after applying
+        the returned entries: the durable chain end, unless the scan was
+        truncated by `limit` (then the last returned version). Idle tags
+        advance through mutation-free versions this way — the reference's
+        empty peek replies carying the tlog version."""
+        out = []
+        for e in self._log:
+            if e.version >= begin_version and tag in e.tagged:
+                out.append((e.version, e.tagged[tag]))
+                if len(out) >= limit:
+                    return out, out[-1][0]
+        return out, self._version
+
+    async def pop(self, tag: int, version: int) -> None:
+        """Storage server `tag` is durable through `version`; trim entries
+        every live tag has popped past. A tag that has pushed entries but
+        never popped holds the floor at 0 (no trim) — correct, if unbounded,
+        until recovery replaces its storage server."""
+        self._popped[tag] = max(self._popped.get(tag, 0), version)
+        floor = min(self._popped.get(t, 0) for t in self._tags_seen)
+        self._log = [e for e in self._log if e.version > floor]
+
+    async def lock(self) -> int:
+        """Recovery: refuse further pushes; → end version (reference:
+        TLogLockResult.end)."""
+        self.locked = True
+        # Wake parked pushes so they observe the lock and fail out.
+        for p in self._waiters.values():
+            p.send(None)
+        self._waiters.clear()
+        return self._version
+
+    async def get_version(self) -> int:
+        return self._version
