@@ -1,0 +1,354 @@
+"""Client library: Database / Transaction — grv, reads, commit, retry loop.
+
+Reference: fdbclient/NativeAPI.actor.cpp. A Transaction lazily acquires a
+read version from a GRV proxy, routes reads to storage servers by shard,
+accumulates mutations and conflict ranges, and commits through a commit
+proxy. ``Database.run`` is the canonical retry loop (reference: the
+``on_error`` contract every binding implements): retryable errors reset
+the transaction and back off; everything else propagates.
+
+Key selectors resolve the way the reference's getKey does: walk |offset|
+keys forward/back from the anchor via shard-routed range reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from foundationdb_tpu.core.errors import FdbError, UsedDuringCommit
+from foundationdb_tpu.core.mutations import (
+    ATOMIC_OPS,
+    Mutation,
+    MutationType,
+    make_versionstamp,
+)
+from foundationdb_tpu.core.types import (
+    KeyRange,
+    MAX_KEY_SIZE,
+    MAX_VALUE_SIZE,
+    single_key_range,
+)
+from foundationdb_tpu.core.errors import KeyTooLarge, ValueTooLarge
+from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
+
+
+@dataclass(frozen=True)
+class KeySelector:
+    """Reference: fdbclient KeySelectorRef. Resolves to the key `offset`
+    positions after (before, if negative) the anchor: the last key < `key`
+    (or ≤ `key` when or_equal)."""
+
+    key: bytes
+    or_equal: bool
+    offset: int
+
+    @classmethod
+    def last_less_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 0)
+
+    @classmethod
+    def first_greater_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 1)
+
+    @classmethod
+    def first_greater_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 1)
+
+    def __add__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset + n)
+
+    def __sub__(self, n: int) -> "KeySelector":
+        return KeySelector(self.key, self.or_equal, self.offset - n)
+
+
+class Database:
+    """Handle to the cluster: GRV proxies, commit proxies, storage routing."""
+
+    def __init__(
+        self,
+        loop,
+        grv_proxy_eps: list,
+        commit_proxy_eps: list,
+        storage_map: KeyShardMap,
+        storage_eps: list,
+    ):
+        self.loop = loop
+        self.grv_proxies = grv_proxy_eps
+        self.commit_proxies = commit_proxy_eps
+        self.storage_map = storage_map
+        self.storage_eps = storage_eps
+        self._rr = 0
+        self.transaction_class = Transaction  # ryw.open_database swaps in RYW
+
+    def _pick(self, eps: list):
+        self._rr += 1
+        return eps[self._rr % len(eps)]
+
+    def transaction(self) -> "Transaction":
+        return self.transaction_class(self)
+
+    async def run(self, fn, max_retries: int = 50):
+        """Run `await fn(tr)` + commit with the standard retry loop."""
+        tr = self.transaction()
+        for _ in range(max_retries):
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except FdbError as e:
+                await tr.on_error(e)  # raises if not retryable
+        raise FdbError("retry limit reached", code=1021)
+
+
+class Transaction:
+    """Raw (non-RYW) transaction: reads see the snapshot only; your own
+    writes become visible after commit. client/ryw.py layers read-your-writes
+    on top (and is what Database.run hands out in practice via layers)."""
+
+    MAX_BACKOFF = 1.0
+
+    def __init__(self, db: Database):
+        self.db = db
+        self._backoff = 0.01
+        self._reset()
+
+    def _reset(self) -> None:
+        self._read_version: int | None = None
+        self.mutations: list[Mutation] = []
+        self.read_ranges: list[KeyRange] = []
+        self.write_ranges: list[KeyRange] = []
+        self._committed: tuple[int, int] | None = None  # (version, batch_order)
+        self._pending_watches: list[tuple[bytes, bytes | None]] = []
+        self._watch_futures: list = []
+
+    # -- versions -------------------------------------------------------------
+
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            self._read_version = await self.db._pick(self.db.grv_proxies).get_read_version()
+        return self._read_version
+
+    def set_read_version(self, version: int) -> None:
+        self._read_version = version
+
+    @property
+    def committed_version(self) -> int:
+        if self._committed is None:
+            raise FdbError("transaction not committed", code=2021)
+        return self._committed[0]
+
+    def get_versionstamp(self) -> bytes:
+        """The 10-byte stamp this txn's versionstamped ops used (valid after
+        commit; reference: Transaction::getVersionstamp)."""
+        v, order = self._committed if self._committed else (None, None)
+        if v is None:
+            raise FdbError("transaction not committed", code=2021)
+        return make_versionstamp(v, order)
+
+    # -- reads ----------------------------------------------------------------
+
+    async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        _check_key(key)
+        version = await self.get_read_version()
+        ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
+        value = await ep.get(key, version)
+        if not snapshot:
+            self.read_ranges.append(single_key_range(key))
+        return value
+
+    async def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        limit: int = 0,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> list[tuple[bytes, bytes]]:
+        """Rows in [begin, end); limit 0 = unlimited. The read conflict range
+        covers only what the result depends on: up to the last key returned
+        when the limit truncates the scan (reference: getRange conflict-range
+        trimming in NativeAPI)."""
+        version = await self.get_read_version()
+        cap = limit if limit > 0 else 1 << 30
+        parts = self.db.storage_map.split_range(KeyRange(begin, end))
+        if reverse:
+            parts = parts[::-1]
+        rows: list[tuple[bytes, bytes]] = []
+        for r, tag in parts:
+            if len(rows) >= cap:
+                break
+            got = await self.db.storage_eps[tag].get_range(
+                r.begin, r.end, version, limit=cap - len(rows), reverse=reverse
+            )
+            rows.extend(got)
+        rows = rows[:cap]
+        if not snapshot:
+            if limit > 0 and len(rows) == cap and rows:
+                if reverse:
+                    conflict = KeyRange(rows[-1][0], end)
+                else:
+                    conflict = KeyRange(begin, rows[-1][0] + b"\x00")
+            else:
+                conflict = KeyRange(begin, end)
+            if not conflict.empty:
+                self.read_ranges.append(conflict)
+        return rows
+
+    async def get_key(self, sel: KeySelector, snapshot: bool = False) -> bytes:
+        """Resolve a key selector (reference: Transaction::getKey). Returns
+        b"" when the selector runs off the front, MAX_KEY off the back."""
+        version = await self.get_read_version()
+        anchor = sel.key
+        # Position 0 is "last key ≤/< anchor"; walk |offset| from there.
+        if sel.offset >= 1:
+            # forward: the offset-th key in order from (anchor, or_equal ? > : ≥)
+            begin = anchor + b"\x00" if sel.or_equal else anchor
+            rows = await self._scan_keys(begin, MAX_KEY, sel.offset, False, version)
+            result = rows[sel.offset - 1] if len(rows) >= sel.offset else MAX_KEY
+        else:
+            back = 1 - sel.offset  # how many keys back from the anchor
+            end = anchor + b"\x00" if sel.or_equal else anchor
+            rows = await self._scan_keys(b"", end, back, True, version)
+            result = rows[back - 1] if len(rows) >= back else b""
+        if not snapshot:
+            # Result depends on the span between anchor and resolved key.
+            lo, hi = sorted((anchor, result))
+            self.read_ranges.append(KeyRange(lo, hi + b"\x00"))
+        return result
+
+    async def _scan_keys(
+        self, begin: bytes, end: bytes, limit: int, reverse: bool, version: int
+    ) -> list[bytes]:
+        parts = self.db.storage_map.split_range(KeyRange(begin, end))
+        if reverse:
+            parts = parts[::-1]
+        keys: list[bytes] = []
+        for r, tag in parts:
+            if len(keys) >= limit:
+                break
+            got = await self.db.storage_eps[tag].get_range(
+                r.begin, r.end, version, limit=limit - len(keys), reverse=reverse
+            )
+            keys.extend(k for k, _v in got)
+        return keys[:limit]
+
+    async def watch(self, key: bytes) -> "object":
+        """Register a watch armed at commit (reference: watches are part of
+        the commit). Returns a Future resolving when the key's value changes
+        from what this txn observed."""
+        value = await self.get(key, snapshot=True)
+        from foundationdb_tpu.runtime.flow import Future
+
+        slot = Future()
+        self._pending_watches.append((key, value))
+        self._watch_futures.append(slot)
+        return slot
+
+    # -- writes ---------------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        _check_key(key)
+        _check_value(value)
+        self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self.write_ranges.append(single_key_range(key))
+
+    def clear(self, key: bytes) -> None:
+        _check_key(key)
+        self.mutations.append(Mutation(MutationType.CLEAR_RANGE, key, key + b"\x00"))
+        self.write_ranges.append(single_key_range(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        r = KeyRange(begin, end)
+        if r.empty:
+            return
+        self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self.write_ranges.append(r)
+
+    def atomic_op(self, op: MutationType, key: bytes, param: bytes) -> None:
+        if op not in ATOMIC_OPS and op not in (
+            MutationType.SET_VERSIONSTAMPED_KEY,
+            MutationType.SET_VERSIONSTAMPED_VALUE,
+        ):
+            raise ValueError(f"not an atomic op: {op!r}")
+        _check_key(key)
+        self.mutations.append(Mutation(op, key, param))
+        if op == MutationType.SET_VERSIONSTAMPED_KEY:
+            # The final key is unknown until commit: conflict over every key
+            # the stamp substitution could produce (prefix below the offset,
+            # then any stamp + suffix).
+            import struct
+
+            (off,) = struct.unpack("<I", key[-4:])
+            prefix = key[:-4][:off]
+            self.write_ranges.append(KeyRange(prefix, prefix + b"\xff" * 11))
+        else:
+            self.write_ranges.append(single_key_range(key))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self.read_ranges.append(KeyRange(begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self.write_ranges.append(KeyRange(begin, end))
+
+    # -- commit ---------------------------------------------------------------
+
+    @property
+    def is_read_only(self) -> bool:
+        return not self.mutations and not self.write_ranges
+
+    async def commit(self) -> int:
+        if self._committed is not None:
+            raise UsedDuringCommit("commit() called twice")
+        version = await self.get_read_version()
+        if self.is_read_only:
+            self._committed = (version, 0)
+            self._arm_watches()  # read-only txns still arm watches at commit
+            return version
+        req = CommitRequest(
+            read_version=version,
+            mutations=list(self.mutations),
+            read_ranges=list(self.read_ranges),
+            write_ranges=list(self.write_ranges),
+        )
+        res = await self.db._pick(self.db.commit_proxies).commit(req)
+        self._committed = (res.version, res.batch_order)
+        self._arm_watches()
+        return res.version
+
+    def _arm_watches(self) -> None:
+        for (key, value), slot in zip(self._pending_watches, self._watch_futures):
+            ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
+            fut = ep.watch(key, value)
+            fut.add_done_callback(
+                lambda f, s=slot: s._finish(f._state, f._value)
+            )
+        self._pending_watches, self._watch_futures = [], []
+
+    async def on_error(self, e: FdbError) -> None:
+        """Reset + backoff for retryable errors; re-raise otherwise."""
+        # This attempt's un-armed watches can never fire (reference fails
+        # them with transaction_cancelled).
+        for slot in self._watch_futures:
+            slot._finish("error", FdbError("transaction reset", code=1025))
+        self._pending_watches, self._watch_futures = [], []
+        if not isinstance(e, FdbError) or not e.retryable:
+            raise e
+        backoff = self._backoff
+        self._backoff = min(self.MAX_BACKOFF, self._backoff * 2)
+        self._reset()
+        await self.db.loop.sleep(backoff * (0.5 + self.db.loop.rng.random()))
+
+
+def _check_key(key: bytes) -> None:
+    if len(key) > MAX_KEY_SIZE:
+        raise KeyTooLarge(f"{len(key)} > {MAX_KEY_SIZE}")
+
+
+def _check_value(value: bytes) -> None:
+    if len(value) > MAX_VALUE_SIZE:
+        raise ValueTooLarge(f"{len(value)} > {MAX_VALUE_SIZE}")

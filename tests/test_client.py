@@ -1,0 +1,334 @@
+"""Client layer: Transaction/RYW semantics, retry loop, selectors, watches.
+
+Mirrors the reference's binding tester + ReadYourWrites unit coverage."""
+
+import pytest
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.client.transaction import KeySelector
+from foundationdb_tpu.core.errors import FdbError, NotCommitted
+from foundationdb_tpu.core.mutations import MutationType as M
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_db(seed=0, **kw):
+    c = SimCluster(seed=seed, **kw)
+    return c, open_database(c)
+
+
+def run(c, coro, timeout=300):
+    return c.loop.run(coro, timeout=timeout)
+
+
+class TestTransactionBasics:
+    def test_set_commit_get(self):
+        c, db = make_db(1)
+
+        async def main():
+            tr = db.transaction()
+            tr.set(b"hello", b"world")
+            v = await tr.commit()
+            tr2 = db.transaction()
+            assert await tr2.get(b"hello") == b"world"
+            assert v > 0
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_database_run_retries_conflict(self):
+        c, db = make_db(2)
+
+        async def main():
+            # Seed the counter.
+            tr = db.transaction()
+            tr.set(b"ctr", (0).to_bytes(8, "little"))
+            await tr.commit()
+
+            async def incr(tr):
+                cur = await tr.get(b"ctr")
+                tr.set(b"ctr", (int.from_bytes(cur, "little") + 1).to_bytes(8, "little"))
+
+            from foundationdb_tpu.runtime.flow import all_of
+
+            # Concurrent read-modify-write: conflicts happen, run() retries.
+            await all_of([c.loop.spawn(db.run(incr)) for _ in range(10)])
+            tr = db.transaction()
+            return int.from_bytes(await tr.get(b"ctr"), "little")
+
+        assert run(c, main()) == 10
+
+    def test_non_retryable_error_propagates(self):
+        c, db = make_db(3)
+
+        async def main():
+            async def bad(tr):
+                raise FdbError("app bug", code=2000)
+
+            with pytest.raises(FdbError) as ei:
+                await db.run(bad)
+            return ei.value.code
+
+        assert run(c, main()) == 2000
+
+    def test_snapshot_read_no_conflict(self):
+        c, db = make_db(4)
+
+        async def main():
+            tr0 = db.transaction()
+            tr0.set(b"k", b"0")
+            await tr0.commit()
+
+            tr1 = db.transaction()
+            await tr1.get(b"k", snapshot=True)  # snapshot: no conflict range
+            tr2 = db.transaction()
+            tr2.set(b"k", b"1")
+            await tr2.commit()
+            tr1.set(b"other", b"x")
+            await tr1.commit()  # would NotCommitted if the read counted
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_conflict_raises_not_committed(self):
+        c, db = make_db(5)
+
+        async def main():
+            tr0 = db.transaction()
+            tr0.set(b"k", b"0")
+            await tr0.commit()
+
+            tr1 = db.transaction()
+            await tr1.get(b"k")
+            tr2 = db.transaction()
+            tr2.set(b"k", b"1")
+            await tr2.commit()
+            tr1.set(b"other", b"x")
+            with pytest.raises(NotCommitted):
+                await tr1.commit()
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+
+class TestRYW:
+    def test_read_your_writes(self):
+        c, db = make_db(6)
+
+        async def main():
+            tr = db.transaction()
+            tr.set(b"a", b"1")
+            assert await tr.get(b"a") == b"1"  # own write visible pre-commit
+            tr.clear(b"a")
+            assert await tr.get(b"a") is None
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_ryw_clear_range_then_set(self):
+        c, db = make_db(7)
+
+        async def main():
+            tr0 = db.transaction()
+            for i in range(5):
+                tr0.set(b"r%d" % i, b"base")
+            await tr0.commit()
+
+            tr = db.transaction()
+            tr.clear_range(b"r", b"s")
+            assert await tr.get(b"r3") is None
+            tr.set(b"r2", b"new")
+            rows = await tr.get_range(b"r", b"s")
+            assert rows == [(b"r2", b"new")]
+            await tr.commit()
+            tr2 = db.transaction()
+            assert await tr2.get_range(b"r", b"s") == [(b"r2", b"new")]
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_ryw_atomic_fold(self):
+        c, db = make_db(8)
+
+        async def main():
+            tr0 = db.transaction()
+            tr0.set(b"n", (7).to_bytes(8, "little"))
+            await tr0.commit()
+
+            tr = db.transaction()
+            tr.atomic_op(M.ADD, b"n", (5).to_bytes(8, "little"))
+            # RYW read folds the pending ADD over the snapshot value.
+            assert int.from_bytes(await tr.get(b"n"), "little") == 12
+            tr.atomic_op(M.ADD, b"n", (1).to_bytes(8, "little"))
+            assert int.from_bytes(await tr.get(b"n"), "little") == 13
+            await tr.commit()
+            tr2 = db.transaction()
+            assert int.from_bytes(await tr2.get(b"n"), "little") == 13
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_ryw_range_merge_with_limit(self):
+        c, db = make_db(9)
+
+        async def main():
+            tr0 = db.transaction()
+            for i in range(0, 10, 2):  # even keys in base
+                tr0.set(b"m%d" % i, b"base")
+            await tr0.commit()
+
+            tr = db.transaction()
+            for i in range(1, 10, 2):  # odd keys in overlay
+                tr.set(b"m%d" % i, b"ovl")
+            tr.clear(b"m0")
+            rows = await tr.get_range(b"m", b"n", limit=4)
+            assert [k for k, _ in rows] == [b"m1", b"m2", b"m3", b"m4"]
+            rows_r = await tr.get_range(b"m", b"n", limit=2, reverse=True)
+            assert [k for k, _ in rows_r] == [b"m9", b"m8"]
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+
+class TestRYWRegressions:
+    def test_limited_range_after_clear_range(self):
+        """Limit must count surviving rows, not rows eaten by own clears."""
+        c, db = make_db(20)
+
+        async def main():
+            tr0 = db.transaction()
+            for i in range(20):
+                tr0.set(b"k%02d" % i, b"v")
+            await tr0.commit()
+            tr = db.transaction()
+            tr.clear_range(b"k00", b"k10")
+            rows = await tr.get_range(b"k00", b"k99", limit=5)
+            assert [k for k, _ in rows] == [b"k10", b"k11", b"k12", b"k13", b"k14"]
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_snapshot_atomic_fold_keeps_conflict_obligation(self):
+        """A snapshot read folding pending atomics must not poison the
+        fast path: a later serializable read still adds its conflict."""
+        c, db = make_db(21)
+
+        async def main():
+            tr = db.transaction()
+            tr.atomic_op(M.ADD, b"n", (1).to_bytes(8, "little"))
+            await tr.get(b"n", snapshot=True)
+            before = len(tr.read_ranges)
+            await tr.get(b"n")  # serializable read
+            assert len(tr.read_ranges) == before + 1
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_get_covered_by_own_clear_no_conflict(self):
+        c, db = make_db(22)
+
+        async def main():
+            tr = db.transaction()
+            tr.clear_range(b"a", b"b")
+            before = len(tr.read_ranges)
+            assert await tr.get(b"ax") is None
+            assert len(tr.read_ranges) == before  # locally known: no conflict
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_unreadable_versionstamped_value_in_range(self):
+        import struct
+
+        c, db = make_db(23)
+
+        async def main():
+            tr = db.transaction()
+            tr.atomic_op(
+                M.SET_VERSIONSTAMPED_VALUE,
+                b"vk",
+                b"\x00" * 10 + struct.pack("<I", 0),
+            )
+            with pytest.raises(FdbError) as ei:
+                await tr.get(b"vk")
+            assert ei.value.code == 1036
+            with pytest.raises(FdbError):
+                await tr.get_range(b"v", b"w")
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_watch_failed_on_transaction_reset(self):
+        c, db = make_db(24)
+
+        async def main():
+            tr = db.transaction()
+            w = await tr.watch(b"k")
+            await tr.on_error(NotCommitted())  # retryable: resets the txn
+            assert w.done() and w.is_error()
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+
+class TestSelectorsAndWatches:
+    def test_key_selectors(self):
+        c, db = make_db(10)
+
+        async def main():
+            tr0 = db.transaction()
+            for k in (b"a", b"c", b"e", b"g"):
+                tr0.set(k, b"v")
+            await tr0.commit()
+
+            tr = db.transaction()
+            assert await tr.get_key(KeySelector.first_greater_or_equal(b"c")) == b"c"
+            assert await tr.get_key(KeySelector.first_greater_than(b"c")) == b"e"
+            assert await tr.get_key(KeySelector.last_less_than(b"c")) == b"a"
+            assert await tr.get_key(KeySelector.last_less_or_equal(b"c")) == b"c"
+            assert await tr.get_key(KeySelector.first_greater_or_equal(b"c") + 1) == b"e"
+            assert await tr.get_key(KeySelector.last_less_than(b"a")) == b""
+            from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+            assert await tr.get_key(KeySelector.first_greater_than(b"zzz")) == MAX_KEY
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_watch_fires_on_change(self):
+        c, db = make_db(11)
+
+        async def main():
+            tr0 = db.transaction()
+            tr0.set(b"w", b"0")
+            await tr0.commit()
+
+            tr = db.transaction()
+            w = await tr.watch(b"w")
+            await tr.commit()
+            assert not w.done()
+
+            tr2 = db.transaction()
+            tr2.set(b"w", b"1")
+            await tr2.commit()
+            await w  # resolves once storage applies the change
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_versionstamp_roundtrip(self):
+        import struct
+
+        c, db = make_db(12)
+
+        async def main():
+            tr = db.transaction()
+            key = b"vs/" + b"\x00" * 10 + struct.pack("<I", 3)
+            tr.atomic_op(M.SET_VERSIONSTAMPED_KEY, key, b"payload")
+            await tr.commit()
+            stamp = tr.get_versionstamp()
+            tr2 = db.transaction()
+            rows = await tr2.get_range(b"vs/", b"vs0")
+            assert rows == [(b"vs/" + stamp, b"payload")]
+            return "ok"
+
+        assert run(c, main()) == "ok"
