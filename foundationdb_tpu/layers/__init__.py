@@ -1,0 +1,30 @@
+"""Layers: tuple encoding, subspaces, directory — the keyspace-structuring
+stack every fdb binding ships (reference: fdbclient/Tuple.cpp,
+bindings/python/fdb/{subspace,directory}_impl.py)."""
+
+from foundationdb_tpu.layers.tuple_layer import (
+    SingleFloat,
+    Subspace,
+    TupleError,
+    Versionstamp,
+    pack,
+    pack_with_versionstamp,
+    range_of,
+    strinc,
+    unpack,
+)
+from foundationdb_tpu.layers.directory import (
+    DirectoryAlreadyExists,
+    DirectoryDoesNotExist,
+    DirectoryError,
+    DirectoryLayer,
+    DirectorySubspace,
+    HighContentionAllocator,
+)
+
+__all__ = [
+    "SingleFloat", "Subspace", "TupleError", "Versionstamp", "pack",
+    "pack_with_versionstamp", "range_of", "strinc", "unpack",
+    "DirectoryAlreadyExists", "DirectoryDoesNotExist", "DirectoryError",
+    "DirectoryLayer", "DirectorySubspace", "HighContentionAllocator",
+]

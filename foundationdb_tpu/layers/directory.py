@@ -1,0 +1,354 @@
+"""Directory layer: hierarchical namespace mapping paths to short key prefixes.
+
+Reference: bindings/python/fdb/directory_impl.py (the cross-binding spec) on
+top of the tuple layer. Directory metadata lives under the node subspace
+(default raw prefix ``\\xfe``); contents live under short prefixes handed out
+by the high-contention allocator so deep paths don't produce long keys.
+
+Layout (identical to the reference so the on-disk format is recognisable):
+- node(prefix)           = node_ss[prefix]              (a Subspace)
+- root node              = node_ss[node_ss.key]
+- subdir pointer         node[0][name] -> child prefix
+- layer id               node[b"layer"] -> layer bytes
+- version                root_node[b"version"] -> 3x uint32 LE
+- allocator state        root_node[b"hca"][0|1][...]
+
+All operations are async and take a Transaction (``tr``) from
+client/transaction.py; use ``Database.run`` for the retry loop.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.core.types import strinc
+from foundationdb_tpu.layers.tuple_layer import Subspace, pack
+
+
+class DirectoryError(FdbError):
+    code = 1500
+
+
+class DirectoryAlreadyExists(DirectoryError):
+    code = 2306  # reference: directory_already_exists
+
+
+class DirectoryDoesNotExist(DirectoryError):
+    code = 2300  # reference: directory_does_not_exist
+
+
+class DirectoryVersionError(DirectoryError):
+    code = 2310  # reference: incompatible directory version
+
+
+_SUBDIRS = 0
+_VERSION = (1, 0, 0)
+
+
+class HighContentionAllocator:
+    """Allocates short, unique byte prefixes with minimal transaction
+    conflicts (reference: HighContentionAllocator in directory_impl.py).
+
+    State: counters[window_start] -> little-endian txn count, and
+    recent[candidate] -> b"" claims. Candidates are drawn uniformly from the
+    current window; the window advances once its count exceeds half its size,
+    which keeps allocated integers small without serialising allocators.
+    """
+
+    def __init__(self, subspace: Subspace):
+        self.counters = subspace[0]
+        self.recent = subspace[1]
+
+    async def allocate(self, tr) -> bytes:
+        while True:
+            start = 0
+            kvs = await tr.get_range(
+                self.counters.key, strinc(self.counters.key), limit=1, reverse=True,
+                snapshot=True,
+            )
+            if kvs:
+                (start,) = self.counters.unpack(kvs[0][0])
+
+            window_advanced = False
+            while True:
+                if window_advanced:
+                    tr.clear_range(self.counters.key, self.counters.pack((start,)))
+                    tr.clear_range(self.recent.key, self.recent.pack((start,)))
+                tr.atomic_op(
+                    MutationType.ADD, self.counters.pack((start,)),
+                    struct.pack("<q", 1),
+                )
+                raw = await tr.get(self.counters.pack((start,)), snapshot=True)
+                count = struct.unpack("<q", raw.ljust(8, b"\x00"))[0] if raw else 0
+                window = self._window_size(start)
+                if count * 2 < window:
+                    break
+                window_advanced = True
+                start += window
+
+            # Draw from the sim loop's seeded RNG so allocation (and hence
+            # conflict/retry schedules) replay deterministically from a seed.
+            rng = tr.db.loop.rng
+            while True:
+                candidate = start + rng.randrange(self._window_size(start))
+                # Has the window moved under us? (another allocator advanced it)
+                latest = await tr.get_range(
+                    self.counters.key, strinc(self.counters.key), limit=1,
+                    reverse=True, snapshot=True,
+                )
+                latest_start = self.counters.unpack(latest[0][0])[0] if latest else 0
+                if latest_start > start:
+                    break  # restart the outer loop with the new window
+                cand_key = self.recent.pack((candidate,))
+                # Non-snapshot read: the read-conflict range is the mutual
+                # exclusion — two allocators claiming the same candidate
+                # conflict at the resolver and one retries.
+                taken = await tr.get(cand_key)
+                if taken is None:
+                    tr.set(cand_key, b"")
+                    return pack((candidate,))
+
+    @staticmethod
+    def _window_size(start: int) -> int:
+        if start < 255:
+            return 64
+        if start < 65535:
+            return 1024
+        return 8192
+
+
+class DirectorySubspace(Subspace):
+    """A Subspace that knows its path and layer and can operate on its own
+    subtree through the owning DirectoryLayer."""
+
+    def __init__(self, path: tuple, prefix: bytes, directory_layer: "DirectoryLayer",
+                 layer: bytes = b""):
+        super().__init__(raw_prefix=prefix)
+        self.path = path
+        self.layer = layer
+        self.directory_layer = directory_layer
+
+    # Convenience proxies: d.create_or_open(tr, "sub") etc.
+    async def create_or_open(self, tr, path, layer: bytes = b""):
+        return await self.directory_layer.create_or_open(
+            tr, self.path + _to_path(path), layer)
+
+    async def open(self, tr, path, layer: bytes = b""):
+        return await self.directory_layer.open(tr, self.path + _to_path(path), layer)
+
+    async def create(self, tr, path, layer: bytes = b"", prefix: bytes | None = None):
+        return await self.directory_layer.create(
+            tr, self.path + _to_path(path), layer, prefix)
+
+    async def list(self, tr, path=()):
+        return await self.directory_layer.list(tr, self.path + _to_path(path))
+
+    async def move_to(self, tr, new_path):
+        return await self.directory_layer.move(tr, self.path, _to_path(new_path))
+
+    async def remove(self, tr, path=()):
+        return await self.directory_layer.remove(tr, self.path + _to_path(path))
+
+    async def exists(self, tr, path=()) -> bool:
+        return await self.directory_layer.exists(tr, self.path + _to_path(path))
+
+    def __repr__(self) -> str:
+        return f"DirectorySubspace(path={self.path!r}, prefix={self.key!r})"
+
+
+def _to_path(path) -> tuple:
+    if isinstance(path, str):
+        return (path,)
+    return tuple(path)
+
+
+class DirectoryLayer:
+    """Reference: DirectoryLayer in directory_impl.py. ``create_or_open``,
+    ``open``, ``create``, ``move``, ``remove``, ``list``, ``exists`` over
+    slash-free unicode path tuples."""
+
+    def __init__(self, node_subspace: Subspace | None = None,
+                 content_subspace: Subspace | None = None):
+        self._node_ss = node_subspace or Subspace(raw_prefix=b"\xfe")
+        self._content_ss = content_subspace or Subspace()
+        self._root_node = self._node_ss.subspace((self._node_ss.key,))
+        self._allocator = HighContentionAllocator(self._root_node[b"hca"])
+
+    # -- node helpers --------------------------------------------------------
+
+    def _node_with_prefix(self, prefix: bytes) -> Subspace:
+        return self._node_ss.subspace((prefix,))
+
+    def _prefix_of(self, node: Subspace) -> bytes:
+        return self._node_ss.unpack(node.key)[0]
+
+    async def _check_version(self, tr, write: bool) -> None:
+        raw = await tr.get(self._root_node.pack((b"version",)))
+        if raw is None:
+            if write:
+                tr.set(self._root_node.pack((b"version",)), struct.pack("<III", *_VERSION))
+            return
+        major, minor, micro = struct.unpack("<III", raw)
+        if major > _VERSION[0]:
+            raise DirectoryVersionError(
+                f"cannot load directory version {major}.{minor}.{micro}")
+        if write and (major, minor) > _VERSION[:2]:
+            raise DirectoryVersionError(
+                f"cannot write to directory version {major}.{minor}.{micro}")
+
+    async def _find(self, tr, path: tuple) -> Subspace | None:
+        node = self._root_node
+        for name in path:
+            prefix = await tr.get(node.pack((_SUBDIRS, name)))
+            if prefix is None:
+                return None
+            node = self._node_with_prefix(prefix)
+        return node
+
+    async def _layer_of(self, tr, node: Subspace) -> bytes:
+        return (await tr.get(node.pack((b"layer",)))) or b""
+
+    def _contents(self, path: tuple, node: Subspace, layer: bytes) -> DirectorySubspace:
+        return DirectorySubspace(path, self._prefix_of(node), self, layer)
+
+    # -- public API ----------------------------------------------------------
+
+    async def create_or_open(self, tr, path, layer: bytes = b"") -> DirectorySubspace:
+        return await self._create_or_open(tr, _to_path(path), layer,
+                                          allow_create=True, allow_open=True)
+
+    async def open(self, tr, path, layer: bytes = b"") -> DirectorySubspace:
+        return await self._create_or_open(tr, _to_path(path), layer,
+                                          allow_create=False, allow_open=True)
+
+    async def create(self, tr, path, layer: bytes = b"",
+                     prefix: bytes | None = None) -> DirectorySubspace:
+        return await self._create_or_open(tr, _to_path(path), layer, prefix=prefix,
+                                          allow_create=True, allow_open=False)
+
+    async def _create_or_open(self, tr, path: tuple, layer: bytes,
+                              prefix: bytes | None = None, *,
+                              allow_create: bool, allow_open: bool) -> DirectorySubspace:
+        if not path:
+            raise DirectoryError("the root directory cannot be opened")
+        await self._check_version(tr, write=False)
+        node = await self._find(tr, path)
+        if node is not None:
+            if not allow_open:
+                raise DirectoryAlreadyExists(f"{path!r} already exists")
+            existing = await self._layer_of(tr, node)
+            if layer and existing != layer:
+                raise DirectoryError(
+                    f"{path!r} was created with layer {existing!r}, not {layer!r}")
+            return self._contents(path, node, existing)
+        if not allow_create:
+            raise DirectoryDoesNotExist(f"{path!r} does not exist")
+
+        await self._check_version(tr, write=True)
+        if prefix is None:
+            prefix = self._content_ss.key + await self._allocator.allocate(tr)
+            if await self._has_keys(tr, prefix):
+                raise DirectoryError(
+                    f"allocated prefix {prefix!r} is not empty; database "
+                    "was manually modified")
+        else:
+            if await self._has_keys(tr, prefix) or await self._is_prefix_in_use(tr, prefix):
+                raise DirectoryError(f"requested prefix {prefix!r} is in use")
+
+        if len(path) > 1:
+            parent = await self._create_or_open(tr, path[:-1], b"",
+                                                allow_create=True, allow_open=True)
+            parent_node = self._node_with_prefix(parent.key)
+        else:
+            parent_node = self._root_node
+        node = self._node_with_prefix(prefix)
+        tr.set(parent_node.pack((_SUBDIRS, path[-1])), prefix)
+        tr.set(node.pack((b"layer",)), layer)
+        return self._contents(path, node, layer)
+
+    async def _has_keys(self, tr, prefix: bytes) -> bool:
+        kvs = await tr.get_range(prefix, strinc(prefix), limit=1)
+        return bool(kvs)
+
+    async def _is_prefix_in_use(self, tr, prefix: bytes) -> bool:
+        """A registered prefix collides if it contains or is contained by
+        `prefix`. Two bounded reads (reference: _is_prefix_free): any node
+        key inside the candidate's tuple range is a contained directory; the
+        last node key at-or-before the candidate is the only possible
+        enclosing one (bytes pack order-preservingly, so an enclosing
+        prefix's node key sorts immediately before)."""
+        inside = await tr.get_range(
+            self._node_ss.pack((prefix,)), self._node_ss.pack((strinc(prefix),)),
+            limit=1)
+        if inside:
+            return True
+        before = await tr.get_range(
+            self._node_ss.key, self._node_ss.pack((prefix,)) + b"\x00",
+            limit=1, reverse=True)
+        for k, _ in before:
+            try:
+                p = self._node_ss.unpack(k)[0]
+            except Exception:
+                continue
+            if isinstance(p, bytes) and prefix.startswith(p):
+                return True
+        return False
+
+    async def list(self, tr, path=()) -> list[str]:
+        await self._check_version(tr, write=False)
+        path = _to_path(path)
+        node = self._root_node if not path else await self._find(tr, path)
+        if node is None:
+            raise DirectoryDoesNotExist(f"{path!r} does not exist")
+        begin, end = node.range((_SUBDIRS,))
+        sub = node.subspace((_SUBDIRS,))
+        return [sub.unpack(k)[0] for k, _ in await tr.get_range(begin, end)]
+
+    async def exists(self, tr, path) -> bool:
+        await self._check_version(tr, write=False)
+        return await self._find(tr, _to_path(path)) is not None
+
+    async def move(self, tr, old_path, new_path) -> DirectorySubspace:
+        await self._check_version(tr, write=True)
+        old_path, new_path = _to_path(old_path), _to_path(new_path)
+        if new_path[: len(old_path)] == old_path:
+            raise DirectoryError("cannot move a directory into its own subtree")
+        old_node = await self._find(tr, old_path)
+        if old_node is None:
+            raise DirectoryDoesNotExist(f"{old_path!r} does not exist")
+        if await self._find(tr, new_path) is not None:
+            raise DirectoryAlreadyExists(f"{new_path!r} already exists")
+        parent = await self._find(tr, new_path[:-1]) if len(new_path) > 1 else self._root_node
+        if parent is None:
+            raise DirectoryDoesNotExist(f"parent of {new_path!r} does not exist")
+        prefix = self._prefix_of(old_node)
+        tr.set(parent.pack((_SUBDIRS, new_path[-1])), prefix)
+        old_parent = (await self._find(tr, old_path[:-1])
+                      if len(old_path) > 1 else self._root_node)
+        tr.clear(old_parent.pack((_SUBDIRS, old_path[-1])))
+        return self._contents(new_path, old_node, await self._layer_of(tr, old_node))
+
+    async def remove(self, tr, path) -> bool:
+        """Remove the directory, its contents, and all subdirectories.
+        Returns False if it didn't exist (reference: remove_if_exists)."""
+        await self._check_version(tr, write=True)
+        path = _to_path(path)
+        if not path:
+            raise DirectoryError("the root directory cannot be removed")
+        node = await self._find(tr, path)
+        if node is None:
+            return False
+        await self._remove_recursive(tr, node)
+        parent = await self._find(tr, path[:-1]) if len(path) > 1 else self._root_node
+        tr.clear(parent.pack((_SUBDIRS, path[-1])))
+        return True
+
+    async def _remove_recursive(self, tr, node: Subspace) -> None:
+        begin, end = node.range((_SUBDIRS,))
+        for _, child_prefix in await tr.get_range(begin, end):
+            await self._remove_recursive(tr, self._node_with_prefix(child_prefix))
+        prefix = self._prefix_of(node)
+        tr.clear_range(prefix, strinc(prefix))  # contents
+        tr.clear_range(node.key, strinc(node.key))  # metadata

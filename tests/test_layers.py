@@ -1,0 +1,313 @@
+"""Tuple + subspace + directory layer tests.
+
+Mirrors the reference binding tester's tuple round-trip / ordering checks
+(bindings/bindingtester/tests/api.py) and directory layer spec tests."""
+
+import random
+import struct
+import uuid
+
+import pytest
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.layers import (
+    DirectoryAlreadyExists,
+    DirectoryDoesNotExist,
+    DirectoryLayer,
+    SingleFloat,
+    Subspace,
+    TupleError,
+    Versionstamp,
+    pack,
+    pack_with_versionstamp,
+    range_of,
+    strinc,
+    unpack,
+)
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+SAMPLES = [
+    (),
+    (None,),
+    (b"",),
+    (b"\x00",),
+    (b"foo\x00bar",),
+    ("",),
+    ("héllo",),
+    ("a\x00b",),
+    (0,),
+    (1,),
+    (-1,),
+    (255,),
+    (256,),
+    (-255,),
+    (-256,),
+    (2**63 - 1,),
+    (-(2**63),),
+    (2**100,),
+    (-(2**100),),
+    (1.5,),
+    (-1.5,),
+    (0.0,),
+    (float("inf"),),
+    (float("-inf"),),
+    (SingleFloat(2.5),),
+    (True,),
+    (False,),
+    (uuid.UUID(int=0x1234567890ABCDEF1234567890ABCDEF),),
+    (Versionstamp(b"\x00" * 10, 7),),
+    ((1, b"nested", None),),
+    ((1, (2, (3,))),),
+    (1, "two", b"three", (4, None), 5.0),
+]
+
+
+class TestTupleRoundTrip:
+    @pytest.mark.parametrize("t", SAMPLES, ids=repr)
+    def test_round_trip(self, t):
+        assert unpack(pack(t)) == t
+
+    def test_bool_is_not_int(self):
+        assert unpack(pack((True,))) == (True,)
+        assert unpack(pack((1,)))[0] == 1 and unpack(pack((1,)))[0] is not True
+
+    def test_float32_round_trip(self):
+        (f,) = unpack(pack((SingleFloat(3.25),)))
+        assert isinstance(f, SingleFloat) and f.value == 3.25
+
+
+def _sort_key(item):
+    # Semantic ordering of the tuple layer: by type code, then value.
+    if item is None:
+        return (0x00,)
+    if isinstance(item, bool):
+        return (0x26, item)
+    if isinstance(item, bytes):
+        return (0x01, item)
+    if isinstance(item, str):
+        return (0x02, item.encode())
+    if isinstance(item, int):
+        return (0x14, item)
+    if isinstance(item, float):
+        return (0x21, item)
+    raise AssertionError(item)
+
+
+class TestTupleOrdering:
+    def test_int_ordering_exhaustive_small(self):
+        vals = list(range(-300, 301))
+        packed = [pack((v,)) for v in vals]
+        assert packed == sorted(packed)
+
+    def test_int_ordering_random_wide(self):
+        rnd = random.Random(7)
+        vals = sorted(
+            rnd.randrange(-(2**80), 2**80) for _ in range(500)
+        )
+        packed = [pack((v,)) for v in vals]
+        assert packed == sorted(packed)
+
+    def test_float_ordering(self):
+        rnd = random.Random(8)
+        vals = sorted(
+            [rnd.uniform(-1e9, 1e9) for _ in range(300)]
+            + [0.0, -0.5, float("inf"), float("-inf"), 1e-300, -1e-300]
+        )
+        packed = [pack((v,)) for v in vals]
+        assert packed == sorted(packed)
+
+    def test_mixed_element_ordering(self):
+        rnd = random.Random(9)
+        pool = [
+            None, b"a", b"ab", b"b", "a", "b", -5, 0, 3, 2**70, -(2**70),
+            1.5, -2.5, True, False,
+        ]
+        items = [rnd.choice(pool) for _ in range(400)]
+        semantic = sorted(items, key=_sort_key)
+        bytewise = sorted(items, key=lambda i: pack((i,)))
+        assert [pack((i,)) for i in semantic] == [pack((i,)) for i in bytewise]
+
+    def test_prefix_tuple_sorts_before_extension(self):
+        assert pack((1,)) < pack((1, 0)) < pack((2,))
+
+    def test_range_covers_extensions_only(self):
+        begin, end = range_of((1,))
+        assert begin <= pack((1, b"x")) < end
+        assert begin <= pack((1, 2, 3)) < end
+        assert not (begin <= pack((1,)) < end)
+        assert not (begin <= pack((2,)) < end)
+
+
+class TestVersionstampPack:
+    def test_incomplete_in_plain_pack_raises(self):
+        with pytest.raises(TupleError):
+            pack((Versionstamp(),))
+
+    def test_pack_with_versionstamp_offset(self):
+        b = pack_with_versionstamp(("k", Versionstamp(user_version=3)), prefix=b"pfx")
+        off = struct.unpack("<I", b[-4:])[0]
+        assert b[off : off + 10] == b"\xff" * 10
+        # After the 10-byte hole come the 2 user-version bytes.
+        assert b[off + 10 : off + 12] == struct.pack(">H", 3)
+
+    def test_two_incomplete_raises(self):
+        with pytest.raises(TupleError):
+            pack_with_versionstamp((Versionstamp(), Versionstamp()))
+
+
+class TestSubspace:
+    def test_pack_unpack_contains(self):
+        s = Subspace(("app", 1))
+        k = s.pack(("x", 2))
+        assert s.contains(k)
+        assert s.unpack(k) == ("x", 2)
+        assert not s.contains(b"zzz")
+        with pytest.raises(TupleError):
+            s.unpack(b"zzz")
+
+    def test_getitem_nesting(self):
+        s = Subspace(("a",))["b"][3]
+        assert s.key == pack(("a", "b", 3))
+
+    def test_strinc(self):
+        assert strinc(b"a") == b"b"
+        assert strinc(b"a\xff\xff") == b"b"
+        assert strinc(b"\x01\x02") == b"\x01\x03"
+
+
+def make_db(seed=0, **kw):
+    c = SimCluster(seed=seed, **kw)
+    return c, open_database(c)
+
+
+def run(c, coro, timeout=300):
+    return c.loop.run(coro, timeout=timeout)
+
+
+class TestDirectoryLayer:
+    def test_create_open_list_remove(self):
+        c, db = make_db(11)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                d = await dl.create_or_open(tr, ("app", "users"))
+                tr.set(d.pack((42,)), b"alice")
+                return d
+
+            d = await db.run(body)
+            assert d.path == ("app", "users")
+
+            async def check(tr):
+                d2 = await dl.open(tr, ("app", "users"))
+                assert d2.key == d.key
+                assert await tr.get(d2.pack((42,))) == b"alice"
+                assert await dl.list(tr, ("app",)) == ["users"]
+                assert await dl.list(tr) == ["app"]
+                assert await dl.exists(tr, ("app", "users"))
+                assert not await dl.exists(tr, ("app", "nope"))
+
+            await db.run(check)
+
+            async def rm(tr):
+                assert await dl.remove(tr, ("app",))
+
+            await db.run(rm)
+
+            async def gone(tr):
+                assert not await dl.exists(tr, ("app", "users"))
+                # Contents cleared too.
+                assert await tr.get(d.pack((42,))) is None
+
+            await db.run(gone)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_create_exclusive_and_open_missing(self):
+        c, db = make_db(12)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                await dl.create(tr, "solo")
+                with pytest.raises(DirectoryAlreadyExists):
+                    await dl.create(tr, "solo")
+                with pytest.raises(DirectoryDoesNotExist):
+                    await dl.open(tr, "missing")
+
+            await db.run(body)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_layer_mismatch(self):
+        c, db = make_db(13)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                await dl.create_or_open(tr, "d", layer=b"queue")
+                await dl.open(tr, "d", layer=b"queue")  # matching layer ok
+                with pytest.raises(Exception):
+                    await dl.open(tr, "d", layer=b"other")
+
+            await db.run(body)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_move(self):
+        c, db = make_db(14)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                d = await dl.create_or_open(tr, ("a", "b"))
+                tr.set(d.pack(("data",)), b"v")
+                return d
+
+            d = await db.run(body)
+
+            async def mv(tr):
+                moved = await dl.move(tr, ("a", "b"), ("c",))
+                assert moved.key == d.key  # prefix survives the move
+
+            await db.run(mv)
+
+            async def check(tr):
+                assert not await dl.exists(tr, ("a", "b"))
+                d2 = await dl.open(tr, ("c",))
+                assert await tr.get(d2.pack(("data",))) == b"v"
+
+            await db.run(check)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_unique_prefixes_under_contention(self):
+        c, db = make_db(15)
+        dl = DirectoryLayer()
+
+        async def main():
+            names = [f"d{i}" for i in range(20)]
+
+            async def mk(name):
+                async def body(tr):
+                    return (await dl.create_or_open(tr, name)).key
+
+                return await db.run(body)
+
+            from foundationdb_tpu.runtime.flow import all_of
+
+            prefixes = await all_of([c.loop.spawn(mk(n)) for n in names])
+            assert len(set(prefixes)) == len(names)
+            # No allocated prefix is a prefix of another (keyspace disjoint).
+            for i, p in enumerate(prefixes):
+                for j, q in enumerate(prefixes):
+                    if i != j:
+                        assert not p.startswith(q)
+            return "ok"
+
+        assert run(c, main()) == "ok"
