@@ -187,6 +187,7 @@ def open_database(cluster) -> Database:
         cluster.commit_proxy_eps,
         cluster.storage_map,
         cluster.storage_eps,
+        controller_ep=getattr(cluster, "controller_ep", None),
     )
     db.transaction_class = RYWTransaction  # RYW is the default surface
     return db

@@ -15,7 +15,13 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from foundationdb_tpu.core.errors import FdbError, UsedDuringCommit
+from foundationdb_tpu.core.errors import (
+    CommitUnknownResult,
+    FdbError,
+    ProcessKilled,
+    UsedDuringCommit,
+)
+from foundationdb_tpu.runtime.flow import BrokenPromise
 from foundationdb_tpu.core.mutations import (
     ATOMIC_OPS,
     Mutation,
@@ -76,14 +82,31 @@ class Database:
         commit_proxy_eps: list,
         storage_map: KeyShardMap,
         storage_eps: list,
+        controller_ep=None,
     ):
         self.loop = loop
         self.grv_proxies = grv_proxy_eps
         self.commit_proxies = commit_proxy_eps
         self.storage_map = storage_map
         self.storage_eps = storage_eps
+        self.controller = controller_ep
+        self.epoch = 1
         self._rr = 0
         self.transaction_class = Transaction  # ryw.open_database swaps in RYW
+
+    async def refresh_client_info(self) -> None:
+        """Re-fetch proxy endpoints from the cluster controller — how clients
+        ride through recovery (reference: clients monitor ClientDBInfo and
+        swap proxy connections when the epoch changes)."""
+        if self.controller is None:
+            return
+        try:
+            info = await self.controller.get_client_info()
+        except Exception:
+            return  # controller briefly unreachable: keep stale info, retry later
+        self.epoch = info.epoch
+        self.grv_proxies = list(info.grv_proxy_eps)
+        self.commit_proxies = list(info.commit_proxy_eps)
 
     def _pick(self, eps: list):
         self._rr += 1
@@ -130,7 +153,14 @@ class Transaction:
 
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            self._read_version = await self.db._pick(self.db.grv_proxies).get_read_version()
+            try:
+                self._read_version = await self.db._pick(
+                    self.db.grv_proxies
+                ).get_read_version()
+            except BrokenPromise as e:
+                # Dead/retired GRV proxy: retryable — on_error refreshes the
+                # proxy list from the controller before the next attempt.
+                raise ProcessKilled(str(e)) from e
         return self._read_version
 
     def set_read_version(self, version: int) -> None:
@@ -315,7 +345,12 @@ class Transaction:
             read_ranges=list(self.read_ranges),
             write_ranges=list(self.write_ranges),
         )
-        res = await self.db._pick(self.db.commit_proxies).commit(req)
+        try:
+            res = await self.db._pick(self.db.commit_proxies).commit(req)
+        except BrokenPromise as e:
+            # Proxy died mid-commit: the batch may or may not have reached
+            # the tlogs — exactly commit_unknown_result.
+            raise CommitUnknownResult(str(e)) from e
         self._committed = (res.version, res.batch_order)
         self._arm_watches()
         return res.version
@@ -342,6 +377,10 @@ class Transaction:
         self._backoff = min(self.MAX_BACKOFF, self._backoff * 2)
         self._reset()
         await self.db.loop.sleep(backoff * (0.5 + self.db.loop.rng.random()))
+        # Only errors that can signal a generation change warrant a trip to
+        # the controller — plain conflict retries must stay proxy-local.
+        if isinstance(e, (CommitUnknownResult, ProcessKilled)):
+            await self.db.refresh_client_info()
 
 
 def _check_key(key: bytes) -> None:

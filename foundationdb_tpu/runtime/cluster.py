@@ -1,0 +1,169 @@
+"""Cluster controller: role liveness monitoring, recruitment, client info.
+
+Reference: fdbserver/ClusterController.actor.cpp. The controller owns the
+current transaction-subsystem *generation* (sequencer, resolvers, tlogs,
+proxies, ratekeeper — everything recovery replaces as a unit), detects
+failure of any generation process via heartbeats, and drives recovery
+(runtime/recovery.py) to recruit the next generation. Clients fetch the
+current proxy endpoints through ``get_client_info`` (reference:
+OpenDatabaseRequest → ClientDBInfo) and refresh it when their cached
+endpoints break.
+
+Recruitment itself is delegated to a *recruiter* supplied by the harness
+(sim/cluster.py): the controller decides WHEN to form a generation, the
+recruiter knows HOW to place role objects on processes. Coordinator disk
+Paxos (Coordination.actor.cpp) is not modelled: the controller is a
+singleton the harness never kills, standing in for the elected CC the
+coordinator quorum would produce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.runtime.flow import Loop
+
+
+class Heartbeat:
+    """Per-process liveness probe. Hosted on every generation process; a
+    killed process fails the RPC with BrokenPromise after the network's
+    failure-detection delay, which is the failure signal (reference:
+    failureDetectionServer / TransportData heartbeats)."""
+
+    async def ping(self) -> str:
+        return "pong"
+
+
+@dataclass
+class Generation:
+    """One recovery epoch's transaction subsystem (reference: the role set
+    recruited by one pass of masterserver recovery)."""
+
+    epoch: int
+    recovery_version: int
+    sequencer_ep: object
+    resolver_eps: list
+    tlog_eps: list
+    grv_proxy_eps: list
+    commit_proxy_eps: list
+    ratekeeper_ep: object
+    # process name -> heartbeat endpoint; the controller's watch list.
+    heartbeat_eps: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ClientDBInfo:
+    """What clients need to route requests (reference: ClientDBInfo)."""
+
+    epoch: int
+    grv_proxy_eps: tuple
+    commit_proxy_eps: tuple
+
+
+class ClusterController:
+    HEARTBEAT_INTERVAL = 0.25  # virtual seconds between liveness sweeps
+    RECOVERY_RETRY_DELAY = 0.5
+
+    def __init__(self, loop: Loop, recruiter):
+        self.loop = loop
+        self.recruiter = recruiter
+        self.generation: Generation | None = None
+        self.recoveries_completed = 0
+        self._recovering = False
+
+    def bootstrap(self) -> None:
+        """Recruit generation 1 (initial, non-recovery startup)."""
+        assert self.generation is None
+        self.generation = self.recruiter.recruit_generation(
+            epoch=1, recovery_version=0, seed_entries=[]
+        )
+
+    # -- client face ----------------------------------------------------------
+
+    async def get_client_info(self) -> ClientDBInfo:
+        g = self.generation
+        return ClientDBInfo(g.epoch, tuple(g.grv_proxy_eps), tuple(g.commit_proxy_eps))
+
+    async def request_recovery(self, epoch: int, reason: str) -> None:
+        """A role observed the transaction pipeline wedged (e.g. a version-
+        chain gap after lost pushes) — something heartbeats cannot see, since
+        every process is alive. Forcing a generation change is the universal
+        repair (reference: proxies/master force recovery on tlog failure).
+        `epoch` guards against stale requests from an already-replaced
+        generation."""
+        if self._recovering or self.generation is None:
+            return
+        if epoch != self.generation.epoch:
+            return  # stale: that generation is already being replaced
+        self.loop.spawn(
+            self._recover(reason=f"requested: {reason}"),
+            process="cluster_controller",
+            name="cc.requested_recovery",
+        )
+
+    async def get_status(self) -> dict:
+        """Controller section of the status document (runtime/status.py)."""
+        g = self.generation
+        return {
+            "epoch": g.epoch,
+            "recovery_version": g.recovery_version,
+            "recoveries_completed": self.recoveries_completed,
+            "recovering": self._recovering,
+            "generation_processes": sorted(g.heartbeat_eps),
+        }
+
+    # -- failure detection ----------------------------------------------------
+
+    async def run(self) -> None:
+        """Liveness sweep: ping every generation process; any failure (or a
+        stale generation found mid-sweep) triggers recovery of the whole
+        transaction subsystem, like the reference's betterMasterExists /
+        failure-triggered recovery."""
+        while True:
+            await self.loop.sleep(self.HEARTBEAT_INTERVAL)
+            if self._recovering or self.generation is None:
+                continue
+            failed = await self._sweep(self.generation)
+            if failed:
+                await self._recover(reason=f"process {failed!r} failed heartbeat")
+
+    async def _sweep(self, gen: Generation) -> str | None:
+        """Ping all generation processes in parallel: one sweep costs one
+        failure-detection delay even with several dead processes."""
+        pings = [
+            (process, self.loop.spawn(hb.ping(), name=f"cc.ping.{process}"))
+            for process, hb in gen.heartbeat_eps.items()
+        ]
+        failed = None
+        for process, t in pings:
+            try:
+                await t
+            except Exception:
+                failed = failed or process
+        if self.generation is not gen:
+            return None  # generation changed under the sweep
+        return failed
+
+    async def _recover(self, reason: str) -> None:
+        from foundationdb_tpu.runtime.recovery import RecoveryFailed, recover
+
+        if self._recovering:
+            return  # a concurrent trigger (sweep vs request) already won
+        self._recovering = True
+        try:
+            old = self.generation
+            while True:
+                try:
+                    self.generation = await recover(
+                        self.loop, old, self.recruiter, epoch=old.epoch + 1
+                    )
+                    self.recoveries_completed += 1
+                    return
+                except RecoveryFailed:
+                    # Not enough of the old generation reachable to determine
+                    # the recovery version — wait for processes/partitions to
+                    # heal and try again (reference: recovery stalls in
+                    # locking_cstate until a tlog quorum rejoins).
+                    await self.loop.sleep(self.RECOVERY_RETRY_DELAY)
+        finally:
+            self._recovering = False

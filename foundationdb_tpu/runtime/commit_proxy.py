@@ -58,6 +58,8 @@ class CommitProxy:
         resolver_map: KeyShardMap,
         tlog_eps: list,
         storage_map: KeyShardMap,
+        controller_ep=None,
+        epoch: int = 1,
     ):
         assert resolver_map.n_shards == len(resolver_eps)
         self.loop = loop
@@ -66,9 +68,15 @@ class CommitProxy:
         self.resolver_map = resolver_map
         self.tlogs = tlog_eps
         self.storage_map = storage_map
+        self.controller = controller_ep
+        self.epoch = epoch
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self.txns_committed = 0
         self.txns_conflicted = 0
+        # Highest batch version this proxy has seen durable on ALL tlogs;
+        # piggybacked on pushes so storage can bound its GC floor
+        # (reference: knownCommittedVersion).
+        self._known_committed = 0
 
     # -- client face ----------------------------------------------------------
 
@@ -98,7 +106,41 @@ class CommitProxy:
                 name=f"commit_batch@{version}",
             )
 
+    # A batch stuck this long means the version chain is wedged (a gap from
+    # lost pushes, or a peer's batch never arriving) — a state heartbeats
+    # can't see because every process is alive. Ask the controller to force
+    # recovery; the new generation retires this proxy and unwinds the batch.
+    # Must exceed _with_retry's worst case (RPC_RETRIES × (failure-detection
+    # delay + backoff) ≈ 4.4s) so the ladder's tail is reachable: transient
+    # blips resolve by retry, only longer outages pay a generation change.
+    WEDGE_TIMEOUT = 6.0
+
     async def _process(
+        self,
+        batch: list[tuple[CommitRequest, Promise]],
+        prev_version: int,
+        version: int,
+    ) -> None:
+        watchdog = self.loop.spawn(
+            self._wedge_watchdog(version), name=f"wedge_watchdog@{version}"
+        )
+        try:
+            await self._process_inner(batch, prev_version, version)
+        finally:
+            watchdog.cancel()
+
+    async def _wedge_watchdog(self, version: int) -> None:
+        await self.loop.sleep(self.WEDGE_TIMEOUT)
+        if self.controller is not None:
+            await self._request_recovery(f"commit batch@{version} wedged")
+
+    async def _request_recovery(self, reason: str) -> None:
+        try:
+            await self.controller.request_recovery(self.epoch, reason)
+        except Exception:
+            pass  # controller unreachable: the heartbeat sweep is the backstop
+
+    async def _process_inner(
         self,
         batch: list[tuple[CommitRequest, Promise]],
         prev_version: int,
@@ -107,15 +149,19 @@ class CommitProxy:
         try:
             verdicts = await self._resolve(batch, prev_version, version)
             tagged = self._assemble(batch, verdicts, version)
+            kc = self._known_committed
             await all_of(
                 [
                     self.loop.spawn(
-                        self._with_retry(lambda t=t: t.push(prev_version, version, tagged)),
+                        self._with_retry(
+                            lambda t=t: t.push(prev_version, version, tagged, kc)
+                        ),
                         name=f"tlog_push@{version}",
                     )
                     for t in self.tlogs
                 ]
             )
+            self._known_committed = max(self._known_committed, version)
             await self.sequencer.report_committed(version)
         except Exception:
             # Resolver/tlog unreachable or locked mid-batch: the batch's fate
@@ -123,6 +169,16 @@ class CommitProxy:
             # commit_unknown_result, and clients retry idempotently.
             for _req, p in batch:
                 p.fail(CommitUnknownResult(f"batch@{version} failed"))
+            # Surviving the whole retry ladder means a generation member was
+            # continuously unreachable (or locked) for seconds — and the
+            # failed batch may have left a gap in the tlog version chain.
+            # Treat it as a role failure and force recovery (reference: the
+            # master marks a tlog failed on push failure and recovers).
+            if self.controller is not None:
+                self.loop.spawn(
+                    self._request_recovery(f"batch@{version} failed its push/resolve"),
+                    name=f"request_recovery@{version}",
+                )
             return
         for i, ((_req, p), v) in enumerate(zip(batch, verdicts)):
             if v == Verdict.COMMITTED:
@@ -134,7 +190,7 @@ class CommitProxy:
                 self.txns_conflicted += 1
                 p.fail(NotCommitted())
 
-    RPC_RETRIES = 8
+    RPC_RETRIES = 4  # worst case ~4.4s — must finish under WEDGE_TIMEOUT
 
     async def _with_retry(self, make_call):
         """Retry a chain-ordered RPC through transient unreachability; the

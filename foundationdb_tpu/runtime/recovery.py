@@ -1,0 +1,74 @@
+"""Recovery: replace the transaction subsystem after a role failure.
+
+Reference: the recovery state machine in fdbserver/masterserver.actor.cpp
+(READING_CSTATE → LOCKING_TLOGS → RECRUITING → ACCEPTING_COMMITS),
+compressed to the steps that matter for a single-region cluster whose
+tlogs are full replicas:
+
+1. **Lock** every reachable old-generation tlog. A locked tlog refuses
+   further pushes, freezing its end version — in-flight batches racing the
+   lock fail back to their proxy as commit_unknown_result.
+2. **Determine the recovery version**: the max end version among locked
+   tlogs. Our tlogs carry identical chains (every proxy pushes every batch
+   to every tlog), so any one locked tlog bounds what could have been
+   acked; the max over the locked set dominates every acked commit. At
+   least one tlog must be reachable — with none, the durable suffix is
+   unknown and recovery must wait (RecoveryFailed → controller retries).
+3. **Salvage** the un-popped suffix of the chosen tlog's log: entries some
+   storage server may not have pulled yet. These seed the new tlogs so
+   storage can finish pulling from the new generation (the reference's
+   equivalent: new-epoch tlogs peek the old generation's logs).
+4. **Recruit** the next generation at ``recovery_version + EPOCH_VERSION_JUMP``
+   — the version gap guarantees nothing the dead generation had in flight
+   can collide — and re-point surviving storage servers at the new tlogs.
+
+Resolver conflict state is deliberately NOT carried over: the version jump
+puts every pre-recovery read version below the new MVCC window floor, so
+in-flight transactions resolve TOO_OLD and retry at a fresh read version —
+exactly the reference's behavior across recoveries.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.runtime.cluster import Generation
+from foundationdb_tpu.runtime.flow import Loop
+
+
+class RecoveryFailed(FdbError):
+    """No tlog reachable to lock — recovery version unknowable (reference:
+    master_recovery_failed, error 1203)."""
+
+    code = 1203
+
+
+async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generation:
+    # 1+2. Lock reachable tlogs; take the max frozen end version. Locks go
+    # out in parallel so k unreachable tlogs cost ONE failure-detection
+    # delay, not k — every extra second here widens the window in which
+    # unlocked tlogs accept pushes recovery will orphan.
+    tasks = [
+        loop.spawn(ep.lock(), name=f"recovery.lock@e{epoch}") for ep in old.tlog_eps
+    ]
+    locked: list[tuple[int, object]] = []
+    for ep, t in zip(old.tlog_eps, tasks):
+        try:
+            locked.append((await t, ep))
+        except Exception:
+            continue  # dead/partitioned tlog — proceed with the rest
+    if not locked:
+        raise RecoveryFailed(f"epoch {epoch}: no old-generation tlog reachable")
+    recovery_version, source_ep = max(locked, key=lambda e: e[0])
+
+    # 3. Salvage the un-popped suffix from the most-advanced locked tlog.
+    try:
+        seed_entries = await source_ep.recover_entries()
+    except Exception:
+        raise RecoveryFailed(
+            f"epoch {epoch}: tlog died between lock and salvage"
+        ) from None
+
+    # 4. Recruit the next generation (also re-points storage servers).
+    return recruiter.recruit_generation(
+        epoch=epoch, recovery_version=recovery_version, seed_entries=seed_entries
+    )

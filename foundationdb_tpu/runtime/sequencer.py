@@ -26,12 +26,20 @@ class Sequencer:
         # epoch handed out — lost in-flight batches can never collide.
         self._version = recovery_version + EPOCH_VERSION_JUMP if epoch > 1 else 0
         self._committed = self._version
+        # Clock base: versions advance ~1M/s RELATIVE to epoch start. An
+        # absolute clock would stall after the epoch jump (prev >> now*1M for
+        # ~90 virtual seconds), detaching the MVCC window from time.
+        self._base_version = self._version
+        self._epoch_start = loop.now
 
     async def get_commit_version(self) -> tuple[int, int]:
         """→ (prev_version, version): one per proxy batch; strictly advancing,
         paced by virtual time so the version clock tracks ~1M/s."""
         prev = self._version
-        self._version = max(prev + 1, int(self.loop.now * VERSIONS_PER_SECOND))
+        clock = self._base_version + int(
+            (self.loop.now - self._epoch_start) * VERSIONS_PER_SECOND
+        )
+        self._version = max(prev + 1, clock)
         return prev, self._version
 
     async def report_committed(self, version: int) -> None:

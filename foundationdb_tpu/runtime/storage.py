@@ -19,7 +19,7 @@ import bisect
 
 from foundationdb_tpu.core.errors import FutureVersion, TransactionTooOld
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
-from foundationdb_tpu.runtime.flow import Loop, Promise, any_of
+from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 
 
@@ -59,6 +59,21 @@ class VersionedMap:
         hi = bisect.bisect_left(self._keys, end)
         return self._keys[lo:hi]
 
+    def rollback(self, version: int) -> None:
+        """Discard every write above `version` (recovery: storage may have
+        pulled entries from a tlog whose durable suffix was lost with it)."""
+        dead: list[bytes] = []
+        for key, chain in self._chains.items():
+            i = bisect.bisect_right(chain, version, key=lambda e: e[0])
+            if i < len(chain):
+                del chain[i:]
+            if not chain:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            i = bisect.bisect_left(self._keys, key)
+            del self._keys[i]
+
     def gc(self, floor: int) -> None:
         """Drop chain entries superseded before `floor`; fully remove keys
         whose only surviving state is an old tombstone."""
@@ -83,31 +98,72 @@ class StorageServer:
         self.loop = loop
         self.tag = tag
         self.tlog = tlog_ep
+        self._tlog_gen = 0  # bumped by recover_to; fences in-flight peeks
         self.map = VersionedMap()
         self._version = init_version  # applied through this version
         self.oldest_version = 0  # MVCC window floor
+        self.known_committed = 0  # acked-on-all-tlogs bound, off peek replies
         self._version_waiters: list[tuple[int, Promise]] = []
         self._watches: dict[bytes, list[tuple[bytes | None, Promise]]] = {}
         self._running = False
 
     # -- write path (tlog pull) ----------------------------------------------
 
+    TLOG_RETRY = 0.05  # backoff while our tlog is unreachable/locked
+
     async def run(self) -> None:
-        """Main pull loop actor; also drives MVCC GC."""
+        """Main pull loop actor; also drives MVCC GC. Survives tlog death:
+        an unreachable or recovery-locked tlog just parks the loop until
+        recovery re-points us at the new generation (recover_to)."""
         self._running = True
         last_gc = self.loop.now
         while True:
-            entries, end_version = await self.tlog.peek(self.tag, self._version + 1)
-            for version, mutations in entries:
-                self._apply(version, mutations)
-            if end_version > self._version:
-                self._advance(end_version)  # mutation-free versions (idle tag)
-            if entries:
-                await self.tlog.pop(self.tag, self._version)
+            try:
+                gen, tlog = self._tlog_gen, self.tlog
+                entries, end_version, known_committed = await tlog.peek(
+                    self.tag, self._version + 1
+                )
+                if gen != self._tlog_gen:
+                    continue  # stale reply from a pre-recovery tlog: discard
+                self.known_committed = max(self.known_committed, known_committed)
+                before = self._version
+                for version, mutations in entries:
+                    self._apply(version, mutations)
+                if end_version > self._version:
+                    self._advance(end_version)  # mutation-free versions (idle tag)
+                if self._version > before:
+                    # Pop on every advance (not just on mutations) so cold
+                    # tags still raise the tlog's trim floor — without this a
+                    # salvage-seeded tag that never sees new writes pins the
+                    # floor at 0 and the log grows without bound.
+                    await tlog.pop(self.tag, self._version)
+            except BrokenPromise:
+                # Only unreachability is survivable; apply-path errors are
+                # real bugs and must crash the actor, not spin silently.
+                await self.loop.sleep(self.TLOG_RETRY)
+                continue
             if self.loop.now - last_gc >= self.GC_INTERVAL:
                 self._gc()
                 last_gc = self.loop.now
             await self.loop.sleep(self.PULL_INTERVAL)
+
+    def recover_to(self, recovery_version: int, tlog_ep) -> None:
+        """Recovery handoff: discard applied state above the recovery version
+        (this server may have pulled writes whose durable suffix died with
+        its tlog — the reference's storage rollback), then pull from the new
+        generation's tlog. Called directly by the recruiter (the harness owns
+        these objects; an RPC could be lost to the very partition recovery is
+        healing).
+
+        Watches are NOT re-evaluated: one armed on a rolled-back (unacked)
+        write has already fired. That is the reference's documented watch
+        contract — watches may fire spuriously and clients must re-read —
+        so rollback keeps it, rather than tracking fired-watch provenance."""
+        if self._version > recovery_version:
+            self.map.rollback(recovery_version)
+            self._version = recovery_version
+        self.tlog = tlog_ep
+        self._tlog_gen += 1  # invalidate any in-flight old-generation peek
 
     def _apply(self, version: int, mutations: list[Mutation]) -> None:
         assert version > self._version
@@ -128,7 +184,13 @@ class StorageServer:
 
     def _advance(self, version: int) -> None:
         self._version = version
-        self.oldest_version = max(self.oldest_version, version - MVCC_WINDOW_VERSIONS)
+        # The GC floor must never pass known_committed: versions above it may
+        # be an unacked suffix of our one tlog that recovery rolls back, and
+        # GC past them would discard the acked values rollback restores.
+        self.oldest_version = max(
+            self.oldest_version,
+            min(version - MVCC_WINDOW_VERSIONS, self.known_committed),
+        )
         still = []
         for want, p in self._version_waiters:
             (p.send(None) if want <= version else still.append((want, p)))

@@ -30,17 +30,35 @@ class TLogLocked(Exception):
 class TLog:
     FSYNC_SECONDS = 0.0005  # simulated durable-write latency per push
 
-    def __init__(self, loop: Loop, init_version: int = 0):
+    def __init__(
+        self,
+        loop: Loop,
+        init_version: int = 0,
+        seed: list[tuple[int, dict[int, list[Mutation]]]] | None = None,
+    ):
+        """`seed`: prior-generation entries salvaged by recovery (versions
+        all < init_version); storage servers finish pulling them from this
+        log as if the old generation had never died."""
         self.loop = loop
-        self._log: list[TLogEntry] = []
+        self._log: list[TLogEntry] = [TLogEntry(v, t) for v, t in (seed or [])]
+        assert all(e.version < init_version for e in self._log)
         self._version = init_version  # end of applied chain
         self._waiters: dict[int, Promise] = {}
         self._popped: dict[int, int] = {}  # tag -> trimmed-below version
-        self._tags_seen: set[int] = set()  # tags with entries ever pushed
+        self._tags_seen: set[int] = {t for e in self._log for t in e.tagged}
         self.locked = False
+        # Highest version the pushing proxies know is durable on EVERY tlog
+        # (reference: knownCommittedVersion in TLogCommitRequest). Storage
+        # reads this off peek replies to bound its MVCC GC floor: anything
+        # above it may be an unacked suffix recovery could roll back.
+        self.known_committed = 0
 
     async def push(
-        self, prev_version: int, version: int, tagged: dict[int, list[Mutation]]
+        self,
+        prev_version: int,
+        version: int,
+        tagged: dict[int, list[Mutation]],
+        known_committed: int = 0,
     ) -> int:
         """Append one batch; ack (returning the durable version) after fsync.
 
@@ -63,6 +81,7 @@ class TLog:
         self._log.append(TLogEntry(version, tagged))
         self._tags_seen.update(tagged)
         self._version = version
+        self.known_committed = max(self.known_committed, known_committed)
         w = self._waiters.pop(version, None)
         if w is not None:
             w.send(None)
@@ -70,21 +89,22 @@ class TLog:
 
     async def peek(
         self, tag: int, begin_version: int, limit: int = 1000
-    ) -> tuple[list[tuple[int, list[Mutation]]], int]:
-        """→ (entries for `tag` with version >= begin_version, end_version).
+    ) -> tuple[list[tuple[int, list[Mutation]]], int, int]:
+        """→ (entries for `tag` with version >= begin_version, end_version,
+        known_committed).
 
         end_version is the version the puller may advance to after applying
         the returned entries: the durable chain end, unless the scan was
         truncated by `limit` (then the last returned version). Idle tags
         advance through mutation-free versions this way — the reference's
-        empty peek replies carying the tlog version."""
+        empty peek replies carrying the tlog version."""
         out = []
         for e in self._log:
             if e.version >= begin_version and tag in e.tagged:
                 out.append((e.version, e.tagged[tag]))
                 if len(out) >= limit:
-                    return out, out[-1][0]
-        return out, self._version
+                    return out, out[-1][0], self.known_committed
+        return out, self._version, self.known_committed
 
     async def pop(self, tag: int, version: int) -> None:
         """Storage server `tag` is durable through `version`; trim entries
@@ -92,6 +112,8 @@ class TLog:
         never popped holds the floor at 0 (no trim) — correct, if unbounded,
         until recovery replaces its storage server."""
         self._popped[tag] = max(self._popped.get(tag, 0), version)
+        if not self._tags_seen:
+            return  # nothing pushed yet (fresh post-recovery log): no trim
         floor = min(self._popped.get(t, 0) for t in self._tags_seen)
         self._log = [e for e in self._log if e.version > floor]
 
@@ -107,3 +129,9 @@ class TLog:
 
     async def get_version(self) -> int:
         return self._version
+
+    async def recover_entries(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
+        """Recovery salvage: the un-popped suffix of the log — everything
+        some storage server may not have applied yet (valid once locked)."""
+        assert self.locked, "recover_entries on an unlocked tlog"
+        return [(e.version, e.tagged) for e in self._log]

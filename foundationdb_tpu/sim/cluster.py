@@ -7,16 +7,25 @@ radii. The conflict engine is pluggable via the ``newConflictSet()`` seam:
 "oracle" (pure-python model), "cpp" (native skiplist), or "tpu" (the jitted
 device kernel) — simulation tests default to the oracle so they run
 anywhere; the TPU engine is exercised by the kernel/bench suites.
+
+The transaction subsystem (sequencer, resolvers, tlogs, proxies,
+ratekeeper) is owned by a ClusterController and recruited per recovery
+*generation*: SimCluster is the controller's recruiter — it knows how to
+place role objects on `.e{epoch}`-suffixed processes, seed new tlogs with
+salvaged entries, re-point the (persistent) storage servers, and retire
+the previous generation. Kill any generation process and the controller's
+heartbeat sweep drives recovery to a fresh epoch.
 """
 
 from __future__ import annotations
 
+from foundationdb_tpu.runtime.cluster import ClusterController, Generation, Heartbeat
 from foundationdb_tpu.runtime.commit_proxy import CommitProxy
 from foundationdb_tpu.runtime.flow import Loop
 from foundationdb_tpu.runtime.grv_proxy import GrvProxy
 from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
 from foundationdb_tpu.runtime.resolver import Resolver
-from foundationdb_tpu.runtime.sequencer import Sequencer
+from foundationdb_tpu.runtime.sequencer import EPOCH_VERSION_JUMP, Sequencer
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 from foundationdb_tpu.runtime.storage import StorageServer
 from foundationdb_tpu.runtime.tlog import TLog
@@ -40,7 +49,8 @@ def new_conflict_set(engine: str):
 
 
 class SimCluster:
-    """A running simulated cluster; role endpoints as attributes."""
+    """A running simulated cluster; role endpoints as attributes (always
+    reflecting the CURRENT generation — refreshed on recovery)."""
 
     def __init__(
         self,
@@ -56,47 +66,95 @@ class SimCluster:
         self.loop = loop or Loop(seed=seed)
         self.net = SimNetwork(self.loop)
         self.engine = engine
+        self.n_proxies = n_proxies
+        self.n_resolvers = n_resolvers
+        self.n_tlogs = n_tlogs
+        self.with_ratekeeper = ratekeeper
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
         self.storage_map = KeyShardMap.uniform(n_storages)
+        self._gen_processes: list[str] = []  # previous generation, for retirement
 
-        self.sequencer = Sequencer(self.loop)
-        self.sequencer_ep = self.net.host("master", "sequencer", self.sequencer)
-
-        self.resolvers = [Resolver(self.loop, new_conflict_set(engine)) for _ in range(n_resolvers)]
-        self.resolver_eps = [
-            self.net.host(f"resolver{i}", f"resolver{i}", r)
-            for i, r in enumerate(self.resolvers)
-        ]
-
-        self.tlogs = [TLog(self.loop) for _ in range(n_tlogs)]
-        self.tlog_eps = [
-            self.net.host(f"tlog{i}", f"tlog{i}", t) for i, t in enumerate(self.tlogs)
-        ]
-
-        # Storage servers pull from the first tlog (replicas hold identical
-        # content; the reference picks a preferred tlog per tag similarly).
+        # Storage servers persist across generations (they ARE the data);
+        # their tlog endpoint is re-pointed by each recruitment.
         self.storages = [
-            StorageServer(self.loop, tag=i, tlog_ep=self.tlog_eps[0])
-            for i in range(n_storages)
+            StorageServer(self.loop, tag=i, tlog_ep=None) for i in range(n_storages)
         ]
         self.storage_eps = [
             self.net.host(f"storage{i}", f"storage{i}", s)
             for i, s in enumerate(self.storages)
         ]
 
-        self.ratekeeper = Ratekeeper(self.loop, self.storage_eps) if ratekeeper else None
+        self.controller = ClusterController(self.loop, recruiter=self)
+        self.controller_ep = self.net.host(
+            "cluster_controller", "cluster_controller", self.controller
+        )
+        self.controller.bootstrap()
+
+        for i, s in enumerate(self.storages):
+            self.loop.spawn(s.run(), process=f"storage{i}", name=f"storage{i}.run")
+        self.loop.spawn(
+            self.controller.run(), process="cluster_controller", name="cc.run"
+        )
+
+    # -- recruiter interface (called by ClusterController / recovery) ---------
+
+    def recruit_generation(
+        self, epoch: int, recovery_version: int, seed_entries: list
+    ) -> Generation:
+        sfx = "" if epoch == 1 else f".e{epoch}"
+        start_version = 0 if epoch == 1 else recovery_version + EPOCH_VERSION_JUMP
+        # Seed only what some storage may still need: salvage can come from a
+        # replica whose log was never trimmed (storages pop one tlog), and
+        # re-seeding its full history would compound across recoveries.
+        floor = min(
+            (min(s._version, recovery_version) for s in self.storages), default=0
+        )
+        seed_entries = [(v, t) for v, t in seed_entries if v > floor]
+        heartbeat_eps: dict = {}
+
+        def host(process: str, name: str, obj, run: bool = False):
+            ep = self.net.host(process, name, obj)
+            heartbeat_eps[process] = self.net.host(process, "heartbeat", Heartbeat())
+            if run:
+                self.loop.spawn(obj.run(), process=process, name=f"{name}.run")
+            return ep
+
+        self.sequencer = Sequencer(self.loop, epoch, recovery_version)
+        assert self.sequencer.last_handed_out == start_version
+        self.sequencer_ep = host("master" + sfx, "sequencer", self.sequencer)
+
+        self.resolvers = [
+            Resolver(self.loop, new_conflict_set(self.engine), init_version=start_version)
+            for _ in range(self.n_resolvers)
+        ]
+        self.resolver_eps = [
+            host(f"resolver{i}{sfx}", f"resolver{i}", r)
+            for i, r in enumerate(self.resolvers)
+        ]
+
+        self.tlogs = [
+            TLog(self.loop, init_version=start_version, seed=list(seed_entries))
+            for _ in range(self.n_tlogs)
+        ]
+        self.tlog_eps = [
+            host(f"tlog{i}{sfx}", f"tlog{i}", t) for i, t in enumerate(self.tlogs)
+        ]
+
+        self.ratekeeper = (
+            Ratekeeper(self.loop, self.storage_eps) if self.with_ratekeeper else None
+        )
         self.ratekeeper_ep = (
-            self.net.host("ratekeeper", "ratekeeper", self.ratekeeper)
+            host("ratekeeper" + sfx, "ratekeeper", self.ratekeeper, run=True)
             if self.ratekeeper
             else None
         )
 
         self.grv_proxies = [
             GrvProxy(self.loop, self.sequencer_ep, self.ratekeeper_ep)
-            for _ in range(n_proxies)
+            for _ in range(self.n_proxies)
         ]
         self.grv_proxy_eps = [
-            self.net.host(f"grv_proxy{i}", f"grv_proxy{i}", g)
+            host(f"grv_proxy{i}{sfx}", f"grv_proxy{i}", g, run=True)
             for i, g in enumerate(self.grv_proxies)
         ]
 
@@ -108,25 +166,41 @@ class SimCluster:
                 self.resolver_map,
                 self.tlog_eps,
                 self.storage_map,
+                controller_ep=getattr(self, "controller_ep", None),
+                epoch=epoch,
             )
-            for _ in range(n_proxies)
+            for _ in range(self.n_proxies)
         ]
         self.commit_proxy_eps = [
-            self.net.host(f"commit_proxy{i}", f"commit_proxy{i}", c)
+            host(f"commit_proxy{i}{sfx}", f"commit_proxy{i}", c, run=True)
             for i, c in enumerate(self.commit_proxies)
         ]
 
-        self._start()
+        # Hand storage servers to the new generation: roll back anything
+        # applied above the recovery version (their old tlog's lost suffix)
+        # and re-point their pull loops at the new tlog.
+        for s in self.storages:
+            s.recover_to(recovery_version, self.tlog_eps[0])
 
-    def _start(self) -> None:
-        for i, s in enumerate(self.storages):
-            self.loop.spawn(s.run(), process=f"storage{i}", name=f"storage{i}.run")
-        for i, g in enumerate(self.grv_proxies):
-            self.loop.spawn(g.run(), process=f"grv_proxy{i}", name=f"grv_proxy{i}.run")
-        for i, c in enumerate(self.commit_proxies):
-            self.loop.spawn(c.run(), process=f"commit_proxy{i}", name=f"commit_proxy{i}.run")
-        if self.ratekeeper:
-            self.loop.spawn(self.ratekeeper.run(), process="ratekeeper", name="ratekeeper.run")
+        # Retire the previous generation: locked/stale roles must not keep
+        # serving (reference: old-epoch roles die on seeing the new epoch),
+        # and their objects must be unhosted or every recovery leaks them.
+        for proc in self._gen_processes:
+            self.loop.kill_process(proc)
+            self.net.unhost_process(proc)
+        self._gen_processes = list(heartbeat_eps)
+
+        return Generation(
+            epoch=epoch,
+            recovery_version=recovery_version,
+            sequencer_ep=self.sequencer_ep,
+            resolver_eps=self.resolver_eps,
+            tlog_eps=self.tlog_eps,
+            grv_proxy_eps=self.grv_proxy_eps,
+            commit_proxy_eps=self.commit_proxy_eps,
+            ratekeeper_ep=self.ratekeeper_ep,
+            heartbeat_eps=heartbeat_eps,
+        )
 
     # -- client-side routing helpers -----------------------------------------
 

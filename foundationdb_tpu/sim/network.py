@@ -66,6 +66,12 @@ class SimNetwork:
     def kill(self, process: str) -> None:
         self.loop.kill_process(process)
 
+    def unhost_process(self, process: str) -> None:
+        """Drop every role object hosted on `process` (generation retirement
+        — without this, each recovery would leak the full old generation,
+        including never-trimmed replica tlogs holding an epoch's history)."""
+        self._objects = {k: v for k, v in self._objects.items() if k[0] != process}
+
     def reboot(self, process: str) -> None:
         """Clears the dead flag; the harness re-hosts/restarts role actors."""
         self.loop.revive_process(process)

@@ -228,7 +228,7 @@ class TestCommitPipeline:
                                     1: [Mutation(M.SET_VALUE, b"b", b"2")]})
             await tlog.push(10, 20, {0: [Mutation(M.SET_VALUE, b"c", b"3")]})
             await tlog.pop(0, 20)  # tag 1 never popped
-            entries, _ = await tlog.peek(1, 1)
+            entries, _end, _kc = await tlog.peek(1, 1)
             assert [v for v, _m in entries] == [10], entries
             # Duplicate push (retransmit) of an already-durable batch re-acks.
             assert await tlog.push(10, 20, {}) == 20
