@@ -85,6 +85,14 @@ class CommitProxy:
         self._queue.append((req, p))
         return await p.future
 
+    async def get_metrics(self) -> dict:
+        """Status inputs (reference: commit proxy stats in status json)."""
+        return {
+            "txns_committed": self.txns_committed,
+            "txns_conflicted": self.txns_conflicted,
+            "queued": len(self._queue),
+        }
+
     # -- batch engine ---------------------------------------------------------
 
     async def run(self) -> None:

@@ -32,6 +32,10 @@ class GrvProxy:
         self._queue.append(p)
         return await p.future
 
+    async def get_metrics(self) -> dict:
+        """Status inputs (reference: GrvProxy metrics in status json)."""
+        return {"grvs_served": self.grvs_served, "queued": len(self._queue)}
+
     async def run(self) -> None:
         self.loop.spawn(self._rate_poller(), name="grv.rate_poller")
         while True:

@@ -64,3 +64,11 @@ class Resolver:
     @property
     def version(self) -> int:
         return self._version
+
+    async def get_metrics(self) -> dict:
+        """Status inputs (reference: resolver stats in status json)."""
+        return {
+            "batches_resolved": self.batches_resolved,
+            "txns_resolved": self.txns_resolved,
+            "version": self._version,
+        }

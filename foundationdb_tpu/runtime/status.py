@@ -1,0 +1,151 @@
+"""Cluster status document: one JSON-able snapshot of every role's health.
+
+Reference: fdbclient/StatusClient.actor.cpp + fdbserver/Status.actor.cpp —
+the ``\\xff\\xff/status/json`` special key clients read for monitoring. The
+shape here follows the reference's top-level sections (cluster / recovery /
+workload / qos / processes) with the fields our roles actually track; every
+number is fetched over the simulated network, so a partitioned or dead role
+shows up as ``"reachable": false`` exactly as the reference's status marks
+unreachable processes.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.runtime.cluster import ClusterController  # noqa: F401 (doc link)
+
+STATUS_KEY = b"\xff\xff/status/json"
+
+
+async def fetch_status(cluster, _retries: int = 3) -> dict:
+    """Assemble the status document for a SimCluster (server side of the
+    reference's status json machinery).
+
+    Consistency: every endpoint/role pair is snapshotted up front, all
+    probes and metric RPCs run in parallel (k dead processes cost ONE
+    failure-detection delay, like the controller's sweep), and if a
+    recovery swaps the generation mid-fetch the whole document is
+    re-assembled so it never mixes epochs."""
+    epoch_before = cluster.controller.generation.epoch
+    # Snapshot all endpoints at one instant.
+    grv_eps = list(cluster.grv_proxy_eps)
+    commit_eps = list(cluster.commit_proxy_eps)
+    resolver_eps = list(cluster.resolver_eps)
+    tlog_eps = list(cluster.tlog_eps)
+    storage_eps = list(cluster.storage_eps)
+    ratekeeper_ep = cluster.ratekeeper_ep
+    sequencer_ep = cluster.sequencer_ep
+
+    # All metric RPCs go out in parallel over the simulated network: k dead
+    # processes cost ONE failure-detection delay, and an unreachable role's
+    # counters are genuinely invisible (reachable=False, no stats) — status
+    # never reads role objects in-process.
+    spawn = cluster.loop.spawn
+    controller_t = spawn(_safe(cluster.controller_ep.get_status()), name="status.cc")
+    grv_ms = [spawn(_safe(ep.get_metrics()), name="status.grv") for ep in grv_eps]
+    commit_ms = [spawn(_safe(ep.get_metrics()), name="status.cp") for ep in commit_eps]
+    resolver_ms = [spawn(_safe(ep.get_metrics()), name="status.res") for ep in resolver_eps]
+    tlog_vers = [spawn(_safe(ep.get_version()), name="status.tlog") for ep in tlog_eps]
+    storage_ms = [spawn(_safe(ep.metrics()), name="status.ss") for ep in storage_eps]
+    rate_t = (
+        spawn(_safe(ratekeeper_ep.get_rate()), name="status.rk")
+        if ratekeeper_ep is not None
+        else None
+    )
+    seq_t = spawn(_safe(sequencer_ep.get_live_committed_version()), name="status.seq")
+
+    controller = await controller_t
+    doc: dict = {
+        "cluster": {
+            "controller": (
+                {"reachable": True, **controller}
+                if controller
+                else {"reachable": False}
+            ),
+            "recovery_state": _recovery_state(controller),
+        },
+        "workload": {
+            "transactions": {"committed": 0, "conflicted": 0},
+            "grvs_served": 0,
+            "resolver": {"batches": 0, "txns": 0},
+        },
+        "qos": {},
+        "processes": {},
+    }
+
+    for ep, mt in zip(grv_eps, grv_ms):
+        m = await mt
+        doc["processes"][ep.process] = {"role": "grv_proxy", "reachable": m is not None}
+        doc["workload"]["grvs_served"] += m["grvs_served"] if m else 0
+
+    for ep, mt in zip(commit_eps, commit_ms):
+        m = await mt
+        doc["processes"][ep.process] = {"role": "commit_proxy", "reachable": m is not None}
+        if m:
+            doc["workload"]["transactions"]["committed"] += m["txns_committed"]
+            doc["workload"]["transactions"]["conflicted"] += m["txns_conflicted"]
+
+    for ep, mt in zip(resolver_eps, resolver_ms):
+        m = await mt
+        doc["processes"][ep.process] = {"role": "resolver", "reachable": m is not None}
+        if m:
+            doc["workload"]["resolver"]["batches"] += m["batches_resolved"]
+            doc["workload"]["resolver"]["txns"] += m["txns_resolved"]
+
+    for ep, vt in zip(tlog_eps, tlog_vers):
+        ver = await vt
+        doc["processes"][ep.process] = {
+            "role": "tlog",
+            "reachable": ver is not None,
+            "version": ver,
+        }
+
+    max_lag = 0
+    for ep, mt in zip(storage_eps, storage_ms):
+        m = await mt
+        doc["processes"][ep.process] = {
+            "role": "storage",
+            "reachable": m is not None,
+            **(m or {}),
+        }
+        if m:
+            max_lag = max(max_lag, m["version_lag"])
+    doc["qos"]["worst_storage_version_lag"] = max_lag
+
+    if rate_t is not None:
+        rate = await rate_t
+        doc["qos"]["ratekeeper"] = {
+            "reachable": rate is not None,
+            "tps_limit": rate,
+        }
+
+    seq_ver = await seq_t
+    doc["processes"][sequencer_ep.process] = {
+        "role": "sequencer",
+        "reachable": seq_ver is not None,
+        "committed_version": seq_ver,
+    }
+    doc["cluster"]["committed_version"] = seq_ver
+
+    if cluster.controller.generation.epoch != epoch_before and _retries > 0:
+        return await fetch_status(cluster, _retries - 1)  # mid-fetch recovery
+    return doc
+
+
+def _recovery_state(controller_status: dict | None) -> dict:
+    """Reference: the recovery_state section (name + description)."""
+    if not controller_status:
+        return {"name": "unknown", "healthy": False}
+    if controller_status.get("recovering"):
+        return {"name": "recovering", "healthy": False}
+    return {
+        "name": "fully_recovered",
+        "healthy": True,
+        "epoch": controller_status.get("epoch"),
+    }
+
+
+async def _safe(fut):
+    try:
+        return await fut
+    except Exception:
+        return None

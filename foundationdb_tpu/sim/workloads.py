@@ -1,0 +1,475 @@
+"""Simulation workloads: randomized transactional load with correctness
+oracles, run against a SimCluster under seeded fault injection.
+
+Reference: fdbserver/workloads/ (~200 actors driven by TOML specs in
+tests/). The ones re-built here are the load-bearing correctness suite:
+
+- CycleWorkload        — Cycle.actor.cpp: the canonical serializability
+  check. Keys form a permutation ring; txns swap successor pointers; any
+  lost/torn/reordered update breaks the single-cycle invariant.
+- AtomicOpsWorkload    — AtomicOps.actor.cpp: concurrent atomic ADD/MAX/
+  MIN/XOR streams vs an exactly-computable expected state.
+- RandomReadWriteWorkload — mako/YCSB-style mixed load (Zipf hot keys);
+  throughput/liveness under contention, with read-your-committed checks.
+- ConflictRangeWorkload — ConflictRange.actor.cpp: randomized range
+  read/write sets through the real commit path; verdict parity is covered
+  kernel-side (tests/test_conflict_oracle.py), here we assert observable
+  serializability of the committed history.
+- FaultInjector        — the machine-kill/clogging half of the reference's
+  simulation: a seeded actor that kills generation processes, injects
+  partitions, and heals them, on a schedule drawn from the loop's RNG.
+
+Every workload exposes  setup(db) / run(db) / check(db)  like the
+reference's TestWorkload interface; `run_workload` wires one (plus
+optional faults) onto a cluster and returns its metrics.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.runtime.flow import all_of
+
+
+class WorkloadFailed(FdbError):
+    """An invariant check failed — the simulation found a bug."""
+
+    code = 1500
+
+
+@dataclass
+class WorkloadMetrics:
+    txns_committed: int = 0
+    txns_retried: int = 0
+    txns_failed: int = 0
+    ops: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+class Workload:
+    """Reference: TestWorkload — setup once, run concurrent clients, then
+    check invariants on the quiesced database."""
+
+    name = "workload"
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.metrics = WorkloadMetrics()
+
+    async def setup(self, db) -> None:  # pragma: no cover - interface
+        pass
+
+    async def run(self, db, cluster) -> None:  # pragma: no cover - interface
+        pass
+
+    async def check(self, db) -> None:  # pragma: no cover - interface
+        pass
+
+    # -- helpers -------------------------------------------------------------
+
+    async def _run_txn(self, db, fn, max_retries: int = 100):
+        """Delegates to the ONE canonical retry loop (Database.run), adding
+        only attempt accounting; tolerates cluster recoveries."""
+        attempts = [0]
+
+        async def counted(tr):
+            attempts[0] += 1
+            return await fn(tr)
+
+        try:
+            result = await db.run(counted, max_retries=max_retries)
+        except FdbError:
+            self.metrics.txns_failed += 1
+            raise
+        self.metrics.txns_committed += 1
+        self.metrics.txns_retried += attempts[0] - 1
+        return result
+
+    @staticmethod
+    def _split(n_txns: int, n_clients: int) -> list[int]:
+        """Per-client txn counts summing exactly to n_txns (no silent
+        remainder drop when n_txns % n_clients != 0)."""
+        base, rem = divmod(n_txns, n_clients)
+        return [base + (1 if i < rem else 0) for i in range(n_clients)]
+
+
+class CycleWorkload(Workload):
+    """Keys 0..N-1 hold a permutation forming one cycle; each transaction
+    picks a random node A and rotates A's successor: A→B→C becomes A→C→B...
+    preserving the permutation-single-cycle invariant IF AND ONLY IF every
+    transaction is atomic and serializable (reference: Cycle.actor.cpp)."""
+
+    name = "cycle"
+
+    def __init__(self, seed: int = 0, n_nodes: int = 16, n_txns: int = 60,
+                 n_clients: int = 4):
+        super().__init__(seed)
+        self.n_nodes = n_nodes
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+
+    def _key(self, i: int) -> bytes:
+        return b"cycle/%06d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_nodes):
+                tr.set(self._key(i), struct.pack("<q", (i + 1) % self.n_nodes))
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                a = rng.randrange(self.n_nodes)
+
+                async def body(tr, a=a):
+                    b = struct.unpack("<q", await tr.get(self._key(a)))[0]
+                    c = struct.unpack("<q", await tr.get(self._key(b)))[0]
+                    d = struct.unpack("<q", await tr.get(self._key(c)))[0]
+                    # Rotate: a -> c -> b -> d
+                    tr.set(self._key(a), struct.pack("<q", c))
+                    tr.set(self._key(c), struct.pack("<q", b))
+                    tr.set(self._key(b), struct.pack("<q", d))
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 3
+
+        await all_of(
+            [
+                cluster.loop.spawn(client(i), name=f"cycle.client{i}")
+                for i in range(self.n_clients)
+            ]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            succ = []
+            for i in range(self.n_nodes):
+                v = await tr.get(self._key(i))
+                if v is None:
+                    raise WorkloadFailed(f"cycle: node {i} missing")
+                succ.append(struct.unpack("<q", v)[0])
+            return succ
+
+        succ = await self._run_txn(db, body)
+        seen, node = set(), 0
+        for _ in range(self.n_nodes):
+            if node in seen:
+                raise WorkloadFailed(f"cycle: not a single cycle (revisit {node})")
+            seen.add(node)
+            node = succ[node]
+        if node != 0 or len(seen) != self.n_nodes:
+            raise WorkloadFailed("cycle: broken ring — lost or torn update")
+
+
+class AtomicOpsWorkload(Workload):
+    """Concurrent atomic-op streams whose final state is exactly computable:
+    ADD totals, MAX/MIN extremes, XOR parity (reference: AtomicOps.actor.cpp
+    compares a log-derived expectation against the db)."""
+
+    name = "atomic_ops"
+
+    def __init__(self, seed: int = 0, n_keys: int = 4, n_txns: int = 48,
+                 n_clients: int = 4):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self._expected_add = [0] * n_keys
+        self._expected_max = [0] * n_keys
+        self._expected_xor = [0] * n_keys
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        # Pre-draw the op log so the expectation is independent of commit
+        # interleaving (atomic ops commute — that is the point of the test).
+        plan = []
+        for count in self._split(self.n_txns, self.n_clients):
+            ops = []
+            for _ in range(count):
+                k = rng.randrange(self.n_keys)
+                val = rng.randrange(1, 1000)
+                ops.append((k, val))
+                self._expected_add[k] += val
+                self._expected_max[k] = max(self._expected_max[k], val)
+                self._expected_xor[k] ^= val
+            plan.append(ops)
+
+        async def client(cid, ops):
+            for n, (k, val) in enumerate(ops):
+                # Idempotency marker: a CommitUnknownResult retry of a txn
+                # that DID commit must not re-apply its ADD/XOR (the
+                # expectation counts each op exactly once).
+                marker = b"aop/done/%d/%d" % (cid, n)
+
+                async def body(tr, k=k, val=val, marker=marker):
+                    if await tr.get(marker) is not None:
+                        return  # earlier attempt committed
+                    tr.set(marker, b"")
+                    p = struct.pack("<q", val)
+                    tr.atomic_op(MutationType.ADD, b"aop/add/%d" % k, p)
+                    tr.atomic_op(MutationType.MAX, b"aop/max/%d" % k, p)
+                    tr.atomic_op(MutationType.XOR, b"aop/xor/%d" % k, p)
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 3
+
+        await all_of(
+            [
+                cluster.loop.spawn(client(i, ops), name=f"aop.client{i}")
+                for i, ops in enumerate(plan)
+            ]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            for k in range(self.n_keys):
+                for kind, expected in (
+                    ("add", self._expected_add[k]),
+                    ("max", self._expected_max[k]),
+                    ("xor", self._expected_xor[k]),
+                ):
+                    raw = await tr.get(b"aop/%s/%d" % (kind.encode(), k))
+                    got = struct.unpack("<q", raw)[0] if raw else 0
+                    if got != expected:
+                        raise WorkloadFailed(
+                            f"atomic {kind}[{k}]: got {got}, want {expected}"
+                        )
+
+        await self._run_txn(db, body)
+
+
+class RandomReadWriteWorkload(Workload):
+    """mako/YCSB-style mixed point load on a hot-key distribution; checks
+    that every acked write is durably readable (read-your-committed)."""
+
+    name = "random_rw"
+
+    def __init__(self, seed: int = 0, n_keys: int = 32, n_txns: int = 80,
+                 n_clients: int = 4, write_fraction: float = 0.5):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.write_fraction = write_fraction
+        self._acked: dict[bytes, bytes] = {}  # key -> last acked write
+
+    def _key(self, i: int) -> bytes:
+        return b"rw/%06d" % i
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counter = [0]
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                k = self._key(min(int(rng.paretovariate(1.5)) - 1, self.n_keys - 1))
+                if rng.random() < self.write_fraction:
+                    counter[0] += 1
+                    val = b"v%08d" % counter[0]
+
+                    async def body(tr, k=k, val=val):
+                        await tr.get(k)
+                        tr.set(k, val)
+
+                    await self._run_txn(db, body)
+                    # Acked: later sequential writes may overwrite, so track
+                    # program order per client stream (last committed wins
+                    # within this client; cross-client order is by commit).
+                    self._acked[k] = val
+                else:
+                    async def body(tr, k=k):
+                        return await tr.get(k)
+
+                    await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of(
+            [
+                cluster.loop.spawn(client(i), name=f"rw.client{i}")
+                for i in range(self.n_clients)
+            ]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            for k in self._acked:
+                if await tr.get(k) is None:
+                    raise WorkloadFailed(f"rw: acked write to {k!r} lost")
+
+        await self._run_txn(db, body)
+
+
+class ConflictRangeWorkload(Workload):
+    """Randomized range reads + writes through the real commit path; the
+    observable check is bank-style conservation: txns move value between
+    accounts under range-read guards, so the total is invariant IF conflict
+    detection is sound (reference: ConflictRange.actor.cpp randomized sets;
+    kernel-level verdict parity lives in tests/test_conflict_oracle.py)."""
+
+    name = "conflict_range"
+
+    TOTAL = 1000
+
+    def __init__(self, seed: int = 0, n_accounts: int = 8, n_txns: int = 40,
+                 n_clients: int = 4):
+        super().__init__(seed)
+        self.n_accounts = n_accounts
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+
+    def _key(self, i: int) -> bytes:
+        return b"bank/%04d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            each = self.TOTAL // self.n_accounts
+            rem = self.TOTAL - each * self.n_accounts
+            for i in range(self.n_accounts):
+                tr.set(self._key(i), struct.pack("<q", each + (rem if i == 0 else 0)))
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                src = rng.randrange(self.n_accounts)
+                dst = rng.randrange(self.n_accounts)
+                amt = rng.randrange(1, 50)
+
+                async def body(tr, src=src, dst=dst, amt=amt):
+                    # Range read over the whole bank: a wide read conflict
+                    # range, the thing the resolver must get right.
+                    rows = await tr.get_range(b"bank/", b"bank0")
+                    balances = {k: struct.unpack("<q", v)[0] for k, v in rows}
+                    s, d = self._key(src), self._key(dst)
+                    if balances.get(s, 0) < amt or src == dst:
+                        return
+                    tr.set(s, struct.pack("<q", balances[s] - amt))
+                    tr.set(d, struct.pack("<q", balances[d] + amt))
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of(
+            [
+                cluster.loop.spawn(client(i), name=f"bank.client{i}")
+                for i in range(self.n_clients)
+            ]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            rows = await tr.get_range(b"bank/", b"bank0")
+            total = sum(struct.unpack("<q", v)[0] for _k, v in rows)
+            if total != self.TOTAL:
+                raise WorkloadFailed(
+                    f"bank conservation broken: total {total} != {self.TOTAL}"
+                )
+            negative = [k for k, v in rows if struct.unpack("<q", v)[0] < 0]
+            if negative:
+                raise WorkloadFailed(f"bank: negative balances {negative}")
+
+        await self._run_txn(db, body)
+
+
+class FaultInjector:
+    """Seeded chaos actor (reference: the machine-kill + clogging machinery
+    of SimulatedCluster): kills random generation processes and injects
+    transient partitions while a workload runs. All choices come from the
+    loop RNG — a seed replays the exact fault schedule."""
+
+    def __init__(self, cluster, kill_interval: float = 2.0,
+                 partition_interval: float = 1.3, partition_length: float = 0.8,
+                 max_kills: int = 2):
+        self.cluster = cluster
+        self.kill_interval = kill_interval
+        self.partition_interval = partition_interval
+        self.partition_length = partition_length
+        self.max_kills = max_kills
+        self.kills: list[str] = []
+        self.partitions = 0
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    async def run(self) -> None:
+        loop = self.cluster.loop
+        rng = loop.rng
+        loop.spawn(self._partitioner(), name="faults.partitioner")
+        while not self._stop and len(self.kills) < self.max_kills:
+            await loop.sleep(self.kill_interval * (0.5 + rng.random()))
+            if self._stop:
+                return
+            gen = self.cluster.controller.generation
+            victims = sorted(gen.heartbeat_eps)
+            victim = victims[rng.randrange(len(victims))]
+            if not self._safe_to_kill(gen, victim):
+                continue  # would destroy the last durable log copy
+            self.kills.append(victim)
+            self.cluster.net.kill(victim)
+
+    def _safe_to_kill(self, gen, victim: str) -> bool:
+        """Never kill the LAST reachable tlog of the generation: with every
+        log copy gone the durable suffix is unknowable and recovery stalls
+        forever (the reference's kill machinery keeps a replica alive the
+        same way — kills are permanent here, nothing reboots)."""
+        tlog_procs = [ep.process for ep in gen.tlog_eps]
+        if victim not in tlog_procs:
+            return True
+        dead = self.cluster.loop.dead_processes
+        alive = [p for p in tlog_procs if p not in dead]
+        return len(alive) > 1 or victim not in alive
+
+    async def _partitioner(self) -> None:
+        loop = self.cluster.loop
+        rng = loop.rng
+        while not self._stop:
+            await loop.sleep(self.partition_interval * (0.5 + rng.random()))
+            if self._stop:
+                return
+            gen = self.cluster.controller.generation
+            procs = sorted(gen.heartbeat_eps) + [
+                f"storage{i}" for i in range(len(self.cluster.storages))
+            ]
+            a = procs[rng.randrange(len(procs))]
+            b = procs[rng.randrange(len(procs))]
+            if a == b:
+                continue
+            self.cluster.net.partition(a, b)
+            self.partitions += 1
+            await loop.sleep(self.partition_length)
+            self.cluster.net.heal(a, b)
+
+
+async def run_workload(cluster, db, workload: Workload,
+                       faults: FaultInjector | None = None) -> WorkloadMetrics:
+    """setup → (run ∥ faults) → quiesce → check. Returns the metrics."""
+    await workload.setup(db)
+    fault_task = (
+        cluster.loop.spawn(faults.run(), name="faults.run") if faults else None
+    )
+    await workload.run(db, cluster)
+    if faults:
+        faults.stop()
+        await fault_task
+        cluster.net.heal_all()
+        # Quiesce: let any in-flight recovery finish before checking.
+        while cluster.controller._recovering:
+            await cluster.loop.sleep(0.25)
+    await workload.check(db)
+    return workload.metrics
