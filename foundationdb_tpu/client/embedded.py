@@ -1,0 +1,257 @@
+"""Embedded database: ctypes binding over the native C client API.
+
+Reference: bindings/python/fdb on top of bindings/c/fdb_c.cpp. The native
+side (native/fdb_tpu_c.cpp) is a complete in-process MVCC transactional
+engine with the fdb_c surface shape; this wrapper gives it the familiar
+Python face — ``EmbeddedDatabase`` / ``EmbeddedTransaction`` with
+get/get_range/set/clear/atomic ops, snapshot reads, and the standard
+``run`` retry loop — raising the SAME error classes (core/errors.py) as
+the distributed client, so layer code (tuple/subspace) runs on either.
+
+Synchronous by design: the embedded engine has no network, so there is
+nothing to await (the reference's C API is callback-async because it talks
+to a cluster; embedded use collapses that)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from foundationdb_tpu.core.errors import (
+    CommitUnknownResult,
+    FdbError,
+    InvertedRange,
+    KeyTooLarge,
+    NotCommitted,
+    TransactionTooOld,
+    UsedDuringCommit,
+    ValueTooLarge,
+)
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.native import load_library
+
+_ERRORS: dict[int, type[FdbError]] = {
+    1007: TransactionTooOld,
+    1020: NotCommitted,
+    1021: CommitUnknownResult,
+    2017: UsedDuringCommit,
+    2102: KeyTooLarge,
+    2103: ValueTooLarge,
+    2005: InvertedRange,
+}
+
+
+def _lib() -> ctypes.CDLL:
+    lib = load_library("fdb_tpu_c")
+    if getattr(lib, "_fdb_tpu_configured", False):
+        return lib
+    u8p, i32p, i64p = (
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64),
+    )
+    vp, vpp = ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)
+    sigs = {
+        "fdb_tpu_create_database": ([], vp),
+        "fdb_tpu_destroy_database": ([vp], None),
+        "fdb_tpu_database_get_version": ([vp], ctypes.c_int64),
+        "fdb_tpu_database_create_transaction": ([vp], vp),
+        "fdb_tpu_transaction_destroy": ([vp], None),
+        "fdb_tpu_transaction_reset": ([vp], None),
+        "fdb_tpu_transaction_get_read_version": ([vp], ctypes.c_int64),
+        "fdb_tpu_transaction_set_read_version": ([vp, ctypes.c_int64], None),
+        "fdb_tpu_transaction_get": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+             ctypes.POINTER(vp), i32p, i32p], ctypes.c_int),
+        "fdb_tpu_transaction_get_range": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+             ctypes.c_int, ctypes.c_int, ctypes.c_int, vpp, i32p, i32p],
+            ctypes.c_int),
+        "fdb_tpu_range_kv": (
+            [vp, ctypes.c_int, ctypes.POINTER(vp), i32p, ctypes.POINTER(vp),
+             i32p], None),
+        "fdb_tpu_transaction_set": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int],
+            ctypes.c_int),
+        "fdb_tpu_transaction_clear": ([vp, ctypes.c_char_p, ctypes.c_int], ctypes.c_int),
+        "fdb_tpu_transaction_clear_range": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int],
+            ctypes.c_int),
+        "fdb_tpu_transaction_atomic_op": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+             ctypes.c_int], ctypes.c_int),
+        "fdb_tpu_transaction_add_conflict_range": (
+            [vp, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+             ctypes.c_int], ctypes.c_int),
+        "fdb_tpu_transaction_commit": ([vp, i64p], ctypes.c_int),
+        "fdb_tpu_transaction_get_committed_version": ([vp], ctypes.c_int64),
+        "fdb_tpu_get_error": ([ctypes.c_int], ctypes.c_char_p),
+        "fdb_tpu_error_predicate": ([ctypes.c_int, ctypes.c_int], ctypes.c_int),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    lib._fdb_tpu_configured = True
+    return lib
+
+
+def _check(code: int) -> None:
+    if code:
+        msg = _lib().fdb_tpu_get_error(code).decode()
+        raise _ERRORS.get(code, FdbError)(msg, code=None if code in _ERRORS else code)
+
+
+class EmbeddedTransaction:
+    def __init__(self, db: "EmbeddedDatabase"):
+        self._lib = db._lib
+        self._tr = self._lib.fdb_tpu_database_create_transaction(db._handle())
+        self._closed = False
+
+    def _h(self):
+        """Live native handle; a closed transaction raises instead of
+        passing a freed pointer into C (use-after-free crash)."""
+        if self._closed:
+            raise FdbError("transaction used after close", code=2017)
+        return self._tr
+
+    # -- versions ----------------------------------------------------------
+
+    def get_read_version(self) -> int:
+        return self._lib.fdb_tpu_transaction_get_read_version(self._h())
+
+    def set_read_version(self, v: int) -> None:
+        self._lib.fdb_tpu_transaction_set_read_version(self._h(), v)
+
+    @property
+    def committed_version(self) -> int:
+        v = self._lib.fdb_tpu_transaction_get_committed_version(self._h())
+        if v < 0:
+            raise FdbError("transaction not committed", code=2021)
+        return v
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        out_val = ctypes.c_void_p()
+        out_len, present = ctypes.c_int(), ctypes.c_int()
+        _check(self._lib.fdb_tpu_transaction_get(
+            self._h(), key, len(key), int(snapshot),
+            ctypes.byref(out_val), ctypes.byref(out_len), ctypes.byref(present)))
+        if not present.value:
+            return None
+        return ctypes.string_at(out_val, out_len.value)
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 0,
+                  reverse: bool = False, snapshot: bool = False
+                  ) -> list[tuple[bytes, bytes]]:
+        handle = ctypes.c_void_p()
+        count, more = ctypes.c_int(), ctypes.c_int()
+        _check(self._lib.fdb_tpu_transaction_get_range(
+            self._h(), begin, len(begin), end, len(end), limit, int(reverse),
+            int(snapshot), ctypes.byref(handle), ctypes.byref(count),
+            ctypes.byref(more)))
+        out = []
+        k, v = ctypes.c_void_p(), ctypes.c_void_p()
+        klen, vlen = ctypes.c_int(), ctypes.c_int()
+        for i in range(count.value):
+            self._lib.fdb_tpu_range_kv(
+                handle, i, ctypes.byref(k), ctypes.byref(klen),
+                ctypes.byref(v), ctypes.byref(vlen))
+            out.append((ctypes.string_at(k, klen.value), ctypes.string_at(v, vlen.value)))
+        return out
+
+    # -- writes ------------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_set(self._h(), key, len(key), value, len(value)))
+
+    def clear(self, key: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_clear(self._h(), key, len(key)))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_clear_range(
+            self._h(), begin, len(begin), end, len(end)))
+
+    def atomic_op(self, op: MutationType, key: bytes, param: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_atomic_op(
+            self._h(), key, len(key), param, len(param), int(op)))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_add_conflict_range(
+            self._h(), begin, len(begin), end, len(end), 0))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        _check(self._lib.fdb_tpu_transaction_add_conflict_range(
+            self._h(), begin, len(begin), end, len(end), 1))
+
+    # -- commit / lifecycle --------------------------------------------------
+
+    def commit(self) -> int:
+        out = ctypes.c_int64()
+        _check(self._lib.fdb_tpu_transaction_commit(self._h(), ctypes.byref(out)))
+        return out.value
+
+    def reset(self) -> None:
+        self._lib.fdb_tpu_transaction_reset(self._h())
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.fdb_tpu_transaction_destroy(self._tr)
+            self._closed = True
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class EmbeddedDatabase:
+    """fdb.open()-shaped handle over the native engine."""
+
+    def __init__(self):
+        self._lib = _lib()
+        self._db = self._lib.fdb_tpu_create_database()
+
+    def _handle(self):
+        if self._db is None:
+            raise FdbError("database used after close", code=2017)
+        return self._db
+
+    def transaction(self) -> EmbeddedTransaction:
+        return EmbeddedTransaction(self)
+
+    @property
+    def version(self) -> int:
+        return self._lib.fdb_tpu_database_get_version(self._handle())
+
+    def run(self, fn, max_retries: int = 50):
+        """The standard retry loop (reference: every binding's
+        @transactional): retryable errors reset + retry."""
+        tr = self.transaction()
+        try:
+            for _ in range(max_retries):
+                try:
+                    result = fn(tr)
+                    tr.commit()
+                    return result
+                except FdbError as e:
+                    # One source of truth for retryability: the shared error
+                    # model (core/errors.py), same as the distributed client.
+                    if not e.retryable:
+                        raise
+                    tr.reset()
+            raise FdbError("retry limit reached", code=1021)
+        finally:
+            tr.close()
+
+    def close(self) -> None:
+        if self._db is not None:
+            self._lib.fdb_tpu_destroy_database(self._db)
+            self._db = None
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,602 @@
+// C client API: an fdb_c-shaped surface over a native embedded MVCC engine.
+//
+// Reference: bindings/c/fdb_c.cpp — the C ABI every reference binding
+// (Python/Java/Go/Ruby) builds on. This is the framework's equivalent
+// surface: database/transaction handles, gets/sets/clears/atomic ops,
+// snapshot reads, conflict ranges, optimistic commit with the same error
+// codes (1020 not_committed, 1007 transaction_too_old, 2011 used_during_
+// commit), and fdb_error_predicate-style retryability — implemented over an
+// in-process MVCC store with a step-function write history, the same
+// conflict-checking design as the device kernel (models/conflict_kernel.py)
+// and the skiplist baseline (native/skiplist.cpp).
+//
+// Transaction semantics mirror the reference client:
+// - reads are snapshot-at-read-version with read-your-writes overlay
+// - non-snapshot reads add read conflict ranges
+// - commit conflict-checks reads against writes committed after the
+//   transaction's read version, then paints its writes at the new version
+// - atomic ops fold little-endian per fdbclient/Atomic.h (see
+//   core/mutations.py apply_atomic for the shared semantics)
+//
+// Built by native/__init__.py (g++ → lib, dlopen'd via ctypes); the Python
+// wrapper is client/embedded.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Key = std::string;
+using Val = std::string;
+
+// Error codes (flow/error_definitions.h values).
+constexpr int ERR_OK = 0;
+constexpr int ERR_TOO_OLD = 1007;
+constexpr int ERR_NOT_COMMITTED = 1020;
+constexpr int ERR_COMMIT_UNKNOWN = 1021;
+constexpr int ERR_USED_DURING_COMMIT = 2017;
+constexpr int ERR_KEY_TOO_LARGE = 2102;
+constexpr int ERR_VALUE_TOO_LARGE = 2103;
+constexpr int ERR_INVERTED_RANGE = 2005;
+constexpr int ERR_CLIENT_INVALID_OP = 2000;
+
+constexpr size_t MAX_KEY_SIZE = 10000;
+constexpr size_t MAX_VALUE_SIZE = 100000;
+// Conflict history window in commits (the reference's ~5s MVCC window is
+// versions-per-second based; an embedded engine counts commits).
+constexpr int64_t MVCC_WINDOW = 5'000'000;
+
+// Mutation type codes matching fdbclient/CommitTransaction.h (and
+// core/mutations.py MutationType).
+enum MutType : int {
+  M_SET = 0, M_CLEAR_RANGE = 1, M_ADD = 2, M_AND = 6, M_OR = 7, M_XOR = 8,
+  M_APPEND_IF_FITS = 9, M_MAX = 12, M_MIN = 13, M_BYTE_MIN = 16,
+  M_BYTE_MAX = 17, M_MIN_V2 = 18, M_AND_V2 = 19, M_COMPARE_AND_CLEAR = 20,
+};
+
+// -- little-endian arithmetic on byte strings (fdbclient/Atomic.h) ----------
+// Byte-wise over the FULL operand width (no 8-byte cap) so results match
+// core/mutations.py apply_atomic, which uses arbitrary-precision ints.
+
+std::string fit(const std::string& s, size_t n) {
+  std::string out = s.substr(0, std::min(n, s.size()));
+  out.resize(n, '\0');
+  return out;
+}
+
+std::string le_add(const std::string& a, const std::string& b, size_t n) {
+  std::string out(n, '\0');
+  unsigned carry = 0;
+  for (size_t i = 0; i < n; ++i) {
+    unsigned s = static_cast<unsigned char>(i < a.size() ? a[i] : 0) +
+                 static_cast<unsigned char>(i < b.size() ? b[i] : 0) + carry;
+    out[i] = static_cast<char>(s & 0xff);
+    carry = s >> 8;
+  }
+  return out;  // overflow past n bytes drops, as in the Python model
+}
+
+template <typename F>
+std::string bytewise(const std::string& a, const std::string& b, size_t n, F op) {
+  std::string out(n, '\0');
+  for (size_t i = 0; i < n; ++i)
+    out[i] = static_cast<char>(op(
+        static_cast<unsigned char>(i < a.size() ? a[i] : 0),
+        static_cast<unsigned char>(i < b.size() ? b[i] : 0)));
+  return out;
+}
+
+// Compare two n-byte little-endian magnitudes: <0, 0, >0.
+int le_cmp(const std::string& a, const std::string& b, size_t n) {
+  for (size_t i = n; i-- > 0;) {
+    unsigned char ca = i < a.size() ? a[i] : 0, cb = i < b.size() ? b[i] : 0;
+    if (ca != cb) return ca < cb ? -1 : 1;
+  }
+  return 0;
+}
+
+std::optional<Val> apply_atomic(int op, const std::optional<Val>& existing,
+                                const std::string& p) {
+  const size_t n = p.size();
+  switch (op) {
+    case M_ADD:
+      return le_add(fit(existing.value_or(""), n), p, n);
+    case M_AND: case M_AND_V2:
+      if (!existing) return p;
+      return bytewise(fit(*existing, n), p, n,
+                      [](unsigned char a, unsigned char b) { return a & b; });
+    case M_OR:
+      return bytewise(fit(existing.value_or(""), n), p, n,
+                      [](unsigned char a, unsigned char b) { return a | b; });
+    case M_XOR:
+      return bytewise(fit(existing.value_or(""), n), p, n,
+                      [](unsigned char a, unsigned char b) { return a ^ b; });
+    case M_APPEND_IF_FITS: {
+      std::string cur = existing.value_or("");
+      return (cur.size() + p.size() <= MAX_VALUE_SIZE) ? cur + p : cur;
+    }
+    case M_MAX: {
+      if (!existing) return p;
+      std::string cur = fit(*existing, n);
+      return le_cmp(cur, p, n) > 0 ? cur : p;
+    }
+    case M_MIN: case M_MIN_V2: {
+      if (!existing) return p;
+      std::string cur = fit(*existing, n);
+      return le_cmp(cur, p, n) < 0 ? cur : p;
+    }
+    case M_BYTE_MIN:
+      if (!existing) return p;
+      return std::min(*existing, p);
+    case M_BYTE_MAX:
+      if (!existing) return p;
+      return std::max(*existing, p);
+    case M_COMPARE_AND_CLEAR:
+      if (existing && *existing == p) return std::nullopt;  // clear
+      return existing;
+    default:
+      return existing;
+  }
+}
+
+// -- the embedded database ---------------------------------------------------
+
+struct Database {
+  std::mutex mu;
+  int64_t version = 0;  // last committed version
+  // MVCC store: per-key version chains (version, value-or-tombstone).
+  std::map<Key, std::vector<std::pair<int64_t, std::optional<Val>>>> chains;
+  // Write-history step function over the keyspace: boundary -> last write
+  // version of the segment [boundary, next boundary). The "" boundary
+  // covers the start of keyspace (same design as the device kernel state).
+  std::map<Key, int64_t> history{{"", -1}};
+
+  int64_t oldest() const { return std::max<int64_t>(0, version - MVCC_WINDOW); }
+
+  std::optional<Val> read(const Key& k, int64_t at) const {
+    auto it = chains.find(k);
+    if (it == chains.end()) return std::nullopt;
+    const auto& chain = it->second;
+    // Last entry with version <= at.
+    auto pos = std::upper_bound(
+        chain.begin(), chain.end(), at,
+        [](int64_t v, const auto& e) { return v < e.first; });
+    if (pos == chain.begin()) return std::nullopt;
+    return std::prev(pos)->second;
+  }
+
+  // Max write version over [b, e) per the step function; an empty interval
+  // has no writes (the reference treats empty conflict ranges as no-ops).
+  int64_t range_max_version(const Key& b, const Key& e) const {
+    if (b >= e) return -1;
+    auto it = history.upper_bound(b);
+    --it;  // segment containing b ("" sentinel guarantees validity)
+    int64_t best = it->second;
+    for (++it; it != history.end() && it->first < e; ++it)
+      best = std::max(best, it->second);
+    return best;
+  }
+
+  // Paint [b, e) with `ver` (split segments at both ends).
+  void paint(const Key& b, const Key& e, int64_t ver) {
+    if (b >= e) return;
+    // Preserve the pre-paint value from e rightward: if no boundary sits at
+    // e, split the segment containing e (prev(upper_bound(e)) is its start;
+    // the "" sentinel guarantees it exists).
+    if (!history.count(e)) {
+      int64_t at_e = std::prev(history.upper_bound(e))->second;
+      history[e] = at_e;
+    }
+    // Replace all boundaries in [b, e) with one segment [b, e) -> ver.
+    history.erase(history.lower_bound(b), history.lower_bound(e));
+    history[b] = ver;
+  }
+};
+
+struct RangeResult {
+  std::vector<std::pair<Key, Val>> kvs;
+  bool more = false;
+};
+
+struct Transaction {
+  Database* db;
+  int64_t read_version = -1;  // lazily acquired
+  int64_t committed_version = -1;
+  bool committed = false;
+  int last_error = ERR_OK;
+
+  // RYW overlay: program-order per-key outcome, either a known value
+  // ("value" entry; nullopt = cleared) or a pending atomic-op fold.
+  struct Overlay {
+    bool is_ops = false;
+    std::optional<Val> value;
+    std::vector<std::pair<int, std::string>> ops;
+  };
+  std::map<Key, Overlay> overlay;
+  std::vector<std::pair<Key, Key>> clears;  // cleared ranges
+  std::vector<std::pair<Key, Key>> read_ranges;
+  std::vector<std::pair<Key, Key>> write_ranges;
+  // Mutation log in program order for commit: (type, key/begin, val/end).
+  std::vector<std::tuple<int, std::string, std::string>> mutations;
+  // Arena for values handed out to C callers (valid until reset/destroy).
+  // deque, not vector: element addresses must be stable across push_back
+  // (vector reallocation would move SSO string buffers and dangle earlier
+  // returned pointers).
+  std::deque<std::string> arena;
+  std::vector<RangeResult*> ranges;
+
+  ~Transaction() { reset(); }
+
+  void reset() {
+    read_version = -1;
+    committed_version = -1;
+    committed = false;
+    last_error = ERR_OK;
+    overlay.clear();
+    clears.clear();
+    read_ranges.clear();
+    write_ranges.clear();
+    mutations.clear();
+    arena.clear();
+    for (auto* r : ranges) delete r;
+    ranges.clear();
+  }
+
+  int64_t grv() {
+    if (read_version < 0) {
+      std::lock_guard<std::mutex> g(db->mu);
+      read_version = db->version;
+    }
+    return read_version;
+  }
+
+  bool covered_by_clear(const Key& k) const {
+    for (const auto& [b, e] : clears)
+      if (b <= k && k < e) return true;
+    return false;
+  }
+
+  // Snapshot + overlay read (the RYW contract).
+  int get(const Key& k, bool snapshot, std::optional<Val>* out) {
+    if (k.size() > MAX_KEY_SIZE) return ERR_KEY_TOO_LARGE;
+    grv();
+    {
+      std::lock_guard<std::mutex> g(db->mu);
+      if (read_version < db->oldest()) return ERR_TOO_OLD;
+      auto ov = overlay.find(k);
+      if (ov != overlay.end() && !ov->second.is_ops) {
+        *out = ov->second.value;
+        return ERR_OK;  // known locally: no conflict range (reference RYW)
+      }
+      std::optional<Val> base =
+          covered_by_clear(k) ? std::nullopt : db->read(k, read_version);
+      if (ov != overlay.end()) {
+        for (const auto& [op, p] : ov->second.ops) base = apply_atomic(op, base, p);
+      }
+      *out = base;
+    }
+    if (!snapshot) {
+      Key end = k;
+      end.push_back('\0');
+      read_ranges.emplace_back(k, end);
+    }
+    return ERR_OK;
+  }
+
+  int get_range(const Key& b, const Key& e, int limit, bool reverse,
+                bool snapshot, RangeResult* out) {
+    if (b > e) return ERR_INVERTED_RANGE;
+    grv();
+    std::vector<Key> keys;
+    {
+      std::lock_guard<std::mutex> g(db->mu);
+      if (read_version < db->oldest()) return ERR_TOO_OLD;
+      for (auto it = db->chains.lower_bound(b);
+           it != db->chains.end() && it->first < e; ++it)
+        keys.push_back(it->first);
+      const size_t n_store = keys.size();  // sorted prefix (map order)
+      for (const auto& [k, ov] : overlay) {
+        (void)ov;
+        if (b <= k && k < e &&
+            !std::binary_search(keys.begin(), keys.begin() + n_store, k))
+          keys.push_back(k);
+      }
+      std::sort(keys.begin(), keys.end());
+      if (reverse) std::reverse(keys.begin(), keys.end());
+      for (const auto& k : keys) {
+        std::optional<Val> v;
+        auto ov = overlay.find(k);
+        if (ov != overlay.end() && !ov->second.is_ops) {
+          v = ov->second.value;
+        } else {
+          v = covered_by_clear(k) ? std::nullopt : db->read(k, read_version);
+          if (ov != overlay.end())
+            for (const auto& [op, p] : ov->second.ops) v = apply_atomic(op, v, p);
+        }
+        if (v) {
+          out->kvs.emplace_back(k, *v);
+          if (limit > 0 && static_cast<int>(out->kvs.size()) >= limit) {
+            out->more = true;
+            break;
+          }
+        }
+      }
+    }
+    if (!snapshot) {
+      // Trim the conflict range to what was actually scanned when a limit
+      // truncated the read (reference RYW does the same) — otherwise a
+      // paginated scan conflicts with writes beyond the page it saw.
+      if (!out->more || out->kvs.empty()) {
+        read_ranges.emplace_back(b, e);
+      } else if (!reverse) {
+        read_ranges.emplace_back(b, out->kvs.back().first + std::string(1, '\0'));
+      } else {
+        read_ranges.emplace_back(out->kvs.back().first, e);
+      }
+    }
+    return ERR_OK;
+  }
+
+  void set(const Key& k, const Val& v) {
+    overlay[k] = Overlay{false, v, {}};
+    mutations.emplace_back(M_SET, k, v);
+    Key end = k;
+    end.push_back('\0');
+    write_ranges.emplace_back(k, end);
+  }
+
+  void clear(const Key& k) {
+    overlay[k] = Overlay{false, std::nullopt, {}};
+    mutations.emplace_back(M_CLEAR_RANGE, k, k + std::string(1, '\0'));
+    Key end = k;
+    end.push_back('\0');
+    write_ranges.emplace_back(k, end);
+  }
+
+  void clear_range(const Key& b, const Key& e) {
+    for (auto it = overlay.lower_bound(b);
+         it != overlay.end() && it->first < e;)
+      it = overlay.erase(it);
+    clears.emplace_back(b, e);
+    mutations.emplace_back(M_CLEAR_RANGE, b, e);
+    write_ranges.emplace_back(b, e);
+  }
+
+  int atomic_op(int op, const Key& k, const std::string& p) {
+    switch (op) {
+      case M_ADD: case M_AND: case M_OR: case M_XOR: case M_APPEND_IF_FITS:
+      case M_MAX: case M_MIN: case M_BYTE_MIN: case M_BYTE_MAX:
+      case M_MIN_V2: case M_AND_V2: case M_COMPARE_AND_CLEAR:
+        break;
+      default:
+        return ERR_CLIENT_INVALID_OP;
+    }
+    auto ov = overlay.find(k);
+    if (ov != overlay.end() && !ov->second.is_ops) {
+      ov->second.value = apply_atomic(op, ov->second.value, p);  // known base
+    } else if (ov != overlay.end()) {
+      ov->second.ops.emplace_back(op, p);
+    } else {
+      Overlay o;
+      o.is_ops = true;
+      o.ops.emplace_back(op, p);
+      overlay[k] = std::move(o);
+    }
+    mutations.emplace_back(op, k, p);
+    Key end = k;
+    end.push_back('\0');
+    write_ranges.emplace_back(k, end);
+    return ERR_OK;
+  }
+
+  int commit() {
+    if (committed) return ERR_USED_DURING_COMMIT;
+    grv();
+    std::lock_guard<std::mutex> g(db->mu);
+    if (read_version < db->oldest()) return ERR_TOO_OLD;
+    // Conflict check: any write committed after our read version that
+    // overlaps a read range aborts us (reference resolver semantics).
+    for (const auto& [b, e] : read_ranges)
+      if (db->range_max_version(b, e) > read_version) return ERR_NOT_COMMITTED;
+    // Read-only means no mutations AND no (manual) write conflict ranges —
+    // an add_write_conflict_range-only transaction must still paint, or it
+    // could never abort anybody (its entire purpose).
+    if (mutations.empty() && write_ranges.empty()) {
+      committed = true;
+      committed_version = read_version;
+      return ERR_OK;
+    }
+    const int64_t ver = ++db->version;
+    for (const auto& [op, k, v] : mutations) {
+      if (op == M_SET) {
+        write_at(k, ver, v);
+      } else if (op == M_CLEAR_RANGE) {
+        for (auto it = db->chains.lower_bound(k);
+             it != db->chains.end() && it->first < v; ++it) {
+          if (db->read(it->first, ver)) write_at(it->first, ver, std::nullopt);
+        }
+      } else {
+        write_at(k, ver, apply_atomic(op, db->read(k, ver), v));
+      }
+    }
+    for (const auto& [b, e] : write_ranges) db->paint(b, e, ver);
+    committed = true;
+    committed_version = ver;
+    return ERR_OK;
+  }
+
+  void write_at(const Key& k, int64_t ver, const std::optional<Val>& v) {
+    auto& chain = db->chains[k];
+    if (!chain.empty() && chain.back().first == ver)
+      chain.back().second = v;
+    else
+      chain.emplace_back(ver, v);
+  }
+};
+
+}  // namespace
+
+// -- C ABI -------------------------------------------------------------------
+
+extern "C" {
+
+void* fdb_tpu_create_database() { return new Database(); }
+void fdb_tpu_destroy_database(void* db) { delete static_cast<Database*>(db); }
+
+int64_t fdb_tpu_database_get_version(void* db) {
+  Database* d = static_cast<Database*>(db);
+  std::lock_guard<std::mutex> g(d->mu);
+  return d->version;
+}
+
+void* fdb_tpu_database_create_transaction(void* db) {
+  Transaction* t = new Transaction();
+  t->db = static_cast<Database*>(db);
+  return t;
+}
+
+void fdb_tpu_transaction_destroy(void* tr) { delete static_cast<Transaction*>(tr); }
+void fdb_tpu_transaction_reset(void* tr) { static_cast<Transaction*>(tr)->reset(); }
+
+int64_t fdb_tpu_transaction_get_read_version(void* tr) {
+  return static_cast<Transaction*>(tr)->grv();
+}
+
+void fdb_tpu_transaction_set_read_version(void* tr, int64_t v) {
+  static_cast<Transaction*>(tr)->read_version = v;
+}
+
+int fdb_tpu_transaction_get(void* tr, const uint8_t* key, int klen, int snapshot,
+                            const uint8_t** out_val, int* out_len,
+                            int* out_present) {
+  Transaction* t = static_cast<Transaction*>(tr);
+  std::optional<Val> v;
+  int err = t->get(Key(reinterpret_cast<const char*>(key), klen), snapshot, &v);
+  if (err) return err;
+  *out_present = v.has_value() ? 1 : 0;
+  if (v) {
+    t->arena.push_back(std::move(*v));
+    *out_val = reinterpret_cast<const uint8_t*>(t->arena.back().data());
+    *out_len = static_cast<int>(t->arena.back().size());
+  } else {
+    *out_val = nullptr;
+    *out_len = 0;
+  }
+  return ERR_OK;
+}
+
+// Range reads: returns a handle; iterate with the accessors below. The
+// handle (and all returned pointers) live until transaction reset/destroy.
+int fdb_tpu_transaction_get_range(void* tr, const uint8_t* b, int blen,
+                                  const uint8_t* e, int elen, int limit,
+                                  int reverse, int snapshot, void** out_handle,
+                                  int* out_count, int* out_more) {
+  Transaction* t = static_cast<Transaction*>(tr);
+  RangeResult* r = new RangeResult();
+  int err = t->get_range(Key(reinterpret_cast<const char*>(b), blen),
+                         Key(reinterpret_cast<const char*>(e), elen), limit,
+                         reverse != 0, snapshot != 0, r);
+  if (err) {
+    delete r;
+    return err;
+  }
+  t->ranges.push_back(r);
+  *out_handle = r;
+  *out_count = static_cast<int>(r->kvs.size());
+  *out_more = r->more ? 1 : 0;
+  return ERR_OK;
+}
+
+void fdb_tpu_range_kv(void* handle, int i, const uint8_t** k, int* klen,
+                      const uint8_t** v, int* vlen) {
+  RangeResult* r = static_cast<RangeResult*>(handle);
+  const auto& [key, val] = r->kvs[i];
+  *k = reinterpret_cast<const uint8_t*>(key.data());
+  *klen = static_cast<int>(key.size());
+  *v = reinterpret_cast<const uint8_t*>(val.data());
+  *vlen = static_cast<int>(val.size());
+}
+
+int fdb_tpu_transaction_set(void* tr, const uint8_t* k, int klen,
+                            const uint8_t* v, int vlen) {
+  if (static_cast<size_t>(klen) > MAX_KEY_SIZE) return ERR_KEY_TOO_LARGE;
+  if (static_cast<size_t>(vlen) > MAX_VALUE_SIZE) return ERR_VALUE_TOO_LARGE;
+  static_cast<Transaction*>(tr)->set(Key(reinterpret_cast<const char*>(k), klen),
+                                     Val(reinterpret_cast<const char*>(v), vlen));
+  return ERR_OK;
+}
+
+int fdb_tpu_transaction_clear(void* tr, const uint8_t* k, int klen) {
+  if (static_cast<size_t>(klen) > MAX_KEY_SIZE) return ERR_KEY_TOO_LARGE;
+  static_cast<Transaction*>(tr)->clear(Key(reinterpret_cast<const char*>(k), klen));
+  return ERR_OK;
+}
+
+int fdb_tpu_transaction_clear_range(void* tr, const uint8_t* b, int blen,
+                                    const uint8_t* e, int elen) {
+  Key kb(reinterpret_cast<const char*>(b), blen), ke(reinterpret_cast<const char*>(e), elen);
+  if (kb > ke) return ERR_INVERTED_RANGE;
+  static_cast<Transaction*>(tr)->clear_range(kb, ke);
+  return ERR_OK;
+}
+
+int fdb_tpu_transaction_atomic_op(void* tr, const uint8_t* k, int klen,
+                                  const uint8_t* p, int plen, int op) {
+  if (static_cast<size_t>(klen) > MAX_KEY_SIZE) return ERR_KEY_TOO_LARGE;
+  return static_cast<Transaction*>(tr)->atomic_op(
+      op, Key(reinterpret_cast<const char*>(k), klen),
+      std::string(reinterpret_cast<const char*>(p), plen));
+}
+
+int fdb_tpu_transaction_add_conflict_range(void* tr, const uint8_t* b, int blen,
+                                           const uint8_t* e, int elen,
+                                           int write) {
+  Transaction* t = static_cast<Transaction*>(tr);
+  Key kb(reinterpret_cast<const char*>(b), blen), ke(reinterpret_cast<const char*>(e), elen);
+  if (kb > ke) return ERR_INVERTED_RANGE;
+  (write ? t->write_ranges : t->read_ranges).emplace_back(kb, ke);
+  return ERR_OK;
+}
+
+int fdb_tpu_transaction_commit(void* tr, int64_t* out_version) {
+  Transaction* t = static_cast<Transaction*>(tr);
+  int err = t->commit();
+  if (!err) *out_version = t->committed_version;
+  return err;
+}
+
+int64_t fdb_tpu_transaction_get_committed_version(void* tr) {
+  return static_cast<Transaction*>(tr)->committed_version;
+}
+
+const char* fdb_tpu_get_error(int code) {
+  switch (code) {
+    case ERR_OK: return "success";
+    case ERR_TOO_OLD: return "transaction_too_old";
+    case ERR_NOT_COMMITTED: return "not_committed";
+    case ERR_COMMIT_UNKNOWN: return "commit_unknown_result";
+    case ERR_USED_DURING_COMMIT: return "used_during_commit";
+    case ERR_KEY_TOO_LARGE: return "key_too_large";
+    case ERR_VALUE_TOO_LARGE: return "value_too_large";
+    case ERR_INVERTED_RANGE: return "inverted_range";
+    case ERR_CLIENT_INVALID_OP: return "client_invalid_operation";
+    default: return "unknown_error";
+  }
+}
+
+// predicate 50000 = fdb_error_predicate RETRYABLE (reference fdb_c.h).
+int fdb_tpu_error_predicate(int predicate, int code) {
+  if (predicate == 50000)
+    return code == ERR_NOT_COMMITTED || code == ERR_TOO_OLD ||
+           code == ERR_COMMIT_UNKNOWN;
+  return 0;
+}
+
+}  // extern "C"

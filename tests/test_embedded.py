@@ -1,0 +1,320 @@
+"""Embedded C engine (native/fdb_tpu_c.cpp via client/embedded.py).
+
+Mirrors the reference binding tester's API coverage (bindings/bindingtester)
+against the fdb_c-shaped surface: transactional semantics, RYW overlay,
+conflict detection parity with the Python model, atomic-op parity with
+core.mutations.apply_atomic, and the tuple layer running unchanged on top."""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.client.embedded import EmbeddedDatabase
+from foundationdb_tpu.core.errors import (
+    FdbError,
+    InvertedRange,
+    NotCommitted,
+    UsedDuringCommit,
+)
+from foundationdb_tpu.core.mutations import ATOMIC_OPS, MutationType as M, apply_atomic
+from foundationdb_tpu.layers import Subspace, pack
+
+
+@pytest.fixture
+def db():
+    d = EmbeddedDatabase()
+    yield d
+    d.close()
+
+
+class TestBasics:
+    def test_set_commit_get(self, db):
+        tr = db.transaction()
+        tr.set(b"hello", b"world")
+        v = tr.commit()
+        assert v > 0
+        tr2 = db.transaction()
+        assert tr2.get(b"hello") == b"world"
+        assert tr2.get(b"missing") is None
+
+    def test_keys_with_nuls(self, db):
+        key, val = b"a\x00b\x00", b"v\x00v"
+        tr = db.transaction()
+        tr.set(key, val)
+        tr.commit()
+        assert db.transaction().get(key) == val
+
+    def test_ryw_overlay(self, db):
+        tr = db.transaction()
+        tr.set(b"k", b"1")
+        assert tr.get(b"k") == b"1"  # own write visible before commit
+        tr.clear(b"k")
+        assert tr.get(b"k") is None
+        tr.commit()
+        assert db.transaction().get(b"k") is None
+
+    def test_commit_twice_raises(self, db):
+        tr = db.transaction()
+        tr.set(b"x", b"1")
+        tr.commit()
+        with pytest.raises(UsedDuringCommit):
+            tr.commit()
+
+    def test_reset_reuses_handle(self, db):
+        tr = db.transaction()
+        tr.set(b"a", b"1")
+        tr.commit()
+        tr.reset()
+        tr.set(b"b", b"2")
+        tr.commit()
+        t = db.transaction()
+        assert t.get(b"a") == b"1" and t.get(b"b") == b"2"
+
+    def test_inverted_range_raises(self, db):
+        with pytest.raises(InvertedRange):
+            db.transaction().clear_range(b"z", b"a")
+
+
+class TestConflicts:
+    def test_rmw_conflict(self, db):
+        tr = db.transaction()
+        tr.set(b"ctr", b"0")
+        tr.commit()
+        t1, t2 = db.transaction(), db.transaction()
+        v1, v2 = t1.get(b"ctr"), t2.get(b"ctr")
+        assert v1 == v2 == b"0"
+        t1.set(b"ctr", b"1")
+        t1.commit()
+        t2.set(b"ctr", b"2")
+        with pytest.raises(NotCommitted):
+            t2.commit()
+
+    def test_snapshot_read_no_conflict(self, db):
+        tr0 = db.transaction()
+        tr0.set(b"k", b"0")
+        tr0.commit()
+        t1, t2 = db.transaction(), db.transaction()
+        t1.get(b"k")  # snapshot=False on t1: will conflict
+        t2.get(b"k", snapshot=True)  # snapshot read: no conflict range
+        w = db.transaction()
+        w.set(b"k", b"9")
+        w.commit()
+        t2.set(b"other", b"1")
+        t2.commit()  # fine
+        t1.set(b"other2", b"1")
+        with pytest.raises(NotCommitted):
+            t1.commit()
+
+    def test_blind_writes_never_conflict(self, db):
+        t1, t2 = db.transaction(), db.transaction()
+        t1.get_read_version(), t2.get_read_version()
+        t1.set(b"k", b"a")
+        t2.set(b"k", b"b")
+        t1.commit()
+        t2.commit()  # write-write does not conflict (no read range)
+        assert db.transaction().get(b"k") == b"b"
+
+    def test_range_read_conflicts_with_insert(self, db):
+        t1 = db.transaction()
+        t1.get_range(b"r/", b"r0")  # read the (empty) range
+        w = db.transaction()
+        w.set(b"r/new", b"1")
+        w.commit()
+        t1.set(b"out", b"1")
+        with pytest.raises(NotCommitted):
+            t1.commit()  # phantom prevented
+
+    def test_manual_conflict_ranges(self, db):
+        t1 = db.transaction()
+        t1.get_read_version()
+        t1.add_read_conflict_range(b"m/", b"m0")
+        w = db.transaction()
+        w.set(b"m/x", b"1")
+        w.commit()
+        t1.set(b"y", b"1")
+        with pytest.raises(NotCommitted):
+            t1.commit()
+
+    def test_retry_loop_converges(self, db):
+        tr = db.transaction()
+        tr.set(b"ctr", (0).to_bytes(8, "little"))
+        tr.commit()
+
+        def incr(t):
+            cur = int.from_bytes(t.get(b"ctr"), "little")
+            t.set(b"ctr", (cur + 1).to_bytes(8, "little"))
+
+        for _ in range(10):
+            db.run(incr)
+        assert int.from_bytes(db.transaction().get(b"ctr"), "little") == 10
+
+
+class TestAtomicOps:
+    def test_add(self, db):
+        tr = db.transaction()
+        tr.atomic_op(M.ADD, b"n", (5).to_bytes(8, "little"))
+        tr.commit()
+        tr = db.transaction()
+        tr.atomic_op(M.ADD, b"n", (7).to_bytes(8, "little"))
+        tr.commit()
+        assert int.from_bytes(db.transaction().get(b"n"), "little") == 12
+
+    def test_ryw_atomic_read_through(self, db):
+        tr = db.transaction()
+        tr.set(b"n", (10).to_bytes(8, "little"))
+        tr.commit()
+        tr = db.transaction()
+        tr.atomic_op(M.ADD, b"n", (5).to_bytes(8, "little"))
+        # RYW folds the pending op over the snapshot value.
+        assert int.from_bytes(tr.get(b"n"), "little") == 15
+
+    def test_compare_and_clear(self, db):
+        tr = db.transaction()
+        tr.set(b"k", b"gone")
+        tr.commit()
+        tr = db.transaction()
+        tr.atomic_op(M.COMPARE_AND_CLEAR, b"k", b"gone")
+        tr.commit()
+        assert db.transaction().get(b"k") is None
+
+    @pytest.mark.parametrize("op", sorted(ATOMIC_OPS, key=int))
+    def test_parity_with_python_model(self, db, op):
+        """Randomized: embedded result == core.mutations.apply_atomic."""
+        rng = random.Random(int(op))
+        key = b"parity/%d" % int(op)
+        model = None
+        for i in range(30):
+            if rng.random() < 0.2:
+                val = rng.randbytes(rng.randrange(1, 13))
+                tr = db.transaction()
+                tr.set(key, val)
+                tr.commit()
+                model = val
+            param = rng.randbytes(rng.randrange(1, 13))
+            tr = db.transaction()
+            tr.atomic_op(op, key, param)
+            tr.commit()
+            model = apply_atomic(op, model, param)
+            assert db.transaction().get(key) == model, f"{op.name} step {i}"
+
+
+class TestRegressions:
+    def test_write_conflict_range_only_txn_aborts_readers(self, db):
+        """A txn with ONLY a manual write conflict range (no mutations) must
+        still paint it — that's its entire purpose."""
+        t1 = db.transaction()
+        t1.get(b"wk")  # read conflict range on wk
+        locker = db.transaction()
+        locker.get_read_version()
+        locker.add_write_conflict_range(b"wk", b"wk\x00")
+        locker.commit()
+        t1.set(b"other", b"1")
+        with pytest.raises(NotCommitted):
+            t1.commit()
+
+    def test_limit_trimmed_range_conflict(self, db):
+        """A limit-truncated scan conflicts only with the page it saw."""
+        tr = db.transaction()
+        for i in range(5):
+            tr.set(b"p/%d" % i, b"x")
+        tr.commit()
+        t1 = db.transaction()
+        t1.get_range(b"p/", b"p0", limit=2)  # saw p/0, p/1 only
+        w = db.transaction()
+        w.set(b"p/4", b"changed")  # beyond the scanned page
+        w.commit()
+        t1.set(b"out", b"1")
+        t1.commit()  # must NOT conflict
+        t2 = db.transaction()
+        t2.get_range(b"p/", b"p0", limit=2)
+        w2 = db.transaction()
+        w2.set(b"p/1", b"changed")  # inside the scanned page
+        w2.commit()
+        t2.set(b"out2", b"1")
+        with pytest.raises(NotCommitted):
+            t2.commit()
+
+    def test_empty_range_is_noop(self, db):
+        t1 = db.transaction()
+        t1.get_range(b"x", b"x")  # empty interval: no conflict range
+        w = db.transaction()
+        w.set(b"x", b"1")
+        w.commit()
+        t1.set(b"y", b"1")
+        t1.commit()  # fine
+
+    def test_atomic_param_longer_than_8_bytes(self, db):
+        param = (2**75 + 12345).to_bytes(12, "little")
+        tr = db.transaction()
+        tr.atomic_op(M.ADD, b"big", param)
+        tr.commit()
+        tr = db.transaction()
+        tr.atomic_op(M.ADD, b"big", param)
+        tr.commit()
+        got = int.from_bytes(db.transaction().get(b"big"), "little")
+        assert got == 2 * (2**75 + 12345)
+
+    def test_use_after_close_raises(self, db):
+        tr = db.transaction()
+        tr.close()
+        with pytest.raises(FdbError):
+            tr.get(b"k")
+        d2 = EmbeddedDatabase()
+        d2.close()
+        with pytest.raises(FdbError):
+            d2.transaction()
+
+
+class TestRanges:
+    def test_range_read_with_overlay_and_clears(self, db):
+        tr = db.transaction()
+        for i in range(10):
+            tr.set(b"r/%02d" % i, b"v%d" % i)
+        tr.commit()
+        tr = db.transaction()
+        tr.set(b"r/10", b"new")  # uncommitted insert visible
+        tr.clear(b"r/03")
+        tr.clear_range(b"r/05", b"r/08")
+        rows = tr.get_range(b"r/", b"r0")
+        keys = [k for k, _ in rows]
+        assert b"r/10" in keys
+        assert b"r/03" not in keys and b"r/05" not in keys and b"r/07" not in keys
+        assert b"r/08" in keys
+
+    def test_limit_and_reverse(self, db):
+        tr = db.transaction()
+        for i in range(5):
+            tr.set(b"s/%d" % i, b"x")
+        tr.commit()
+        tr = db.transaction()
+        rows = tr.get_range(b"s/", b"s0", limit=2)
+        assert [k for k, _ in rows] == [b"s/0", b"s/1"]
+        rows = tr.get_range(b"s/", b"s0", limit=2, reverse=True)
+        assert [k for k, _ in rows] == [b"s/4", b"s/3"]
+
+    def test_mvcc_snapshot_isolation(self, db):
+        tr = db.transaction()
+        tr.set(b"iso", b"old")
+        tr.commit()
+        reader = db.transaction()
+        assert reader.get(b"iso", snapshot=True) == b"old"
+        w = db.transaction()
+        w.set(b"iso", b"new")
+        w.commit()
+        # Reader still sees its snapshot.
+        assert reader.get(b"iso", snapshot=True) == b"old"
+        assert db.transaction().get(b"iso") == b"new"
+
+
+class TestLayersOnEmbedded:
+    def test_tuple_layer_runs_on_top(self, db):
+        s = Subspace(("app", 1))
+        tr = db.transaction()
+        tr.set(s.pack(("user", 42)), pack(("alice", True)))
+        tr.set(s.pack(("user", 43)), pack(("bob", False)))
+        tr.commit()
+        tr = db.transaction()
+        b, e = s.range(("user",))
+        rows = tr.get_range(b, e)
+        assert len(rows) == 2
+        assert s.unpack(rows[0][0]) == ("user", 42)
