@@ -1,0 +1,290 @@
+"""Backup and restore: range snapshots + a continuous mutation log.
+
+Reference: fdbclient/FileBackupAgent.actor.cpp + the backup workers in
+fdbserver. The reference's design, kept here:
+
+- **Mutation log**: while a backup is active, commit proxies dual-tag every
+  committed batch's mutations with a dedicated backup tag; a BackupWorker
+  pulls that tag from the tlogs (exactly like a storage server pulls its
+  own tag) and appends (version, mutations) log entries to the backup
+  container. The log is therefore exactly the durable commit stream.
+- **Range snapshot**: the agent scans the keyspace in chunks, each chunk a
+  consistent read at its own version (the reference's snapshots are rolling,
+  NOT single-version — consistency comes from combining with the log).
+- **Restorable version**: once the snapshot pass completes, any version V
+  with  max(chunk versions) <= V <= max log version  is restorable: apply
+  each chunk at its version, then replay log mutations in (chunk_version, V]
+  for keys in that chunk's range.
+
+Restore applies that recipe through ordinary transactions, so it works
+against a live cluster (or the embedded engine — anything with the
+transaction surface).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType
+
+# The pseudo storage tag backup mutations ride under (reference: backup
+# workers get their own tag ranges; storage tags here are >= 0).
+BACKUP_TAG = -1
+
+
+class RestoreError(FdbError):
+    code = 2310  # reference: restore_error
+
+
+@dataclass
+class RangeChunk:
+    """One consistent range-file: [begin, end) scanned at `version`."""
+
+    begin: bytes
+    end: bytes
+    version: int
+    kvs: list[tuple[bytes, bytes]]
+
+
+@dataclass
+class BackupContainer:
+    """In-memory backup container (reference: IBackupContainer). Holds the
+    snapshot chunks and the mutation log; save/load give it a file form."""
+
+    chunks: list[RangeChunk] = field(default_factory=list)
+    # Ascending (version, [Mutation]) — the durable commit stream.
+    log: list[tuple[int, list[Mutation]]] = field(default_factory=list)
+    snapshot_complete: bool = False
+
+    def add_log(self, version: int, mutations: list[Mutation]) -> None:
+        assert not self.log or version > self.log[-1][0]
+        self.log.append((version, mutations))
+
+    @property
+    def log_end_version(self) -> int:
+        return self.log[-1][0] if self.log else 0
+
+    def restorable_version(self) -> int | None:
+        """Max version this container can restore to, or None."""
+        if not self.snapshot_complete:
+            return None
+        snap_max = max((c.version for c in self.chunks), default=0)
+        end = max(self.log_end_version, snap_max)
+        return end if end >= snap_max else None
+
+    # -- file form (JSON lines; values hex — keys are arbitrary bytes) ------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for c in self.chunks:
+                f.write(json.dumps({
+                    "t": "range", "b": c.begin.hex(), "e": c.end.hex(),
+                    "v": c.version,
+                    "kvs": [[k.hex(), v.hex()] for k, v in c.kvs],
+                }) + "\n")
+            for version, muts in self.log:
+                f.write(json.dumps({
+                    "t": "log", "v": version,
+                    "m": [[int(m.type), m.param1.hex(), m.param2.hex()]
+                          for m in muts],
+                }) + "\n")
+            f.write(json.dumps({"t": "meta",
+                                "snapshot_complete": self.snapshot_complete}) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "BackupContainer":
+        out = cls()
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["t"] == "range":
+                    out.chunks.append(RangeChunk(
+                        bytes.fromhex(rec["b"]), bytes.fromhex(rec["e"]),
+                        rec["v"],
+                        [(bytes.fromhex(k), bytes.fromhex(v))
+                         for k, v in rec["kvs"]]))
+                elif rec["t"] == "log":
+                    out.log.append((rec["v"], [
+                        Mutation(MutationType(t), bytes.fromhex(p1),
+                                 bytes.fromhex(p2))
+                        for t, p1, p2 in rec["m"]]))
+                else:
+                    out.snapshot_complete = rec["snapshot_complete"]
+        return out
+
+
+class BackupWorker:
+    """Pulls the backup tag from the tlog into the container (reference:
+    the backup worker role pulling its tag range). Rides recoveries the
+    same way storage does: reads the cluster's CURRENT tlog endpoint each
+    iteration and tolerates unreachability."""
+
+    PULL_INTERVAL = 0.002
+    RETRY = 0.05
+
+    def __init__(self, cluster, container: BackupContainer):
+        self.cluster = cluster
+        self.container = container
+        self._version = 0  # log pulled through this version
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    async def run(self) -> None:
+        loop = self.cluster.loop
+        while not self._stop:
+            tlog = self.cluster.tlog_eps[0]
+            try:
+                entries, end_version, _kc = await tlog.peek(
+                    BACKUP_TAG, self._version + 1
+                )
+                for version, mutations in entries:
+                    if version > self._version:
+                        self.container.add_log(version, mutations)
+                        self._version = version
+                if end_version > self._version:
+                    self._version = end_version
+                await tlog.pop(BACKUP_TAG, self._version)
+            except Exception:
+                await loop.sleep(self.RETRY)
+                continue
+            await loop.sleep(self.PULL_INTERVAL)
+
+
+class BackupAgent:
+    """Drives a backup: enable the proxies' dual-tagging, run the worker,
+    take the rolling range snapshot (reference: FileBackupAgent's task
+    bucket executing range tasks + log tasks)."""
+
+    CHUNK_LIMIT = 1000  # keys per range chunk
+
+    def __init__(self, cluster, db):
+        self.cluster = cluster
+        self.db = db
+        self.container = BackupContainer()
+        self._worker: BackupWorker | None = None
+        self._worker_task = None
+
+    async def start(self) -> None:
+        """Begin continuous backup: log first, then snapshot (the log must
+        cover every snapshot chunk's version onward)."""
+        # Un-retire the tag (a previous backup may have retired it).
+        self.cluster.retired_tags.discard(BACKUP_TAG)
+        for ep in self.cluster.tlog_eps:
+            try:
+                await ep.register_tag(BACKUP_TAG)
+            except Exception:
+                pass
+        await self._set_proxies(True)
+        self._worker = BackupWorker(self.cluster, self.container)
+        self.cluster.backup_worker = self._worker  # recovery bounds salvage by it
+        self._worker_task = self.cluster.loop.spawn(
+            self._worker.run(), name="backup.worker"
+        )
+
+    async def snapshot(self, begin: bytes = b"", end: bytes = b"\xff") -> None:
+        """Rolling range snapshot in chunks; each chunk consistent at its
+        own read version."""
+        cursor = begin
+        while cursor < end:
+            async def chunk_read(tr, cursor=cursor):
+                rows = await tr.get_range(cursor, end, limit=self.CHUNK_LIMIT)
+                return rows, await tr.get_read_version()
+
+            rows, version = await self.db.run(chunk_read)
+            if len(rows) >= self.CHUNK_LIMIT:
+                chunk_end = rows[-1][0] + b"\x00"
+            else:
+                chunk_end = end
+            self.container.chunks.append(
+                RangeChunk(cursor, chunk_end, version, rows)
+            )
+            cursor = chunk_end
+        self.container.snapshot_complete = True
+
+    async def stop(self) -> None:
+        """End the backup: stop dual-tagging and retire the backup tag so
+        the tlogs' trim floor is not pinned forever."""
+        await self._set_proxies(False)
+        if self._worker:
+            self._worker.stop()
+        self.cluster.backup_worker = None
+        # Persistent retirement: future generations' tlogs are constructed
+        # with the tag already retired, and late backup-tagged pushes (a
+        # batch that read the flag before the disable) cannot re-pin the
+        # trim floor.
+        self.cluster.retired_tags.add(BACKUP_TAG)
+        for ep in self.cluster.tlog_eps:
+            try:
+                await ep.retire_tag(BACKUP_TAG)
+            except Exception:
+                pass
+
+    async def _set_proxies(self, enabled: bool) -> None:
+        self.cluster.backup_active = enabled  # recruiter propagates on recovery
+        for ep in self.cluster.commit_proxy_eps:
+            try:
+                await ep.set_backup_enabled(enabled)
+            except Exception:
+                pass  # dead proxy: its generation is being replaced anyway
+
+
+async def restore(db, container: BackupContainer, target_version: int | None = None,
+                  batch: int = 500) -> int:
+    """Restore the container into `db` (reference: FileBackupAgent restore):
+    clear the target range, apply each range chunk at its version, then
+    replay log mutations in (chunk.version, target] clipped to the chunk's
+    key range. Returns the restored version."""
+    restorable = container.restorable_version()
+    if restorable is None:
+        raise RestoreError("backup not restorable: snapshot incomplete")
+    target = restorable if target_version is None else target_version
+    if target < max((c.version for c in container.chunks), default=0):
+        raise RestoreError(f"target {target} predates the snapshot")
+    if target > max(container.log_end_version,
+                    max((c.version for c in container.chunks), default=0)):
+        raise RestoreError(f"target {target} beyond the log end")
+
+    for chunk in container.chunks:
+        # 1. Clear + apply the chunk snapshot, batched.
+        async def clear_chunk(tr, chunk=chunk):
+            tr.clear_range(chunk.begin, chunk.end)
+
+        await db.run(clear_chunk)
+        for i in range(0, len(chunk.kvs), batch):
+            async def put_batch(tr, rows=chunk.kvs[i : i + batch]):
+                for k, v in rows:
+                    tr.set(k, v)
+
+            await db.run(put_batch)
+
+        # 2. Replay the log over this chunk's key range.
+        muts: list[Mutation] = []
+        for version, mutations in container.log:
+            if version <= chunk.version or version > target:
+                continue
+            for m in mutations:
+                if m.type == MutationType.CLEAR_RANGE:
+                    lo = max(m.param1, chunk.begin)
+                    hi = min(m.param2, chunk.end)
+                    if lo < hi:
+                        muts.append(Mutation(MutationType.CLEAR_RANGE, lo, hi))
+                elif chunk.begin <= m.param1 < chunk.end:
+                    muts.append(m)
+        for i in range(0, len(muts), batch):
+            async def replay(tr, ms=muts[i : i + batch]):
+                for m in ms:
+                    if m.type == MutationType.SET_VALUE:
+                        tr.set(m.param1, m.param2)
+                    elif m.type == MutationType.CLEAR_RANGE:
+                        tr.clear_range(m.param1, m.param2)
+                    elif m.type in ATOMIC_OPS:
+                        tr.atomic_op(m.type, m.param1, m.param2)
+                    else:
+                        raise RestoreError(f"unreplayable mutation {m.type!r}")
+
+            await db.run(replay)
+    return target

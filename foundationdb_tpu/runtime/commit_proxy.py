@@ -25,6 +25,7 @@ from foundationdb_tpu.core.mutations import (
     resolve_versionstamps,
 )
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.runtime.backup import BACKUP_TAG
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 
@@ -70,6 +71,11 @@ class CommitProxy:
         self.storage_map = storage_map
         self.controller = controller_ep
         self.epoch = epoch
+        # Continuous backup: when enabled, every committed mutation is ALSO
+        # tagged with the backup pseudo-tag so the backup worker can pull
+        # the commit stream off the tlogs (reference: proxies write backup
+        # mutations when backup/DR is active; runtime/backup.py).
+        self.backup_enabled = False
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self.txns_committed = 0
         self.txns_conflicted = 0
@@ -84,6 +90,9 @@ class CommitProxy:
         p = Promise()
         self._queue.append((req, p))
         return await p.future
+
+    async def set_backup_enabled(self, enabled: bool) -> None:
+        self.backup_enabled = enabled
 
     async def get_metrics(self) -> dict:
         """Status inputs (reference: commit proxy stats in status json)."""
@@ -289,6 +298,8 @@ class CommitProxy:
                 else:
                     tag = self.storage_map.tag_for_key(m.param1)
                     tagged.setdefault(tag, []).append(m)
+                if self.backup_enabled:
+                    tagged.setdefault(BACKUP_TAG, []).append(m)
         return tagged
 
 

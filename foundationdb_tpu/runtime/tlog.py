@@ -35,17 +35,23 @@ class TLog:
         loop: Loop,
         init_version: int = 0,
         seed: list[tuple[int, dict[int, list[Mutation]]]] | None = None,
+        retired_tags: set[int] | None = None,
     ):
         """`seed`: prior-generation entries salvaged by recovery (versions
         all < init_version); storage servers finish pulling them from this
-        log as if the old generation had never died."""
+        log as if the old generation had never died. `retired_tags`: tags
+        that will never pull again (stopped backups) — excluded from the
+        trim floor even if seed entries or late pushes still carry them."""
         self.loop = loop
         self._log: list[TLogEntry] = [TLogEntry(v, t) for v, t in (seed or [])]
         assert all(e.version < init_version for e in self._log)
         self._version = init_version  # end of applied chain
         self._waiters: dict[int, Promise] = {}
         self._popped: dict[int, int] = {}  # tag -> trimmed-below version
-        self._tags_seen: set[int] = {t for e in self._log for t in e.tagged}
+        self._retired: set[int] = set(retired_tags or ())
+        self._tags_seen: set[int] = {
+            t for e in self._log for t in e.tagged if t not in self._retired
+        }
         self.locked = False
         # Highest version the pushing proxies know is durable on EVERY tlog
         # (reference: knownCommittedVersion in TLogCommitRequest). Storage
@@ -79,7 +85,7 @@ class TLog:
         if self.locked:  # lock won the race while we were "fsyncing"
             raise TLogLocked(f"push v{version} after lock at v{self._version}")
         self._log.append(TLogEntry(version, tagged))
-        self._tags_seen.update(tagged)
+        self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
         self.known_committed = max(self.known_committed, known_committed)
         w = self._waiters.pop(version, None)
@@ -112,6 +118,9 @@ class TLog:
         never popped holds the floor at 0 (no trim) — correct, if unbounded,
         until recovery replaces its storage server."""
         self._popped[tag] = max(self._popped.get(tag, 0), version)
+        self._trim()
+
+    def _trim(self) -> None:
         if not self._tags_seen:
             return  # nothing pushed yet (fresh post-recovery log): no trim
         floor = min(self._popped.get(t, 0) for t in self._tags_seen)
@@ -129,6 +138,20 @@ class TLog:
 
     async def get_version(self) -> int:
         return self._version
+
+    async def retire_tag(self, tag: int) -> None:
+        """Forget a tag that will never pull again (backup stopped): its
+        last pop would otherwise pin the trim floor forever. Persistent —
+        late pushes still carrying the tag (a batch that read the backup
+        flag before the disable) cannot re-add it."""
+        self._retired.add(tag)
+        self._tags_seen.discard(tag)
+        self._popped.pop(tag, None)
+        self._trim()
+
+    async def register_tag(self, tag: int) -> None:
+        """Un-retire a tag (a NEW backup starting after a stopped one)."""
+        self._retired.discard(tag)
 
     async def recover_entries(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
         """Recovery salvage: the un-popped suffix of the log — everything

@@ -73,6 +73,9 @@ class SimCluster:
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
         self.storage_map = KeyShardMap.uniform(n_storages)
         self._gen_processes: list[str] = []  # previous generation, for retirement
+        self.backup_active = False  # BackupAgent sets; survives recoveries
+        self.backup_worker = None  # live BackupWorker (its cursor bounds salvage)
+        self.retired_tags: set[int] = set()  # stopped-backup tags, per tlog
 
         # Storage servers persist across generations (they ARE the data);
         # their tlog endpoint is re-pointed by each recruitment.
@@ -103,12 +106,17 @@ class SimCluster:
     ) -> Generation:
         sfx = "" if epoch == 1 else f".e{epoch}"
         start_version = 0 if epoch == 1 else recovery_version + EPOCH_VERSION_JUMP
-        # Seed only what some storage may still need: salvage can come from a
-        # replica whose log was never trimmed (storages pop one tlog), and
-        # re-seeding its full history would compound across recoveries.
+        # Seed only what some puller may still need: salvage can come from a
+        # replica whose log was never trimmed (pullers pop one tlog), and
+        # re-seeding its full history would compound across recoveries. The
+        # floor is the min over every pull cursor: storage applied versions
+        # AND the backup worker's log cursor when a backup is running.
         floor = min(
-            (min(s._version, recovery_version) for s in self.storages), default=0
+            (min(s._version, recovery_version) for s in self.storages),
+            default=0,
         )
+        if self.backup_active and self.backup_worker is not None:
+            floor = min(floor, self.backup_worker._version)
         seed_entries = [(v, t) for v, t in seed_entries if v > floor]
         heartbeat_eps: dict = {}
 
@@ -133,7 +141,8 @@ class SimCluster:
         ]
 
         self.tlogs = [
-            TLog(self.loop, init_version=start_version, seed=list(seed_entries))
+            TLog(self.loop, init_version=start_version, seed=list(seed_entries),
+                 retired_tags=set(self.retired_tags))
             for _ in range(self.n_tlogs)
         ]
         self.tlog_eps = [
@@ -171,6 +180,8 @@ class SimCluster:
             )
             for _ in range(self.n_proxies)
         ]
+        for c in self.commit_proxies:
+            c.backup_enabled = self.backup_active  # backup spans recoveries
         self.commit_proxy_eps = [
             host(f"commit_proxy{i}{sfx}", f"commit_proxy{i}", c, run=True)
             for i, c in enumerate(self.commit_proxies)

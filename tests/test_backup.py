@@ -1,0 +1,299 @@
+"""Backup/restore: continuous mutation log + rolling snapshot + restore.
+
+Mirrors the reference's BackupToBlob/RestoreFromBlob simulation coverage:
+back up under live writes, restore into a fresh cluster, compare entire
+keyspaces; plus restore-to-a-point and durability of the log across
+recovery."""
+
+import pytest
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.core.mutations import MutationType as M
+from foundationdb_tpu.runtime.backup import (
+    BackupAgent,
+    BackupContainer,
+    RestoreError,
+    restore,
+)
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_db(seed=0, **kw):
+    c = SimCluster(seed=seed, **kw)
+    return c, open_database(c)
+
+
+def run(c, coro, timeout=3000):
+    return c.loop.run(coro, timeout=timeout)
+
+
+async def dump_all(db) -> list:
+    async def body(tr):
+        return await tr.get_range(b"", b"\xff")
+
+    return await db.run(body)
+
+
+class TestBackupRestore:
+    def test_snapshot_then_restore_elsewhere(self):
+        src_c, src = make_db(seed=61)
+        dst_c, dst = make_db(seed=62)
+
+        async def main():
+            async def seed_data(tr):
+                for i in range(50):
+                    tr.set(b"k%03d" % i, b"v%03d" % i)
+
+            await src.run(seed_data)
+            agent = BackupAgent(src_c, src)
+            await agent.start()
+            await agent.snapshot()
+            await agent.stop()
+            return agent.container
+
+        container = run(src_c, main())
+        assert container.restorable_version() is not None
+
+        async def do_restore():
+            await restore(dst, container)
+            return await dump_all(dst)
+
+        rows = run(dst_c, do_restore())
+        assert rows == [(b"k%03d" % i, b"v%03d" % i) for i in range(50)]
+
+    def test_continuous_backup_captures_live_writes(self):
+        """Writes AFTER the snapshot land in the mutation log and restore."""
+        src_c, src = make_db(seed=63)
+        dst_c, dst = make_db(seed=64)
+
+        async def main():
+            async def seed_data(tr):
+                for i in range(20):
+                    tr.set(b"a%03d" % i, b"snap")
+
+            await src.run(seed_data)
+            agent = BackupAgent(src_c, src)
+            await agent.start()
+            await agent.snapshot()
+
+            # Post-snapshot live traffic: sets, clears, atomic adds.
+            async def mutate(tr):
+                tr.set(b"a000", b"overwritten")
+                tr.clear(b"a001")
+                tr.atomic_op(M.ADD, b"counter", (7).to_bytes(8, "little"))
+
+            await src.run(mutate)
+            await src.run(mutate)  # ADD twice -> 14
+            await src_c.loop.sleep(0.5)  # worker drains the log
+            await agent.stop()
+            return agent.container, await dump_all(src)
+
+        container, src_rows = run(src_c, main())
+
+        async def do_restore():
+            await restore(dst, container)
+            return await dump_all(dst)
+
+        dst_rows = run(dst_c, do_restore())
+        assert dst_rows == src_rows
+        d = dict(dst_rows)
+        assert d[b"a000"] == b"overwritten"
+        assert b"a001" not in d
+        assert int.from_bytes(d[b"counter"], "little") == 14
+
+    def test_restore_to_point_in_time(self):
+        src_c, src = make_db(seed=65)
+        dst_c, dst = make_db(seed=66)
+
+        async def main():
+            agent = BackupAgent(src_c, src)
+            await agent.start()
+
+            async def put(k, v):
+                async def body(tr):
+                    tr.set(k, v)
+
+                await src.run(body)
+
+            await put(b"x", b"1")
+            await agent.snapshot()
+            await put(b"x", b"2")
+            await src_c.loop.sleep(0.3)
+            v_mid = agent.container.log_end_version
+            await put(b"x", b"3")
+            await src_c.loop.sleep(0.3)
+            await agent.stop()
+            return agent.container, v_mid
+
+        container, v_mid = run(src_c, main())
+
+        async def do_restore():
+            await restore(dst, container, target_version=v_mid)
+
+            async def body(tr):
+                return await tr.get(b"x")
+
+            return await dst.run(body)
+
+        assert run(dst_c, do_restore()) == b"2"
+
+    def test_backup_log_survives_recovery(self):
+        """The mutation log spans a generation change: dual-tagging is
+        re-enabled on new proxies and the worker re-points to new tlogs."""
+        src_c, src = make_db(seed=67, n_tlogs=2)
+        dst_c, dst = make_db(seed=68)
+
+        async def main():
+            agent = BackupAgent(src_c, src)
+            await agent.start()
+
+            async def put(k, v):
+                async def body(tr):
+                    tr.set(k, v)
+
+                await src.run(body)
+
+            await put(b"pre", b"1")
+            await agent.snapshot()
+            src_c.net.kill("master")
+            while src_c.controller.generation.epoch < 2:
+                await src_c.loop.sleep(0.25)
+            await put(b"post", b"2")
+            await src_c.loop.sleep(0.5)
+            await agent.stop()
+            return agent.container, await dump_all(src)
+
+        container, src_rows = run(src_c, main())
+
+        async def do_restore():
+            await restore(dst, container)
+            return await dump_all(dst)
+
+        assert run(dst_c, do_restore()) == src_rows
+
+    def test_container_file_round_trip(self, tmp_path):
+        src_c, src = make_db(seed=69)
+        dst_c, dst = make_db(seed=70)
+
+        async def main():
+            async def seed_data(tr):
+                tr.set(b"bin\x00key", b"bin\xffval")
+                tr.set(b"k", b"v")
+
+            await src.run(seed_data)
+            agent = BackupAgent(src_c, src)
+            await agent.start()
+            await agent.snapshot()
+            await agent.stop()
+            return agent.container
+
+        container = run(src_c, main())
+        path = str(tmp_path / "backup.jsonl")
+        container.save(path)
+        loaded = BackupContainer.load(path)
+        assert loaded.restorable_version() == container.restorable_version()
+
+        async def do_restore():
+            await restore(dst, loaded)
+            return await dump_all(dst)
+
+        rows = run(dst_c, do_restore())
+        assert dict(rows)[b"bin\x00key"] == b"bin\xffval"
+
+    def test_unrestorable_without_snapshot(self):
+        c, db = make_db(seed=71)
+        container = BackupContainer()
+        with pytest.raises(RestoreError):
+            run(c, restore(db, container))
+
+    def test_retirement_survives_recovery(self):
+        """Stopped-backup tag must stay retired across a generation change:
+        salvaged entries still carrying it must not pin the new tlog's trim
+        floor (unbounded growth)."""
+        c, db = make_db(seed=73, n_tlogs=2)
+
+        async def main():
+            agent = BackupAgent(c, db)
+            await agent.start()
+
+            async def put(i):
+                async def body(tr):
+                    tr.set(b"r%03d" % i, b"v")
+
+                await db.run(body)
+
+            for i in range(10):
+                await put(i)
+            await c.loop.sleep(0.3)
+            await agent.stop()
+            c.net.kill("master")
+            while c.controller.generation.epoch < 2:
+                await c.loop.sleep(0.25)
+            for i in range(10, 40):
+                await put(i)
+            await c.loop.sleep(1.0)
+            assert len(c.tlogs[0]._log) < 10  # floor not pinned by BACKUP_TAG
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_backup_restart_after_stop(self):
+        """A NEW backup after a stopped one un-retires the tag and captures
+        subsequent writes."""
+        src_c, src = make_db(seed=74)
+        dst_c, dst = make_db(seed=75)
+
+        async def main():
+            a1 = BackupAgent(src_c, src)
+            await a1.start()
+            await a1.snapshot()
+            await a1.stop()
+
+            async def put(k, v):
+                async def body(tr):
+                    tr.set(k, v)
+
+                await src.run(body)
+
+            await put(b"second", b"backup")
+            a2 = BackupAgent(src_c, src)
+            await a2.start()
+            await a2.snapshot()
+            await put(b"late", b"write")
+            await src_c.loop.sleep(0.5)
+            await a2.stop()
+            return a2.container, await dump_all(src)
+
+        container, src_rows = run(src_c, main())
+
+        async def do_restore():
+            await restore(dst, container)
+            return await dump_all(dst)
+
+        assert run(dst_c, do_restore()) == src_rows
+
+    def test_backup_tag_trim_after_stop(self):
+        """Stopping backup retires its tag so the tlog keeps trimming."""
+        c, db = make_db(seed=72)
+
+        async def main():
+            agent = BackupAgent(c, db)
+            await agent.start()
+
+            async def put(i):
+                async def body(tr):
+                    tr.set(b"t%03d" % i, b"v")
+
+                await db.run(body)
+
+            for i in range(10):
+                await put(i)
+            await c.loop.sleep(0.3)
+            await agent.stop()
+            for i in range(10, 30):
+                await put(i)
+            await c.loop.sleep(1.0)
+            assert len(c.tlogs[0]._log) < 10  # trimmed post-retire
+            return "ok"
+
+        assert run(c, main()) == "ok"
