@@ -31,9 +31,13 @@ from foundationdb_tpu.core.mutations import (
 from foundationdb_tpu.core.types import (
     KeyRange,
     MAX_KEY_SIZE,
+    MAX_TRANSACTION_SIZE,
     MAX_VALUE_SIZE,
     single_key_range,
 )
+
+SPECIAL_KEY_PREFIX = b"\xff\xff"
+STATUS_JSON_KEY = b"\xff\xff/status/json"
 from foundationdb_tpu.core.errors import KeyTooLarge, ValueTooLarge
 from foundationdb_tpu.runtime.commit_proxy import CommitRequest
 from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
@@ -90,6 +94,7 @@ class Database:
         self.storage_map = storage_map
         self.storage_eps = storage_eps
         self.controller = controller_ep
+        self.cluster = None  # open_database attaches; special-key reads use it
         self.epoch = 1
         self._rr = 0
         self.transaction_class = Transaction  # ryw.open_database swaps in RYW
@@ -183,6 +188,8 @@ class Transaction:
     # -- reads ----------------------------------------------------------------
 
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        if key.startswith(SPECIAL_KEY_PREFIX):
+            return await self._get_special(key)
         _check_key(key)
         version = await self.get_read_version()
         ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
@@ -190,6 +197,19 @@ class Transaction:
         if not snapshot:
             self.read_ranges.append(single_key_range(key))
         return value
+
+    async def _get_special(self, key: bytes) -> bytes | None:
+        """The special key space (reference: SpecialKeySpace — synthetic
+        reads served by the client, no conflict ranges). Only the status
+        document is populated, like the reference's most-used entry."""
+        if key == STATUS_JSON_KEY and self.db.cluster is not None:
+            import json
+
+            from foundationdb_tpu.runtime.status import fetch_status
+
+            doc = await fetch_status(self.db.cluster)
+            return json.dumps(doc).encode()
+        return None
 
     async def get_range(
         self,
@@ -282,13 +302,13 @@ class Transaction:
     # -- writes ---------------------------------------------------------------
 
     def set(self, key: bytes, value: bytes) -> None:
-        _check_key(key)
+        _check_writable_key(key)
         _check_value(value)
         self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
         self.write_ranges.append(single_key_range(key))
 
     def clear(self, key: bytes) -> None:
-        _check_key(key)
+        _check_writable_key(key)
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, key, key + b"\x00"))
         self.write_ranges.append(single_key_range(key))
 
@@ -296,6 +316,9 @@ class Transaction:
         r = KeyRange(begin, end)
         if r.empty:
             return
+        _check_writable_key(begin)
+        if end > b"\xff":
+            raise KeyOutsideLegalRange(f"clear_range end {end[:16]!r} beyond 0xff")
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
         self.write_ranges.append(r)
 
@@ -305,7 +328,7 @@ class Transaction:
             MutationType.SET_VERSIONSTAMPED_VALUE,
         ):
             raise ValueError(f"not an atomic op: {op!r}")
-        _check_key(key)
+        _check_writable_key(key)
         self.mutations.append(Mutation(op, key, param))
         if op == MutationType.SET_VERSIONSTAMPED_KEY:
             # The final key is unknown until commit: conflict over every key
@@ -339,6 +362,12 @@ class Transaction:
             self._committed = (version, 0)
             self._arm_watches()  # read-only txns still arm watches at commit
             return version
+        size = sum(len(m.param1) + len(m.param2) + 24 for m in self.mutations) + sum(
+            len(r.begin) + len(r.end) + 16
+            for r in self.read_ranges + self.write_ranges
+        )
+        if size > MAX_TRANSACTION_SIZE:
+            raise TransactionTooLarge(f"{size} > {MAX_TRANSACTION_SIZE}")
         req = CommitRequest(
             read_version=version,
             mutations=list(self.mutations),
@@ -386,6 +415,15 @@ class Transaction:
 def _check_key(key: bytes) -> None:
     if len(key) > MAX_KEY_SIZE:
         raise KeyTooLarge(f"{len(key)} > {MAX_KEY_SIZE}")
+
+
+def _check_writable_key(key: bytes) -> None:
+    """Writes to the system keyspace (keys starting with 0xff) are illegal
+    without the access-system-keys option, which this client does not offer
+    (reference: error 2004 key_outside_legal_range on such mutations)."""
+    _check_key(key)
+    if key.startswith(b"\xff"):
+        raise KeyOutsideLegalRange(f"write to system key {key[:16]!r}")
 
 
 def _check_value(value: bytes) -> None:
