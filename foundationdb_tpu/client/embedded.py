@@ -54,6 +54,8 @@ def _lib() -> ctypes.CDLL:
         "fdb_tpu_create_database": ([], vp),
         "fdb_tpu_destroy_database": ([vp], None),
         "fdb_tpu_database_get_version": ([vp], ctypes.c_int64),
+        "fdb_tpu_database_set_window": ([vp, ctypes.c_int64], None),
+        "fdb_tpu_database_debug_entries": ([vp], ctypes.c_int64),
         "fdb_tpu_database_create_transaction": ([vp], vp),
         "fdb_tpu_transaction_destroy": ([vp], None),
         "fdb_tpu_transaction_reset": ([vp], None),

@@ -190,4 +190,5 @@ def open_database(cluster) -> Database:
         controller_ep=getattr(cluster, "controller_ep", None),
     )
     db.transaction_class = RYWTransaction  # RYW is the default surface
+    db.cluster = cluster  # \xff\xff/status/json reads route through it
     return db

@@ -38,7 +38,12 @@ from foundationdb_tpu.core.types import (
 
 SPECIAL_KEY_PREFIX = b"\xff\xff"
 STATUS_JSON_KEY = b"\xff\xff/status/json"
-from foundationdb_tpu.core.errors import KeyTooLarge, ValueTooLarge
+from foundationdb_tpu.core.errors import (
+    KeyOutsideLegalRange,
+    KeyTooLarge,
+    TransactionTooLarge,
+    ValueTooLarge,
+)
 from foundationdb_tpu.runtime.commit_proxy import CommitRequest
 from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
 

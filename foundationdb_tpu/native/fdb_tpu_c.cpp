@@ -158,7 +158,9 @@ struct Database {
   // covers the start of keyspace (same design as the device kernel state).
   std::map<Key, int64_t> history{{"", -1}};
 
-  int64_t oldest() const { return std::max<int64_t>(0, version - MVCC_WINDOW); }
+  int64_t window = MVCC_WINDOW;  // adjustable (tests shrink it to hit GC)
+
+  int64_t oldest() const { return std::max<int64_t>(0, version - window); }
 
   std::optional<Val> read(const Key& k, int64_t at) const {
     auto it = chains.find(k);
@@ -182,6 +184,48 @@ struct Database {
     for (++it; it != history.end() && it->first < e; ++it)
       best = std::max(best, it->second);
     return best;
+  }
+
+  // Sweep abandoned chains: per-key GC in write_at only fires on the NEXT
+  // write to that key, so a key cleared and never touched again keeps a
+  // one-entry tombstone chain forever. Periodically drop chains that are
+  // entirely below the floor and end in a tombstone (unreadable at every
+  // admissible version), and prune the expired prefix of the rest.
+  void sweep_chains() {
+    const int64_t floor = oldest();
+    for (auto it = chains.begin(); it != chains.end();) {
+      auto& chain = it->second;
+      auto pos = std::upper_bound(
+          chain.begin(), chain.end(), floor,
+          [](int64_t f, const auto& e) { return f < e.first; });
+      if (pos != chain.begin()) {
+        auto keep = std::prev(pos);
+        chain.erase(chain.begin(), keep->second ? keep : pos);
+      }
+      if (chain.empty())
+        it = chains.erase(it);
+      else
+        ++it;
+    }
+  }
+
+  // Merge adjacent expired history segments: any version below the MVCC
+  // floor is unreachable (commit rejects read_version < oldest first), so
+  // expired segments are interchangeable — clamp them to -1 and coalesce
+  // runs, bounding the boundary map under sustained painting.
+  void coalesce_history() {
+    const int64_t floor = oldest();
+    bool prev_expired = false;
+    for (auto it = history.begin(); it != history.end();) {
+      const bool expired = it->second < floor;
+      if (expired) it->second = -1;
+      if (expired && prev_expired && it != history.begin())
+        it = history.erase(it);
+      else {
+        prev_expired = expired;
+        ++it;
+      }
+    }
   }
 
   // Paint [b, e) with `ver` (split segments at both ends).
@@ -427,6 +471,10 @@ struct Transaction {
       }
     }
     for (const auto& [b, e] : write_ranges) db->paint(b, e, ver);
+    if ((ver & 0xFF) == 0) {  // amortised GC
+      db->coalesce_history();
+      db->sweep_chains();
+    }
     committed = true;
     committed_version = ver;
     return ERR_OK;
@@ -434,6 +482,19 @@ struct Transaction {
 
   void write_at(const Key& k, int64_t ver, const std::optional<Val>& v) {
     auto& chain = db->chains[k];
+    // MVCC GC, amortised onto the write path: readers hold versions in
+    // [oldest, version], so only the newest entry at-or-below the floor is
+    // reachable — drop everything older (and that entry too if it is a
+    // tombstone, which reads identically to "no entry"). Chains touched by
+    // sustained writes therefore stay O(window) instead of growing forever.
+    const int64_t floor = db->oldest();
+    auto pos = std::upper_bound(
+        chain.begin(), chain.end(), floor,
+        [](int64_t f, const auto& e) { return f < e.first; });
+    if (pos != chain.begin()) {
+      auto keep = std::prev(pos);
+      chain.erase(chain.begin(), keep->second ? keep : pos);
+    }
     if (!chain.empty() && chain.back().first == ver)
       chain.back().second = v;
     else
@@ -454,6 +515,22 @@ int64_t fdb_tpu_database_get_version(void* db) {
   Database* d = static_cast<Database*>(db);
   std::lock_guard<std::mutex> g(d->mu);
   return d->version;
+}
+
+void fdb_tpu_database_set_window(void* db, int64_t w) {
+  Database* d = static_cast<Database*>(db);
+  std::lock_guard<std::mutex> g(d->mu);
+  d->window = w;
+}
+
+// Diagnostic: total MVCC chain entries + history boundaries. Lets tests
+// assert the amortised GC bounds memory under sustained writes.
+int64_t fdb_tpu_database_debug_entries(void* db) {
+  Database* d = static_cast<Database*>(db);
+  std::lock_guard<std::mutex> g(d->mu);
+  int64_t n = static_cast<int64_t>(d->history.size());
+  for (const auto& [k, chain] : d->chains) n += chain.size();
+  return n;
 }
 
 void* fdb_tpu_database_create_transaction(void* db) {

@@ -57,22 +57,32 @@ class BackupContainer:
     # Ascending (version, [Mutation]) — the durable commit stream.
     log: list[tuple[int, list[Mutation]]] = field(default_factory=list)
     snapshot_complete: bool = False
+    # Coverage watermark: the worker has observed the commit stream through
+    # here, including mutation-free versions that append no entry. Without
+    # it an idle stream looks like a lagging log and blocks restorability.
+    log_covered: int = 0
 
     def add_log(self, version: int, mutations: list[Mutation]) -> None:
         assert not self.log or version > self.log[-1][0]
         self.log.append((version, mutations))
+        self.log_covered = max(self.log_covered, version)
 
     @property
     def log_end_version(self) -> int:
-        return self.log[-1][0] if self.log else 0
+        last = self.log[-1][0] if self.log else 0
+        return max(last, self.log_covered)
 
     def restorable_version(self) -> int | None:
         """Max version this container can restore to, or None."""
         if not self.snapshot_complete:
             return None
         snap_max = max((c.version for c in self.chunks), default=0)
-        end = max(self.log_end_version, snap_max)
-        return end if end >= snap_max else None
+        # Restorable only once the mutation log covers every version the
+        # snapshot chunks were scanned at; otherwise chunks captured early
+        # would miss mutations in (log_end, snap_max].
+        if self.log_end_version < snap_max:
+            return None
+        return self.log_end_version
 
     # -- file form (JSON lines; values hex — keys are arbitrary bytes) ------
 
@@ -91,7 +101,8 @@ class BackupContainer:
                           for m in muts],
                 }) + "\n")
             f.write(json.dumps({"t": "meta",
-                                "snapshot_complete": self.snapshot_complete}) + "\n")
+                                "snapshot_complete": self.snapshot_complete,
+                                "log_covered": self.log_covered}) + "\n")
 
     @classmethod
     def load(cls, path: str) -> "BackupContainer":
@@ -112,6 +123,7 @@ class BackupContainer:
                         for t, p1, p2 in rec["m"]]))
                 else:
                     out.snapshot_complete = rec["snapshot_complete"]
+                    out.log_covered = rec.get("log_covered", 0)
         return out
 
 
@@ -147,7 +159,17 @@ class BackupWorker:
                         self._version = version
                 if end_version > self._version:
                     self._version = end_version
-                await tlog.pop(BACKUP_TAG, self._version)
+                self.container.log_covered = max(
+                    self.container.log_covered, self._version
+                )
+                # Pop on EVERY replica: proxies dual-tag all tlogs, so a
+                # replica that never sees our pop pins its trim floor at 0
+                # and grows without bound within the epoch.
+                for ep in self.cluster.tlog_eps:
+                    try:
+                        await ep.pop(BACKUP_TAG, self._version)
+                    except Exception:
+                        pass  # dead replica: recovery will retire it
             except Exception:
                 await loop.sleep(self.RETRY)
                 continue

@@ -94,10 +94,14 @@ class StorageServer:
     PULL_INTERVAL = 0.001
     GC_INTERVAL = 0.5
 
-    def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0):
+    def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0,
+                 tlog_replicas=None):
         self.loop = loop
         self.tag = tag
         self.tlog = tlog_ep
+        # Replica tlogs also hold our tag; pops must reach every one or the
+        # non-primary logs never trim and grow unbounded within an epoch.
+        self.tlog_replicas = list(tlog_replicas or [])
         self._tlog_gen = 0  # bumped by recover_to; fences in-flight peeks
         self.map = VersionedMap()
         self._version = init_version  # applied through this version
@@ -137,6 +141,13 @@ class StorageServer:
                     # salvage-seeded tag that never sees new writes pins the
                     # floor at 0 and the log grows without bound.
                     await tlog.pop(self.tag, self._version)
+                    for rep in self.tlog_replicas:
+                        if rep is tlog:
+                            continue
+                        try:
+                            await rep.pop(self.tag, self._version)
+                        except BrokenPromise:
+                            pass  # dead replica: recovery will retire it
             except BrokenPromise:
                 # Only unreachability is survivable; apply-path errors are
                 # real bugs and must crash the actor, not spin silently.
@@ -147,7 +158,8 @@ class StorageServer:
                 last_gc = self.loop.now
             await self.loop.sleep(self.PULL_INTERVAL)
 
-    def recover_to(self, recovery_version: int, tlog_ep) -> None:
+    def recover_to(self, recovery_version: int, tlog_ep,
+                   tlog_replicas=None) -> None:
         """Recovery handoff: discard applied state above the recovery version
         (this server may have pulled writes whose durable suffix died with
         its tlog — the reference's storage rollback), then pull from the new
@@ -163,6 +175,7 @@ class StorageServer:
             self.map.rollback(recovery_version)
             self._version = recovery_version
         self.tlog = tlog_ep
+        self.tlog_replicas = list(tlog_replicas or [])
         self._tlog_gen += 1  # invalidate any in-flight old-generation peek
 
     def _apply(self, version: int, mutations: list[Mutation]) -> None:

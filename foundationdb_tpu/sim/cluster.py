@@ -191,7 +191,7 @@ class SimCluster:
         # applied above the recovery version (their old tlog's lost suffix)
         # and re-point their pull loops at the new tlog.
         for s in self.storages:
-            s.recover_to(recovery_version, self.tlog_eps[0])
+            s.recover_to(recovery_version, self.tlog_eps[0], self.tlog_eps)
 
         # Retire the previous generation: locked/stale roles must not keep
         # serving (reference: old-epoch roles die on seeing the new epoch),

@@ -12,6 +12,7 @@ from foundationdb_tpu.core.mutations import MutationType as M
 from foundationdb_tpu.runtime.backup import (
     BackupAgent,
     BackupContainer,
+    RangeChunk,
     RestoreError,
     restore,
 )
@@ -297,3 +298,19 @@ class TestBackupRestore:
             return "ok"
 
         assert run(c, main()) == "ok"
+
+
+class TestRestorableVersion:
+    def test_not_restorable_while_log_lags_snapshot(self):
+        """A chunk scanned at version V needs log coverage through V —
+        otherwise mutations in (log_end, V] for earlier-scanned chunks are
+        silently lost (ADVICE r1 high)."""
+        container = BackupContainer()
+        container.chunks.append(RangeChunk(b"a", b"b", version=10, kvs=[]))
+        container.snapshot_complete = True
+        container.add_log(5, [])
+        assert container.restorable_version() is None
+        container.add_log(10, [])
+        assert container.restorable_version() == 10
+        container.add_log(12, [])
+        assert container.restorable_version() == 12

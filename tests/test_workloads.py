@@ -71,7 +71,16 @@ class TestWorkloadsUnderFaults:
         m = run(c, run_workload(c, db, w, faults=f))
         assert m.txns_committed >= 32
         assert f.kills, "fault injector never fired"
-        assert c.controller.generation.epoch >= 2  # recoveries happened
+
+        # A generation-role kill must eventually force a recovery; the
+        # workload may finish before the controller's sweep notices, so
+        # wait for the epoch rather than sampling it at workload end.
+        async def wait_recovery():
+            while c.controller.generation.epoch < 2:
+                await c.loop.sleep(0.05)
+            return c.controller.generation.epoch
+
+        assert run(c, wait_recovery()) >= 2
 
     def test_atomic_ops_with_faults(self):
         c, db = make_db(seed=33, n_tlogs=2)
