@@ -63,17 +63,29 @@ class KeyCodec:
         out = np.zeros((n, self.width), dtype=np.int32)
         if n == 0:
             return out
-        padded = np.zeros((n, self.max_key_bytes), dtype=np.uint8)
-        lengths = np.zeros(n, dtype=np.int32)
-        inf_rows = []
-        for i, k in enumerate(keys):
-            if len(k) > self.max_key_bytes:
-                k = self._shorten(k, mode)
+        lengths = np.fromiter((len(k) for k in keys), np.int32, count=n)
+        inf_rows: list[int] = []
+        if lengths.max(initial=0) > self.max_key_bytes:
+            # Rare slow path: shorten overlong keys in place first.
+            keys = list(keys)
+            for i in np.flatnonzero(lengths > self.max_key_bytes):
+                k = self._shorten(keys[i], mode)
                 if k is None:  # end-mode prefix was all 0xff → +inf
-                    inf_rows.append(i)
-                    continue
-            padded[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
-            lengths[i] = len(k)
+                    inf_rows.append(int(i))
+                    keys[i] = b""
+                    lengths[i] = 0
+                else:
+                    keys[i] = k
+                    lengths[i] = len(k)
+        # Vectorized gather-pad: one C-speed join, then a masked gather into
+        # the padded [n, max_bytes] matrix (this loop was the host hot path).
+        joined = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        offs = np.zeros(n, np.int64)
+        np.cumsum(lengths[:-1], out=offs[1:])
+        col = np.arange(self.max_key_bytes, dtype=np.int64)
+        mask = col[None, :] < lengths[:, None]
+        src = np.minimum(offs[:, None] + col[None, :], max(joined.size - 1, 0))
+        padded = np.where(mask, joined[src] if joined.size else 0, 0).astype(np.uint8)
         w = padded.reshape(n, self.n_words, 4).astype(np.uint32)
         words = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
         out[:, : self.n_words] = (words ^ _BIAS).view(np.int32)

@@ -203,25 +203,37 @@ def _wave_accept(base: jax.Array, m: jax.Array) -> jax.Array:
     """
     b = base.shape[0]
     tri = jnp.tril(jnp.ones((b, b), jnp.bool_), k=-1)
-    p = (m & tri).astype(jnp.float32)  # [B, B] edges, MXU matvec fodder
+    # bf16 edges: the matvec rides the MXU; accumulation is forced to f32 so
+    # row sums up to B stay exact (we only test > 0 anyway).
+    p = (m & tri).astype(jnp.bfloat16)  # [B, B]
+
+    def mv(vec):
+        return (
+            jax.lax.dot(p, vec.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+            > 0.0
+        )
 
     def cond(carry):
-        det, _ = carry
-        return ~jnp.all(det)
+        det, _, i = carry
+        # Formal bound: each round determines at least the lowest
+        # undetermined txn (all its predecessors are determined), so B
+        # rounds always suffice — the cap makes the worst case explicit.
+        return ~jnp.all(det) & (i < b)
 
     def step(carry):
-        det, acc = carry
-        hit_acc = (p @ acc.astype(jnp.float32)) > 0.0
-        pending = (p @ (~det).astype(jnp.float32)) > 0.0
+        det, acc, i = carry
+        hit_acc = mv(acc)
+        pending = mv(~det)
         newly_rej = ~det & hit_acc
         newly_acc = ~det & base & ~hit_acc & ~pending
         det = det | newly_rej | newly_acc | (~det & ~base)
         acc = acc | newly_acc
-        return det, acc
+        return det, acc, i + 1
 
     det0 = ~base  # non-candidates are determined (not accepted) immediately
     acc0 = jnp.zeros_like(base)
-    _, acc = jax.lax.while_loop(cond, step, (det0, acc0))
+    _, acc, _ = jax.lax.while_loop(cond, step, (det0, acc0, jnp.int32(0)))
     return acc
 
 
@@ -237,9 +249,20 @@ def _paint_and_compact(
     commit_version: jax.Array,
     new_oldest: jax.Array,
 ) -> ConflictState:
+    """Fold accepted writes into the step function WITHOUT re-sorting the
+    whole history. The history keys are already sorted, so only the batch's
+    2·B·Q new endpoints are sorted ([2BQ, W], tiny next to [C+2BQ, W]); the
+    two sorted sequences are then interleaved by rank arithmetic (the
+    merge-path construction: each element's output slot is its own index
+    plus its cross-rank in the other sequence, history winning ties), and
+    the surviving boundaries are compacted to the front with a prefix-sum
+    scatter. TPU sorts are the expensive primitive here — this removes both
+    full-history sorts the first version of this kernel did per batch."""
     c, w = state.keys.shape
     b, q, _ = batch.write_begin.shape
     e2 = b * q
+    n2 = 2 * e2
+    n = c + n2
 
     valid = (
         accepted[:, None]
@@ -250,22 +273,41 @@ def _paint_and_compact(
     wb = jnp.where(valid[..., None], batch.write_begin, inf_row).reshape(e2, w)
     we = jnp.where(valid[..., None], batch.write_end, inf_row).reshape(e2, w)
 
-    merged = jnp.concatenate([state.keys, wb, we])  # [C + 2*E2, W]
-    delta = jnp.concatenate(
-        [
-            jnp.zeros((c,), jnp.int32),
-            valid.reshape(e2).astype(jnp.int32),
-            -valid.reshape(e2).astype(jnp.int32),
-        ]
+    # New endpoints with their coverage delta and their segment's pre-paint
+    # version (the version a split boundary must inherit).
+    new_keys = jnp.concatenate([wb, we])  # [n2, W]
+    new_delta = jnp.concatenate(
+        [valid.reshape(e2).astype(jnp.int32), -valid.reshape(e2).astype(jnp.int32)]
     )
-    # Version each entry's segment had before this batch.
-    new_pts = jnp.concatenate([wb, we])
-    seg = searchsorted_words(state.keys, new_pts, side="right") - 1
-    oldv = jnp.concatenate(
-        [state.versions, state.versions[jnp.maximum(seg, 0)]]
+    seg = searchsorted_words(state.keys, new_keys, side="right") - 1
+    new_oldv = state.versions[jnp.maximum(seg, 0)]
+
+    snew, sdelta_new, soldv_new = sort_keys_with_payload(
+        new_keys, new_delta, new_oldv
     )
 
-    skeys, sdelta, soldv = sort_keys_with_payload(merged, delta, oldv)
+    # Merge-path: output slot = own index + cross-rank. 'left' on the new
+    # side / 'right' on the history side puts history entries before equal
+    # new entries — a collision-free permutation of [0, n) even with
+    # duplicate keys on either side.
+    pos_h = jnp.arange(c, dtype=jnp.int32) + searchsorted_words(
+        snew, state.keys, side="left"
+    )
+    pos_n = jnp.arange(n2, dtype=jnp.int32) + searchsorted_words(
+        state.keys, snew, side="right"
+    )
+
+    skeys = (
+        jnp.full((n, w), INT32_MAX, jnp.int32)
+        .at[pos_h].set(state.keys)
+        .at[pos_n].set(snew)
+    )
+    sdelta = jnp.zeros((n,), jnp.int32).at[pos_n].set(sdelta_new)
+    soldv = (
+        jnp.full((n,), NEG_VERSION, jnp.int32)
+        .at[pos_h].set(state.versions)
+        .at[pos_n].set(soldv_new)
+    )
 
     covered = jnp.cumsum(sdelta) > 0
     is_inf = jnp.all(skeys == INT32_MAX, axis=-1)
@@ -273,7 +315,6 @@ def _paint_and_compact(
     # GC: segments at/below the window floor can never conflict again.
     newv = jnp.where((newv <= new_oldest) | is_inf, NEG_VERSION, newv)
 
-    n = skeys.shape[0]
     # Dedup equal keys: keep the LAST occurrence (it carries the full
     # coverage sum and the consistent old version).
     neq_next = jnp.any(skeys[:-1] != skeys[1:], axis=-1)
@@ -293,15 +334,21 @@ def _paint_and_compact(
     first_live = jnp.argmax(~is_inf)  # index of smallest real key (= min key)
     keep = keep.at[first_live].set(True)
 
-    dropped_key = jnp.where(keep[:, None], skeys, inf_row)
-    dropped_v = jnp.where(keep, newv, NEG_VERSION)
-    fkeys, fv = sort_keys_with_payload(dropped_key, dropped_v)
+    # Compact survivors to the front by prefix-sum scatter (no sort): each
+    # kept entry's destination is the count of kept entries before it.
+    dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    dest = jnp.where(keep, dest, n)  # dropped / out-of-capacity → oob
+    fkeys = (
+        jnp.full((c, w), INT32_MAX, jnp.int32)
+        .at[dest].set(skeys, mode="drop")
+    )
+    fv = jnp.full((c,), NEG_VERSION, jnp.int32).at[dest].set(newv, mode="drop")
 
     n_used = jnp.sum(keep).astype(jnp.int32)
     overflow = state.overflow | (n_used > c)
     return ConflictState(
-        keys=fkeys[:c],
-        versions=fv[:c],
+        keys=fkeys,
+        versions=fv,
         n_used=jnp.minimum(n_used, c),
         oldest=new_oldest,
         overflow=overflow,
@@ -405,3 +452,29 @@ def _resolve_jit(state, batch, commit_version, new_oldest):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _rebase_jit(state, delta):
     return rebase(state, delta)
+
+
+# ---------------------------------------------------------------------------
+# Per-phase entry points (bench --profile): each phase compiled alone so the
+# host can time it with block_until_ready and attribute the batch cost.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _phase_history_jit(state, batch):
+    return _history_conflicts(state, batch)
+
+
+@jax.jit
+def _phase_overlap_jit(batch):
+    return _pairwise_overlap(batch)
+
+
+@jax.jit
+def _phase_wave_jit(base, m):
+    return _wave_accept(base, m)
+
+
+@jax.jit  # state NOT donated: profiling replays phases on the same state
+def _phase_paint_jit(state, batch, accepted, commit_version, new_oldest):
+    return _paint_and_compact(state, batch, accepted, commit_version, new_oldest)

@@ -14,6 +14,10 @@ absolute↔relative version mapping with periodic device rebase.
 
 from __future__ import annotations
 
+import ctypes
+import struct
+from typing import Callable
+
 import numpy as np
 
 from foundationdb_tpu.core.keypack import INT32_MAX, KeyCodec
@@ -62,6 +66,87 @@ class TPUConflictSet:
         commit_version: int,
         oldest_version: int | None = None,
     ) -> list[Verdict]:
+        return self.resolve_async(txns, commit_version, oldest_version)()
+
+    def resolve_async(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> Callable[[], list[Verdict]]:
+        """Dispatch every chunk to the device immediately and return a
+        collector. The caller (resolver role, bench) packs/dispatches the
+        NEXT batch while the device still computes this one — materializing
+        verdicts (the device→host sync) is deferred to the collector."""
+        self._begin_resolve(commit_version, oldest_version)
+        cv = np.int32(self._rel(commit_version))
+        oldest = np.int32(self._rel(self.oldest_version))
+        pending: list[tuple] = []
+        for i in range(0, len(txns), self.batch_size):
+            chunk = txns[i : i + self.batch_size]
+            batch = self._pack(chunk)
+            verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
+            pending.append((verdicts, len(chunk)))
+        return lambda: self._collect(pending)
+
+    def resolve_wire(
+        self,
+        wire: bytes | np.ndarray,
+        commit_version: int,
+        oldest_version: int | None = None,
+        count: int | None = None,
+    ) -> list[Verdict]:
+        return self.resolve_wire_async(wire, commit_version, oldest_version, count)()
+
+    def resolve_wire_async(
+        self,
+        wire: bytes | np.ndarray,
+        commit_version: int,
+        oldest_version: int | None = None,
+        count: int | None = None,
+        as_array: bool = False,
+    ) -> Callable[[], list[Verdict]]:
+        """The production hot path: a flat serialized resolver batch (see
+        native/keypack.cpp for the wire format — the analogue of the
+        reference's ResolveTransactionBatchRequest bytes) is packed into
+        device tensors by one C pass, never touching per-txn Python objects."""
+        buf = np.frombuffer(wire, dtype=np.uint8) if isinstance(wire, (bytes, bytearray)) else wire
+        lib = _keypack_lib()
+        # Structurally validate the WHOLE buffer before any dispatch: a chunk
+        # failing mid-stream would leave earlier chunks' writes painted into
+        # device history with no verdicts delivered (phantom conflicts
+        # forever). kp_count_txns walks every record's bounds in one C pass.
+        counted = int(lib.kp_count_txns(_u8(buf), buf.size, 0))
+        if counted < 0 or (count is not None and count > counted):
+            raise ValueError("malformed resolver wire batch")
+        if count is None:
+            count = counted
+        self._begin_resolve(commit_version, oldest_version)
+        cv = np.int32(self._rel(commit_version))
+        oldest = np.int32(self._rel(self.oldest_version))
+        pending: list[tuple] = []
+        offset, remaining = 0, count
+        while remaining > 0:
+            n = min(remaining, self.batch_size)
+            batch, offset = self._pack_wire(buf, offset, n)
+            verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
+            pending.append((verdicts, n))
+            remaining -= n
+        if as_array:
+            return lambda: np.concatenate(
+                [np.asarray(v)[:n] for v, n in pending]
+            )
+        return lambda: self._collect(pending)
+
+    @staticmethod
+    def _collect(pending: list[tuple]) -> list[Verdict]:
+        out: list[Verdict] = []
+        for verdicts, n in pending:
+            v = np.asarray(verdicts)[:n]
+            out.extend(Verdict(int(x)) for x in v)
+        return out
+
+    def _begin_resolve(self, commit_version: int, oldest_version: int | None) -> None:
         if commit_version <= self._last_commit:
             raise ValueError(
                 f"commit versions must advance: {commit_version} <= {self._last_commit}"
@@ -75,11 +160,6 @@ class TPUConflictSet:
         )
         self._maybe_rebase(commit_version)
         self._last_commit = commit_version
-
-        out: list[Verdict] = []
-        for i in range(0, len(txns), self.batch_size):
-            out.extend(self._resolve_chunk(txns[i : i + self.batch_size], commit_version))
-        return out
 
     @property
     def overflowed(self) -> bool:
@@ -114,29 +194,47 @@ class TPUConflictSet:
         self.state = self._rebase_fn(self.state, np.int32(min(delta, 2**31 - 1)))
         self.base_version += delta
 
-    def _resolve_chunk(
-        self, txns: list[TxnConflictInfo], commit_version: int
-    ) -> list[Verdict]:
-        batch = self._pack(txns)
-        cv = np.int32(self._rel(commit_version))
-        oldest = np.int32(self._rel(self.oldest_version))
-        verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
-        v = np.asarray(verdicts)[: len(txns)]
-        return [Verdict(int(x)) for x in v]
-
-    def _pack(self, txns: list[TxnConflictInfo]) -> ck.BatchTensors:
+    def _empty_batch(self) -> ck.BatchTensors:
+        """Padded all-masked-out batch tensors (shared by both packers so
+        the wire and object paths can never diverge on layout)."""
         b = self.batch_size
         r, q = self.max_read_ranges, self.max_write_ranges
         w = self.codec.width
+        return ck.BatchTensors(
+            read_begin=np.full((b, r, w), INT32_MAX, np.int32),
+            read_end=np.full((b, r, w), INT32_MAX, np.int32),
+            read_mask=np.zeros((b, r), bool),
+            write_begin=np.full((b, q, w), INT32_MAX, np.int32),
+            write_end=np.full((b, q, w), INT32_MAX, np.int32),
+            write_mask=np.zeros((b, q), bool),
+            read_version=np.zeros((b,), np.int32),
+            txn_mask=np.zeros((b,), bool),
+        )
 
-        read_begin = np.full((b, r, w), INT32_MAX, np.int32)
-        read_end = np.full((b, r, w), INT32_MAX, np.int32)
-        read_mask = np.zeros((b, r), bool)
-        write_begin = np.full((b, q, w), INT32_MAX, np.int32)
-        write_end = np.full((b, q, w), INT32_MAX, np.int32)
-        write_mask = np.zeros((b, q), bool)
-        read_version = np.zeros((b,), np.int32)
-        txn_mask = np.zeros((b,), bool)
+    def _pack_wire(
+        self, buf: np.ndarray, offset: int, count: int
+    ) -> tuple[ck.BatchTensors, int]:
+        """One C pass: wire bytes [offset..] → padded batch tensors."""
+        bt = self._empty_batch()
+        lib = _keypack_lib()
+        new_off = lib.kp_pack_batch(
+            _u8(buf), buf.size, offset, count,
+            self.batch_size, self.max_read_ranges, self.max_write_ranges,
+            self.codec.n_words, self.base_version,
+            _i32(bt.read_begin), _i32(bt.read_end), _u8(bt.read_mask),
+            _i32(bt.write_begin), _i32(bt.write_end), _u8(bt.write_mask),
+            _i32(bt.read_version), _u8(bt.txn_mask),
+        )
+        if new_off < 0:
+            raise ValueError("malformed resolver wire batch")
+        return bt, int(new_off)
+
+    def _pack(self, txns: list[TxnConflictInfo]) -> ck.BatchTensors:
+        bt = self._empty_batch()
+        read_begin, read_end, read_mask = bt.read_begin, bt.read_end, bt.read_mask
+        write_begin, write_end, write_mask = bt.write_begin, bt.write_end, bt.write_mask
+        read_version, txn_mask = bt.read_version, bt.txn_mask
+        r, q = self.max_read_ranges, self.max_write_ranges
 
         # One vectorized pack per endpoint kind across the whole batch (the
         # per-txn Python work is just index bookkeeping).
@@ -164,16 +262,57 @@ class TPUConflictSet:
             write_end[w_rows, w_cols] = we
             write_mask[w_rows, w_cols] = True
 
-        return ck.BatchTensors(
-            read_begin=read_begin,
-            read_end=read_end,
-            read_mask=read_mask,
-            write_begin=write_begin,
-            write_end=write_end,
-            write_mask=write_mask,
-            read_version=read_version,
-            txn_mask=txn_mask,
-        )
+        return bt
+
+
+def encode_resolve_batch(txns: list[TxnConflictInfo]) -> bytes:
+    """Serialize txns to the resolver wire format (native/keypack.cpp).
+
+    The sim runtime and tests use this to exercise the production path; a
+    real deployment's proxies would emit these bytes directly as their RPC
+    payload (the analogue of serializing ResolveTransactionBatchRequest)."""
+    out = bytearray()
+    for t in txns:
+        reads = list(t.read_ranges)
+        writes = list(t.write_ranges)
+        out += struct.pack("<qii", t.read_version, len(reads), len(writes))
+        for rng in reads + writes:
+            out += struct.pack("<ii", len(rng.begin), len(rng.end))
+            out += rng.begin
+            out += rng.end
+    return bytes(out)
+
+
+_KP_LIB = None
+
+
+def _keypack_lib():
+    global _KP_LIB
+    if _KP_LIB is None:
+        from foundationdb_tpu.native import load_library
+
+        lib = load_library("keypack")
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64 = ctypes.c_int64
+        lib.kp_pack_batch.restype = i64
+        lib.kp_pack_batch.argtypes = [
+            u8p, i64, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, i64,
+            i32p, i32p, u8p, i32p, i32p, u8p, i32p, u8p,
+        ]
+        lib.kp_count_txns.restype = i64
+        lib.kp_count_txns.argtypes = [u8p, i64, i64]
+        _KP_LIB = lib
+    return _KP_LIB
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
 
 def _coalesce(ranges: list[KeyRange], limit: int) -> list[KeyRange]:

@@ -1,13 +1,14 @@
-"""bench.py unit coverage: the vectorized packer must equal KeyCodec, and a
-small stream must produce identical verdicts on kernel / C++ / oracle —
-the same three-way parity the ConflictRange workload asserts in the
-reference's simulation suite (fdbserver/workloads/ConflictRange.actor.cpp)."""
+"""bench.py unit coverage: the vectorized wire-stream builder must equal
+encode_resolve_batch byte-for-byte, and a small stream must produce
+identical verdicts on kernel / C++ / oracle — the same three-way parity
+the ConflictRange workload asserts in the reference's simulation suite
+(fdbserver/workloads/ConflictRange.actor.cpp)."""
 
 import numpy as np
 
 import bench
-from foundationdb_tpu.core.keypack import KeyCodec
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo
+from foundationdb_tpu.models.conflict_set import encode_resolve_batch
 from foundationdb_tpu.sim.oracle import OracleConflictSet
 
 
@@ -15,34 +16,48 @@ def key_bytes(i: int) -> bytes:
     return int(i).to_bytes(8, "big")
 
 
-def test_pack_ids_matches_keycodec():
-    codec = KeyCodec(bench.KEY_BYTES)
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, 2**63 - 1, size=50, dtype=np.int64)
-    keys = [key_bytes(i) for i in ids]
-    np.testing.assert_array_equal(
-        bench.pack_ids(ids, end=False), codec.pack(keys, "begin")
-    )
-    np.testing.assert_array_equal(
-        bench.pack_ids(ids, end=True),
-        codec.pack([k + b"\x00" for k in keys], "end"),
-    )
-
-
 def _stream_txns(n_batches):
     n = n_batches * bench.BATCH
-    read_ids, write_ids, write_mask, lag = bench.gen_workload(n, 512, seed=7)
-    return read_ids, write_ids, write_mask, lag
+    return bench.gen_workload(n, 512, seed=7)
+
+
+def _object_txns(read_ids, write_ids, write_mask, lag, b):
+    """The object-path equivalent of wire batch b (for oracle/encode)."""
+    cv = b + 1
+    txns = []
+    for i in range(b * bench.BATCH, (b + 1) * bench.BATCH):
+        rv = max(0, cv - 1 - int(lag[i]))
+        reads = [KeyRange(key_bytes(k), key_bytes(k) + b"\x00")
+                 for k in read_ids[i]]
+        writes = ([KeyRange(key_bytes(write_ids[i]),
+                            key_bytes(write_ids[i]) + b"\x00")]
+                  if write_mask[i] else [])
+        txns.append(TxnConflictInfo(rv, reads, writes))
+    return txns
+
+
+def test_wire_stream_matches_encode():
+    n_batches = 1
+    read_ids, write_ids, write_mask, lag = _stream_txns(n_batches)
+    blob, ends = bench.build_wire_stream(
+        read_ids, write_ids, write_mask, lag, n_batches
+    )
+    txns = _object_txns(read_ids, write_ids, write_mask, lag, 0)
+    expect = encode_resolve_batch(txns)
+    got = blob[int(ends[0]) : int(ends[bench.BATCH])].tobytes()
+    assert got == expect
 
 
 def test_bench_stream_three_way_parity():
     n_batches = 2
     read_ids, write_ids, write_mask, lag = _stream_txns(n_batches)
-    packer = bench.make_batch_packer(read_ids, write_ids, write_mask, lag)
 
-    # Kernel path, exactly as bench drives it.
-    _, tpu_conf, overflowed = bench.run_tpu(
-        n_batches, 1 << 14, packer, repeats=1
+    # Production wire path, exactly as bench drives it.
+    blob, ends = bench.build_wire_stream(
+        read_ids, write_ids, write_mask, lag, n_batches
+    )
+    _, tpu_conf, overflowed = bench.run_tpu_wire(
+        n_batches, 1 << 14, blob, ends, repeats=1
     )
     assert not overflowed
 
@@ -56,22 +71,8 @@ def test_bench_stream_three_way_parity():
     oracle = OracleConflictSet()
     oracle_conf = 0
     for b in range(n_batches):
-        s = slice(b * bench.BATCH, (b + 1) * bench.BATCH)
         cv = b + 1
-        txns = []
-        for i in range(s.start, s.stop):
-            rv = max(0, cv - 1 - int(lag[i]))
-            reads = [
-                KeyRange(key_bytes(k), key_bytes(k) + b"\x00")
-                for k in read_ids[i]
-            ]
-            writes = (
-                [KeyRange(key_bytes(write_ids[i]),
-                          key_bytes(write_ids[i]) + b"\x00")]
-                if write_mask[i]
-                else []
-            )
-            txns.append(TxnConflictInfo(rv, reads, writes))
+        txns = _object_txns(read_ids, write_ids, write_mask, lag, b)
         got = oracle.resolve(txns, cv, max(0, cv - bench.WINDOW))
         oracle_conf += sum(1 for v in got if v.name == "CONFLICT")
 
