@@ -185,8 +185,8 @@ def open_database(cluster) -> Database:
         cluster.loop,
         cluster.grv_proxy_eps,
         cluster.commit_proxy_eps,
-        cluster.storage_map,
-        cluster.storage_eps,
+        cluster.storage_map.clone(),  # own copy: goes stale, refreshed on
+        cluster.storage_eps,          # wrong_shard_server (location cache)
         controller_ep=getattr(cluster, "controller_ep", None),
     )
     db.transaction_class = RYWTransaction  # RYW is the default surface

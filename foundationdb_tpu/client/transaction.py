@@ -43,6 +43,7 @@ from foundationdb_tpu.core.errors import (
     KeyTooLarge,
     TransactionTooLarge,
     ValueTooLarge,
+    WrongShardServer,
 )
 from foundationdb_tpu.runtime.commit_proxy import CommitRequest
 from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
@@ -117,6 +118,84 @@ class Database:
         self.epoch = info.epoch
         self.grv_proxies = list(info.grv_proxy_eps)
         self.commit_proxies = list(info.commit_proxy_eps)
+
+    def refresh_shard_map(self) -> None:
+        """Invalidate the location cache after wrong_shard_server (reference:
+        NativeAPI's invalidateCache + re-read of \\xff/keyServers)."""
+        if self.cluster is not None:
+            self.storage_map = self.cluster.storage_map.clone()
+
+    MAX_SHARD_RETRIES = 5
+
+    async def read_key(self, key: bytes, version: int):
+        """Point read with replica failover + shard-map refresh: try every
+        team member (dead replicas skipped), refresh the map and re-route on
+        wrong_shard_server (data distribution moved the shard)."""
+        for _ in range(self.MAX_SHARD_RETRIES):
+            team = self.storage_map.team_for_key(key)
+            wrong_shard = False
+            for tag in team:
+                try:
+                    return await self.storage_eps[tag].get(key, version)
+                except BrokenPromise:
+                    continue  # dead/partitioned replica: try the next
+                except WrongShardServer:
+                    wrong_shard = True
+                    break
+            self.refresh_shard_map()
+            if not wrong_shard:
+                # Whole team unreachable: brief pause, maybe a recovery or
+                # move lands; retried reads are idempotent.
+                await self.loop.sleep(0.05)
+        raise ProcessKilled(f"no reachable storage replica for {key[:16]!r}")
+
+    async def read_range(
+        self, begin: bytes, end: bytes, version: int,
+        limit: int, reverse: bool,
+    ) -> list[tuple[bytes, bytes]]:
+        """Range read across shards with the same failover/refresh loop."""
+        out: list[tuple[bytes, bytes]] = []
+        cursor_begin, cursor_end = begin, end
+        for _ in range(self.MAX_SHARD_RETRIES):
+            try:
+                parts = self.storage_map.split_range_teams(
+                    KeyRange(cursor_begin, cursor_end)
+                )
+                if reverse:
+                    parts = parts[::-1]
+                for r, team in parts:
+                    if len(out) >= limit:
+                        return out
+                    got = await self._read_part(r, team, version, limit - len(out), reverse)
+                    out.extend(got)
+                    # Progress cursor so a later wrong-shard retry does not
+                    # re-read (and double-count) finished parts.
+                    if reverse:
+                        cursor_end = r.begin
+                    else:
+                        cursor_begin = r.end
+                return out
+            except WrongShardServer:
+                self.refresh_shard_map()
+        raise ProcessKilled("shard map kept changing under range read")
+
+    async def _read_part(
+        self, r: KeyRange, team, version: int, limit: int, reverse: bool
+    ) -> list[tuple[bytes, bytes]]:
+        last_wrong: Exception | None = None
+        for tag in team:
+            try:
+                return await self.storage_eps[tag].get_range(
+                    r.begin, r.end, version, limit=limit, reverse=reverse
+                )
+            except BrokenPromise:
+                continue
+            except WrongShardServer as e:
+                last_wrong = e
+                continue
+        if last_wrong is not None:
+            raise last_wrong
+        raise ProcessKilled(f"no reachable storage replica for range {r.begin[:16]!r}")
 
     def _pick(self, eps: list):
         self._rr += 1
@@ -197,8 +276,7 @@ class Transaction:
             return await self._get_special(key)
         _check_key(key)
         version = await self.get_read_version()
-        ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
-        value = await ep.get(key, version)
+        value = await self.db.read_key(key, version)
         if not snapshot:
             self.read_ranges.append(single_key_range(key))
         return value
@@ -230,17 +308,7 @@ class Transaction:
         trimming in NativeAPI)."""
         version = await self.get_read_version()
         cap = limit if limit > 0 else 1 << 30
-        parts = self.db.storage_map.split_range(KeyRange(begin, end))
-        if reverse:
-            parts = parts[::-1]
-        rows: list[tuple[bytes, bytes]] = []
-        for r, tag in parts:
-            if len(rows) >= cap:
-                break
-            got = await self.db.storage_eps[tag].get_range(
-                r.begin, r.end, version, limit=cap - len(rows), reverse=reverse
-            )
-            rows.extend(got)
+        rows = await self.db.read_range(begin, end, version, cap, reverse)
         rows = rows[:cap]
         if not snapshot:
             if limit > 0 and len(rows) == cap and rows:
@@ -279,18 +347,8 @@ class Transaction:
     async def _scan_keys(
         self, begin: bytes, end: bytes, limit: int, reverse: bool, version: int
     ) -> list[bytes]:
-        parts = self.db.storage_map.split_range(KeyRange(begin, end))
-        if reverse:
-            parts = parts[::-1]
-        keys: list[bytes] = []
-        for r, tag in parts:
-            if len(keys) >= limit:
-                break
-            got = await self.db.storage_eps[tag].get_range(
-                r.begin, r.end, version, limit=limit - len(keys), reverse=reverse
-            )
-            keys.extend(k for k, _v in got)
-        return keys[:limit]
+        rows = await self.db.read_range(begin, end, version, limit, reverse)
+        return [k for k, _v in rows[:limit]]
 
     async def watch(self, key: bytes) -> "object":
         """Register a watch armed at commit (reference: watches are part of

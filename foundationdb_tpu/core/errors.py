@@ -47,6 +47,13 @@ class CommitUnknownResult(FdbError):
     code = 1021
 
 
+class WrongShardServer(FdbError):
+    """Storage server no longer (or does not yet) serve this key range
+    (error 1001) — the client refreshes its shard map and re-routes."""
+
+    code = 1001
+
+
 class KeyOutsideLegalRange(FdbError):
     code = 2003
 
@@ -77,4 +84,4 @@ class ProcessKilled(FdbError):
     code = 1211  # cluster_version_changed stand-in for injected kills
 
 
-_RETRYABLE = {1007, 1009, 1020, 1021, 1211}
+_RETRYABLE = {1001, 1007, 1009, 1020, 1021, 1211}

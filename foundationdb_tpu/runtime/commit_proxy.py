@@ -289,15 +289,17 @@ class CommitProxy:
                 continue
             for m in resolve_versionstamps(req.mutations, version, i):
                 if m.type == MutationType.CLEAR_RANGE:
-                    for sub, tag in self.storage_map.split_range(
+                    for sub, team in self.storage_map.split_range_teams(
                         KeyRange(m.param1, m.param2)
                     ):
-                        tagged.setdefault(tag, []).append(
-                            Mutation(MutationType.CLEAR_RANGE, sub.begin, sub.end)
+                        sub_m = Mutation(
+                            MutationType.CLEAR_RANGE, sub.begin, sub.end
                         )
+                        for tag in team:  # every replica of the shard's team
+                            tagged.setdefault(tag, []).append(sub_m)
                 else:
-                    tag = self.storage_map.tag_for_key(m.param1)
-                    tagged.setdefault(tag, []).append(m)
+                    for tag in self.storage_map.team_for_key(m.param1):
+                        tagged.setdefault(tag, []).append(m)
                 if self.backup_enabled:
                     tagged.setdefault(BACKUP_TAG, []).append(m)
         return tagged

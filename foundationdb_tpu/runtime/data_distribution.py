@@ -1,0 +1,207 @@
+"""Data distribution: shard split/merge on size, movement between teams,
+and load rebalancing — with traffic running.
+
+Reference: fdbserver/DataDistribution.actor.cpp (the monitor/queue) +
+MoveKeys.actor.cpp (the movement protocol). The shape kept here:
+
+- **Split/merge are metadata-only**: a boundary is inserted at the shard's
+  byte-median key (storage suggests it, splitMetrics-style) or removed when
+  both neighbours are small and same-team. No data moves.
+- **Movement is a dual-tag window**: the shard's team is widened to
+  src ∪ dst (commit proxies immediately tag new mutations for both sides),
+  each new member fetchKeys-copies the range from a source replica while
+  buffering its concurrent tagged mutations, and once every new member has
+  applied past its snapshot version the map flips to dst and the departing
+  members stop serving above the flip version. In-window readers with old
+  read versions keep hitting the departing replica until GC retires it —
+  the same grace the reference gets from reading the keyServers map at the
+  transaction's version.
+- **Rebalance** moves a shard from the most-loaded storage to a team led
+  by the least-loaded (reference: DDQueue's rebalancing moves).
+
+Serve-set bookkeeping (begin_serve/end_serve) is applied directly to the
+storage objects: it is control-plane state the reference carries through
+private mutations in the system keyspace; the data path (fetch, reads,
+mutation flow) goes through endpoints and is fault-injectable.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.types import KeyRange
+from foundationdb_tpu.runtime.flow import Loop
+
+MAX_MOVE_RETRIES = 3
+
+
+class DataDistributor:
+    POLL_INTERVAL = 0.4
+    SPLIT_BYTES = 5_000  # sim-scale thresholds (reference: 500MB/125MB)
+    MERGE_BYTES = 500
+    REBALANCE_RATIO = 3.0  # max/min primary-bytes ratio that triggers a move
+
+    def __init__(self, loop: Loop, cluster, replication: int = 1):
+        self.loop = loop
+        self.cluster = cluster
+        self.replication = replication
+        self.splits = 0
+        self.merges = 0
+        self.moves = 0
+        self.move_failures = 0
+        self._moving = False
+
+    async def get_metrics(self) -> dict:
+        return {
+            "splits": self.splits,
+            "merges": self.merges,
+            "moves": self.moves,
+            "move_failures": self.move_failures,
+            "shards": self.cluster.storage_map.n_shards,
+        }
+
+    async def run(self) -> None:
+        while True:
+            await self.loop.sleep(self.POLL_INTERVAL)
+            try:
+                await self._pass()
+            except Exception:
+                continue  # transient role failure: next pass retries
+
+    # -- one monitoring pass --------------------------------------------------
+
+    async def _pass(self) -> None:
+        """One monitoring pass over ONE stats snapshot: each shard's stats
+        are fetched once and reused by the split, merge, and rebalance
+        decisions (shard_stats is a full key-walk on the storage server —
+        re-fetching per decision would triple control-plane load)."""
+        m = self.cluster.storage_map
+        shards = m.shards
+        stats = []
+        for s in shards:
+            ep = self.cluster.storage_eps[s.team[0]]
+            stats.append(await ep.shard_stats(s.range.begin, s.range.end))
+
+        split_ranges = []
+        for s, st in zip(shards, stats):
+            if st["bytes"] > self.SPLIT_BYTES and st["split_key"]:
+                if m.split_at(st["split_key"]):
+                    self.splits += 1
+                    split_ranges.append(s.range)
+
+        # Merge small same-team neighbours, judged on the snapshot (pairs
+        # touched by a fresh split are skipped — they are big by definition).
+        for i in range(len(shards) - 1):
+            a, b = shards[i], shards[i + 1]
+            if a.team != b.team or a.range in split_ranges or b.range in split_ranges:
+                continue
+            if stats[i]["bytes"] + stats[i + 1]["bytes"] < self.MERGE_BYTES:
+                if m.merge_at(b.range.begin):
+                    self.merges += 1
+
+        await self._maybe_rebalance(list(zip(shards, (st["bytes"] for st in stats))))
+
+    async def _maybe_rebalance(self, per_shard: list[tuple]) -> None:
+        if self._moving:
+            return  # one move at a time (reference: bounded in-flight moves)
+        m = self.cluster.storage_map
+        load: dict[int, int] = {
+            t: 0 for t in range(len(self.cluster.storage_eps))
+        }
+        for s, nbytes in per_shard:
+            for t in s.team:
+                load[t] += nbytes
+        if not load:
+            return
+        hot_tag = max(load, key=lambda t: load[t])
+        cold_tag = min(load, key=lambda t: load[t])
+        if load[hot_tag] < self.REBALANCE_RATIO * max(1, load[cold_tag]):
+            return
+        # Biggest shard whose team contains hot but not cold.
+        candidates = [
+            (s, b) for s, b in per_shard
+            if hot_tag in s.team and cold_tag not in s.team and b > 0
+        ]
+        if not candidates:
+            return
+        shard, _ = max(candidates, key=lambda x: x[1])
+        dst_team = tuple(
+            cold_tag if t == hot_tag else t for t in shard.team
+        )
+        await self.move_shard(shard.range.begin, shard.range.end, dst_team)
+
+    # -- movement (reference: MoveKeys.actor.cpp) -----------------------------
+
+    async def move_shard(
+        self, begin: bytes, end: bytes, dst_team: tuple[int, ...]
+    ) -> None:
+        """Move [begin, end) to `dst_team` (must align with, or split to,
+        shard boundaries). Safe under traffic and fault injection: aborts
+        restore the source team and purge destination partial state."""
+        m = self.cluster.storage_map
+        if begin:
+            m.split_at(begin)
+        if end:
+            m.split_at(end)
+        for sub, src_team in list(m.split_range_teams(KeyRange(begin, end))):
+            await self._move_one(sub.begin, sub.end, src_team, tuple(dst_team))
+
+    async def _move_one(
+        self,
+        begin: bytes,
+        end: bytes,
+        src_team: tuple[int, ...],
+        dst_team: tuple[int, ...],
+    ) -> None:
+        if src_team == dst_team:
+            return
+        m = self.cluster.storage_map
+        newcomers = [t for t in dst_team if t not in src_team]
+        leavers = [t for t in src_team if t not in dst_team]
+        self._moving = True
+        # Open the dual-tag window: proxies now tag every mutation in the
+        # range for src AND dst members, so newcomers' tag streams carry
+        # all traffic concurrent with their snapshots.
+        union = tuple(src_team) + tuple(newcomers)
+        m.set_team(begin, end, union)
+        try:
+            src_ep = self.cluster.storage_eps[src_team[0]]
+            snap_versions: dict[int, int] = {}
+            for tag in newcomers:
+                dst_ep = self.cluster.storage_eps[tag]
+                snap_versions[tag] = await self._retry(
+                    lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep)
+                )
+            # Every newcomer must be applied past its snapshot before it can
+            # answer reads issued after the flip.
+            for tag, v in snap_versions.items():
+                await self._retry(
+                    lambda ep=self.cluster.storage_eps[tag], v=v:
+                        ep.wait_for_version(v)
+                )
+                self.cluster.storages[tag].begin_serve(begin, end, v)
+            flip_version = await self._retry(
+                self.cluster.tlog_eps[0].get_version
+            )
+            m.set_team(begin, end, dst_team)
+            for tag in leavers:
+                self.cluster.storages[tag].end_serve(begin, end, flip_version)
+            self.moves += 1
+        except Exception:
+            self.move_failures += 1
+            m.set_team(begin, end, tuple(src_team))
+            for tag in newcomers:
+                s = self.cluster.storages[tag]
+                s.cancel_serve(begin, end)  # purged data must not be served
+                s.abort_fetch(begin, end)
+            raise
+        finally:
+            self._moving = False
+
+    async def _retry(self, make_call):
+        backoff = 0.05
+        for _ in range(MAX_MOVE_RETRIES - 1):
+            try:
+                return await make_call()
+            except Exception:
+                await self.loop.sleep(backoff)
+                backoff *= 2
+        return await make_call()

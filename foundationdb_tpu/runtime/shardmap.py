@@ -1,11 +1,16 @@
-"""Keyspace shard map: key → storage tag / team.
+"""Keyspace shard map: key → storage team (k storage tags).
 
 The reference keeps this in the system keyspace (`\\xff/keyServers/`,
-fdbclient/SystemData.cpp) maintained by data distribution; commit proxies
-use it to tag mutations and clients to route reads. Here it is a static
-sorted-boundary table shared by both sides; data-distribution-driven shard
-movement is out of scope for the core pipeline (the map can be swapped
-wholesale on recovery).
+fdbclient/SystemData.cpp), maintained by data distribution
+(fdbserver/DataDistribution.actor.cpp) and read by commit proxies (to tag
+mutations for every team member) and clients (to route reads to any
+replica). Here it is a sorted-boundary table owned by the cluster and
+mutated by the DataDistributor role: shards split/merge on size and move
+between teams with traffic running (runtime/data_distribution.py).
+
+``map_version`` bumps on every mutation; clients hold clones and refresh
+on wrong_shard_server, mirroring the reference's location-cache
+invalidation path.
 """
 
 from __future__ import annotations
@@ -17,55 +22,133 @@ from foundationdb_tpu.core.types import KeyRange
 
 MAX_KEY = b"\xff\xff"  # end of the user+system keyspace
 
+Team = tuple[int, ...]  # storage tags; [0] is the preferred read replica
+
 
 @dataclass(frozen=True)
 class Shard:
     range: KeyRange
-    tag: int
+    team: Team
+
+    @property
+    def tag(self) -> int:
+        return self.team[0]
 
 
 class KeyShardMap:
-    """Static partition of [b"", MAX_KEY) into contiguous tagged shards."""
+    """Partition of [b"", MAX_KEY) into contiguous team-owned shards."""
 
-    def __init__(self, boundaries: list[bytes], tags: list[int] | None = None):
+    def __init__(
+        self,
+        boundaries: list[bytes],
+        tags: list[int] | None = None,
+        teams: list[Team] | None = None,
+    ):
         """boundaries: interior split points (sorted, unique). Shard i covers
-        [b_i, b_{i+1}) with b_0 = b"" and b_last = MAX_KEY."""
+        [b_i, b_{i+1}) with b_0 = b"" and b_last = MAX_KEY. ``tags`` is the
+        single-replica shorthand for ``teams``."""
         assert boundaries == sorted(boundaries), "boundaries must be sorted"
         self._bounds = [b""] + list(boundaries) + [MAX_KEY]
         n = len(self._bounds) - 1
-        self._tags = list(tags) if tags is not None else list(range(n))
-        assert len(self._tags) == n
+        if teams is not None:
+            assert tags is None
+            self._teams = [tuple(t) for t in teams]
+        elif tags is not None:
+            self._teams = [(t,) for t in tags]
+        else:
+            self._teams = [(i,) for i in range(n)]
+        assert len(self._teams) == n
+        self.map_version = 0
 
     @classmethod
-    def uniform(cls, n_shards: int) -> "KeyShardMap":
+    def uniform(cls, n_shards: int, teams: list[Team] | None = None) -> "KeyShardMap":
         """Evenly split the byte keyspace by first-byte prefix."""
         bounds = [bytes([(256 * i) // n_shards]) for i in range(1, n_shards)]
-        return cls(bounds)
+        return cls(bounds, teams=teams)
+
+    def clone(self) -> "KeyShardMap":
+        m = KeyShardMap(self._bounds[1:-1], teams=list(self._teams))
+        m.map_version = self.map_version
+        return m
 
     @property
     def n_shards(self) -> int:
-        return len(self._tags)
+        return len(self._teams)
 
     @property
     def shards(self) -> list[Shard]:
         return [
-            Shard(KeyRange(self._bounds[i], self._bounds[i + 1]), self._tags[i])
+            Shard(KeyRange(self._bounds[i], self._bounds[i + 1]), self._teams[i])
             for i in range(self.n_shards)
         ]
 
+    def _index_for_key(self, key: bytes) -> int:
+        return bisect.bisect_right(self._bounds, key, 1, len(self._bounds) - 1) - 1
+
+    def shard_for_key(self, key: bytes) -> Shard:
+        i = self._index_for_key(key)
+        return Shard(KeyRange(self._bounds[i], self._bounds[i + 1]), self._teams[i])
+
+    def team_for_key(self, key: bytes) -> Team:
+        return self._teams[self._index_for_key(key)]
+
     def tag_for_key(self, key: bytes) -> int:
-        i = bisect.bisect_right(self._bounds, key, 1, len(self._bounds) - 1) - 1
-        return self._tags[i]
+        return self._teams[self._index_for_key(key)][0]
 
     def split_range(self, r: KeyRange) -> list[tuple[KeyRange, int]]:
         """Intersect a range with the shard partition → [(subrange, tag)]."""
-        out: list[tuple[KeyRange, int]] = []
+        return [(sub, team[0]) for sub, team in self.split_range_teams(r)]
+
+    def split_range_teams(self, r: KeyRange) -> list[tuple[KeyRange, Team]]:
+        out: list[tuple[KeyRange, Team]] = []
         for i in range(self.n_shards):
             lo = max(r.begin, self._bounds[i])
             hi = min(r.end, self._bounds[i + 1])
             if lo < hi:
-                out.append((KeyRange(lo, hi), self._tags[i]))
+                out.append((KeyRange(lo, hi), self._teams[i]))
         return out
 
     def tags_for_range(self, r: KeyRange) -> list[int]:
         return [t for _, t in self.split_range(r)]
+
+    # -- mutation (DataDistributor only) --------------------------------------
+
+    def split_at(self, key: bytes) -> bool:
+        """Insert an interior boundary at `key`; both halves keep the team.
+        (Reference: shard split is a pure metadata change — no data moves.)"""
+        if not b"" < key < MAX_KEY:
+            return False
+        i = bisect.bisect_left(self._bounds, key)
+        if i < len(self._bounds) and self._bounds[i] == key:
+            return False  # already a boundary
+        self._bounds.insert(i, key)
+        self._teams.insert(i - 1, self._teams[i - 1])
+        self.map_version += 1
+        return True
+
+    def merge_at(self, key: bytes) -> bool:
+        """Remove the interior boundary at `key`, merging its neighbours —
+        only legal when both sides are owned by the same team."""
+        i = bisect.bisect_left(self._bounds, key)
+        if not (0 < i < len(self._bounds) - 1) or self._bounds[i] != key:
+            return False
+        if self._teams[i - 1] != self._teams[i]:
+            return False
+        del self._bounds[i]
+        del self._teams[i - 1]
+        self.map_version += 1
+        return True
+
+    def set_team(self, begin: bytes, end: bytes, team: Team) -> None:
+        """Assign [begin, end) to `team`. Both endpoints must already be
+        shard boundaries (split first); every covered shard is reassigned."""
+        team = tuple(team)
+        i = bisect.bisect_left(self._bounds, begin)
+        j = bisect.bisect_left(self._bounds, end if end else MAX_KEY)
+        assert self._bounds[i] == begin, f"{begin!r} is not a shard boundary"
+        assert j < len(self._bounds) and self._bounds[j] == end, (
+            f"{end!r} is not a shard boundary"
+        )
+        for k in range(i, j):
+            self._teams[k] = team
+        self.map_version += 1

@@ -17,7 +17,13 @@ from __future__ import annotations
 
 import bisect
 
-from foundationdb_tpu.core.errors import FutureVersion, TransactionTooOld
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.errors import (
+    FutureVersion,
+    TransactionTooOld,
+    WrongShardServer,
+)
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
@@ -59,6 +65,15 @@ class VersionedMap:
         hi = bisect.bisect_left(self._keys, end)
         return self._keys[lo:hi]
 
+    def purge_range(self, begin: bytes, end: bytes) -> None:
+        """Drop all keys (and their history) in [begin, end) — shard moved
+        away and aged out, or an aborted fetch left partial state."""
+        for k in list(self.range_keys(begin, end)):
+            del self._chains[k]
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        del self._keys[lo:hi]
+
     def rollback(self, version: int) -> None:
         """Discard every write above `version` (recovery: storage may have
         pulled entries from a tlog whose durable suffix was lost with it)."""
@@ -90,6 +105,38 @@ class VersionedMap:
             del self._keys[i]
 
 
+@dataclass
+class ServedRange:
+    """A shard this server answers reads for, bounded by the versions at
+    which it acquired/lost the shard (reference: the SS's shard-availability
+    tracking — newly fetched shards have no history below their fetch
+    version; moved-away shards stop at the handoff version)."""
+
+    begin: bytes
+    end: bytes
+    start_version: int = 0
+    end_version: int | None = None  # None = still owned
+
+
+@dataclass
+class FetchState:
+    """An in-flight fetchKeys: tagged mutations for the range are buffered
+    (not applied) until the snapshot lands, then replayed — atomic ops must
+    never apply against a missing base value (reference: fetchKeys'
+    fetchWaitingVector buffering).
+
+    After the snapshot lands (`snap_version` set) the state stays
+    registered until the pull loop passes snap_version: in-range mutations
+    at versions <= snap_version are already reflected in the snapshot and
+    must be DROPPED, not re-applied (re-applying would violate per-key
+    version order, or double-apply an atomic op at exactly snap_version)."""
+
+    begin: bytes
+    end: bytes
+    buffer: list[tuple[int, Mutation]] = field(default_factory=list)
+    snap_version: int | None = None  # set once the snapshot is injected
+
+
 class StorageServer:
     PULL_INTERVAL = 0.001
     GC_INTERVAL = 0.5
@@ -110,6 +157,10 @@ class StorageServer:
         self._version_waiters: list[tuple[int, Promise]] = []
         self._watches: dict[bytes, list[tuple[bytes | None, Promise]]] = {}
         self._running = False
+        # Shard serving state (data distribution). None = serve everything
+        # (single-team clusters never register ranges and skip the guard).
+        self.served: list[ServedRange] | None = None
+        self._fetching: list[FetchState] = []
 
     # -- write path (tlog pull) ----------------------------------------------
 
@@ -174,12 +225,17 @@ class StorageServer:
         if self._version > recovery_version:
             self.map.rollback(recovery_version)
             self._version = recovery_version
+        # In-flight fetch buffers may hold the rolled-back suffix.
+        for f in self._fetching:
+            f.buffer = [(v, m) for v, m in f.buffer if v <= recovery_version]
         self.tlog = tlog_ep
         self.tlog_replicas = list(tlog_replicas or [])
         self._tlog_gen += 1  # invalidate any in-flight old-generation peek
 
     def _apply(self, version: int, mutations: list[Mutation]) -> None:
         assert version > self._version
+        if self._fetching:
+            mutations = self._buffer_fetching(version, mutations)
         for m in mutations:
             if m.type == MutationType.SET_VALUE:
                 self._write(m.param1, version, m.param2)
@@ -221,6 +277,239 @@ class StorageServer:
 
     def _gc(self) -> None:
         self.map.gc(self.oldest_version)
+        # Retire moved-away shards once no in-window reader can still need
+        # them: drop the serve entry and purge the bytes (reference: the SS
+        # removes a moved range after its readers age out of the window).
+        if self.served is not None:
+            dead = [
+                s for s in self.served
+                if s.end_version is not None and s.end_version < self.oldest_version
+            ]
+            for s in dead:
+                self.served.remove(s)
+                # Purge exactly the portions no remaining entry covers — a
+                # partial overlap must not pin the whole retired range.
+                parts = [(s.begin, s.end)]
+                for o in self.served:
+                    nxt: list[tuple[bytes, bytes]] = []
+                    for b, e in parts:
+                        ob, oe = max(b, o.begin), min(e, o.end)
+                        if ob < oe:
+                            if b < ob:
+                                nxt.append((b, ob))
+                            if oe < e:
+                                nxt.append((oe, e))
+                        else:
+                            nxt.append((b, e))
+                    parts = nxt
+                for b, e in parts:
+                    self.map.purge_range(b, e)
+
+    # -- shard serving / data movement (reference: fetchKeys + shard map) ----
+
+    def _buffer_fetching(
+        self, version: int, mutations: list[Mutation]
+    ) -> list[Mutation]:
+        """Divert mutations for fetch ranges: in-flight fetches buffer them,
+        completed fetches drop the already-snapshotted prefix (clears are
+        clipped); the remainder applies normally."""
+        # Retire completed states the pull loop has fully passed.
+        self._fetching = [
+            f for f in self._fetching
+            if f.snap_version is None or version <= f.snap_version
+        ]
+
+        def divert(f: FetchState, v: int, m: Mutation) -> bool:
+            """True if `m` (already clipped to f's range) was consumed."""
+            if f.snap_version is None:
+                f.buffer.append((v, m))
+                return True
+            return v <= f.snap_version  # in snapshot already: drop
+
+        out: list[Mutation] = []
+        for m in mutations:
+            if m.type == MutationType.CLEAR_RANGE:
+                segs = [(m.param1, m.param2)]
+                for f in self._fetching:
+                    nxt: list[tuple[bytes, bytes]] = []
+                    for b, e in segs:
+                        ob, oe = max(b, f.begin), min(e, f.end)
+                        if ob < oe:
+                            if not divert(
+                                f, version,
+                                Mutation(MutationType.CLEAR_RANGE, ob, oe),
+                            ):
+                                nxt.append((ob, oe))
+                            if b < ob:
+                                nxt.append((b, ob))
+                            if oe < e:
+                                nxt.append((oe, e))
+                        else:
+                            nxt.append((b, e))
+                    segs = nxt
+                out.extend(
+                    Mutation(MutationType.CLEAR_RANGE, b, e) for b, e in segs
+                )
+            else:
+                f = next(
+                    (f for f in self._fetching if f.begin <= m.param1 < f.end),
+                    None,
+                )
+                if f is None or not divert(f, version, m):
+                    out.append(m)
+        return out
+
+    def _apply_one(self, m: Mutation, version: int) -> None:
+        if m.type == MutationType.SET_VALUE:
+            self._write(m.param1, version, m.param2)
+        elif m.type == MutationType.CLEAR_RANGE:
+            for k in self.map.range_keys(m.param1, m.param2):
+                if self.map.latest(k) is not None:
+                    self._write(k, version, None)
+        elif m.type in ATOMIC_OPS:
+            self._write(
+                m.param1, version,
+                apply_atomic(m.type, self.map.latest(m.param1), m.param2),
+            )
+        else:
+            raise ValueError(f"storage cannot apply mutation {m.type!r}")
+
+    async def snapshot_range(
+        self, begin: bytes, end: bytes
+    ) -> tuple[int, list[tuple[bytes, bytes]]]:
+        """Source side of fetchKeys: the range at our applied version."""
+        v = self._version
+        rows = []
+        for k in self.map.range_keys(begin, end):
+            val = self.map.at(k, v)
+            if val is not None:
+                rows.append((k, val))
+        return v, rows
+
+    async def fetch_keys(self, begin: bytes, end: bytes, src_ep) -> int:
+        """Destination side of a shard move: copy [begin, end) from `src_ep`.
+
+        The caller (DataDistributor) must already have dual-tagged the range
+        so our tag stream carries every mutation concurrent with the
+        snapshot; those buffer while the copy is in flight and replay on
+        top (atomic ops must never fold into a missing base value).
+        Returns the snapshot version — the shard has no history below it."""
+        f = FetchState(begin, end)
+        self._fetching.append(f)
+        try:
+            snap_version, rows = await src_ep.snapshot_range(begin, end)
+            self.map.purge_range(begin, end)  # drop any aborted-move residue
+            for k, v in rows:
+                self.map.write(k, snap_version, v)
+            for version, m in f.buffer:  # sync block through snap_version set
+                if version > snap_version:
+                    self._apply_one(m, version)
+            # Keep the state registered until the pull loop passes
+            # snap_version: it must DROP re-deliveries at versions the
+            # snapshot already covers (our pull cursor may still be behind
+            # the source's). _buffer_fetching retires it.
+            f.snap_version = snap_version
+            return snap_version
+        except BaseException:
+            if f in self._fetching:
+                self._fetching.remove(f)
+            self.map.purge_range(begin, end)  # buffered mutations were lost
+            raise
+
+    def abort_fetch(self, begin: bytes, end: bytes) -> None:
+        """Abandon a move: drop buffers and partial data for the range."""
+        self._fetching = [
+            f for f in self._fetching if not (f.begin == begin and f.end == end)
+        ]
+        self.map.purge_range(begin, end)
+
+    def init_served(self, ranges: list[tuple[bytes, bytes]]) -> None:
+        self.served = [ServedRange(b, e) for b, e in ranges]
+
+    def begin_serve(self, begin: bytes, end: bytes, start_version: int) -> None:
+        assert self.served is not None
+        self.served.append(ServedRange(begin, end, start_version))
+
+    def cancel_serve(self, begin: bytes, end: bytes) -> None:
+        """Undo begin_serve after an aborted move: drop LIVE entries fully
+        inside the range (the move registered exactly this range; purged
+        data must not be advertised as served)."""
+        if self.served is None:
+            return
+        self.served = [
+            s for s in self.served
+            if not (
+                s.end_version is None and begin <= s.begin and s.end <= end
+            )
+        ]
+
+    def end_serve(self, begin: bytes, end: bytes, end_version: int) -> None:
+        """Stop owning [begin, end) above `end_version`; in-window readers
+        with older versions are still served until GC retires the entry."""
+        assert self.served is not None
+        out: list[ServedRange] = []
+        for s in self.served:
+            if s.end <= begin or end <= s.begin or s.end_version is not None:
+                out.append(s)
+                continue
+            if s.begin < begin:
+                out.append(ServedRange(s.begin, begin, s.start_version))
+            if end < s.end:
+                out.append(ServedRange(end, s.end, s.start_version))
+            ob, oe = max(s.begin, begin), min(s.end, end)
+            out.append(ServedRange(ob, oe, s.start_version, end_version))
+        self.served = out
+
+    def _check_serving(self, begin: bytes, end: bytes, version: int) -> None:
+        """Reads must land on shards we own at `version`. Spatial gaps →
+        wrong_shard_server (client refreshes its map and re-routes); owned
+        but no history that old (freshly fetched shard) → too_old (client
+        restarts at a fresh read version)."""
+        if self.served is None:
+            return
+        pos = begin
+        too_old = False
+        for s in sorted(self.served, key=lambda s: s.begin):
+            if pos >= end:
+                break
+            if s.end <= pos or s.begin > pos:
+                continue
+            if s.end_version is not None and version > s.end_version:
+                continue  # moved away before this version
+            if version < s.start_version:
+                too_old = True
+            pos = max(pos, s.end)
+        if pos < end:
+            raise WrongShardServer(
+                f"tag {self.tag} does not serve [{begin!r}, {end!r}) at {version}"
+            )
+        if too_old:
+            raise TransactionTooOld(
+                f"shard acquired above read version {version}"
+            )
+
+    async def shard_stats(self, begin: bytes, end: bytes) -> dict:
+        """DataDistributor inputs: byte size + a median split key
+        (reference: StorageMetrics / splitMetrics)."""
+        total, n = 0, 0
+        sizes: list[tuple[bytes, int]] = []
+        for k in self.map.range_keys(begin, end):
+            v = self.map.latest(k)
+            if v is None:
+                continue
+            sz = len(k) + len(v)
+            total += sz
+            n += 1
+            sizes.append((k, sz))
+        split_key = None
+        if n >= 2:
+            cum, half = 0, total / 2
+            for k, sz in sizes:
+                cum += sz
+                if cum >= half and k > begin:
+                    split_key = k
+                    break
+        return {"bytes": total, "keys": n, "split_key": split_key}
 
     # -- read path ------------------------------------------------------------
 
@@ -244,6 +533,7 @@ class StorageServer:
 
     async def get(self, key: bytes, version: int) -> bytes | None:
         await self._check_version(version)
+        self._check_serving(key, key + b"\x00", version)
         return self.map.at(key, version)
 
     async def get_range(
@@ -255,6 +545,7 @@ class StorageServer:
         reverse: bool = False,
     ) -> list[tuple[bytes, bytes]]:
         await self._check_version(version)
+        self._check_serving(begin, end, version)
         keys = self.map.range_keys(begin, end)
         if reverse:
             keys = reversed(keys)
@@ -277,7 +568,13 @@ class StorageServer:
 
     async def watch(self, key: bytes, value: bytes | None) -> int:
         """Resolves (with the triggering version) once the key's value is
-        observed ≠ `value` (reference: storage watch at the latest version)."""
+        observed ≠ `value` (reference: storage watch at the latest version).
+
+        Serving guard: a watch armed on a replica that lost (or never had)
+        the shard would hang forever — after a move, proxies stop tagging
+        us, so the triggering write never arrives. Reject instead; the
+        client sees a retryable error and re-arms on the new owner."""
+        self._check_serving(key, key + b"\x00", self._version)
         current = self.map.latest(key)
         if current != value:
             return self._version

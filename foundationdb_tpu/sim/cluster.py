@@ -60,18 +60,29 @@ class SimCluster:
         n_resolvers: int = 1,
         n_tlogs: int = 1,
         n_storages: int = 2,
+        n_replicas: int = 1,
         engine: str = "oracle",
         ratekeeper: bool = True,
+        data_distribution: bool = False,
     ):
+        assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
         self.net = SimNetwork(self.loop)
         self.engine = engine
         self.n_proxies = n_proxies
         self.n_resolvers = n_resolvers
         self.n_tlogs = n_tlogs
+        self.n_replicas = n_replicas
         self.with_ratekeeper = ratekeeper
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
-        self.storage_map = KeyShardMap.uniform(n_storages)
+        # k-way teams: shard i is owned by storages {i, i+1, ..., i+k-1}
+        # (reference: DDTeamCollection builds overlapping teams so load
+        # spreads without k*n servers).
+        teams = [
+            tuple((i + j) % n_storages for j in range(n_replicas))
+            for i in range(n_storages)
+        ]
+        self.storage_map = KeyShardMap.uniform(n_storages, teams=teams)
         self._gen_processes: list[str] = []  # previous generation, for retirement
         self.backup_active = False  # BackupAgent sets; survives recoveries
         self.backup_worker = None  # live BackupWorker (its cursor bounds salvage)
@@ -86,6 +97,15 @@ class SimCluster:
             self.net.host(f"storage{i}", f"storage{i}", s)
             for i, s in enumerate(self.storages)
         ]
+        # Serve-set guards are active whenever shards can move or replicate
+        # (single-replica static clusters skip them entirely).
+        if data_distribution or n_replicas > 1:
+            for i, s in enumerate(self.storages):
+                s.init_served([
+                    (sh.range.begin, sh.range.end)
+                    for sh in self.storage_map.shards
+                    if i in sh.team
+                ])
 
         self.controller = ClusterController(self.loop, recruiter=self)
         self.controller_ep = self.net.host(
@@ -98,6 +118,23 @@ class SimCluster:
         self.loop.spawn(
             self.controller.run(), process="cluster_controller", name="cc.run"
         )
+
+        self.data_distributor = None
+        self.data_distributor_ep = None
+        if data_distribution:
+            from foundationdb_tpu.runtime.data_distribution import DataDistributor
+
+            self.data_distributor = DataDistributor(
+                self.loop, self, replication=n_replicas
+            )
+            self.data_distributor_ep = self.net.host(
+                "data_distributor", "data_distributor", self.data_distributor
+            )
+            self.loop.spawn(
+                self.data_distributor.run(),
+                process="data_distributor",
+                name="dd.run",
+            )
 
     # -- recruiter interface (called by ClusterController / recovery) ---------
 
