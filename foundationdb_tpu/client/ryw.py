@@ -188,6 +188,7 @@ def open_database(cluster) -> Database:
         cluster.storage_map.clone(),  # own copy: goes stale, refreshed on
         cluster.storage_eps,          # wrong_shard_server (location cache)
         controller_ep=getattr(cluster, "controller_ep", None),
+        coordinator_eps=getattr(cluster, "coordinator_eps", None),
     )
     db.transaction_class = RYWTransaction  # RYW is the default surface
     db.cluster = cluster  # \xff\xff/status/json reads route through it

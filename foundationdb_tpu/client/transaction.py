@@ -93,6 +93,7 @@ class Database:
         storage_map: KeyShardMap,
         storage_eps: list,
         controller_ep=None,
+        coordinator_eps: list | None = None,
     ):
         self.loop = loop
         self.grv_proxies = grv_proxy_eps
@@ -100,6 +101,7 @@ class Database:
         self.storage_map = storage_map
         self.storage_eps = storage_eps
         self.controller = controller_ep
+        self.coordinator_eps = list(coordinator_eps or [])
         self.cluster = None  # open_database attaches; special-key reads use it
         self.epoch = 1
         self._rr = 0
@@ -109,15 +111,32 @@ class Database:
         """Re-fetch proxy endpoints from the cluster controller — how clients
         ride through recovery (reference: clients monitor ClientDBInfo and
         swap proxy connections when the epoch changes)."""
-        if self.controller is None:
+        if self.controller is None and not self.coordinator_eps:
             return
         try:
             info = await self.controller.get_client_info()
         except Exception:
-            return  # controller briefly unreachable: keep stale info, retry later
+            # Controller unreachable — maybe killed and re-elected: ask the
+            # coordinators who leads now (reference: clients re-resolve the
+            # controller through the cluster file's coordinators).
+            await self._relocate_controller()
+            try:
+                info = await self.controller.get_client_info()
+            except Exception:
+                return  # still down: keep stale info, retry later
         self.epoch = info.epoch
         self.grv_proxies = list(info.grv_proxy_eps)
         self.commit_proxies = list(info.commit_proxy_eps)
+
+    async def _relocate_controller(self) -> None:
+        for ep in self.coordinator_eps:
+            try:
+                val = await ep.get_leader()
+            except Exception:
+                continue
+            if val and val.get("controller_ep") is not None:
+                self.controller = val["controller_ep"]
+                return
 
     def refresh_shard_map(self) -> None:
         """Invalidate the location cache after wrong_shard_server (reference:

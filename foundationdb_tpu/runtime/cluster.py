@@ -64,12 +64,20 @@ class ClusterController:
     HEARTBEAT_INTERVAL = 0.25  # virtual seconds between liveness sweeps
     RECOVERY_RETRY_DELAY = 0.5
 
-    def __init__(self, loop: Loop, recruiter):
+    def __init__(self, loop: Loop, recruiter, identity: str = "cluster_controller",
+                 coord=None, reign: int = 0):
         self.loop = loop
         self.recruiter = recruiter
+        self.identity = identity
+        # CoordinatedState when a coordinator quorum exists (None = legacy
+        # singleton controller). Every post-election registry write doubles
+        # as the deposition check (runtime/coordination.py).
+        self.coord = coord
+        self.reign = reign
         self.generation: Generation | None = None
         self.recoveries_completed = 0
         self._recovering = False
+        self._deposed = False
 
     def bootstrap(self) -> None:
         """Recruit generation 1 (initial, non-recovery startup)."""
@@ -110,6 +118,8 @@ class ClusterController:
             "recoveries_completed": self.recoveries_completed,
             "recovering": self._recovering,
             "generation_processes": sorted(g.heartbeat_eps),
+            "controller": self.identity,
+            "reign": self.reign,
         }
 
     # -- failure detection ----------------------------------------------------
@@ -119,7 +129,7 @@ class ClusterController:
         stale generation found mid-sweep) triggers recovery of the whole
         transaction subsystem, like the reference's betterMasterExists /
         failure-triggered recovery."""
-        while True:
+        while not self._deposed:
             await self.loop.sleep(self.HEARTBEAT_INTERVAL)
             if self._recovering or self.generation is None:
                 continue
@@ -147,16 +157,24 @@ class ClusterController:
     async def _recover(self, reason: str) -> None:
         from foundationdb_tpu.runtime.recovery import RecoveryFailed, recover
 
-        if self._recovering:
+        if self._recovering or self._deposed:
             return  # a concurrent trigger (sweep vs request) already won
         self._recovering = True
         try:
+            # A deposed controller must not touch the cluster: confirm
+            # leadership through the quorum before recruiting (reference:
+            # the master's cstate read at recovery start).
+            if not await self._confirm_leadership():
+                return
             old = self.generation
             while True:
                 try:
                     self.generation = await recover(
                         self.loop, old, self.recruiter, epoch=old.epoch + 1
                     )
+                    await self._publish_generation()
+                    if self._deposed:
+                        return
                     self.recoveries_completed += 1
                     return
                 except RecoveryFailed:
@@ -167,3 +185,49 @@ class ClusterController:
                     await self.loop.sleep(self.RECOVERY_RETRY_DELAY)
         finally:
             self._recovering = False
+
+    async def _confirm_leadership(self) -> bool:
+        if self.coord is None:
+            return True
+        try:
+            view = await self.coord.read()
+        except Exception:
+            return False  # quorum unreachable: act later, not on stale belief
+        cur = view.value or {}
+        if cur.get("leader") != self.identity or cur.get("reign") != self.reign:
+            self._deposed = True
+            return False
+        return True
+
+    async def _publish_generation(self) -> None:
+        """Record the new generation in the coordinated registry — the write
+        a rival-elected controller's quorum rejects (we learn we're deposed
+        before serving a stale generation to anyone)."""
+        if self.coord is None or self.generation is None:
+            return
+        from foundationdb_tpu.runtime.coordination import Deposed
+
+        g = self.generation
+        backoff = 0.1
+        while True:
+            try:
+                await self.coord.write_if_leader(
+                    self.identity, self.reign,
+                    {
+                        "epoch": g.epoch,
+                        "recovery_version": g.recovery_version,
+                        "tlog_eps": list(g.tlog_eps),
+                    },
+                )
+                return
+            except Deposed:
+                self._deposed = True
+                return
+            except Exception:
+                # Quorum transiently unreachable / write contention: recovery
+                # CANNOT complete without the registry write (the reference
+                # blocks in WRITING_CSTATE the same way) — and it must not
+                # crash the controller's run task either, or rivals would see
+                # a live-but-braindead incumbent forever. Keep trying.
+                await self.loop.sleep(backoff)
+                backoff = min(1.0, backoff * 2)

@@ -75,10 +75,7 @@ class DataDistributor:
         re-fetching per decision would triple control-plane load)."""
         m = self.cluster.storage_map
         shards = m.shards
-        stats = []
-        for s in shards:
-            ep = self.cluster.storage_eps[s.team[0]]
-            stats.append(await ep.shard_stats(s.range.begin, s.range.end))
+        stats = [await self._shard_stats(s) for s in shards]
 
         split_ranges = []
         for s, st in zip(shards, stats):
@@ -99,18 +96,37 @@ class DataDistributor:
 
         await self._maybe_rebalance(list(zip(shards, (st["bytes"] for st in stats))))
 
+    async def _shard_stats(self, shard) -> dict:
+        """Stats from any live team member (kills are permanent in the sim:
+        a dead primary must not wedge the monitor forever)."""
+        err: Exception | None = None
+        for tag in shard.team:
+            try:
+                return await self.cluster.storage_eps[tag].shard_stats(
+                    shard.range.begin, shard.range.end
+                )
+            except Exception as e:
+                err = e
+        raise err if err else RuntimeError("empty team")
+
+    def _live_tags(self) -> list[int]:
+        dead = self.cluster.loop.dead_processes
+        return [
+            t for t in range(len(self.cluster.storage_eps))
+            if f"storage{t}" not in dead
+        ]
+
     async def _maybe_rebalance(self, per_shard: list[tuple]) -> None:
         if self._moving:
             return  # one move at a time (reference: bounded in-flight moves)
-        m = self.cluster.storage_map
-        load: dict[int, int] = {
-            t: 0 for t in range(len(self.cluster.storage_eps))
-        }
+        live = self._live_tags()
+        if len(live) < 2:
+            return
+        load: dict[int, int] = {t: 0 for t in live}
         for s, nbytes in per_shard:
             for t in s.team:
-                load[t] += nbytes
-        if not load:
-            return
+                if t in load:
+                    load[t] += nbytes
         hot_tag = max(load, key=lambda t: load[t])
         cold_tag = min(load, key=lambda t: load[t])
         if load[hot_tag] < self.REBALANCE_RATIO * max(1, load[cold_tag]):
@@ -171,13 +187,13 @@ class DataDistributor:
                     lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep)
                 )
             # Every newcomer must be applied past its snapshot before it can
-            # answer reads issued after the flip.
+            # answer reads issued after the flip (fetch_keys itself already
+            # registered the serve entry at the snapshot version).
             for tag, v in snap_versions.items():
                 await self._retry(
                     lambda ep=self.cluster.storage_eps[tag], v=v:
                         ep.wait_for_version(v)
                 )
-                self.cluster.storages[tag].begin_serve(begin, end, v)
             flip_version = await self._retry(
                 self.cluster.tlog_eps[0].get_version
             )

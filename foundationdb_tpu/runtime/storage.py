@@ -287,13 +287,17 @@ class StorageServer:
             ]
             for s in dead:
                 self.served.remove(s)
-                # Purge exactly the portions no remaining entry covers — a
-                # partial overlap must not pin the whole retired range.
+                # Purge exactly the portions neither a remaining entry nor
+                # an in-flight fetch covers — a partial overlap must not pin
+                # the whole retired range, and a fetch re-acquiring the
+                # shard must not have its fresh snapshot swept away.
+                covers = [(o.begin, o.end) for o in self.served]
+                covers += [(fs.begin, fs.end) for fs in self._fetching]
                 parts = [(s.begin, s.end)]
-                for o in self.served:
+                for cb, ce in covers:
                     nxt: list[tuple[bytes, bytes]] = []
                     for b, e in parts:
-                        ob, oe = max(b, o.begin), min(e, o.end)
+                        ob, oe = max(b, cb), min(e, ce)
                         if ob < oe:
                             if b < ob:
                                 nxt.append((b, ob))
@@ -398,9 +402,29 @@ class StorageServer:
         self._fetching.append(f)
         try:
             snap_version, rows = await src_ep.snapshot_range(begin, end)
-            self.map.purge_range(begin, end)  # drop any aborted-move residue
+            # Reconcile existing history with the snapshot instead of
+            # purging: when a shard is RE-acquired within the read window,
+            # the old history still serves in-window readers through the
+            # retired ServedRange (the grace the map's versioned reads give
+            # the reference). Only aborted-move residue (entries above the
+            # snapshot) is dropped, and keys deleted while we were away get
+            # a tombstone so post-flip readers do not resurrect them.
+            snap_keys = {k for k, _v in rows}
+            for k in list(self.map.range_keys(begin, end)):
+                chain = self.map._chains[k]
+                if chain[-1][0] > snap_version:
+                    self.map.purge_range(k, k + b"\x00")  # residue
+                elif k not in snap_keys and chain[-1][1] is not None:
+                    self.map.write(k, snap_version, None)
             for k, v in rows:
                 self.map.write(k, snap_version, v)
+            # Advertise the shard as of the snapshot immediately: reads
+            # cannot reach us before the map flip (or a replica failover),
+            # and registering now means _gc can never mistake the fetched
+            # rows for retired-range garbage in the window before the
+            # distributor flips the map.
+            if self.served is not None:
+                self.begin_serve(begin, end, snap_version)
             for version, m in f.buffer:  # sync block through snap_version set
                 if version > snap_version:
                     self._apply_one(m, version)
@@ -459,6 +483,12 @@ class StorageServer:
             ob, oe = max(s.begin, begin), min(s.end, end)
             out.append(ServedRange(ob, oe, s.start_version, end_version))
         self.served = out
+        # Fail in-flight watches for the range: proxies stop tagging us, so
+        # the triggering write would never arrive here — the client gets a
+        # retryable error and re-arms on the new owner.
+        for key in [k for k in self._watches if begin <= k < end]:
+            for _expect, p in self._watches.pop(key):
+                p.fail(WrongShardServer(f"shard with {key[:16]!r} moved away"))
 
     def _check_serving(self, begin: bytes, end: bytes, version: int) -> None:
         """Reads must land on shards we own at `version`. Spatial gaps →

@@ -64,6 +64,8 @@ class SimCluster:
         engine: str = "oracle",
         ratekeeper: bool = True,
         data_distribution: bool = False,
+        n_coordinators: int = 0,
+        n_cc_candidates: int = 3,
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
@@ -107,17 +109,24 @@ class SimCluster:
                     if i in sh.team
                 ])
 
-        self.controller = ClusterController(self.loop, recruiter=self)
-        self.controller_ep = self.net.host(
-            "cluster_controller", "cluster_controller", self.controller
-        )
-        self.controller.bootstrap()
+        if n_coordinators:
+            self._bootstrap_coordinated(n_coordinators, n_cc_candidates)
+        else:
+            # Legacy singleton controller (no election, never killed).
+            self.coordinators = []
+            self.coordinator_eps = []
+            self.cc_heartbeats = {}
+            self.controller = ClusterController(self.loop, recruiter=self)
+            self.controller_ep = self.net.host(
+                "cluster_controller", "cluster_controller", self.controller
+            )
+            self.controller.bootstrap()
+            self.loop.spawn(
+                self.controller.run(), process="cluster_controller", name="cc.run"
+            )
 
         for i, s in enumerate(self.storages):
             self.loop.spawn(s.run(), process=f"storage{i}", name=f"storage{i}.run")
-        self.loop.spawn(
-            self.controller.run(), process="cluster_controller", name="cc.run"
-        )
 
         self.data_distributor = None
         self.data_distributor_ep = None
@@ -134,6 +143,71 @@ class SimCluster:
                 self.data_distributor.run(),
                 process="data_distributor",
                 name="dd.run",
+            )
+
+    # -- coordinated-controller mode ------------------------------------------
+
+    def install_controller(self, cc, process: str):
+        """Host an elected controller's RPC surface and make it the cluster's
+        current controller (called at bootstrap and by takeover winners)."""
+        ep = self.net.host(process, "cluster_controller", cc)
+        self.controller = cc
+        self.controller_ep = ep
+        return ep
+
+    def _bootstrap_coordinated(self, n_coordinators: int, n_cc: int) -> None:
+        """Coordinator quorum + controller candidates. Initial election is
+        seeded synchronously (candidate 0 wins reign 1) so the first
+        generation exists before the loop runs — the same shortcut the
+        reference takes by writing the cluster file's initial coordinated
+        state at database creation."""
+        from foundationdb_tpu.runtime.cluster import Heartbeat
+        from foundationdb_tpu.runtime.coordination import (
+            ControllerCandidate,
+            CoordinatedState,
+            Coordinator,
+        )
+
+        self.coordinators = [Coordinator() for _ in range(n_coordinators)]
+        self.coordinator_eps = [
+            self.net.host(f"coord{i}", "coordinator", c)
+            for i, c in enumerate(self.coordinators)
+        ]
+        # Every candidate process carries a liveness probe so rivals can
+        # tell a dead incumbent from a live one before racing a takeover.
+        self.cc_heartbeats = {
+            f"cc{i}": self.net.host(f"cc{i}", "heartbeat", Heartbeat())
+            for i in range(n_cc)
+        }
+
+        cc0 = ClusterController(
+            self.loop, recruiter=self, identity="cc0",
+            coord=CoordinatedState(self.loop, self.coordinator_eps, 0),
+            reign=1,
+        )
+        self.install_controller(cc0, "cc0")
+        cc0.bootstrap()
+        seed = {
+            "reign": 1,
+            "leader": "cc0",
+            "controller_ep": self.controller_ep,
+            "epoch": 1,
+            "recovery_version": 0,
+            "tlog_eps": list(self.tlog_eps),
+        }
+        for c in self.coordinators:
+            c.accepted_ballot = (1, 0)
+            c.promised = (1, 0)
+            c.accepted_value = dict(seed)
+        self.loop.spawn(cc0.run(), process="cc0", name="cc0.run")
+
+        self.cc_candidates = [
+            ControllerCandidate(self.loop, self, i, self.coordinator_eps)
+            for i in range(n_cc)
+        ]
+        for cand in self.cc_candidates:
+            self.loop.spawn(
+                cand.run(), process=cand.my_id, name=f"{cand.my_id}.candidate"
             )
 
     # -- recruiter interface (called by ClusterController / recovery) ---------

@@ -394,12 +394,16 @@ class FaultInjector:
 
     def __init__(self, cluster, kill_interval: float = 2.0,
                  partition_interval: float = 1.3, partition_length: float = 0.8,
-                 max_kills: int = 2):
+                 max_kills: int = 2, include_controller: bool = False):
         self.cluster = cluster
         self.kill_interval = kill_interval
         self.partition_interval = partition_interval
         self.partition_length = partition_length
         self.max_kills = max_kills
+        # With a coordinator quorum the controller itself is fair game: a
+        # rival candidate must win election and recover (the hardest
+        # failure mode of the reference — CC loss).
+        self.include_controller = include_controller
         self.kills: list[str] = []
         self.partitions = 0
         self._stop = False
@@ -417,6 +421,8 @@ class FaultInjector:
                 return
             gen = self.cluster.controller.generation
             victims = sorted(gen.heartbeat_eps)
+            if self.include_controller and self.cluster.cc_heartbeats:
+                victims.append(self.cluster.controller.identity)
             victim = victims[rng.randrange(len(victims))]
             if not self._safe_to_kill(gen, victim):
                 continue  # would destroy the last durable log copy
@@ -427,11 +433,18 @@ class FaultInjector:
         """Never kill the LAST reachable tlog of the generation: with every
         log copy gone the durable suffix is unknowable and recovery stalls
         forever (the reference's kill machinery keeps a replica alive the
-        same way — kills are permanent here, nothing reboots)."""
+        same way — kills are permanent here, nothing reboots). Likewise a
+        controller kill needs a surviving candidate to take over."""
+        dead = self.cluster.loop.dead_processes
+        if victim in getattr(self.cluster, "cc_heartbeats", {}):
+            others = [
+                p for p in self.cluster.cc_heartbeats
+                if p != victim and p not in dead
+            ]
+            return bool(others)
         tlog_procs = [ep.process for ep in gen.tlog_eps]
         if victim not in tlog_procs:
             return True
-        dead = self.cluster.loop.dead_processes
         alive = [p for p in tlog_procs if p not in dead]
         return len(alive) > 1 or victim not in alive
 

@@ -1,0 +1,185 @@
+"""Coordinators + controller election: quorum register safety, takeover
+on CC death, stale-controller deposition, client relocation.
+
+Mirrors the reference contracts (Coordination.actor.cpp +
+LeaderElection.actor.cpp): the coordinated state serializes elections,
+a killed controller is replaced and the cluster keeps serving, and a
+partitioned ex-controller cannot clobber the new generation."""
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.runtime.coordination import (
+    CoordinatedState,
+    Coordinator,
+    Deposed,
+)
+from foundationdb_tpu.runtime.flow import Loop, all_of
+from foundationdb_tpu.sim.cluster import SimCluster
+from foundationdb_tpu.sim.network import SimNetwork
+from foundationdb_tpu.sim.workloads import (
+    CycleWorkload,
+    FaultInjector,
+    run_workload,
+)
+
+
+def run(c, coro, timeout=600):
+    return c.loop.run(coro, timeout=timeout)
+
+
+class TestRegister:
+    def _quorum(self, n=3, seed=0):
+        loop = Loop(seed=seed)
+        net = SimNetwork(loop)
+        coords = [Coordinator() for _ in range(n)]
+        eps = [net.host(f"coord{i}", "coordinator", c)
+               for i, c in enumerate(coords)]
+        return loop, net, coords, eps
+
+    def test_racing_elections_one_winner_per_reign(self):
+        loop, net, coords, eps = self._quorum()
+        a = CoordinatedState(loop, eps, candidate_id=0)
+        b = CoordinatedState(loop, eps, candidate_id=1)
+
+        async def main():
+            results = []
+
+            async def racer(cs, my_id):
+                try:
+                    results.append((my_id, await cs.elect(my_id, None)))
+                except Deposed:
+                    results.append((my_id, None))
+
+            await all_of([
+                loop.spawn(racer(a, "ccA"), name="raceA"),
+                loop.spawn(racer(b, "ccB"), name="raceB"),
+            ])
+            final = (await a.read()).value
+            # Both writes are serialized by ballots: the register converges
+            # to exactly one leader, and reigns never collide.
+            reigns = [r["reign"] for _id, r in results if r]
+            assert len(set(reigns)) == len(reigns), "duplicate reign won"
+            assert final["leader"] in ("ccA", "ccB")
+            return "ok"
+
+        assert run(type("C", (), {"loop": loop})(), main()) == "ok"
+
+    def test_write_if_leader_rejects_deposed(self):
+        loop, net, coords, eps = self._quorum(seed=1)
+        a = CoordinatedState(loop, eps, candidate_id=0)
+        b = CoordinatedState(loop, eps, candidate_id=1)
+
+        async def main():
+            sa = await a.elect("ccA", None)
+            await b.elect("ccB", None)  # takes over
+            try:
+                await a.write_if_leader("ccA", sa["reign"], {"epoch": 99})
+                return "accepted"
+            except Deposed:
+                return "deposed"
+
+        assert run(type("C", (), {"loop": loop})(), main()) == "deposed"
+
+    def test_quorum_survives_minority_coordinator_death(self):
+        loop, net, coords, eps = self._quorum(seed=2)
+        a = CoordinatedState(loop, eps, candidate_id=0)
+
+        async def main():
+            net.kill("coord1")  # minority down: still a quorum
+            state = await a.elect("ccA", None)
+            assert state["leader"] == "ccA"
+            return "ok"
+
+        assert run(type("C", (), {"loop": loop})(), main()) == "ok"
+
+
+class TestControllerElection:
+    def test_kill_controller_reelects_and_recovers(self):
+        c = SimCluster(seed=201, n_coordinators=3, n_tlogs=2)
+        db = open_database(c)
+
+        async def main():
+            tr = db.transaction()
+            tr.set(b"before", b"kill")
+            await tr.commit()
+            assert c.controller.identity == "cc0"
+            c.net.kill("cc0")
+            # A rival wins election and drives recovery to a new epoch.
+            for _ in range(400):
+                if c.controller.identity != "cc0" \
+                        and c.controller.generation.epoch >= 2:
+                    break
+                await c.loop.sleep(0.1)
+            assert c.controller.identity in ("cc1", "cc2")
+            assert c.controller.generation.epoch >= 2
+            # Client rides through: relocates the controller via the
+            # coordinators and keeps transacting.
+            async def txn(tr):
+                assert await tr.get(b"before") == b"kill"
+                tr.set(b"after", b"reelection")
+
+            await db.run(txn)
+            tr = db.transaction()
+            assert await tr.get(b"after") == b"reelection"
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_cycle_workload_with_controller_kills(self):
+        """VERDICT r1 item 5 done-criterion: the fault injector may kill
+        the controller and the cycle workload still passes."""
+        c = SimCluster(seed=202, n_coordinators=3, n_tlogs=2)
+        db = open_database(c)
+        w = CycleWorkload(202, n_nodes=8, n_txns=30, n_clients=3)
+        f = FaultInjector(c, kill_interval=0.3, partition_interval=0.4,
+                          max_kills=2, include_controller=True)
+        m = run(c, run_workload(c, db, w, faults=f))
+        assert m.txns_committed >= 30
+        assert f.kills, "fault injector never fired"
+
+    def test_explicit_controller_kill_under_cycle(self):
+        """Deterministic CC kill mid-workload (the injector's choice is
+        seed-dependent; this pins the scenario)."""
+        c = SimCluster(seed=203, n_coordinators=3, n_tlogs=2)
+        db = open_database(c)
+        w = CycleWorkload(203, n_nodes=8, n_txns=30, n_clients=3)
+
+        async def main():
+            task = c.loop.spawn(run_workload(c, db, w), name="wl")
+            await c.loop.sleep(0.4)
+            c.net.kill(c.controller.identity)
+            m = await task
+            # The workload may finish before the takeover lands; wait for
+            # the rival to install itself before asserting.
+            for _ in range(400):
+                if c.controller.identity != "cc0":
+                    break
+                await c.loop.sleep(0.1)
+            return m
+
+        m = run(c, main())
+        assert m.txns_committed >= 30
+        assert c.controller.identity != "cc0"
+
+    def test_partitioned_ex_controller_is_deposed(self):
+        c = SimCluster(seed=204, n_coordinators=3, n_tlogs=2)
+        open_database(c)
+
+        async def main():
+            cc0 = c.controller
+            # Cut cc0 off from the quorum AND from its rivals' probes.
+            peers = [f"coord{i}" for i in range(3)] + ["cc1", "cc2"]
+            for p in peers:
+                c.net.partition("cc0", p)
+            for _ in range(400):
+                if c.controller is not cc0:
+                    break
+                await c.loop.sleep(0.1)
+            assert c.controller is not cc0, "no takeover happened"
+            for p in peers:
+                c.net.heal("cc0", p)
+            # Healed, the ex-controller's next quorum check deposes it.
+            assert not await cc0._confirm_leadership()
+            assert cc0._deposed
+            return "ok"
+
+        assert run(c, main()) == "ok"
