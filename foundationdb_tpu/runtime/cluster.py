@@ -79,11 +79,15 @@ class ClusterController:
         self._recovering = False
         self._deposed = False
 
-    def bootstrap(self) -> None:
-        """Recruit generation 1 (initial, non-recovery startup)."""
+    def bootstrap(self, epoch: int = 1, recovery_version: int = 0,
+                  seed_entries: list | None = None) -> None:
+        """Recruit the first generation of this process lifetime. A fresh
+        cluster starts at epoch 1; a restart from disk starts at the
+        persisted epoch + 1 with the disk queues' salvaged entries."""
         assert self.generation is None
         self.generation = self.recruiter.recruit_generation(
-            epoch=1, recovery_version=0, seed_entries=[]
+            epoch=epoch, recovery_version=recovery_version,
+            seed_entries=list(seed_entries or []),
         )
 
     # -- client face ----------------------------------------------------------

@@ -1,0 +1,83 @@
+"""Durable append-only queue for the tlog (reference: fdbserver/DiskQueue).
+
+Records are length-prefixed, CRC-protected pickled payloads appended to a
+single file and fsync'd in batches. Recovery replays the file front to
+back and stops at the first torn/corrupt record, truncating the garbage —
+exactly the reference DiskQueue's recovery contract (a crash mid-write
+loses only the unacknowledged suffix, never acknowledged data, because
+the tlog acks a push only after fsync).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+
+_HDR = struct.Struct("<II")  # payload length, crc32
+
+
+class DiskQueue:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Truncate on create: every queue belongs to exactly one brand-new
+        # tlog generation. A leftover same-named file (crash between queue
+        # creation and the cluster-meta swap, then a same-epoch re-recruit)
+        # must not get a second seed appended onto its stale contents.
+        self._f = open(path, "wb")
+
+    def append(self, record: object) -> None:
+        payload = pickle.dumps(record)
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+
+    def fsync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def rewrite(self, records: list[object]) -> None:
+        """Compaction: atomically replace the file's contents with `records`
+        (the un-popped suffix) — the pop-side space reclamation the
+        reference DiskQueue does with its ring buffer."""
+        tmp_path = self.path + ".tmp"
+        with open(tmp_path, "wb") as tmp:
+            for r in records:
+                payload = pickle.dumps(r)
+                tmp.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+                tmp.write(payload)
+            tmp.flush()
+            os.fsync(tmp.fileno())
+        self._f.close()
+        os.replace(tmp_path, self.path)
+        self._f = open(self.path, "ab")
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def recover(path: str) -> list[object]:
+        """All intact records; truncates a torn tail in place."""
+        if not os.path.exists(path):
+            return []
+        out: list[object] = []
+        good_end = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _HDR.size <= len(data):
+            length, crc = _HDR.unpack_from(data, pos)
+            end = pos + _HDR.size + length
+            if end > len(data):
+                break  # torn final record
+            payload = data[pos + _HDR.size : end]
+            if zlib.crc32(payload) != crc:
+                break  # corruption: everything after is untrustworthy
+            out.append(pickle.loads(payload))
+            good_end = end
+            pos = end
+        if good_end < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+        return out

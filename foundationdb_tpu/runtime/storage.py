@@ -142,10 +142,23 @@ class StorageServer:
     GC_INTERVAL = 0.5
 
     def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0,
-                 tlog_replicas=None):
+                 tlog_replicas=None, kvstore=None):
         self.loop = loop
         self.tag = tag
         self.tlog = tlog_ep
+        # Persistent engine behind the MVCC window (runtime/kvstore.py;
+        # reference: KeyValueStoreSQLite). On restart the durable snapshot
+        # reloads and the pull loop resumes from its version. The flush
+        # version never exceeds known_committed, so recovery rollback can
+        # never contradict what the engine already made durable.
+        self.kvstore = kvstore
+        self._dirty: set[bytes] = set()
+        self._pending_purges: list[tuple[bytes, bytes]] = []
+        self._durable_version = 0
+        if kvstore is not None:
+            version, rows = kvstore.load()
+            self._durable_version = version
+            init_version = max(init_version, version)
         # Replica tlogs also hold our tag; pops must reach every one or the
         # non-primary logs never trim and grow unbounded within an epoch.
         self.tlog_replicas = list(tlog_replicas or [])
@@ -161,6 +174,9 @@ class StorageServer:
         # (single-team clusters never register ranges and skip the guard).
         self.served: list[ServedRange] | None = None
         self._fetching: list[FetchState] = []
+        if kvstore is not None:
+            for k, v in rows:
+                self.map.write(k, self._durable_version, v)
 
     # -- write path (tlog pull) ----------------------------------------------
 
@@ -191,12 +207,21 @@ class StorageServer:
                     # tags still raise the tlog's trim floor — without this a
                     # salvage-seeded tag that never sees new writes pins the
                     # floor at 0 and the log grows without bound.
-                    await tlog.pop(self.tag, self._version)
+                    #
+                    # With a persistent engine the pop floor is the DURABLE
+                    # version, not the applied one: popping past what sqlite
+                    # holds would let the tlog trim (and recovery salvage
+                    # drop) acked commits a whole-cluster crash still needs.
+                    pop_v = (
+                        self._version if self.kvstore is None
+                        else self._durable_version
+                    )
+                    await tlog.pop(self.tag, pop_v)
                     for rep in self.tlog_replicas:
                         if rep is tlog:
                             continue
                         try:
-                            await rep.pop(self.tag, self._version)
+                            await rep.pop(self.tag, pop_v)
                         except BrokenPromise:
                             pass  # dead replica: recovery will retire it
             except BrokenPromise:
@@ -267,6 +292,8 @@ class StorageServer:
 
     def _write(self, key: bytes, version: int, value: bytes | None) -> None:
         self.map.write(key, version, value)
+        if self.kvstore is not None:
+            self._dirty.add(key)
         watchers = self._watches.pop(key, None)
         if watchers:
             keep = []
@@ -277,6 +304,7 @@ class StorageServer:
 
     def _gc(self) -> None:
         self.map.gc(self.oldest_version)
+        self._flush_durable()
         # Retire moved-away shards once no in-window reader can still need
         # them: drop the serve entry and purge the bytes (reference: the SS
         # removes a moved range after its readers age out of the window).
@@ -307,7 +335,38 @@ class StorageServer:
                             nxt.append((b, e))
                     parts = nxt
                 for b, e in parts:
-                    self.map.purge_range(b, e)
+                    self._purge(b, e)
+
+    def _flush_durable(self) -> None:
+        """Make a consistent prefix durable: dirty keys' values AS OF the
+        flush version (never above known_committed — the only bound
+        recovery rollback respects) in one atomic engine commit."""
+        if self.kvstore is None:
+            return
+        flush_version = min(self._version, self.known_committed)
+        if flush_version <= self._durable_version:
+            return
+        writes: dict[bytes, bytes | None] = {}
+        still_dirty: set[bytes] = set()
+        for k in self._dirty:
+            chain = self.map._chains.get(k)
+            if chain is None:
+                writes[k] = None  # purged/GC'd away entirely
+                continue
+            writes[k] = self.map.at(k, flush_version)
+            if chain[-1][0] > flush_version:
+                still_dirty.add(k)  # has writes above the flush point
+        self.kvstore.flush(writes, flush_version, purges=self._pending_purges)
+        self._pending_purges = []
+        self._dirty = still_dirty
+        self._durable_version = flush_version
+
+    def _purge(self, begin: bytes, end: bytes) -> None:
+        """Purge a range from the window AND schedule the same delete in the
+        persistent engine (mirrored at the next flush, atomically)."""
+        self.map.purge_range(begin, end)
+        if self.kvstore is not None:
+            self._pending_purges.append((begin, end))
 
     # -- shard serving / data movement (reference: fetchKeys + shard map) ----
 
@@ -413,7 +472,7 @@ class StorageServer:
             for k in list(self.map.range_keys(begin, end)):
                 chain = self.map._chains[k]
                 if chain[-1][0] > snap_version:
-                    self.map.purge_range(k, k + b"\x00")  # residue
+                    self._purge(k, k + b"\x00")  # residue
                 elif k not in snap_keys and chain[-1][1] is not None:
                     self.map.write(k, snap_version, None)
             for k, v in rows:
@@ -437,7 +496,7 @@ class StorageServer:
         except BaseException:
             if f in self._fetching:
                 self._fetching.remove(f)
-            self.map.purge_range(begin, end)  # buffered mutations were lost
+            self._purge(begin, end)  # buffered mutations were lost
             raise
 
     def abort_fetch(self, begin: bytes, end: bytes) -> None:
@@ -445,7 +504,7 @@ class StorageServer:
         self._fetching = [
             f for f in self._fetching if not (f.begin == begin and f.end == end)
         ]
-        self.map.purge_range(begin, end)
+        self._purge(begin, end)
 
     def init_served(self, ranges: list[tuple[bytes, bytes]]) -> None:
         self.served = [ServedRange(b, e) for b, e in ranges]

@@ -36,13 +36,26 @@ class TLog:
         init_version: int = 0,
         seed: list[tuple[int, dict[int, list[Mutation]]]] | None = None,
         retired_tags: set[int] | None = None,
+        disk_path: str | None = None,
     ):
         """`seed`: prior-generation entries salvaged by recovery (versions
         all < init_version); storage servers finish pulling them from this
         log as if the old generation had never died. `retired_tags`: tags
         that will never pull again (stopped backups) — excluded from the
-        trim floor even if seed entries or late pushes still carry them."""
+        trim floor even if seed entries or late pushes still carry them.
+        `disk_path`: append-only disk queue — pushes are written + fsync'd
+        before the ack, so acknowledged commits survive a full-cluster
+        restart (runtime/diskqueue.py; reference: the tlog's DiskQueue)."""
         self.loop = loop
+        self.disk = None
+        if disk_path is not None:
+            from foundationdb_tpu.runtime.diskqueue import DiskQueue
+
+            self.disk = DiskQueue(disk_path)
+            if seed:  # salvaged entries must be durable in OUR file too
+                for v, t in seed:
+                    self.disk.append((v, t))
+                self.disk.fsync()
         self._log: list[TLogEntry] = [TLogEntry(v, t) for v, t in (seed or [])]
         assert all(e.version < init_version for e in self._log)
         self._version = init_version  # end of applied chain
@@ -84,6 +97,11 @@ class TLog:
         await self.loop.sleep(self.FSYNC_SECONDS)
         if self.locked:  # lock won the race while we were "fsyncing"
             raise TLogLocked(f"push v{version} after lock at v{self._version}")
+        if self.disk is not None:
+            # REAL durability before the ack: a crash after this point
+            # cannot lose the batch; a crash before it never acked.
+            self.disk.append((version, tagged))
+            self.disk.fsync()
         self._log.append(TLogEntry(version, tagged))
         self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
@@ -120,11 +138,20 @@ class TLog:
         self._popped[tag] = max(self._popped.get(tag, 0), version)
         self._trim()
 
+    DISK_COMPACT_EVERY = 256  # trims between disk-queue rewrites
+
     def _trim(self) -> None:
         if not self._tags_seen:
             return  # nothing pushed yet (fresh post-recovery log): no trim
         floor = min(self._popped.get(t, 0) for t in self._tags_seen)
+        before = len(self._log)
         self._log = [e for e in self._log if e.version > floor]
+        if self.disk is not None and before != len(self._log):
+            self._disk_trims = getattr(self, "_disk_trims", 0) + 1
+            if self._disk_trims % self.DISK_COMPACT_EVERY == 0:
+                # Reclaim queue space: the in-memory log IS the un-popped
+                # suffix a restart still needs — rewrite the file to it.
+                self.disk.rewrite([(e.version, e.tagged) for e in self._log])
 
     async def lock(self) -> int:
         """Recovery: refuse further pushes; → end version (reference:

@@ -19,6 +19,9 @@ heartbeat sweep drives recovery to a fresh epoch.
 
 from __future__ import annotations
 
+import json
+import os
+
 from foundationdb_tpu.runtime.cluster import ClusterController, Generation, Heartbeat
 from foundationdb_tpu.runtime.commit_proxy import CommitProxy
 from foundationdb_tpu.runtime.flow import Loop
@@ -66,9 +69,17 @@ class SimCluster:
         data_distribution: bool = False,
         n_coordinators: int = 0,
         n_cc_candidates: int = 3,
+        data_dir: str | None = None,
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
+        # Real durability (reference: tlog DiskQueue + KeyValueStoreSQLite):
+        # tlogs fsync pushes to append-only queues, storages flush a
+        # consistent prefix to sqlite. A SimCluster re-created on the same
+        # data_dir restarts from disk: epoch advances, the last generation's
+        # disk queues seed the new tlogs, storage reloads its snapshot.
+        self.data_dir = data_dir
+        self._restore = self._read_cluster_meta() if data_dir else None
         self.net = SimNetwork(self.loop)
         self.engine = engine
         self.n_proxies = n_proxies
@@ -92,8 +103,16 @@ class SimCluster:
 
         # Storage servers persist across generations (they ARE the data);
         # their tlog endpoint is re-pointed by each recruitment.
+        def make_kvstore(i: int):
+            if data_dir is None:
+                return None
+            from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
+
+            return KeyValueStoreSQLite(os.path.join(data_dir, f"storage{i}.db"))
+
         self.storages = [
-            StorageServer(self.loop, tag=i, tlog_ep=None) for i in range(n_storages)
+            StorageServer(self.loop, tag=i, tlog_ep=None, kvstore=make_kvstore(i))
+            for i in range(n_storages)
         ]
         self.storage_eps = [
             self.net.host(f"storage{i}", f"storage{i}", s)
@@ -120,7 +139,7 @@ class SimCluster:
             self.controller_ep = self.net.host(
                 "cluster_controller", "cluster_controller", self.controller
             )
-            self.controller.bootstrap()
+            self.controller.bootstrap(**self._bootstrap_args())
             self.loop.spawn(
                 self.controller.run(), process="cluster_controller", name="cc.run"
             )
@@ -144,6 +163,53 @@ class SimCluster:
                 process="data_distributor",
                 name="dd.run",
             )
+
+    # -- durable restart (reference: tlog DiskQueue + sqlite engine) ----------
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.data_dir, "cluster.json")
+
+    def _read_cluster_meta(self) -> dict | None:
+        path = self._meta_path()
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def _persist_cluster_meta(self, epoch: int, recovery_version: int,
+                              tlog_files: list[str]) -> None:
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "epoch": epoch,
+                "recovery_version": recovery_version,
+                "tlog_files": tlog_files,
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path())  # atomic swap
+
+    def _bootstrap_args(self) -> dict:
+        """Fresh cluster → epoch 1; restart → persisted epoch + 1 with the
+        last generation's disk queues salvaged as seed entries (the whole-
+        cluster-crash analogue of recovery's lock-and-salvage)."""
+        if not self._restore:
+            return {}
+        from foundationdb_tpu.runtime.diskqueue import DiskQueue
+
+        best: list = []
+        for path in self._restore["tlog_files"]:
+            entries = DiskQueue.recover(path)
+            if len(entries) > len(best):
+                best = entries  # replicas are identical chains: longest wins
+        recovery_version = max(
+            [v for v, _t in best] + [self._restore["recovery_version"]]
+        )
+        return {
+            "epoch": self._restore["epoch"] + 1,
+            "recovery_version": recovery_version,
+            "seed_entries": best,
+        }
 
     # -- coordinated-controller mode ------------------------------------------
 
@@ -186,13 +252,14 @@ class SimCluster:
             reign=1,
         )
         self.install_controller(cc0, "cc0")
-        cc0.bootstrap()
+        cc0.bootstrap(**self._bootstrap_args())
+        g = cc0.generation
         seed = {
             "reign": 1,
             "leader": "cc0",
             "controller_ep": self.controller_ep,
-            "epoch": 1,
-            "recovery_version": 0,
+            "epoch": g.epoch,
+            "recovery_version": g.recovery_version,
             "tlog_eps": list(self.tlog_eps),
         }
         for c in self.coordinators:
@@ -221,9 +288,15 @@ class SimCluster:
         # replica whose log was never trimmed (pullers pop one tlog), and
         # re-seeding its full history would compound across recoveries. The
         # floor is the min over every pull cursor: storage applied versions
-        # AND the backup worker's log cursor when a backup is running.
+        # (DURABLE versions when a persistent engine runs — everything above
+        # sqlite's snapshot must survive into the new epoch's disk queues or
+        # a later whole-cluster crash loses acked commits) AND the backup
+        # worker's log cursor when a backup is running.
+        def pull_floor(s) -> int:
+            return s._version if s.kvstore is None else s._durable_version
+
         floor = min(
-            (min(s._version, recovery_version) for s in self.storages),
+            (min(pull_floor(s), recovery_version) for s in self.storages),
             default=0,
         )
         if self.backup_active and self.backup_worker is not None:
@@ -251,14 +324,24 @@ class SimCluster:
             for i, r in enumerate(self.resolvers)
         ]
 
+        def tlog_disk(i: int) -> str | None:
+            if self.data_dir is None:
+                return None
+            return os.path.join(self.data_dir, f"tlog{i}.e{epoch}.q")
+
         self.tlogs = [
             TLog(self.loop, init_version=start_version, seed=list(seed_entries),
-                 retired_tags=set(self.retired_tags))
-            for _ in range(self.n_tlogs)
+                 retired_tags=set(self.retired_tags), disk_path=tlog_disk(i))
+            for i in range(self.n_tlogs)
         ]
         self.tlog_eps = [
             host(f"tlog{i}{sfx}", f"tlog{i}", t) for i, t in enumerate(self.tlogs)
         ]
+        if self.data_dir is not None:
+            self._persist_cluster_meta(
+                epoch, recovery_version,
+                [tlog_disk(i) for i in range(self.n_tlogs)],
+            )
 
         self.ratekeeper = (
             Ratekeeper(self.loop, self.storage_eps) if self.with_ratekeeper else None

@@ -1,0 +1,230 @@
+"""Real durability: tlog disk queues, the sqlite storage engine, and
+whole-cluster restart from disk.
+
+The done-criterion from round 1's verdict: kill the WHOLE cluster,
+restart from disk, and read committed data (reference: the tlog's
+DiskQueue + KeyValueStoreSQLite make exactly this survivable)."""
+
+import os
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.runtime.diskqueue import DiskQueue
+from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def run(c, coro, timeout=600):
+    return c.loop.run(coro, timeout=timeout)
+
+
+class TestDiskQueue:
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "q")
+        q = DiskQueue(p)
+        q.append((1, {"a": 1}))
+        q.append((2, {"b": 2}))
+        q.fsync()
+        q.close()
+        assert DiskQueue.recover(p) == [(1, {"a": 1}), (2, {"b": 2})]
+
+    def test_torn_tail_truncated(self, tmp_path):
+        p = str(tmp_path / "q")
+        q = DiskQueue(p)
+        q.append(("good", 1))
+        q.fsync()
+        q.close()
+        size_good = os.path.getsize(p)
+        with open(p, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad")  # torn header+garbage
+        assert DiskQueue.recover(p) == [("good", 1)]
+        assert os.path.getsize(p) == size_good  # garbage truncated away
+
+    def test_corrupt_record_stops_replay(self, tmp_path):
+        p = str(tmp_path / "q")
+        q = DiskQueue(p)
+        q.append(("first", 1))
+        q.append(("second", 2))
+        q.fsync()
+        q.close()
+        data = bytearray(open(p, "rb").read())
+        data[-1] ^= 0xFF  # flip a bit in the last record's payload
+        open(p, "wb").write(bytes(data))
+        assert DiskQueue.recover(p) == [("first", 1)]
+
+
+class TestKvStore:
+    def test_flush_load_purge(self, tmp_path):
+        p = str(tmp_path / "s.db")
+        kv = KeyValueStoreSQLite(p)
+        kv.flush({b"a": b"1", b"b": b"2", b"z": b"3"}, version=10)
+        kv.flush({b"a": None}, version=20, purges=[(b"y", b"zz")])
+        kv.close()
+        kv2 = KeyValueStoreSQLite(p)
+        version, rows = kv2.load()
+        assert version == 20
+        assert rows == [(b"b", b"2")]
+
+
+class TestClusterRestart:
+    def _commit_keys(self, c, db, prefix: bytes, n: int):
+        async def main():
+            for i in range(n):
+                tr = db.transaction()
+                tr.set(prefix + b"%04d" % i, b"val%04d" % i)
+                await tr.commit()
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def _read_all(self, c, db, prefix: bytes, n: int):
+        async def main():
+            tr = db.transaction()
+            for i in range(n):
+                got = await tr.get(prefix + b"%04d" % i)
+                assert got == b"val%04d" % i, (i, got)
+            return "ok"
+
+        return run(c, main())
+
+    def test_full_cluster_restart_reads_committed_data(self, tmp_path):
+        d = str(tmp_path)
+        c1 = SimCluster(seed=301, data_dir=d, n_tlogs=2)
+        db1 = open_database(c1)
+        self._commit_keys(c1, db1, b"dur/", 30)
+
+        # Let the storage engine flush a prefix (GC interval + idle commit
+        # so known_committed advances past most writes).
+        async def settle():
+            tr = db1.transaction()
+            tr.set(b"zz/settle", b"1")
+            await tr.commit()
+            await c1.loop.sleep(1.5)
+            return "ok"
+
+        assert run(c1, settle()) == "ok"
+        assert any(s._durable_version > 0 for s in c1.storages)
+
+        # The whole cluster "crashes": the old loop is simply abandoned.
+        c2 = SimCluster(seed=302, data_dir=d, n_tlogs=2)
+        assert c2.controller.generation.epoch >= 2  # restart = new epoch
+        db2 = open_database(c2)
+        assert self._read_all(c2, db2, b"dur/", 30) == "ok"
+
+    def test_restart_without_flush_recovers_from_tlog(self, tmp_path):
+        """Crash BEFORE any storage flush: acked commits live only in the
+        tlogs' disk queues — the fsync-before-ack contract must be enough."""
+        d = str(tmp_path)
+        c1 = SimCluster(seed=303, data_dir=d)
+        db1 = open_database(c1)
+        self._commit_keys(c1, db1, b"log/", 10)  # no settle: no flush window
+
+        c2 = SimCluster(seed=304, data_dir=d)
+        db2 = open_database(c2)
+        assert self._read_all(c2, db2, b"log/", 10) == "ok"
+
+    def test_double_restart(self, tmp_path):
+        d = str(tmp_path)
+        c1 = SimCluster(seed=305, data_dir=d)
+        db1 = open_database(c1)
+        self._commit_keys(c1, db1, b"a/", 8)
+
+        c2 = SimCluster(seed=306, data_dir=d)
+        db2 = open_database(c2)
+        assert self._read_all(c2, db2, b"a/", 8) == "ok"
+        self._commit_keys(c2, db2, b"b/", 8)
+
+        c3 = SimCluster(seed=307, data_dir=d)
+        db3 = open_database(c3)
+        assert self._read_all(c3, db3, b"a/", 8) == "ok"
+        assert self._read_all(c3, db3, b"b/", 8) == "ok"
+        assert c3.controller.generation.epoch >= 3
+
+    def test_restart_new_writes_then_read_old(self, tmp_path):
+        d = str(tmp_path)
+        c1 = SimCluster(seed=308, data_dir=d, n_tlogs=2)
+        db1 = open_database(c1)
+        self._commit_keys(c1, db1, b"mix/", 12)
+
+        c2 = SimCluster(seed=309, data_dir=d, n_tlogs=2)
+        db2 = open_database(c2)
+
+        async def main():
+            tr = db2.transaction()
+            tr.set(b"mix/0003", b"overwritten")
+            await tr.commit()
+            tr = db2.transaction()
+            assert await tr.get(b"mix/0003") == b"overwritten"
+            assert await tr.get(b"mix/0007") == b"val0007"
+            return "ok"
+
+        assert run(c2, main()) == "ok"
+
+
+class TestPurgePaths:
+    def test_abort_fetch_and_retirement_purge(self):
+        """The purge helper is exercised by abort_fetch and retired-range
+        GC (code review r2: an earlier version recursed infinitely and no
+        test covered it)."""
+        from foundationdb_tpu.runtime.flow import Loop
+        from foundationdb_tpu.runtime.storage import StorageServer
+
+        loop = Loop(seed=0)
+        s = StorageServer(loop, tag=0, tlog_ep=None)
+        s.init_served([(b"a", b"m")])
+
+        async def main():
+            s.map.write(b"b1", 5, b"x")
+            s.abort_fetch(b"b", b"c")  # purges [b, c)
+            assert s.map.latest(b"b1") is None
+            # Retired-range purge in _gc:
+            s.map.write(b"d1", 5, b"y")
+            s.end_serve(b"a", b"m", end_version=6)
+            s.oldest_version = 100  # retire + purge
+            s._gc()
+            assert s.map.latest(b"d1") is None
+            return "ok"
+
+        assert loop.run(main(), timeout=30) == "ok"
+
+
+class TestDurableGapAcrossRecovery:
+    def test_inlife_recovery_then_crash_keeps_acked_commits(self, tmp_path):
+        """Acked commits above the sqlite flush but below the applied
+        version must survive an in-life recovery FOLLOWED by a whole-
+        cluster crash: pops/salvage floors respect the durable version, so
+        the gap rides into the new epoch's disk queues."""
+        d = str(tmp_path)
+        c1 = SimCluster(seed=310, data_dir=d, n_tlogs=2)
+        db1 = open_database(c1)
+
+        async def phase1():
+            for i in range(20):
+                tr = db1.transaction()
+                tr.set(b"gap/%04d" % i, b"val%04d" % i)
+                await tr.commit()
+            # Force an in-life recovery while flushes lag applied versions.
+            c1.net.kill("resolver0")
+            while c1.controller.generation.epoch < 2:
+                await c1.loop.sleep(0.1)
+            while c1.controller._recovering:
+                await c1.loop.sleep(0.1)
+            await db1.refresh_client_info()  # old-generation proxies retired
+            for i in range(20, 28):
+                tr = db1.transaction()
+                tr.set(b"gap/%04d" % i, b"val%04d" % i)
+                await tr.commit()
+            return "ok"
+
+        assert run(c1, phase1()) == "ok"
+
+        c2 = SimCluster(seed=311, data_dir=d, n_tlogs=2)
+        db2 = open_database(c2)
+
+        async def check():
+            tr = db2.transaction()
+            for i in range(28):
+                got = await tr.get(b"gap/%04d" % i)
+                assert got == b"val%04d" % i, (i, got)
+            return "ok"
+
+        assert run(c2, check()) == "ok"
