@@ -34,15 +34,37 @@ import json
 import sys
 import time
 import traceback
+from dataclasses import dataclass
 
 import numpy as np
 
 BATCH = 8192
-N_READS = 2  # point reads per txn
+N_READS = 2  # point reads per txn (ycsb default; see MODES)
 WINDOW = 64  # MVCC window in commit versions (batches)
 MAX_LAG = 8  # read-version staleness in versions (<< WINDOW: no TOO_OLD)
 KEY_BYTES = 12  # codec width: 8-byte keys + point-range end fits exactly
 _BIAS = np.uint32(0x80000000)
+
+
+@dataclass(frozen=True)
+class ModeConfig:
+    """One §5 benchmark configuration (reference: mako run configs)."""
+
+    n_reads: int  # point reads per txn
+    n_writes: int  # point writes per txn (all-or-none via write_frac)
+    write_frac: float
+    theta: float  # Zipf skew (0 = uniform)
+    batch: int
+
+
+MODES = {
+    # YCSB-A hot-key contention: 2 reads + 50% single write, Zipf 0.99.
+    "ycsb": ModeConfig(2, 1, 0.5, 0.99, BATCH),
+    # mako 90/10 op mix: 9 reads + 1 write every txn.
+    "mako": ModeConfig(9, 1, 1.0, 0.99, 4096),
+    # TPC-C new-order shape: wide txns (12 reads, 8 writes), uniform items.
+    "tpcc": ModeConfig(12, 8, 1.0, 0.0, 2048),
+}
 
 
 def log(msg: str) -> None:
@@ -106,13 +128,14 @@ def zipf_sampler(rng: np.random.Generator, n_keys: int, theta: float = 0.99):
     return sample
 
 
-def gen_workload(n_txns: int, n_keys: int, seed: int, write_frac: float = 0.5):
-    """Returns (read_ids [N, R], write_ids [N], write_mask [N], lag [N])."""
+def gen_workload(n_txns: int, n_keys: int, seed: int,
+                 mode: ModeConfig = MODES["ycsb"]):
+    """Returns (read_ids [N, R], write_ids [N, Q], write_mask [N], lag [N])."""
     rng = np.random.default_rng(seed)
-    sample = zipf_sampler(rng, n_keys)
-    read_ids = sample((n_txns, N_READS))
-    write_ids = sample((n_txns,))
-    write_mask = rng.random(n_txns) < write_frac
+    sample = zipf_sampler(rng, n_keys, mode.theta)
+    read_ids = sample((n_txns, mode.n_reads))
+    write_ids = sample((n_txns, mode.n_writes))
+    write_mask = rng.random(n_txns) < mode.write_frac
     lag = np.minimum(rng.geometric(0.6, n_txns) - 1, MAX_LAG).astype(np.int64)
     return read_ids, write_ids, write_mask, lag
 
@@ -122,46 +145,49 @@ def gen_workload(n_txns: int, n_keys: int, seed: int, write_frac: float = 0.5):
 # emits these bytes as its RPC payload, so generation is not resolver work)
 # ---------------------------------------------------------------------------
 
-# Fixed with-write record layout (little-endian), nw in the header encodes
-# whether the trailing write range is present; without-write records are a
-# strict prefix so a masked ragged flatten assembles the stream in numpy.
-_REC_READ = 8 + 17  # (bl, el) + 8B begin + 9B end
+# Fixed with-writes record layout (little-endian), nw in the header encodes
+# whether the trailing write ranges are present; without-writes records are
+# a strict prefix so a masked ragged flatten assembles the stream in numpy.
+_REC_RANGE = 8 + 17  # (bl, el) + 8B begin + 9B end
 _REC_HDR = 16
-_REC_FULL = _REC_HDR + 3 * _REC_READ
-_REC_NOWRITE = _REC_HDR + 2 * _REC_READ
 
 
-def build_wire_stream(read_ids, write_ids, write_mask, lag, n_batches):
-    """Returns (blob uint8[...], batch_offsets int64[n_batches+1])."""
-    n = read_ids.shape[0]
-    be = read_ids.astype(">u8").view(np.uint8).reshape(n, N_READS, 8)
-    wbe = write_ids.astype(">u8").view(np.uint8).reshape(n, 8)
-    cvs = np.repeat(np.arange(1, n_batches + 1, dtype=np.int64), BATCH)
+def build_wire_stream(read_ids, write_ids, write_mask, lag, n_batches,
+                      mode: ModeConfig = MODES["ycsb"]):
+    """Returns (blob uint8[...], txn_ends int64[n_txns+1])."""
+    n, n_reads = read_ids.shape
+    n_writes = write_ids.shape[1]
+    rec_full = _REC_HDR + (n_reads + n_writes) * _REC_RANGE
+    rec_nowrite = _REC_HDR + n_reads * _REC_RANGE
+    be = read_ids.astype(">u8").view(np.uint8).reshape(n, n_reads, 8)
+    wbe = write_ids.astype(">u8").view(np.uint8).reshape(n, n_writes, 8)
+    cvs = np.repeat(np.arange(1, n_batches + 1, dtype=np.int64), mode.batch)
     rv = np.maximum(cvs - 1 - lag, 0)
 
-    rec = np.zeros((n, _REC_FULL), np.uint8)
+    rec = np.zeros((n, rec_full), np.uint8)
     rec[:, 0:8] = rv.astype("<i8").view(np.uint8).reshape(n, 8)
     rec[:, 8:12] = np.frombuffer(
-        np.int32(N_READS).astype("<i4").tobytes(), np.uint8
+        np.int32(n_reads).astype("<i4").tobytes(), np.uint8
     )
-    rec[:, 12:16] = write_mask.astype("<i4").view(np.uint8).reshape(n, 4)
+    rec[:, 12:16] = (write_mask * n_writes).astype("<i4").view(np.uint8).reshape(n, 4)
     lens = np.frombuffer(
         np.array([8, 9], "<i4").tobytes(), np.uint8
     )  # (bl=8, el=9)
-    for r in range(N_READS):
-        off = _REC_HDR + r * _REC_READ
-        rec[:, off : off + 8] = lens
-        rec[:, off + 8 : off + 16] = be[:, r]
-        rec[:, off + 16 : off + 24] = be[:, r]
-        rec[:, off + 24] = 0  # end = key + b"\x00"
-    off = _REC_HDR + N_READS * _REC_READ
-    rec[:, off : off + 8] = lens
-    rec[:, off + 8 : off + 16] = wbe
-    rec[:, off + 16 : off + 24] = wbe
-    rec[:, off + 24] = 0
 
-    rec_len = np.where(write_mask, _REC_FULL, _REC_NOWRITE)
-    col = np.arange(_REC_FULL)
+    def put_range(slot: int, keys_be: np.ndarray) -> None:
+        off = _REC_HDR + slot * _REC_RANGE
+        rec[:, off : off + 8] = lens
+        rec[:, off + 8 : off + 16] = keys_be
+        rec[:, off + 16 : off + 24] = keys_be
+        rec[:, off + 24] = 0  # end = key + b"\x00"
+
+    for r in range(n_reads):
+        put_range(r, be[:, r])
+    for q in range(n_writes):
+        put_range(n_reads + q, wbe[:, q])
+
+    rec_len = np.where(write_mask, rec_full, rec_nowrite)
+    col = np.arange(rec_full)
     blob = rec[col[None, :] < rec_len[:, None]]  # ragged flatten, C speed
 
     ends = np.zeros(n + 1, np.int64)
@@ -170,28 +196,40 @@ def build_wire_stream(read_ids, write_ids, write_mask, lag, n_batches):
 
 
 def run_tpu_wire(
-    n_batches, capacity, blob, txn_ends, repeats: int = 3
+    n_batches, capacity, blob, txn_ends, repeats: int = 3,
+    mode: ModeConfig = MODES["ycsb"], n_resolvers: int = 1,
 ) -> tuple[float, int, bool]:
     """Drive the production path: TPUConflictSet.resolve_wire_async per
-    batch, collect after the clock stops. Returns (sec, conflicts, overflow)."""
+    batch, collect after the clock stops. Returns (sec, conflicts, overflow).
+
+    n_resolvers > 1 runs the mesh-sharded engine (§5's 4-resolver config:
+    keyspace sharded over devices, per-shard verdicts psum'd on-device)."""
     import jax
 
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
     def make_cs():
-        return TPUConflictSet(
+        kw = dict(
             capacity=capacity,
-            batch_size=BATCH,
-            max_read_ranges=N_READS,
-            max_write_ranges=1,
+            batch_size=mode.batch,
+            max_read_ranges=mode.n_reads,
+            max_write_ranges=mode.n_writes,
             max_key_bytes=KEY_BYTES,
             window_versions=WINDOW,
         )
+        if n_resolvers > 1:
+            from foundationdb_tpu.parallel.sharded_resolver import (
+                ShardedConflictSet,
+            )
+
+            return ShardedConflictSet(n_shards=n_resolvers, **kw)
+        return TPUConflictSet(**kw)
 
     # Warm-up compile.
     cs = make_cs()
-    off0, off1 = int(txn_ends[0]), int(txn_ends[BATCH])
-    cs.resolve_wire_async(blob[off0:off1], 1, count=BATCH, as_array=True)()
+    B = mode.batch
+    off0, off1 = int(txn_ends[0]), int(txn_ends[B])
+    cs.resolve_wire_async(blob[off0:off1], 1, count=B, as_array=True)()
 
     best_dt, conflicts, overflowed = float("inf"), 0, False
     for rep in range(repeats):
@@ -199,10 +237,10 @@ def run_tpu_wire(
         collectors = []
         t0 = time.perf_counter()
         for b in range(n_batches):
-            lo, hi = int(txn_ends[b * BATCH]), int(txn_ends[(b + 1) * BATCH])
+            lo, hi = int(txn_ends[b * B]), int(txn_ends[(b + 1) * B])
             collectors.append(
                 cs.resolve_wire_async(
-                    blob[lo:hi], b + 1, count=BATCH, as_array=True
+                    blob[lo:hi], b + 1, count=B, as_array=True
                 )
             )
         jax.block_until_ready(cs.state)
@@ -222,21 +260,24 @@ def run_tpu_wire(
 # ---------------------------------------------------------------------------
 
 
-def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8) -> None:
+def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
+                   mode: ModeConfig = MODES["ycsb"]) -> None:
     import jax
 
     from foundationdb_tpu.models import conflict_kernel as ck
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
+    B = mode.batch
     cs = TPUConflictSet(
-        capacity=capacity, batch_size=BATCH, max_read_ranges=N_READS,
-        max_write_ranges=1, max_key_bytes=KEY_BYTES, window_versions=WINDOW,
+        capacity=capacity, batch_size=B, max_read_ranges=mode.n_reads,
+        max_write_ranges=mode.n_writes, max_key_bytes=KEY_BYTES,
+        window_versions=WINDOW,
     )
     for b in range(warm_batches):  # populate real history
-        lo, hi = int(txn_ends[b * BATCH]), int(txn_ends[(b + 1) * BATCH])
-        cs.resolve_wire_async(blob[lo:hi], b + 1, count=BATCH, as_array=True)()
-    lo, hi = int(txn_ends[warm_batches * BATCH]), int(txn_ends[(warm_batches + 1) * BATCH])
-    batch, _ = cs._pack_wire(np.asarray(blob[lo:hi]), 0, BATCH)
+        lo, hi = int(txn_ends[b * B]), int(txn_ends[(b + 1) * B])
+        cs.resolve_wire_async(blob[lo:hi], b + 1, count=B, as_array=True)()
+    lo, hi = int(txn_ends[warm_batches * B]), int(txn_ends[(warm_batches + 1) * B])
+    batch, _ = cs._pack_wire(np.asarray(blob[lo:hi]), 0, B)
     state = cs.state
     cv = np.int32(warm_batches + 1)
     oldest = np.int32(max(0, warm_batches + 1 - WINDOW))
@@ -265,20 +306,22 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8) -> None:
 # ---------------------------------------------------------------------------
 
 
-def marshal_cpu_batches(n_batches, read_ids, write_ids, write_mask, lag):
+def marshal_cpu_batches(n_batches, read_ids, write_ids, write_mask, lag,
+                        mode: ModeConfig = MODES["ycsb"]):
     """Pre-marshal every batch to the C ABI (outside the timed loop).
 
     Blob layout: one 9-byte record per range (8-byte BE key + 0x00); the
     begin endpoint is bytes [9i, 9i+8), the end endpoint [9i, 9i+9).
-    Ranges are emitted in per-txn order: reads then the optional write.
+    Ranges are emitted in per-txn order: reads then the optional writes.
     """
+    B, R, Q = mode.batch, mode.n_reads, mode.n_writes
     out = []
     for b in range(n_batches):
-        s = slice(b * BATCH, (b + 1) * BATCH)
+        s = slice(b * B, (b + 1) * B)
         r_ids, w_ids, wm = read_ids[s], write_ids[s], write_mask[s]
-        slots = np.concatenate([r_ids, w_ids[:, None]], axis=1)
-        live = np.ones((BATCH, N_READS + 1), bool)
-        live[:, -1] = wm
+        slots = np.concatenate([r_ids, w_ids], axis=1)
+        live = np.ones((B, R + Q), bool)
+        live[:, R:] = wm[:, None]
         ids = slots[live]
         m = ids.size
         recs = np.zeros((m, 9), np.uint8)
@@ -288,8 +331,8 @@ def marshal_cpu_batches(n_batches, read_ids, write_ids, write_mask, lag):
         ranges = np.stack(
             [off, np.full(m, 8, np.int64), off, np.full(m, 9, np.int64)], axis=1
         )
-        rc = np.full(BATCH, N_READS, np.int32)
-        wc = wm.astype(np.int32)
+        rc = np.full(B, R, np.int32)
+        wc = (wm * Q).astype(np.int32)
         cv = b + 1
         rv = np.maximum(cv - 1 - lag[s], 0).astype(np.int64)
         out.append((blob, np.ascontiguousarray(ranges), rc, wc, rv,
@@ -297,7 +340,7 @@ def marshal_cpu_batches(n_batches, read_ids, write_ids, write_mask, lag):
     return out
 
 
-def run_cpu(batches) -> tuple[float, int]:
+def run_cpu(batches, mode: ModeConfig = MODES["ycsb"]) -> tuple[float, int]:
     from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
 
     cs = CPUSkipListConflictSet()
@@ -305,7 +348,7 @@ def run_cpu(batches) -> tuple[float, int]:
     i64p = ctypes.POINTER(ctypes.c_int64)
     i32p = ctypes.POINTER(ctypes.c_int32)
     i8p = ctypes.POINTER(ctypes.c_int8)
-    verdicts = np.zeros(BATCH, np.int8)
+    verdicts = np.zeros(mode.batch, np.int8)
     conflicts = 0
     t0 = time.perf_counter()
     for blob, ranges, rc, wc, rv, cv, oldest in batches:
@@ -315,7 +358,7 @@ def run_cpu(batches) -> tuple[float, int]:
             rc.ctypes.data_as(i32p),
             wc.ctypes.data_as(i32p),
             rv.ctypes.data_as(i64p),
-            np.int32(BATCH), np.int64(cv), np.int64(oldest),
+            np.int32(mode.batch), np.int64(cv), np.int64(oldest),
             verdicts.ctypes.data_as(i8p),
         )
         conflicts += int((verdicts == 1).sum())
@@ -333,8 +376,11 @@ def main() -> None:
     ap.add_argument("--capacity", type=int, default=1 << 18)
     ap.add_argument("--seed", type=int, default=20260729)
     ap.add_argument("--profile", action="store_true")
-    ap.add_argument("--write-frac", type=float, default=0.5)
+    ap.add_argument("--mode", choices=sorted(MODES), default="ycsb")
+    ap.add_argument("--resolvers", type=int, default=1,
+                    help="mesh-sharded resolver count (§5 4-resolver config)")
     args = ap.parse_args()
+    mode = MODES[args.mode]
 
     result = {
         "metric": "resolved_txns_per_sec_per_chip",
@@ -342,24 +388,27 @@ def main() -> None:
         "unit": "txns/s",
         "vs_baseline": 0.0,
         "valid": False,
+        "mode": args.mode,
+        "resolvers": args.resolvers,
     }
 
     try:
-        n_batches = max(1, args.txns // BATCH)
-        n_txns = n_batches * BATCH
-        log(f"[gen] {n_txns} txns, {n_batches} batches of {BATCH}, "
-            f"{args.keys} keys, Zipf 0.99")
+        n_batches = max(1, args.txns // mode.batch)
+        n_txns = n_batches * mode.batch
+        log(f"[gen] {args.mode}: {n_txns} txns, {n_batches} batches of "
+            f"{mode.batch}, {args.keys} keys, R={mode.n_reads} "
+            f"Q={mode.n_writes} wf={mode.write_frac} theta={mode.theta}")
         read_ids, write_ids, write_mask, lag = gen_workload(
-            n_txns, args.keys, args.seed, args.write_frac
+            n_txns, args.keys, args.seed, mode
         )
 
         # CPU baseline FIRST: even if the TPU backend is unreachable the
         # round still records the reference number.
         log("[cpu] marshalling...")
         cpu_batches = marshal_cpu_batches(
-            n_batches, read_ids, write_ids, write_mask, lag
+            n_batches, read_ids, write_ids, write_mask, lag, mode
         )
-        cpu_dt, cpu_conf = run_cpu(cpu_batches)
+        cpu_dt, cpu_conf = run_cpu(cpu_batches, mode)
         cpu_rate = n_txns / cpu_dt
         log(f"[cpu] {cpu_dt:.2f}s → {cpu_rate:,.0f} txns/s "
             f"({cpu_conf} conflicts, {cpu_conf / n_txns:.1%})")
@@ -378,17 +427,18 @@ def main() -> None:
 
         log("[tpu] building wire stream...")
         blob, txn_ends = build_wire_stream(
-            read_ids, write_ids, write_mask, lag, n_batches
+            read_ids, write_ids, write_mask, lag, n_batches, mode
         )
         tpu_dt, tpu_conf, overflowed = run_tpu_wire(
-            n_batches, args.capacity, blob, txn_ends
+            n_batches, args.capacity, blob, txn_ends,
+            mode=mode, n_resolvers=args.resolvers,
         )
         tpu_rate = n_txns / tpu_dt
         log(f"[tpu] {tpu_dt:.2f}s → {tpu_rate:,.0f} txns/s "
             f"({tpu_conf} conflicts, {tpu_conf / n_txns:.1%})")
 
         if args.profile:
-            profile_phases(args.capacity, blob, txn_ends)
+            profile_phases(args.capacity, blob, txn_ends, mode=mode)
 
         if tpu_conf != cpu_conf:
             log(f"[warn] verdict divergence: tpu={tpu_conf} cpu={cpu_conf} "

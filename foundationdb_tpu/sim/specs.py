@@ -1,0 +1,166 @@
+"""TOML test specs driving simulation workloads.
+
+Reference: tests/fast/*.toml — each file holds one or more ``[[test]]``
+blocks; a test has a title and one or more ``[[test.workload]]`` entries
+run CONCURRENTLY against the same cluster, with optional fault-injection
+knobs. The reference's fdbserver -r simulation consumes these; here
+``run_spec`` does, against a SimCluster.
+
+Example (the shape the reference uses, reference: tests/fast/Cycle.toml):
+
+    [[test]]
+    testTitle = 'CycleWithFaults'
+    killInterval = 0.4
+    maxKills = 2
+
+    [[test.workload]]
+    testName = 'Cycle'
+    nodeCount = 10
+    transactionCount = 40
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.runtime.flow import all_of
+from foundationdb_tpu.sim.workloads import (
+    AtomicOpsWorkload,
+    ConflictRangeWorkload,
+    CycleWorkload,
+    FaultInjector,
+    MakoWorkload,
+    RandomReadWriteWorkload,
+    TPCCNewOrderWorkload,
+    WorkloadMetrics,
+)
+
+# testName -> (workload class, TOML key -> constructor kwarg). Unknown TOML
+# keys are ignored, like the reference tolerates unconsumed knobs.
+WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
+    "Cycle": (CycleWorkload, {
+        "nodeCount": "n_nodes",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "AtomicOps": (AtomicOpsWorkload, {
+        "transactionCount": "n_txns",
+    }),
+    "RandomReadWrite": (RandomReadWriteWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "writeFraction": "write_fraction",
+    }),
+    "Mako": (MakoWorkload, {
+        "rows": "rows",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "readsPerTransaction": "reads_per_txn",
+        "writesPerTransaction": "writes_per_txn",
+    }),
+    "TpccNewOrder": (TPCCNewOrderWorkload, {
+        "warehouses": "warehouses",
+        "districts": "districts",
+        "items": "items",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "ConflictRange": (ConflictRangeWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+}
+
+
+@dataclass
+class TestSpec:
+    title: str
+    workloads: list  # instantiated Workload objects
+    kill_interval: float | None = None
+    partition_interval: float | None = None
+    max_kills: int = 0
+    include_controller: bool = False
+
+
+@dataclass
+class SpecResult:
+    title: str
+    metrics: dict[str, WorkloadMetrics] = field(default_factory=dict)
+    kills: list[str] = field(default_factory=list)
+
+
+def load_spec(source: str | bytes) -> list[TestSpec]:
+    """Parse TOML text (or a path ending in .toml) into TestSpecs."""
+    if isinstance(source, str) and source.endswith(".toml"):
+        with open(source, "rb") as f:
+            doc = tomllib.load(f)
+    else:
+        text = source.decode() if isinstance(source, bytes) else source
+        doc = tomllib.loads(text)
+    specs: list[TestSpec] = []
+    for test in doc.get("test", []):
+        workloads = []
+        for i, w in enumerate(test.get("workload", [])):
+            name = w["testName"]
+            if name not in WORKLOAD_REGISTRY:
+                raise ValueError(f"unknown workload testName {name!r}")
+            cls, mapping = WORKLOAD_REGISTRY[name]
+            kwargs = {
+                mapping[k]: v for k, v in w.items() if k in mapping
+            }
+            kwargs["seed"] = w.get("seed", test.get("seed", i))
+            workloads.append(cls(**kwargs))
+        specs.append(TestSpec(
+            title=test.get("testTitle", "untitled"),
+            workloads=workloads,
+            kill_interval=test.get("killInterval"),
+            partition_interval=test.get("partitionInterval"),
+            max_kills=test.get("maxKills", 0),
+            include_controller=test.get("killController", False),
+        ))
+    return specs
+
+
+async def run_spec_test(spec: TestSpec, cluster, db) -> SpecResult:
+    """setup all → run all CONCURRENTLY (± faults) → quiesce → check all —
+    the reference's multi-workload test execution order."""
+    result = SpecResult(spec.title)
+    for w in spec.workloads:
+        await w.setup(db)
+    faults = None
+    if spec.max_kills > 0 or spec.partition_interval:
+        faults = FaultInjector(
+            cluster,
+            kill_interval=spec.kill_interval or 2.0,
+            partition_interval=spec.partition_interval or 1.3,
+            max_kills=spec.max_kills,
+            include_controller=spec.include_controller,
+        )
+        fault_task = cluster.loop.spawn(faults.run(), name="spec.faults")
+    await all_of([
+        cluster.loop.spawn(w.run(db, cluster), name=f"spec.{w.name}")
+        for w in spec.workloads
+    ])
+    if faults:
+        faults.stop()
+        await fault_task
+        cluster.net.heal_all()
+        while cluster.controller._recovering:
+            await cluster.loop.sleep(0.25)
+        result.kills = list(faults.kills)
+    for w in spec.workloads:
+        await w.check(db)
+        result.metrics[w.name] = w.metrics
+    return result
+
+
+def run_spec(source: str | bytes, cluster, db) -> list[SpecResult]:
+    """Run every [[test]] in the spec against the given cluster."""
+    out = []
+    for spec in load_spec(source):
+        out.append(
+            cluster.loop.run(run_spec_test(spec, cluster, db), timeout=3000)
+        )
+    return out

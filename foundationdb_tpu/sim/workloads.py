@@ -309,6 +309,222 @@ class RandomReadWriteWorkload(Workload):
         await self._run_txn(db, body)
 
 
+class MakoWorkload(Workload):
+    """mako-style fixed op mix (reference: bindings/c/test/mako): each
+    transaction runs `reads_per_txn` GETs and `writes_per_txn` UPDATEs on a
+    preloaded row set (the classic 90/10 mix is 9 reads + 1 write). The
+    check is read-your-committed from the database itself: every surviving
+    value must be one some client actually committed (values are tagged
+    with client id + sequence, so torn/partial writes are detectable)."""
+
+    name = "mako"
+
+    def __init__(self, seed: int = 0, rows: int = 64, n_txns: int = 60,
+                 n_clients: int = 4, reads_per_txn: int = 9,
+                 writes_per_txn: int = 1):
+        super().__init__(seed)
+        self.rows = rows
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.reads_per_txn = reads_per_txn
+        self.writes_per_txn = writes_per_txn
+        self._committed: dict[bytes, set[bytes]] = {}
+
+    def _key(self, i: int) -> bytes:
+        return b"mako%08d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for i in range(self.rows):
+                k = self._key(i)
+                tr.set(k, b"init")
+                self._committed.setdefault(k, set()).add(b"init")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for seq in range(counts[cid]):
+                picks_r = [rng.randrange(self.rows)
+                           for _ in range(self.reads_per_txn)]
+                picks_w = [rng.randrange(self.rows)
+                           for _ in range(self.writes_per_txn)]
+                vals = {self._key(i): b"c%d.%d.%d" % (cid, seq, i)
+                        for i in picks_w}
+
+                async def body(tr, picks_r=picks_r, vals=vals):
+                    for i in picks_r:
+                        await tr.get(self._key(i))
+                    for k, v in vals.items():
+                        tr.set(k, v)
+
+                await self._run_txn(db, body)
+                for k, v in vals.items():
+                    self._committed.setdefault(k, set()).add(v)
+                self.metrics.ops += self.reads_per_txn + self.writes_per_txn
+
+        await all_of([
+            cluster.loop.spawn(client(i), name=f"mako.client{i}")
+            for i in range(self.n_clients)
+        ])
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            rows = await tr.get_range(self._key(0), self._key(self.rows))
+            if len(rows) != self.rows:
+                raise WorkloadFailed(
+                    f"mako: {len(rows)} rows survive, expected {self.rows}"
+                )
+            for k, v in rows:
+                if v not in self._committed.get(k, ()):
+                    raise WorkloadFailed(
+                        f"mako: {k!r} holds {v!r}, never committed"
+                    )
+
+        await self._run_txn(db, body)
+
+
+class TPCCNewOrderWorkload(Workload):
+    """Simplified TPC-C new-order mix (reference: mako's tpcc-flavored
+    configs; the §5 baseline's 'TPC-C new-order, 1M txns/s sustained').
+
+    Schema (tuple-layer keys): per (warehouse, district) a next_order_id
+    counter; per item a stock level; orders + order lines inserted by each
+    new-order transaction. Invariants checked from the database alone:
+
+    - order ids are dense: next_order_id - 1 == #orders for the district
+      (a lost or double-committed order breaks it);
+    - stock conservation: initial_stock == stock + sum(order-line qty)
+      - 100 * restocks (restocks ride an atomic ADD counter).
+    """
+
+    name = "tpcc_new_order"
+
+    def __init__(self, seed: int = 0, warehouses: int = 2, districts: int = 2,
+                 items: int = 20, n_txns: int = 40, n_clients: int = 4,
+                 initial_stock: int = 100):
+        super().__init__(seed)
+        self.warehouses = warehouses
+        self.districts = districts
+        self.items = items
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.initial_stock = initial_stock
+
+    # -- keys (tuple layer) ---------------------------------------------------
+
+    @staticmethod
+    def _pack(*parts) -> bytes:
+        from foundationdb_tpu.layers.tuple_layer import pack
+
+        return pack(parts)
+
+    def k_district(self, w, d) -> bytes:
+        return self._pack("tpcc", "district", w, d)
+
+    def k_stock(self, i) -> bytes:
+        return self._pack("tpcc", "stock", i)
+
+    def k_order(self, w, d, oid) -> bytes:
+        return self._pack("tpcc", "order", w, d, oid)
+
+    def k_restocks(self) -> bytes:
+        return self._pack("tpcc", "restocks")
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for w in range(self.warehouses):
+                for d in range(self.districts):
+                    tr.set(self.k_district(w, d), struct.pack("<q", 1))
+            for i in range(self.items):
+                tr.set(self.k_stock(i), struct.pack("<q", self.initial_stock))
+            tr.set(self.k_restocks(), struct.pack("<q", 0))
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def new_order(cid: int):
+            for _ in range(counts[cid]):
+                w = rng.randrange(self.warehouses)
+                d = rng.randrange(self.districts)
+                n_lines = rng.randrange(3, 8)
+                lines = [(rng.randrange(self.items), rng.randrange(1, 5))
+                         for _ in range(n_lines)]
+
+                async def body(tr, w=w, d=d, lines=lines):
+                    (oid,) = struct.unpack("<q", await tr.get(self.k_district(w, d)))
+                    tr.set(self.k_district(w, d), struct.pack("<q", oid + 1))
+                    tr.set(
+                        self.k_order(w, d, oid),
+                        self._pack(*[x for ln in lines for x in ln]),
+                    )
+                    for item, qty in lines:
+                        (stock,) = struct.unpack(
+                            "<q", await tr.get(self.k_stock(item))
+                        )
+                        stock -= qty
+                        if stock < 10:  # TPC-C's restock rule
+                            stock += 100
+                            tr.atomic_op(
+                                MutationType.ADD, self.k_restocks(),
+                                struct.pack("<q", 1),
+                            )
+                        tr.set(self.k_stock(item), struct.pack("<q", stock))
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1 + len(lines)
+
+        await all_of([
+            cluster.loop.spawn(new_order(i), name=f"tpcc.client{i}")
+            for i in range(self.n_clients)
+        ])
+
+    async def check(self, db) -> None:
+        from foundationdb_tpu.layers.tuple_layer import unpack
+
+        async def body(tr):
+            total_lines_qty = 0
+            n_orders = 0
+            for w in range(self.warehouses):
+                for d in range(self.districts):
+                    (next_oid,) = struct.unpack(
+                        "<q", await tr.get(self.k_district(w, d))
+                    )
+                    lo = self.k_order(w, d, 0)
+                    hi = self.k_order(w, d, 1 << 60)
+                    orders = await tr.get_range(lo, hi)
+                    if len(orders) != next_oid - 1:
+                        raise WorkloadFailed(
+                            f"tpcc: district ({w},{d}) has {len(orders)} "
+                            f"orders but next_oid={next_oid}"
+                        )
+                    n_orders += len(orders)
+                    for _k, v in orders:
+                        flat = unpack(v)
+                        total_lines_qty += sum(flat[1::2])
+            total_stock = 0
+            for i in range(self.items):
+                (s,) = struct.unpack("<q", await tr.get(self.k_stock(i)))
+                total_stock += s
+            (restocks,) = struct.unpack("<q", await tr.get(self.k_restocks()))
+            expect = self.items * self.initial_stock
+            got = total_stock + total_lines_qty - 100 * restocks
+            if got != expect:
+                raise WorkloadFailed(
+                    f"tpcc: stock not conserved: {got} != {expect} "
+                    f"(stock={total_stock} lines={total_lines_qty} "
+                    f"restocks={restocks}, orders={n_orders})"
+                )
+
+        await self._run_txn(db, body)
+
+
 class ConflictRangeWorkload(Workload):
     """Randomized range reads + writes through the real commit path; the
     observable check is bank-style conservation: txns move value between

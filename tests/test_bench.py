@@ -21,16 +21,17 @@ def _stream_txns(n_batches):
     return bench.gen_workload(n, 512, seed=7)
 
 
-def _object_txns(read_ids, write_ids, write_mask, lag, b):
+def _object_txns(read_ids, write_ids, write_mask, lag, b, batch=None):
     """The object-path equivalent of wire batch b (for oracle/encode)."""
+    batch = batch or bench.BATCH
     cv = b + 1
     txns = []
-    for i in range(b * bench.BATCH, (b + 1) * bench.BATCH):
+    for i in range(b * batch, (b + 1) * batch):
         rv = max(0, cv - 1 - int(lag[i]))
         reads = [KeyRange(key_bytes(k), key_bytes(k) + b"\x00")
                  for k in read_ids[i]]
-        writes = ([KeyRange(key_bytes(write_ids[i]),
-                            key_bytes(write_ids[i]) + b"\x00")]
+        writes = ([KeyRange(key_bytes(k), key_bytes(k) + b"\x00")
+                   for k in write_ids[i]]
                   if write_mask[i] else [])
         txns.append(TxnConflictInfo(rv, reads, writes))
     return txns
@@ -77,3 +78,56 @@ def test_bench_stream_three_way_parity():
         oracle_conf += sum(1 for v in got if v.name == "CONFLICT")
 
     assert tpu_conf == cpu_conf == oracle_conf
+
+
+def test_mode_streams_three_way_parity():
+    """Every bench mode's wire stream must match encode_resolve_batch and
+    produce kernel/C++/oracle-identical verdicts (mako + tpcc shapes)."""
+    for mode_name in ("mako", "tpcc"):
+        mode = bench.MODES[mode_name]
+        n_batches = 1
+        n = n_batches * mode.batch
+        read_ids, write_ids, write_mask, lag = bench.gen_workload(
+            n, 256, seed=13, mode=mode
+        )
+        blob, ends = bench.build_wire_stream(
+            read_ids, write_ids, write_mask, lag, n_batches, mode
+        )
+        txns = _object_txns(read_ids, write_ids, write_mask, lag, 0,
+                            batch=mode.batch)
+        assert blob[: int(ends[mode.batch])].tobytes() == \
+            encode_resolve_batch(txns), mode_name
+
+        _, tpu_conf, overflow = bench.run_tpu_wire(
+            n_batches, 1 << 14, blob, ends, repeats=1, mode=mode
+        )
+        assert not overflow
+        cpu_batches = bench.marshal_cpu_batches(
+            n_batches, read_ids, write_ids, write_mask, lag, mode
+        )
+        _, cpu_conf = bench.run_cpu(cpu_batches, mode)
+        oracle = OracleConflictSet()
+        got = oracle.resolve(txns, 1, 0)
+        oracle_conf = sum(1 for v in got if v.name == "CONFLICT")
+        assert tpu_conf == cpu_conf == oracle_conf, mode_name
+
+
+def test_sharded_resolver_mode_parity():
+    """--resolvers N (mesh-sharded) must produce the same verdicts as the
+    single-shard engine on the same stream."""
+    mode = bench.MODES["ycsb"]
+    n_batches = 2
+    n = n_batches * mode.batch
+    read_ids, write_ids, write_mask, lag = bench.gen_workload(
+        n, 512, seed=17, mode=mode
+    )
+    blob, ends = bench.build_wire_stream(
+        read_ids, write_ids, write_mask, lag, n_batches, mode
+    )
+    _, conf1, _ = bench.run_tpu_wire(
+        n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=1
+    )
+    _, conf4, _ = bench.run_tpu_wire(
+        n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=4
+    )
+    assert conf1 == conf4
