@@ -78,6 +78,24 @@ class UsedDuringCommit(FdbError):
     code = 2017
 
 
+class TooManyWatches(FdbError):
+    """Too many watches are armed on this database (error 1032)."""
+
+    code = 1032
+
+
+class ChangeFeedCancelled(FdbError):
+    """Change feed was destroyed while being read (error 2036)."""
+
+    code = 2036
+
+
+class ChangeFeedPopped(FdbError):
+    """Read begin version is below the feed's popped floor (error 2037)."""
+
+    code = 2037
+
+
 class ProcessKilled(FdbError):
     """Simulation-only: the role's process was killed mid-operation."""
 

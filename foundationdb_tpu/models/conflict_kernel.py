@@ -444,9 +444,41 @@ def rebase(state: ConflictState, delta: jax.Array) -> ConflictState:
     )
 
 
+def resolve_many(
+    state: ConflictState,
+    batches: BatchTensors,  # leading scan axis [k, ...] on every leaf
+    commit_versions: jax.Array,  # int32 [k], strictly increasing
+    new_oldests: jax.Array,  # int32 [k], non-decreasing
+) -> tuple[jax.Array, ConflictState]:
+    """Resolve k batches in ONE compiled program (device-side lax.scan).
+
+    Semantically identical to k sequential resolve_batch calls; exists
+    because per-dispatch host→device latency (66 ms through a tunneled
+    PJRT backend) would otherwise dominate the ~4 ms of real per-batch
+    compute. The reference amortizes the same way at a different layer:
+    CommitProxy batches many client commits per ResolveTransactionBatch
+    RPC (CommitProxyServer.actor.cpp).
+    """
+
+    def body(st, xs):
+        batch, cv, old = xs
+        verdicts, st = resolve_batch(st, batch, cv, old)
+        return st, verdicts
+
+    state, verdicts = jax.lax.scan(
+        body, state, (batches, commit_versions, new_oldests)
+    )
+    return verdicts, state
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _resolve_jit(state, batch, commit_version, new_oldest):
     return resolve_batch(state, batch, commit_version, new_oldest)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_many_jit(state, batches, commit_versions, new_oldests):
+    return resolve_many(state, batches, commit_versions, new_oldests)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -56,6 +56,7 @@ class TPUConflictSet:
         engine) override this; all host-side logic is shared."""
         self.state = ck.init_state(self.capacity, self.codec.width, self.codec.min_key)
         self._resolve_fn = ck._resolve_jit
+        self._resolve_many_fn = ck._resolve_many_jit
         self._rebase_fn = ck._rebase_jit
 
     # -- public API ---------------------------------------------------------
@@ -138,6 +139,81 @@ class TPUConflictSet:
             )
         return lambda: self._collect(pending)
 
+    def resolve_wire_window(
+        self,
+        wire: bytes | np.ndarray,
+        commit_versions,
+        count: int,
+    ) -> np.ndarray:
+        return self.resolve_wire_window_async(wire, commit_versions, count)()
+
+    def resolve_wire_window_async(
+        self,
+        wire: bytes | np.ndarray,
+        commit_versions,
+        count: int,
+    ) -> Callable[[], np.ndarray]:
+        """Resolve a WINDOW of k consecutive batches in one device dispatch.
+
+        ``wire`` holds k·count txns; txns [i·count, (i+1)·count) resolve at
+        ``commit_versions[i]`` (strictly increasing). One lax.scan program
+        (conflict_kernel.resolve_many) replaces k dispatches — the host-side
+        analogue of the reference proxy batching many commits per resolver
+        RPC, here amortizing per-dispatch latency instead of network round
+        trips. Returns a collector yielding verdicts int8 [k, count].
+
+        Callers should keep k fixed across calls (each distinct k compiles
+        its own program).
+        """
+        buf = (
+            np.frombuffer(wire, dtype=np.uint8)
+            if isinstance(wire, (bytes, bytearray))
+            else wire
+        )
+        k = len(commit_versions)
+        if count > self.batch_size:
+            raise ValueError("window path resolves one kernel batch per version")
+        lib = _keypack_lib()
+        counted = int(lib.kp_count_txns(_u8(buf), buf.size, 0))
+        if counted < k * count:
+            raise ValueError("malformed resolver wire batch")
+
+        oldest_abs = np.empty(k, np.int64)
+        for i, cv in enumerate(commit_versions):
+            self._begin_resolve(int(cv), None)
+            oldest_abs[i] = self.oldest_version
+        # base_version is final after all _begin_resolve rebases — convert
+        # now. A rebase mid-window can lift base above floors snapshotted
+        # earlier; clamp those to 0 (everything below base is already
+        # expired on device, so a zero floor is exact — the kernel takes
+        # max(state.oldest, new_oldest) and never regresses).
+        cvs_rel = np.asarray(
+            [self._rel(int(cv)) for cv in commit_versions], np.int32
+        )
+        olds_rel = np.asarray(
+            [max(0, int(v) - self.base_version) for v in oldest_abs], np.int32
+        )
+
+        batches = self._empty_batch(k)
+        offset = 0
+        for i in range(k):
+            offset = lib.kp_pack_batch(
+                _u8(buf), buf.size, offset, count,
+                self.batch_size, self.max_read_ranges, self.max_write_ranges,
+                self.codec.n_words, self.base_version,
+                _i32(batches.read_begin[i]), _i32(batches.read_end[i]),
+                _u8(batches.read_mask[i]),
+                _i32(batches.write_begin[i]), _i32(batches.write_end[i]),
+                _u8(batches.write_mask[i]),
+                _i32(batches.read_version[i]), _u8(batches.txn_mask[i]),
+            )
+            if offset < 0:
+                raise ValueError("malformed resolver wire batch")
+        verdicts, self.state = self._resolve_many_fn(
+            self.state, batches, cvs_rel, olds_rel
+        )
+        return lambda: np.asarray(verdicts)[:, :count]
+
     @staticmethod
     def _collect(pending: list[tuple]) -> list[Verdict]:
         out: list[Verdict] = []
@@ -194,21 +270,23 @@ class TPUConflictSet:
         self.state = self._rebase_fn(self.state, np.int32(min(delta, 2**31 - 1)))
         self.base_version += delta
 
-    def _empty_batch(self) -> ck.BatchTensors:
+    def _empty_batch(self, k: int | None = None) -> ck.BatchTensors:
         """Padded all-masked-out batch tensors (shared by both packers so
-        the wire and object paths can never diverge on layout)."""
+        the wire and object paths can never diverge on layout). k adds a
+        leading window axis for the scan path."""
+        lead = () if k is None else (k,)
         b = self.batch_size
         r, q = self.max_read_ranges, self.max_write_ranges
         w = self.codec.width
         return ck.BatchTensors(
-            read_begin=np.full((b, r, w), INT32_MAX, np.int32),
-            read_end=np.full((b, r, w), INT32_MAX, np.int32),
-            read_mask=np.zeros((b, r), bool),
-            write_begin=np.full((b, q, w), INT32_MAX, np.int32),
-            write_end=np.full((b, q, w), INT32_MAX, np.int32),
-            write_mask=np.zeros((b, q), bool),
-            read_version=np.zeros((b,), np.int32),
-            txn_mask=np.zeros((b,), bool),
+            read_begin=np.full((*lead, b, r, w), INT32_MAX, np.int32),
+            read_end=np.full((*lead, b, r, w), INT32_MAX, np.int32),
+            read_mask=np.zeros((*lead, b, r), bool),
+            write_begin=np.full((*lead, b, q, w), INT32_MAX, np.int32),
+            write_end=np.full((*lead, b, q, w), INT32_MAX, np.int32),
+            write_mask=np.zeros((*lead, b, q), bool),
+            read_version=np.zeros((*lead, b), np.int32),
+            txn_mask=np.zeros((*lead, b), bool),
         )
 
     def _pack_wire(

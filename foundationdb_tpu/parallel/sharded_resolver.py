@@ -156,6 +156,17 @@ class ShardedConflictSet(TPUConflictSet):
         self._resolve_fn = lambda s, bt, cv, old: jitted(
             s, bt, cv, old, lo_dev, hi_dev
         )
+
+        def many(s, bts, cvs, olds):
+            def scan_body(st, xs):
+                bt, cv, old = xs
+                verdicts, st = body(st, bt, cv, old, lo_dev, hi_dev)
+                return st, verdicts
+
+            st, verdicts = jax.lax.scan(scan_body, s, (bts, cvs, olds))
+            return verdicts, st
+
+        self._resolve_many_fn = jax.jit(many, donate_argnums=(0,))
         self._rebase_fn = jax.jit(
             jax.shard_map(
                 lambda s, d: jax.tree.map(

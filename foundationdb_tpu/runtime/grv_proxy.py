@@ -5,12 +5,18 @@ requests queue up, a batch loop drains them every interval (one sequencer
 round-trip serves the whole batch), and the reply is the cluster's live
 committed version. Admission: a token bucket refilled from the
 ratekeeper's tps budget; when empty, waiters simply stay queued, which is
-exactly how the reference applies back-pressure.
+exactly how the reference applies back-pressure. Two lanes mirror the
+reference's TransactionPriority::DEFAULT / BATCH split: batch requests
+draw from their own (stricter) bucket and are only drained after every
+admitted default-priority request.
 """
 
 from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import Loop, Promise
+
+PRIORITY_DEFAULT = "default"
+PRIORITY_BATCH = "batch"
 
 
 class GrvProxy:
@@ -23,35 +29,56 @@ class GrvProxy:
         self.sequencer = sequencer_ep
         self.ratekeeper = ratekeeper_ep
         self._queue: list[Promise] = []
+        self._batch_queue: list[Promise] = []
         self._tokens = self.MAX_TOKENS
-        self._rate = float("inf") if ratekeeper_ep is None else 0.0
+        self._batch_tokens = self.MAX_TOKENS
+        unlimited = float("inf") if ratekeeper_ep is None else 0.0
+        self._rate = unlimited
+        self._batch_rate = unlimited
         self.grvs_served = 0
 
-    async def get_read_version(self) -> int:
+    async def get_read_version(self, priority: str = PRIORITY_DEFAULT) -> int:
         p = Promise()
-        self._queue.append(p)
+        (self._batch_queue if priority == PRIORITY_BATCH else self._queue).append(p)
         return await p.future
 
     async def get_metrics(self) -> dict:
         """Status inputs (reference: GrvProxy metrics in status json)."""
-        return {"grvs_served": self.grvs_served, "queued": len(self._queue)}
+        return {
+            "grvs_served": self.grvs_served,
+            "queued": len(self._queue),
+            "batch_queued": len(self._batch_queue),
+        }
+
+    def _admit(self, queue: list[Promise], tokens: float) -> tuple[list, float]:
+        n = len(queue) if tokens == float("inf") else int(min(len(queue), tokens))
+        if n and tokens != float("inf"):
+            tokens -= n
+        return queue[:n], tokens
 
     async def run(self) -> None:
         self.loop.spawn(self._rate_poller(), name="grv.rate_poller")
         while True:
             await self.loop.sleep(self.BATCH_INTERVAL)
-            self._tokens = min(
-                self.MAX_TOKENS, self._tokens + self._rate * self.BATCH_INTERVAL
-            )
-            if not self._queue:
+            if self._tokens != float("inf"):
+                self._tokens = min(
+                    self.MAX_TOKENS, self._tokens + self._rate * self.BATCH_INTERVAL
+                )
+                self._batch_tokens = min(
+                    self.MAX_TOKENS,
+                    self._batch_tokens + self._batch_rate * self.BATCH_INTERVAL,
+                )
+            if not self._queue and not self._batch_queue:
                 continue
-            admit = len(self._queue) if self._tokens == float("inf") else int(
-                min(len(self._queue), self._tokens)
+            admitted, self._tokens = self._admit(self._queue, self._tokens)
+            self._queue = self._queue[len(admitted):]
+            b_admitted, self._batch_tokens = self._admit(
+                self._batch_queue, self._batch_tokens
             )
-            if admit == 0:
+            self._batch_queue = self._batch_queue[len(b_admitted):]
+            batch = admitted + b_admitted
+            if not batch:
                 continue
-            batch, self._queue = self._queue[:admit], self._queue[admit:]
-            self._tokens -= admit
             try:
                 version = await self.sequencer.get_live_committed_version()
             except Exception as e:
@@ -67,7 +94,9 @@ class GrvProxy:
             return
         while True:
             try:
-                self._rate = await self.ratekeeper.get_rate()
+                rates = await self.ratekeeper.get_rates()
+                self._rate = rates["tps_limit"]
+                self._batch_rate = rates["batch_tps_limit"]
             except Exception:
                 pass  # keep last known rate while ratekeeper is unreachable
             await self.loop.sleep(self.RATE_POLL_INTERVAL)

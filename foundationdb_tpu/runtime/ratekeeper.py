@@ -1,10 +1,15 @@
-"""Ratekeeper: admission control from storage lag.
+"""Ratekeeper: admission control from storage + tlog queuing metrics.
 
-Reference: fdbserver/Ratekeeper.actor.cpp — polls storage queuing metrics,
-computes a cluster-wide transactions-per-second budget that shrinks as
-storage falls behind the tlogs, and leases per-interval transaction budgets
-to the GRV proxies, which block getReadVersion batches once the lease is
-exhausted (that back-pressure is what keeps the MVCC window bounded).
+Reference: fdbserver/Ratekeeper.actor.cpp — polls StorageQueuingMetrics and
+TLogQueuingMetrics, tracks the worst storage version lag, storage durability
+lag, storage queue bytes and tlog queue bytes, computes a cluster-wide
+transactions-per-second budget from the WORST signal, and leases per-interval
+budgets to the GRV proxies, which block getReadVersion batches once the lease
+is exhausted (that back-pressure is what keeps the MVCC window bounded).
+
+Two priority lanes, like the reference's default/batch split: batch-priority
+traffic is throttled at half the thresholds, so background work yields
+long before interactive traffic feels anything.
 """
 
 from __future__ import annotations
@@ -16,22 +21,52 @@ from foundationdb_tpu.runtime.sequencer import VERSIONS_PER_SECOND
 class Ratekeeper:
     POLL_INTERVAL = 0.1
     BASE_TPS = 200_000.0
-    # Storage lag (versions) where throttling starts / where admission stops.
-    LAG_SOFT = 1 * VERSIONS_PER_SECOND
+    # Per-signal (soft, hard) limits: scale falls linearly from 1 at soft
+    # to 0 at hard; the governing signal is whichever is worst (reference:
+    # Ratekeeper takes the min over its limit reasons).
+    LAG_SOFT = 1 * VERSIONS_PER_SECOND  # storage behind tlogs (versions)
     LAG_HARD = 4 * VERSIONS_PER_SECOND
+    DLAG_SOFT = 2 * VERSIONS_PER_SECOND  # applied but not fsynced (versions)
+    DLAG_HARD = 8 * VERSIONS_PER_SECOND
+    SQ_SOFT = 16 << 20  # storage queue bytes (reference: TARGET_BYTES_PER_SS)
+    SQ_HARD = 64 << 20
+    TQ_SOFT = 64 << 20  # tlog queue bytes (reference: TARGET_BYTES_PER_TLOG)
+    TQ_HARD = 256 << 20
+    # Batch lane throttles at this fraction of every threshold.
+    BATCH_FRACTION = 0.5
 
-    def __init__(self, loop: Loop, storage_eps: list):
+    def __init__(self, loop: Loop, storage_eps: list, tlog_eps: list | None = None):
         self.loop = loop
         self.storages = storage_eps
+        self.tlogs = list(tlog_eps or [])
         self.tps_limit = self.BASE_TPS
+        self.batch_tps_limit = self.BASE_TPS
         self.worst_lag = 0
+        self.worst_durability_lag = 0
+        self.worst_storage_queue = 0
+        self.worst_tlog_queue = 0
+        self.limiting_reason = "none"
 
     async def run(self) -> None:
         while True:
             try:
                 metrics = await all_of([s.metrics() for s in self.storages])
                 self.worst_lag = max((m["version_lag"] for m in metrics), default=0)
-                self.tps_limit = self.BASE_TPS * self._scale(self.worst_lag)
+                self.worst_durability_lag = max(
+                    (m.get("durability_lag", 0) for m in metrics), default=0
+                )
+                self.worst_storage_queue = max(
+                    (m.get("queue_bytes", 0) for m in metrics), default=0
+                )
+                if self.tlogs:
+                    tmetrics = await all_of([t.metrics() for t in self.tlogs])
+                    self.worst_tlog_queue = max(
+                        (m["queue_bytes"] for m in tmetrics), default=0
+                    )
+                self.tps_limit = self.BASE_TPS * self._scale(1.0)
+                self.batch_tps_limit = self.BASE_TPS * self._scale(
+                    self.BATCH_FRACTION
+                )
             except Exception:
                 # A dead storage server shows up as a broken metrics RPC;
                 # keep the last limit until it is replaced (reference keeps
@@ -39,13 +74,42 @@ class Ratekeeper:
                 pass
             await self.loop.sleep(self.POLL_INTERVAL)
 
-    def _scale(self, lag: int) -> float:
-        if lag <= self.LAG_SOFT:
-            return 1.0
-        if lag >= self.LAG_HARD:
-            return 0.0
-        return 1.0 - (lag - self.LAG_SOFT) / (self.LAG_HARD - self.LAG_SOFT)
+    def _scale(self, frac: float) -> float:
+        signals = [
+            ("storage_lag", self.worst_lag, self.LAG_SOFT, self.LAG_HARD),
+            ("durability_lag", self.worst_durability_lag,
+             self.DLAG_SOFT, self.DLAG_HARD),
+            ("storage_queue", self.worst_storage_queue,
+             self.SQ_SOFT, self.SQ_HARD),
+            ("tlog_queue", self.worst_tlog_queue, self.TQ_SOFT, self.TQ_HARD),
+        ]
+        worst, reason = 1.0, "none"
+        for name, value, soft, hard in signals:
+            soft, hard = soft * frac, hard * frac
+            if value <= soft:
+                s = 1.0
+            elif value >= hard:
+                s = 0.0
+            else:
+                s = 1.0 - (value - soft) / (hard - soft)
+            if s < worst:
+                worst, reason = s, name
+        if frac == 1.0:
+            self.limiting_reason = reason
+        return worst
 
     async def get_rate(self) -> float:
         """GRV proxies poll this as their admission budget (txns/sec)."""
         return self.tps_limit
+
+    async def get_rates(self) -> dict:
+        """Both lanes + the governing signal (status json reports these)."""
+        return {
+            "tps_limit": self.tps_limit,
+            "batch_tps_limit": self.batch_tps_limit,
+            "limiting_reason": self.limiting_reason,
+            "worst_storage_lag": self.worst_lag,
+            "worst_durability_lag": self.worst_durability_lag,
+            "worst_storage_queue_bytes": self.worst_storage_queue,
+            "worst_tlog_queue_bytes": self.worst_tlog_queue,
+        }

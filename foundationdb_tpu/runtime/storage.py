@@ -20,7 +20,10 @@ import bisect
 from dataclasses import dataclass, field
 
 from foundationdb_tpu.core.errors import (
+    ChangeFeedCancelled,
+    ChangeFeedPopped,
     FutureVersion,
+    TooManyWatches,
     TransactionTooOld,
     WrongShardServer,
 )
@@ -137,9 +140,40 @@ class FetchState:
     snap_version: int | None = None  # set once the snapshot is injected
 
 
+@dataclass
+class ChangeFeed:
+    """One registered change feed (reference: storageserver.actor.cpp change
+    feed state — mutations overlapping [begin, end) are retained in version
+    order until popped; readers stream from a begin version and can park on
+    a waiter until more arrive). Atomic ops are captured post-application as
+    SetValue of the computed result, matching the reference's feed contract."""
+
+    feed_id: bytes
+    begin: bytes
+    end: bytes
+    entries: list[tuple[int, Mutation]] = field(default_factory=list)
+    pop_version: int = 0
+    stopped: bool = False
+    waiters: list[Promise] = field(default_factory=list)
+
+    def add(self, version: int, m: Mutation) -> None:
+        # Insert in version order: fetch_keys replays buffered mutations at
+        # versions older than captures that already landed (reads promise
+        # version order, so appending blindly would corrupt the stream).
+        if self.entries and self.entries[-1][0] > version:
+            i = bisect.bisect_right(self.entries, version, key=lambda e: e[0])
+            self.entries.insert(i, (version, m))
+        else:
+            self.entries.append((version, m))
+        waiters, self.waiters = self.waiters, []
+        for p in waiters:
+            p.send(version)
+
+
 class StorageServer:
     PULL_INTERVAL = 0.001
     GC_INTERVAL = 0.5
+    MAX_WATCHES = 10_000  # reference knob MAX_WATCHES → too_many_watches
 
     def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0,
                  tlog_replicas=None, kvstore=None):
@@ -169,6 +203,8 @@ class StorageServer:
         self.known_committed = 0  # acked-on-all-tlogs bound, off peek replies
         self._version_waiters: list[tuple[int, Promise]] = []
         self._watches: dict[bytes, list[tuple[bytes | None, Promise]]] = {}
+        self._watch_count = 0
+        self._feeds: dict[bytes, ChangeFeed] = {}
         self._running = False
         # Shard serving state (data distribution). None = serve everything
         # (single-team clusters never register ranges and skip the guard).
@@ -262,18 +298,7 @@ class StorageServer:
         if self._fetching:
             mutations = self._buffer_fetching(version, mutations)
         for m in mutations:
-            if m.type == MutationType.SET_VALUE:
-                self._write(m.param1, version, m.param2)
-            elif m.type == MutationType.CLEAR_RANGE:
-                for k in self.map.range_keys(m.param1, m.param2):
-                    if self.map.latest(k) is not None:
-                        self._write(k, version, None)
-            elif m.type in ATOMIC_OPS:
-                self._write(
-                    m.param1, version, apply_atomic(m.type, self.map.latest(m.param1), m.param2)
-                )
-            else:
-                raise ValueError(f"storage cannot apply mutation {m.type!r}")
+            self._apply_one(m, version)
         self._advance(version)
 
     def _advance(self, version: int) -> None:
@@ -299,6 +324,7 @@ class StorageServer:
             keep = []
             for expect, p in watchers:
                 (p.send(version) if value != expect else keep.append((expect, p)))
+            self._watch_count -= len(watchers) - len(keep)
             if keep:
                 self._watches[key] = keep
 
@@ -423,16 +449,21 @@ class StorageServer:
         return out
 
     def _apply_one(self, m: Mutation, version: int) -> None:
+        """Apply one mutation and mirror it into overlapping change feeds
+        (atomics normalized to the computed SetValue, clears clipped)."""
         if m.type == MutationType.SET_VALUE:
             self._write(m.param1, version, m.param2)
+            self._feed_capture(version, m)
         elif m.type == MutationType.CLEAR_RANGE:
             for k in self.map.range_keys(m.param1, m.param2):
                 if self.map.latest(k) is not None:
                     self._write(k, version, None)
+            self._feed_capture(version, m)
         elif m.type in ATOMIC_OPS:
-            self._write(
-                m.param1, version,
-                apply_atomic(m.type, self.map.latest(m.param1), m.param2),
+            value = apply_atomic(m.type, self.map.latest(m.param1), m.param2)
+            self._write(m.param1, version, value)
+            self._feed_capture(
+                version, Mutation(MutationType.SET_VALUE, m.param1, value)
             )
         else:
             raise ValueError(f"storage cannot apply mutation {m.type!r}")
@@ -547,6 +578,7 @@ class StorageServer:
         # retryable error and re-arms on the new owner.
         for key in [k for k in self._watches if begin <= k < end]:
             for _expect, p in self._watches.pop(key):
+                self._watch_count -= 1
                 p.fail(WrongShardServer(f"shard with {key[:16]!r} moved away"))
 
     def _check_serving(self, begin: bytes, end: bytes, version: int) -> None:
@@ -667,16 +699,117 @@ class StorageServer:
         current = self.map.latest(key)
         if current != value:
             return self._version
+        if self._watch_count >= self.MAX_WATCHES:
+            raise TooManyWatches(f"{self.MAX_WATCHES} watches already armed")
         p = Promise()
         self._watches.setdefault(key, []).append((value, p))
+        self._watch_count += 1
         return await p.future
 
+    # -- change feeds (reference: storageserver.actor.cpp change feeds) ------
+
+    def _feed_capture(self, version: int, m: Mutation) -> None:
+        if not self._feeds:
+            return
+        for f in self._feeds.values():
+            if f.stopped:
+                continue
+            if m.type == MutationType.CLEAR_RANGE:
+                ob, oe = max(m.param1, f.begin), min(m.param2, f.end)
+                if ob < oe:
+                    f.add(version, Mutation(MutationType.CLEAR_RANGE, ob, oe))
+            elif f.begin <= m.param1 < f.end:
+                f.add(version, m)
+
+    def register_change_feed(self, feed_id: bytes, begin: bytes, end: bytes) -> None:
+        """Start retaining this range's mutations under `feed_id`. Re-registration
+        with the same range is idempotent (reference: change feed registration
+        is a versioned special-key write; duplicates are no-ops)."""
+        existing = self._feeds.get(feed_id)
+        if existing is not None:
+            if (existing.begin, existing.end) != (begin, end):
+                raise ValueError(f"feed {feed_id!r} exists with another range")
+            return
+        self._feeds[feed_id] = ChangeFeed(feed_id, begin, end)
+
+    def read_change_feed(
+        self, feed_id: bytes, begin_version: int, end_version: int | None = None
+    ) -> list[tuple[int, Mutation]]:
+        """Mutations with begin_version <= version < end_version, in version
+        order. Reading below the popped floor raises ChangeFeedPopped (the
+        data is gone; the reader must re-snapshot)."""
+        f = self._feed(feed_id)
+        if begin_version < f.pop_version:
+            raise ChangeFeedPopped(
+                f"feed {feed_id!r} popped through {f.pop_version}"
+            )
+        hi = self._version + 1 if end_version is None else end_version
+        return [e for e in f.entries if begin_version <= e[0] < hi]
+
+    async def wait_change_feed(self, feed_id: bytes, after_version: int) -> int:
+        """Park until the feed holds a mutation above `after_version`;
+        returns that mutation's version. Destroying OR stopping the feed
+        wakes waiters with ChangeFeedCancelled (a stopped feed can never
+        produce the awaited entry)."""
+        while True:
+            f = self._feed(feed_id)
+            newer = [v for v, _m in f.entries if v > after_version]
+            if newer:
+                return min(newer)
+            if f.stopped:
+                raise ChangeFeedCancelled(f"feed {feed_id!r} stopped")
+            p = Promise()
+            f.waiters.append(p)
+            await p.future
+
+    def pop_change_feed(self, feed_id: bytes, version: int) -> None:
+        """Discard feed data below `version` (the reader has durably
+        consumed it — the feed analogue of tlog pop)."""
+        f = self._feed(feed_id)
+        f.pop_version = max(f.pop_version, version)
+        f.entries = [e for e in f.entries if e[0] >= f.pop_version]
+
+    def stop_change_feed(self, feed_id: bytes) -> None:
+        """Stop capturing; retained entries stay readable until destroy.
+        Parked waiters are failed — no future capture can ever wake them."""
+        f = self._feed(feed_id)
+        f.stopped = True
+        waiters, f.waiters = f.waiters, []
+        for p in waiters:
+            p.fail(ChangeFeedCancelled(f"feed {feed_id!r} stopped"))
+
+    def destroy_change_feed(self, feed_id: bytes) -> None:
+        f = self._feeds.pop(feed_id, None)
+        if f is not None:
+            for p in f.waiters:
+                p.fail(ChangeFeedCancelled(f"feed {feed_id!r} destroyed"))
+
+    def _feed(self, feed_id: bytes) -> ChangeFeed:
+        f = self._feeds.get(feed_id)
+        if f is None:
+            raise ChangeFeedCancelled(f"no change feed {feed_id!r}")
+        return f
+
     async def metrics(self) -> dict:
-        """Ratekeeper inputs (reference: StorageQueuingMetricsReply)."""
+        """Ratekeeper inputs (reference: StorageQueuingMetricsReply — the
+        real ratekeeper smooths version lag, DURABILITY lag (applied but not
+        yet fsynced), and storage queue bytes; all three are reported)."""
         tlog_version = await self.tlog.get_version()
+        queue_bytes = 0
+        if self.kvstore is not None:
+            for k in self._dirty:
+                v = self.map.latest(k)
+                queue_bytes += len(k) + (len(v) if v is not None else 0)
         return {
             "tag": self.tag,
-            "durable_version": self._version,
+            "durable_version": (
+                self._version if self.kvstore is None else self._durable_version
+            ),
             "version_lag": max(0, tlog_version - self._version),
+            "durability_lag": (
+                0 if self.kvstore is None
+                else max(0, self._version - self._durable_version)
+            ),
+            "queue_bytes": queue_bytes,
             "keys": len(self.map._keys),
         }

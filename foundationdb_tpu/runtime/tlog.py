@@ -22,6 +22,14 @@ class TLogEntry:
     # tag -> mutations bound for that storage server
     tagged: dict[int, list[Mutation]]
 
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            len(m.param1) + len(m.param2) + 8
+            for muts in self.tagged.values()
+            for m in muts
+        )
+
 
 class TLogLocked(Exception):
     """Pushed after recovery locked this log (reference: tlog_stopped)."""
@@ -58,6 +66,9 @@ class TLog:
                 self.disk.fsync()
         self._log: list[TLogEntry] = [TLogEntry(v, t) for v, t in (seed or [])]
         assert all(e.version < init_version for e in self._log)
+        # Running queue size (ratekeeper polls every 100 ms; recounting the
+        # whole log there would be O(queue) exactly when the queue is huge).
+        self._queue_bytes = sum(e.nbytes for e in self._log)
         self._version = init_version  # end of applied chain
         self._waiters: dict[int, Promise] = {}
         self._popped: dict[int, int] = {}  # tag -> trimmed-below version
@@ -102,7 +113,9 @@ class TLog:
             # cannot lose the batch; a crash before it never acked.
             self.disk.append((version, tagged))
             self.disk.fsync()
-        self._log.append(TLogEntry(version, tagged))
+        entry = TLogEntry(version, tagged)
+        self._log.append(entry)
+        self._queue_bytes += entry.nbytes
         self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
         self.known_committed = max(self.known_committed, known_committed)
@@ -145,7 +158,9 @@ class TLog:
             return  # nothing pushed yet (fresh post-recovery log): no trim
         floor = min(self._popped.get(t, 0) for t in self._tags_seen)
         before = len(self._log)
-        self._log = [e for e in self._log if e.version > floor]
+        kept = [e for e in self._log if e.version > floor]
+        self._queue_bytes -= sum(e.nbytes for e in self._log if e.version <= floor)
+        self._log = kept
         if self.disk is not None and before != len(self._log):
             self._disk_trims = getattr(self, "_disk_trims", 0) + 1
             if self._disk_trims % self.DISK_COMPACT_EVERY == 0:
@@ -165,6 +180,15 @@ class TLog:
 
     async def get_version(self) -> int:
         return self._version
+
+    async def metrics(self) -> dict:
+        """Ratekeeper inputs (reference: TLogQueuingMetricsReply — queue
+        bytes is the un-popped suffix some storage server still needs)."""
+        return {
+            "version": self._version,
+            "queue_bytes": self._queue_bytes,
+            "queue_entries": len(self._log),
+        }
 
     async def retire_tag(self, tag: int) -> None:
         """Forget a tag that will never pull again (backup stopped): its

@@ -344,7 +344,9 @@ class SimCluster:
             )
 
         self.ratekeeper = (
-            Ratekeeper(self.loop, self.storage_eps) if self.with_ratekeeper else None
+            Ratekeeper(self.loop, self.storage_eps, self.tlog_eps)
+            if self.with_ratekeeper
+            else None
         )
         self.ratekeeper_ep = (
             host("ratekeeper" + sfx, "ratekeeper", self.ratekeeper, run=True)

@@ -1,0 +1,28 @@
+"""Shared host-side utilities."""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Point JAX's persistent executable cache at the repo-local directory.
+
+    The tunneled TPU backend compiles remotely (minutes, and subject to
+    service queueing), so a warm cache is the difference between a 30 s and
+    a 30 min run. Safe to call before or after backend init; silently a
+    no-op if the running JAX lacks the config knobs.
+    """
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass

@@ -31,8 +31,12 @@ try:  # private JAX internal — degrade gracefully if it moves
 except (ImportError, AttributeError):
     pass
 
-import numpy as np
-import pytest
+from foundationdb_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()  # cuts repeat suite runs by minutes
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
 
 
 @pytest.fixture

@@ -1,0 +1,265 @@
+"""Change feeds, the watch limit, and multi-signal ratekeeper admission.
+
+Reference behaviors under test: storageserver.actor.cpp change feeds
+(capture, clip, atomic normalization, pop/destroy semantics), the
+too_many_watches limit (error 1032), Ratekeeper.actor.cpp's multi-signal
+rate computation with the default/batch priority split, and the GRV proxy
+lane behavior under a throttled batch budget.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.errors import (
+    ChangeFeedCancelled,
+    ChangeFeedPopped,
+    TooManyWatches,
+)
+from foundationdb_tpu.core.mutations import Mutation, MutationType as M
+from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.grv_proxy import PRIORITY_BATCH, GrvProxy
+from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
+from foundationdb_tpu.runtime.storage import StorageServer
+
+
+def make_ss():
+    loop = Loop(seed=0)
+    return loop, StorageServer(loop, tag=0, tlog_ep=None)
+
+
+class TestChangeFeeds:
+    def test_capture_clip_and_read(self):
+        _loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"b", b"d")
+        ss._apply(1, [Mutation(M.SET_VALUE, b"a", b"0")])  # outside
+        ss._apply(2, [Mutation(M.SET_VALUE, b"b", b"1")])  # inside
+        ss._apply(3, [Mutation(M.CLEAR_RANGE, b"a", b"z")])  # clipped
+        got = ss.read_change_feed(b"f", 0)
+        assert got == [
+            (2, Mutation(M.SET_VALUE, b"b", b"1")),
+            (3, Mutation(M.CLEAR_RANGE, b"b", b"d")),
+        ]
+        # Version-window reads.
+        assert ss.read_change_feed(b"f", 3) == [
+            (3, Mutation(M.CLEAR_RANGE, b"b", b"d"))
+        ]
+        assert ss.read_change_feed(b"f", 0, end_version=3) == [
+            (2, Mutation(M.SET_VALUE, b"b", b"1"))
+        ]
+
+    def test_atomic_ops_normalize_to_set(self):
+        _loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+        ss._apply(1, [Mutation(M.SET_VALUE, b"k", (5).to_bytes(8, "little"))])
+        ss._apply(2, [Mutation(M.ADD, b"k", (3).to_bytes(8, "little"))])
+        got = ss.read_change_feed(b"f", 2)
+        assert got == [
+            (2, Mutation(M.SET_VALUE, b"k", (8).to_bytes(8, "little")))
+        ]
+
+    def test_pop_and_popped_error(self):
+        _loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+        ss._apply(1, [Mutation(M.SET_VALUE, b"k", b"1")])
+        ss._apply(2, [Mutation(M.SET_VALUE, b"k", b"2")])
+        ss.pop_change_feed(b"f", 2)
+        assert ss.read_change_feed(b"f", 2) == [
+            (2, Mutation(M.SET_VALUE, b"k", b"2"))
+        ]
+        with pytest.raises(ChangeFeedPopped):
+            ss.read_change_feed(b"f", 1)
+
+    def test_stop_and_destroy(self):
+        loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+        ss._apply(1, [Mutation(M.SET_VALUE, b"k", b"1")])
+        ss.stop_change_feed(b"f")
+        ss._apply(2, [Mutation(M.SET_VALUE, b"k", b"2")])
+        assert len(ss.read_change_feed(b"f", 0)) == 1  # capture stopped
+        ss.destroy_change_feed(b"f")
+        with pytest.raises(ChangeFeedCancelled):
+            ss.read_change_feed(b"f", 0)
+
+    def test_wait_wakes_on_capture(self):
+        loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+
+        async def main():
+            async def writer():
+                await loop.sleep(0.01)
+                ss._apply(5, [Mutation(M.SET_VALUE, b"k", b"v")])
+
+            loop.spawn(writer(), name="writer")
+            v = await ss.wait_change_feed(b"f", 0)
+            assert v == 5
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
+
+    def test_stop_wakes_waiter(self):
+        loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+
+        async def main():
+            async def stopper():
+                await loop.sleep(0.01)
+                ss.stop_change_feed(b"f")
+
+            loop.spawn(stopper(), name="stopper")
+            with pytest.raises(ChangeFeedCancelled):
+                await ss.wait_change_feed(b"f", 0)
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
+
+    def test_out_of_order_capture_sorts(self):
+        """fetch_keys replay captures at older versions than live traffic
+        already captured — reads must still come back version-ordered."""
+        _loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+        ss._feed_capture(5, Mutation(M.SET_VALUE, b"k", b"new"))
+        ss._feed_capture(3, Mutation(M.SET_VALUE, b"k", b"replayed"))
+        got = ss.read_change_feed(b"f", 0, end_version=100)
+        assert [v for v, _m in got] == [3, 5]
+
+    def test_destroy_wakes_waiter(self):
+        loop, ss = make_ss()
+        ss.register_change_feed(b"f", b"", b"\xff")
+
+        async def main():
+            async def killer():
+                await loop.sleep(0.01)
+                ss.destroy_change_feed(b"f")
+
+            loop.spawn(killer(), name="killer")
+            with pytest.raises(ChangeFeedCancelled):
+                await ss.wait_change_feed(b"f", 0)
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
+
+
+class TestWatchLimit:
+    def test_too_many_watches(self, monkeypatch):
+        loop, ss = make_ss()
+        monkeypatch.setattr(StorageServer, "MAX_WATCHES", 3)
+
+        async def main():
+            for i in range(3):
+                loop.spawn(ss.watch(b"k%d" % i, None), name=f"w{i}")
+            await loop.sleep(0.001)  # let the watches arm
+            with pytest.raises(TooManyWatches):
+                await ss.watch(b"k9", None)
+            # Firing one frees a slot.
+            ss._apply(1, [Mutation(M.SET_VALUE, b"k0", b"v")])
+            loop.spawn(ss.watch(b"k9", None), name="w9")
+            await loop.sleep(0.001)
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
+
+
+class FakeStorage:
+    """Endpoint-shaped fake: metrics() returns a Future (all_of's contract)."""
+
+    def __init__(self):
+        self.loop = None  # attached by run_rk
+        self.m = {
+            "tag": 0, "durable_version": 0, "version_lag": 0,
+            "durability_lag": 0, "queue_bytes": 0, "keys": 0,
+        }
+
+    def metrics(self):
+        async def get():
+            return dict(self.m)
+
+        return self.loop.spawn(get(), name="fake_storage.metrics")
+
+
+class FakeTlog:
+    def __init__(self):
+        self.loop = None
+        self.queue_bytes = 0
+
+    def metrics(self):
+        async def get():
+            return {"version": 0, "queue_bytes": self.queue_bytes,
+                    "queue_entries": 0}
+
+        return self.loop.spawn(get(), name="fake_tlog.metrics")
+
+
+class TestRatekeeperSignals:
+    def run_rk(self, storage, tlog):
+        loop = Loop(seed=0)
+        storage.loop = tlog.loop = loop
+        rk = Ratekeeper(loop, [storage], [tlog])
+
+        async def main():
+            loop.spawn(rk.run(), name="rk")
+            await loop.sleep(0.5)
+            return await rk.get_rates()
+
+        return loop.run(main(), timeout=10), rk
+
+    def test_healthy_full_rate(self):
+        rates, rk = self.run_rk(FakeStorage(), FakeTlog())
+        assert rates["tps_limit"] == Ratekeeper.BASE_TPS
+        assert rates["batch_tps_limit"] == Ratekeeper.BASE_TPS
+        assert rates["limiting_reason"] == "none"
+
+    def test_storage_queue_throttles_batch_first(self):
+        s = FakeStorage()
+        s.m["queue_bytes"] = int(Ratekeeper.SQ_SOFT * 0.75)  # over batch soft
+        rates, _ = self.run_rk(s, FakeTlog())
+        assert rates["tps_limit"] == Ratekeeper.BASE_TPS  # default untouched
+        assert rates["batch_tps_limit"] < Ratekeeper.BASE_TPS
+
+    def test_tlog_queue_kills_rate(self):
+        t = FakeTlog()
+        t.queue_bytes = Ratekeeper.TQ_HARD
+        rates, _ = self.run_rk(FakeStorage(), t)
+        assert rates["tps_limit"] == 0.0
+        assert rates["limiting_reason"] == "tlog_queue"
+
+    def test_durability_lag_signal(self):
+        s = FakeStorage()
+        s.m["durability_lag"] = Ratekeeper.DLAG_HARD
+        rates, _ = self.run_rk(s, FakeTlog())
+        assert rates["tps_limit"] == 0.0
+        assert rates["limiting_reason"] == "durability_lag"
+
+
+class FakeSequencer:
+    async def get_live_committed_version(self):
+        return 42
+
+
+class FakeRatekeeper:
+    def __init__(self, tps, batch_tps):
+        self.tps, self.batch_tps = tps, batch_tps
+
+    async def get_rates(self):
+        return {"tps_limit": self.tps, "batch_tps_limit": self.batch_tps}
+
+
+class TestGrvPriorityLanes:
+    def test_batch_lane_starves_while_default_serves(self):
+        loop = Loop(seed=0)
+        proxy = GrvProxy(loop, FakeSequencer(), FakeRatekeeper(1e6, 0.0))
+        proxy._tokens = proxy._batch_tokens = 0.0  # force bucket refill path
+
+        async def main():
+            loop.spawn(proxy.run(), name="grv")
+            got = {}
+
+            async def batch_req():
+                got["batch"] = await proxy.get_read_version(PRIORITY_BATCH)
+
+            loop.spawn(batch_req(), name="batch")
+            got["default"] = await proxy.get_read_version()
+            await loop.sleep(0.2)
+            return got
+
+        got = loop.run(main(), timeout=10)
+        assert got["default"] == 42
+        assert "batch" not in got  # zero batch budget → still queued
