@@ -18,6 +18,7 @@ from foundationdb_tpu.layers.directory import (
     DirectoryDoesNotExist,
     DirectoryError,
     DirectoryLayer,
+    DirectoryPartition,
     DirectorySubspace,
     HighContentionAllocator,
 )
@@ -26,5 +27,5 @@ __all__ = [
     "SingleFloat", "Subspace", "TupleError", "Versionstamp", "pack",
     "pack_with_versionstamp", "range_of", "strinc", "unpack",
     "DirectoryAlreadyExists", "DirectoryDoesNotExist", "DirectoryError",
-    "DirectoryLayer", "DirectorySubspace", "HighContentionAllocator",
+    "DirectoryLayer", "DirectoryPartition", "DirectorySubspace", "HighContentionAllocator",
 ]

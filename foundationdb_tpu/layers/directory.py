@@ -130,32 +130,111 @@ class DirectorySubspace(Subspace):
         self.layer = layer
         self.directory_layer = directory_layer
 
+    def _subpath(self, path) -> tuple:
+        """Path relative to our directory layer's root (reference:
+        _partition_subpath — self.path is absolute; a partition's inner
+        layer only understands paths below the partition)."""
+        return tuple(self.path[len(self.directory_layer._path):]) + _to_path(path)
+
     # Convenience proxies: d.create_or_open(tr, "sub") etc.
     async def create_or_open(self, tr, path, layer: bytes = b""):
         return await self.directory_layer.create_or_open(
-            tr, self.path + _to_path(path), layer)
+            tr, self._subpath(path), layer)
 
     async def open(self, tr, path, layer: bytes = b""):
-        return await self.directory_layer.open(tr, self.path + _to_path(path), layer)
+        return await self.directory_layer.open(tr, self._subpath(path), layer)
 
     async def create(self, tr, path, layer: bytes = b"", prefix: bytes | None = None):
         return await self.directory_layer.create(
-            tr, self.path + _to_path(path), layer, prefix)
+            tr, self._subpath(path), layer, prefix)
 
     async def list(self, tr, path=()):
-        return await self.directory_layer.list(tr, self.path + _to_path(path))
+        return await self.directory_layer.list(tr, self._subpath(path))
 
     async def move_to(self, tr, new_path):
-        return await self.directory_layer.move(tr, self.path, _to_path(new_path))
+        new_path = _to_path(new_path)
+        dl = self.directory_layer
+        if tuple(new_path[: len(dl._path)]) != tuple(dl._path):
+            raise DirectoryError("cannot move between partitions")
+        return await dl.move(tr, self._subpath(()), new_path[len(dl._path):])
 
     async def remove(self, tr, path=()):
-        return await self.directory_layer.remove(tr, self.path + _to_path(path))
+        return await self.directory_layer.remove(tr, self._subpath(path))
 
     async def exists(self, tr, path=()) -> bool:
-        return await self.directory_layer.exists(tr, self.path + _to_path(path))
+        return await self.directory_layer.exists(tr, self._subpath(path))
 
     def __repr__(self) -> str:
         return f"DirectorySubspace(path={self.path!r}, prefix={self.key!r})"
+
+
+class DirectoryPartition(DirectorySubspace):
+    """A directory whose contents live under their OWN directory layer
+    (reference: DirectoryPartition in directory_impl.py — created by the
+    b"partition" layer id). The partition's subtree has its node metadata
+    under prefix+b"\\xfe" and its contents under prefix, so the whole
+    partition can be moved/removed as one contiguous key range, and
+    directories inside it can never collide with outside prefixes.
+
+    The partition itself is NOT usable as a subspace: keys must not be
+    packed directly against a partition prefix (they would interleave with
+    the inner layer's metadata)."""
+
+    def __init__(self, path: tuple, prefix: bytes,
+                 parent_directory_layer: "DirectoryLayer"):
+        super().__init__(path, prefix, _inner_layer(prefix, path), b"partition")
+        self.parent_directory_layer = parent_directory_layer
+
+    # Self-operations go through the PARENT layer (the partition is a node
+    # in its parent's tree); child operations through the inner layer.
+    async def move_to(self, tr, new_path):
+        new_path = _to_path(new_path)
+        pdl = self.parent_directory_layer
+        if tuple(new_path[: len(pdl._path)]) != tuple(pdl._path):
+            raise DirectoryError("cannot move between partitions")
+        return await pdl.move(
+            tr, self.path[len(pdl._path):], new_path[len(pdl._path):]
+        )
+
+    async def remove(self, tr, path=()):
+        if _to_path(path):
+            return await self.directory_layer.remove(tr, self._subpath(path))
+        pdl = self.parent_directory_layer
+        return await pdl.remove(tr, self.path[len(pdl._path):])
+
+    async def exists(self, tr, path=()) -> bool:
+        if _to_path(path):
+            return await self.directory_layer.exists(tr, self._subpath(path))
+        pdl = self.parent_directory_layer
+        return await pdl.exists(tr, self.path[len(pdl._path):])
+
+    def _forbidden(self):
+        raise DirectoryError(
+            "a directory partition cannot be used as a subspace")
+
+    def pack(self, t: tuple = ()):
+        self._forbidden()
+
+    def pack_with_versionstamp(self, t: tuple):
+        self._forbidden()
+
+    def unpack(self, key: bytes):
+        self._forbidden()
+
+    def range(self, t: tuple = ()):
+        self._forbidden()
+
+    def subspace(self, t: tuple):
+        self._forbidden()
+
+    def __getitem__(self, item):
+        self._forbidden()
+
+    def contains(self, key: bytes):
+        self._forbidden()
+
+    def __repr__(self) -> str:
+        return f"DirectoryPartition(path={self.path!r})"
 
 
 def _to_path(path) -> tuple:
@@ -164,17 +243,51 @@ def _to_path(path) -> tuple:
     return tuple(path)
 
 
+def _inner_layer(prefix: bytes, abs_path: tuple) -> "DirectoryLayer":
+    """The directory layer managing a partition's subtree: node metadata
+    under prefix+0xfe, contents under the prefix itself."""
+    return DirectoryLayer(
+        node_subspace=Subspace(raw_prefix=prefix + b"\xfe"),
+        content_subspace=Subspace(raw_prefix=prefix),
+        path=abs_path,
+    )
+
+
 class DirectoryLayer:
     """Reference: DirectoryLayer in directory_impl.py. ``create_or_open``,
     ``open``, ``create``, ``move``, ``remove``, ``list``, ``exists`` over
     slash-free unicode path tuples."""
 
     def __init__(self, node_subspace: Subspace | None = None,
-                 content_subspace: Subspace | None = None):
+                 content_subspace: Subspace | None = None,
+                 path: tuple = ()):
         self._node_ss = node_subspace or Subspace(raw_prefix=b"\xfe")
         self._content_ss = content_subspace or Subspace()
         self._root_node = self._node_ss.subspace((self._node_ss.key,))
         self._allocator = HighContentionAllocator(self._root_node[b"hca"])
+        self._path = tuple(path)  # absolute path of this layer's root
+        # (non-empty only for a partition's inner layer)
+
+    async def _find_owner(
+        self, tr, path: tuple
+    ) -> tuple["DirectoryLayer", tuple, Subspace | None]:
+        """ONE walk resolving partitions: → (owner layer, path relative to
+        it, node or None). An ancestor with layer id b"partition" owns
+        everything below it, so the walk hops into the partition's own
+        directory layer (reference: _find's Node.get_contents hop). The
+        final path element's node is returned so callers need no second
+        walk."""
+        node = self._root_node
+        for i, name in enumerate(path):
+            prefix = await tr.get(node.pack((_SUBDIRS, name)))
+            if prefix is None:
+                return self, path, None
+            node = self._node_with_prefix(prefix)
+            last = i == len(path) - 1
+            if not last and (await self._layer_of(tr, node)) == b"partition":
+                inner = _inner_layer(prefix, self._path + tuple(path[: i + 1]))
+                return await inner._find_owner(tr, path[i + 1:])
+        return self, path, (self._root_node if not path else node)
 
     # -- node helpers --------------------------------------------------------
 
@@ -211,7 +324,13 @@ class DirectoryLayer:
         return (await tr.get(node.pack((b"layer",)))) or b""
 
     def _contents(self, path: tuple, node: Subspace, layer: bytes) -> DirectorySubspace:
-        return DirectorySubspace(path, self._prefix_of(node), self, layer)
+        if layer == b"partition":
+            return DirectoryPartition(
+                self._path + tuple(path), self._prefix_of(node), self
+            )
+        return DirectorySubspace(
+            self._path + tuple(path), self._prefix_of(node), self, layer
+        )
 
     # -- public API ----------------------------------------------------------
 
@@ -233,8 +352,12 @@ class DirectoryLayer:
                               allow_create: bool, allow_open: bool) -> DirectorySubspace:
         if not path:
             raise DirectoryError("the root directory cannot be opened")
+        owner, path, node = await self._find_owner(tr, path)
+        if owner is not self:
+            return await owner._create_or_open(
+                tr, path, layer, prefix,
+                allow_create=allow_create, allow_open=allow_open)
         await self._check_version(tr, write=False)
-        node = await self._find(tr, path)
         if node is not None:
             if not allow_open:
                 raise DirectoryAlreadyExists(f"{path!r} already exists")
@@ -247,6 +370,11 @@ class DirectoryLayer:
             raise DirectoryDoesNotExist(f"{path!r} does not exist")
 
         await self._check_version(tr, write=True)
+        if prefix is not None and self._path:
+            # Reference: "cannot specify a prefix in a partition" — a manual
+            # prefix could land outside the partition's contiguous range,
+            # orphaning data when the partition is moved/removed.
+            raise DirectoryError("cannot specify a prefix in a partition")
         if prefix is None:
             prefix = self._content_ss.key + await self._allocator.allocate(tr)
             if await self._has_keys(tr, prefix):
@@ -298,27 +426,41 @@ class DirectoryLayer:
 
     async def list(self, tr, path=()) -> list[str]:
         await self._check_version(tr, write=False)
-        path = _to_path(path)
-        node = self._root_node if not path else await self._find(tr, path)
+        owner, path, node = await self._find_owner(tr, _to_path(path))
+        if owner is not self:
+            return await owner.list(tr, path)
         if node is None:
             raise DirectoryDoesNotExist(f"{path!r} does not exist")
+        if path and (await self._layer_of(tr, node)) == b"partition":
+            # Listing a partition lists the partition's own root.
+            inner = _inner_layer(self._prefix_of(node), self._path + path)
+            return await inner.list(tr, ())
         begin, end = node.range((_SUBDIRS,))
         sub = node.subspace((_SUBDIRS,))
         return [sub.unpack(k)[0] for k, _ in await tr.get_range(begin, end)]
 
     async def exists(self, tr, path) -> bool:
         await self._check_version(tr, write=False)
-        return await self._find(tr, _to_path(path)) is not None
+        owner, path, node = await self._find_owner(tr, _to_path(path))
+        if owner is not self:
+            return await owner.exists(tr, path)
+        return node is not None
 
     async def move(self, tr, old_path, new_path) -> DirectorySubspace:
         await self._check_version(tr, write=True)
         old_path, new_path = _to_path(old_path), _to_path(new_path)
+        old_owner, old_rel, old_node = await self._find_owner(tr, old_path)
+        new_owner, new_rel, new_node = await self._find_owner(tr, new_path)
+        if old_owner._path != new_owner._path:
+            raise DirectoryError("cannot move between partitions")
+        if old_owner is not self:
+            return await old_owner.move(tr, old_rel, new_rel)
+        old_path, new_path = old_rel, new_rel
         if new_path[: len(old_path)] == old_path:
             raise DirectoryError("cannot move a directory into its own subtree")
-        old_node = await self._find(tr, old_path)
         if old_node is None:
             raise DirectoryDoesNotExist(f"{old_path!r} does not exist")
-        if await self._find(tr, new_path) is not None:
+        if new_node is not None:
             raise DirectoryAlreadyExists(f"{new_path!r} already exists")
         parent = await self._find(tr, new_path[:-1]) if len(new_path) > 1 else self._root_node
         if parent is None:
@@ -337,7 +479,9 @@ class DirectoryLayer:
         path = _to_path(path)
         if not path:
             raise DirectoryError("the root directory cannot be removed")
-        node = await self._find(tr, path)
+        owner, path, node = await self._find_owner(tr, path)
+        if owner is not self:
+            return await owner.remove(tr, path)
         if node is None:
             return False
         await self._remove_recursive(tr, node)

@@ -1,0 +1,243 @@
+"""Tagged binary serialization for the real-socket RPC transport.
+
+The reference serializes RPC payloads with flat_buffers (fdbrpc/
+FlatBuffers.h) over a stable of registered message structs. This is the
+same idea at Python scale: a compact tagged encoding for the value shapes
+the runtime actually passes (scalars, bytes, containers) plus a registry
+for the runtime's message dataclasses (Mutation, KeyRange, ...) and a
+wire form for FdbError so failures cross the network with their codes.
+
+Deliberately NOT pickle: no arbitrary code execution on receive, and the
+format is stable against refactors (a registered struct is identified by
+its registry id, not its import path).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable
+
+from foundationdb_tpu.core.errors import FdbError
+
+_T_NONE = 0x00
+_T_TRUE = 0x01
+_T_FALSE = 0x02
+_T_INT = 0x03  # signed 64-bit
+_T_BIGINT = 0x04  # arbitrary precision (len + sign + magnitude)
+_T_FLOAT = 0x05
+_T_BYTES = 0x06
+_T_STR = 0x07
+_T_LIST = 0x08
+_T_TUPLE = 0x09
+_T_DICT = 0x0A
+_T_STRUCT = 0x0B  # registered dataclass/enum
+_T_ERROR = 0x0C  # FdbError (code + message)
+
+_u32 = struct.Struct("<I")
+_i64 = struct.Struct("<q")
+_f64 = struct.Struct("<d")
+_u16 = struct.Struct("<H")
+
+# struct_id -> (cls, to_tuple, from_tuple); cls -> struct_id
+_STRUCTS: dict[int, tuple[type, Callable, Callable]] = {}
+_STRUCT_IDS: dict[type, int] = {}
+
+
+def register_struct(
+    struct_id: int,
+    cls: type,
+    to_tuple: Callable[[Any], tuple],
+    from_tuple: Callable[[tuple], Any],
+) -> None:
+    """Register a message type. Ids are part of the wire contract — both
+    peers must agree (they import the same module, which registers the
+    runtime's stable set below)."""
+    if struct_id in _STRUCTS and _STRUCTS[struct_id][0] is not cls:
+        raise ValueError(f"struct id {struct_id} already registered")
+    _STRUCTS[struct_id] = (cls, to_tuple, from_tuple)
+    _STRUCT_IDS[cls] = struct_id
+
+
+def pack_obj(obj: Any, out: bytearray) -> None:
+    t = type(obj)
+    if obj is None:
+        out.append(_T_NONE)
+    elif obj is True:
+        out.append(_T_TRUE)
+    elif obj is False:
+        out.append(_T_FALSE)
+    elif t is int:
+        if -(2**63) <= obj < 2**63:
+            out.append(_T_INT)
+            out += _i64.pack(obj)
+        else:
+            mag = abs(obj).to_bytes((abs(obj).bit_length() + 7) // 8, "little")
+            out.append(_T_BIGINT)
+            out += _u32.pack(len(mag))
+            out.append(1 if obj < 0 else 0)
+            out += mag
+    elif t is float:
+        out.append(_T_FLOAT)
+        out += _f64.pack(obj)
+    elif t is bytes or t is bytearray or t is memoryview:
+        b = bytes(obj)
+        out.append(_T_BYTES)
+        out += _u32.pack(len(b))
+        out += b
+    elif t is str:
+        b = obj.encode("utf-8")
+        out.append(_T_STR)
+        out += _u32.pack(len(b))
+        out += b
+    elif t is list or t is tuple:
+        out.append(_T_LIST if t is list else _T_TUPLE)
+        out += _u32.pack(len(obj))
+        for x in obj:
+            pack_obj(x, out)
+    elif t is dict:
+        out.append(_T_DICT)
+        out += _u32.pack(len(obj))
+        for k, v in obj.items():
+            pack_obj(k, out)
+            pack_obj(v, out)
+    elif isinstance(obj, FdbError):
+        msg = str(obj).encode("utf-8")
+        out.append(_T_ERROR)
+        out += _u16.pack(obj.code)
+        out += _u32.pack(len(msg))
+        out += msg
+    elif t in _STRUCT_IDS:
+        sid = _STRUCT_IDS[t]
+        out.append(_T_STRUCT)
+        out += _u16.pack(sid)
+        pack_obj(_STRUCTS[sid][1](obj), out)
+    else:
+        # enums / subclasses registered by exact type only, checked above.
+        raise TypeError(f"wire cannot serialize {type(obj).__name__}: {obj!r}")
+
+
+def unpack_obj(buf: bytes | memoryview, pos: int = 0) -> tuple[Any, int]:
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        return _i64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_BIGINT:
+        n = _u32.unpack_from(buf, pos)[0]
+        neg = buf[pos + 4]
+        mag = int.from_bytes(bytes(buf[pos + 5 : pos + 5 + n]), "little")
+        return (-mag if neg else mag), pos + 5 + n
+    if tag == _T_FLOAT:
+        return _f64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_BYTES:
+        n = _u32.unpack_from(buf, pos)[0]
+        return bytes(buf[pos + 4 : pos + 4 + n]), pos + 4 + n
+    if tag == _T_STR:
+        n = _u32.unpack_from(buf, pos)[0]
+        return bytes(buf[pos + 4 : pos + 4 + n]).decode("utf-8"), pos + 4 + n
+    if tag in (_T_LIST, _T_TUPLE):
+        n = _u32.unpack_from(buf, pos)[0]
+        pos += 4
+        items = []
+        for _ in range(n):
+            x, pos = unpack_obj(buf, pos)
+            items.append(x)
+        return (items if tag == _T_LIST else tuple(items)), pos
+    if tag == _T_DICT:
+        n = _u32.unpack_from(buf, pos)[0]
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = unpack_obj(buf, pos)
+            v, pos = unpack_obj(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _T_STRUCT:
+        sid = _u16.unpack_from(buf, pos)[0]
+        fields, pos = unpack_obj(buf, pos + 2)
+        entry = _STRUCTS.get(sid)
+        if entry is None:
+            raise ValueError(f"unknown wire struct id {sid}")
+        return entry[2](fields), pos
+    if tag == _T_ERROR:
+        code = _u16.unpack_from(buf, pos)[0]
+        n = _u32.unpack_from(buf, pos + 2)[0]
+        msg = bytes(buf[pos + 6 : pos + 6 + n]).decode("utf-8")
+        return FdbError(msg, code=code), pos + 6 + n
+    raise ValueError(f"unknown wire tag {tag:#x}")
+
+
+def dumps(obj: Any) -> bytes:
+    out = bytearray()
+    pack_obj(obj, out)
+    return bytes(out)
+
+
+def loads(buf: bytes) -> Any:
+    obj, pos = unpack_obj(buf)
+    if pos != len(buf):
+        raise ValueError(f"trailing bytes after wire object ({len(buf) - pos})")
+    return obj
+
+
+# -- the runtime's stable message registry ----------------------------------
+
+
+def _register_runtime_types() -> None:
+    from foundationdb_tpu.core.mutations import Mutation, MutationType
+    from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+
+    register_struct(
+        1, Mutation,
+        lambda m: (int(m.type), m.param1, m.param2),
+        lambda f: Mutation(MutationType(f[0]), f[1], f[2]),
+    )
+    register_struct(
+        2, KeyRange,
+        lambda r: (r.begin, r.end),
+        lambda f: KeyRange(f[0], f[1]),
+    )
+    register_struct(
+        3, MutationType, lambda e: (int(e),), lambda f: MutationType(f[0])
+    )
+    register_struct(
+        4, Verdict, lambda e: (int(e),), lambda f: Verdict(f[0])
+    )
+    register_struct(
+        7, TxnConflictInfo,
+        lambda t: (
+            t.read_version, list(t.read_ranges), list(t.write_ranges),
+            t.report_conflicting_keys,
+        ),
+        lambda f: TxnConflictInfo(
+            read_version=f[0], read_ranges=f[1], write_ranges=f[2],
+            report_conflicting_keys=f[3],
+        ),
+    )
+
+    from foundationdb_tpu.runtime.commit_proxy import CommitRequest, CommitResult
+
+    register_struct(
+        5, CommitRequest,
+        lambda r: (
+            r.read_version, list(r.mutations), list(r.read_ranges),
+            list(r.write_ranges), r.report_conflicting_keys,
+        ),
+        lambda f: CommitRequest(
+            read_version=f[0], mutations=f[1], read_ranges=f[2],
+            write_ranges=f[3], report_conflicting_keys=f[4],
+        ),
+    )
+    register_struct(
+        6, CommitResult,
+        lambda r: (r.version, r.batch_order),
+        lambda f: CommitResult(*f),
+    )
+
+
+_register_runtime_types()

@@ -13,6 +13,7 @@ from foundationdb_tpu.client.ryw import open_database
 from foundationdb_tpu.layers import (
     DirectoryAlreadyExists,
     DirectoryDoesNotExist,
+    DirectoryError,
     DirectoryLayer,
     SingleFloat,
     Subspace,
@@ -309,5 +310,122 @@ class TestDirectoryLayer:
                     if i != j:
                         assert not p.startswith(q)
             return "ok"
+
+        assert run(c, main()) == "ok"
+
+
+class TestDirectoryPartitions:
+    """Reference: DirectoryPartition in directory_impl.py — a directory with
+    layer id b"partition" owns its own node/content subspaces; ops route
+    through it transparently, cross-partition moves are rejected, and the
+    partition prefix is not usable as a subspace."""
+
+    def test_partition_children_and_routing(self):
+        c, db = make_db(31)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                part = await dl.create_or_open(tr, ("p",), layer=b"partition")
+                child = await part.create_or_open(tr, "users")
+                tr.set(child.pack((1,)), b"alice")
+                return part, child
+
+            part, child = await db.run(body)
+            assert part.path == ("p",)
+            assert child.path == ("p", "users")
+            # Child contents live under the partition prefix, metadata under
+            # prefix + 0xfe.
+            assert child.key.startswith(part.key)
+
+            async def check(tr):
+                # Routing through the PARENT layer reaches into the partition.
+                again = await dl.open(tr, ("p", "users"))
+                assert again.key == child.key
+                assert await tr.get(again.pack((1,))) == b"alice"
+                assert await dl.list(tr, ("p",)) == ["users"]
+                assert await dl.exists(tr, ("p", "users"))
+                deep = await dl.create_or_open(tr, ("p", "a", "b"))
+                assert deep.key.startswith(part.key)
+
+            await db.run(check)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_partition_not_a_subspace(self):
+        c, db = make_db(32)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                part = await dl.create_or_open(tr, ("p",), layer=b"partition")
+                import pytest
+
+                with pytest.raises(DirectoryError):
+                    part.pack((1,))
+                with pytest.raises(DirectoryError):
+                    part.range()
+                with pytest.raises(DirectoryError):
+                    part["x"]
+                return "ok"
+
+            return await db.run(body)
+
+        assert run(c, main()) == "ok"
+
+    def test_cross_partition_move_rejected(self):
+        c, db = make_db(33)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                await dl.create_or_open(tr, ("p1",), layer=b"partition")
+                await dl.create_or_open(tr, ("p2",), layer=b"partition")
+                await dl.create_or_open(tr, ("p1", "d"))
+                await dl.create_or_open(tr, ("outside",))
+                import pytest
+
+                with pytest.raises(DirectoryError, match="between partitions"):
+                    await dl.move(tr, ("p1", "d"), ("p2", "d"))
+                with pytest.raises(DirectoryError, match="between partitions"):
+                    await dl.move(tr, ("p1", "d"), ("elsewhere",))
+                # Moves WITHIN one partition work.
+                moved = await dl.move(tr, ("p1", "d"), ("p1", "e"))
+                assert moved.path == ("p1", "e")
+                assert await dl.exists(tr, ("p1", "e"))
+                assert not await dl.exists(tr, ("p1", "d"))
+                return "ok"
+
+            return await db.run(body)
+
+        assert run(c, main()) == "ok"
+
+    def test_partition_remove_clears_everything(self):
+        c, db = make_db(34)
+        dl = DirectoryLayer()
+
+        async def main():
+            async def body(tr):
+                part = await dl.create_or_open(tr, ("p",), layer=b"partition")
+                child = await part.create_or_open(tr, "d")
+                tr.set(child.pack((1,)), b"x")
+                return part
+
+            part = await db.run(body)
+
+            async def rm(tr):
+                assert await part.remove(tr)
+
+            await db.run(rm)
+
+            async def gone(tr):
+                assert not await dl.exists(tr, ("p",))
+                # The partition's whole key range is cleared.
+                rows = await tr.get_range(part.key, part.key + b"\xff")
+                assert rows == []
+                return "ok"
+
+            return await db.run(gone)
 
         assert run(c, main()) == "ok"

@@ -1,0 +1,259 @@
+"""Real-socket transport: wire format, in-process TCP RPC, cross-process
+RPC against an unmodified runtime role (TLog), and failure semantics.
+
+This is the deployment-mode pump the flow module promises (reference:
+fdbrpc/FlowTransport.actor.cpp + Net2): the same role objects the sim
+drives answer RPCs over real TCP, and a lost peer surfaces as
+BrokenPromise exactly like a sim kill_process.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import Mutation, MutationType as M
+from foundationdb_tpu.core.types import KeyRange, Verdict
+from foundationdb_tpu.runtime import wire
+from foundationdb_tpu.runtime.flow import BrokenPromise
+from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+from foundationdb_tpu.runtime.tlog import TLog
+
+
+class TestWireFormat:
+    def test_scalar_round_trips(self):
+        for v in [None, True, False, 0, -1, 2**40, -(2**70), 2**200, 1.5,
+                  b"", b"\x00\xff", "héllo", [1, [2, b"x"]], (1, 2),
+                  {b"k": [None, False]}, {}]:
+            assert wire.loads(wire.dumps(v)) == v
+
+    def test_struct_round_trips(self):
+        m = Mutation(M.ADD, b"k", b"\x01")
+        assert wire.loads(wire.dumps(m)) == m
+        r = KeyRange(b"a", b"b")
+        assert wire.loads(wire.dumps(r)) == r
+        assert wire.loads(wire.dumps(M.SET_VALUE)) is M.SET_VALUE
+        assert wire.loads(wire.dumps(Verdict.CONFLICT)) is Verdict.CONFLICT
+        assert wire.loads(wire.dumps([m, r, {1: m}])) == [m, r, {1: m}]
+
+    def test_error_round_trip(self):
+        e = wire.loads(wire.dumps(FdbError("boom", code=1020)))
+        assert isinstance(e, FdbError) and e.code == 1020 and e.retryable
+        assert "boom" in str(e)
+
+    def test_unserializable_raises(self):
+        with pytest.raises(TypeError):
+            wire.dumps(object())
+
+
+class Echo:
+    async def echo(self, x):
+        return x
+
+    def sync_echo(self, x):  # non-async methods also serve
+        return x
+
+    async def boom(self):
+        raise FdbError("nope", code=1007)
+
+
+class TestInProcessTcp:
+    def test_rpc_round_trip_and_errors(self):
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("echo", Echo())
+        ep = client.endpoint(server.addr, "echo")
+
+        async def main():
+            got = await ep.echo({b"k": [Mutation(M.SET_VALUE, b"a", b"b")]})
+            assert got == {b"k": [Mutation(M.SET_VALUE, b"a", b"b")]}
+            assert await ep.sync_echo(7) == 7
+            with pytest.raises(FdbError) as ei:
+                await ep.boom()
+            assert ei.value.code == 1007
+            with pytest.raises(FdbError):
+                await ep.no_such_method()
+            with pytest.raises(FdbError):
+                await client.endpoint(server.addr, "nope").echo(1)
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=30) == "ok"
+        finally:
+            server.close()
+            client.close()
+
+    def test_tlog_role_over_tcp(self):
+        """An unmodified runtime TLog serves push/peek/pop over TCP."""
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("tlog", TLog(loop))
+        ep = client.endpoint(server.addr, "tlog")
+
+        async def main():
+            await ep.push(0, 5, {1: [Mutation(M.SET_VALUE, b"k", b"v")]}, 0)
+            entries, end, _kc = await ep.peek(1, 1)
+            assert entries == [(5, [Mutation(M.SET_VALUE, b"k", b"v")])]
+            assert end == 5
+            await ep.pop(1, 5)
+            entries, _end, _kc = await ep.peek(1, 6)
+            assert entries == []
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=30) == "ok"
+        finally:
+            server.close()
+            client.close()
+
+
+SERVER_SCRIPT = textwrap.dedent("""
+    import sys
+    from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+    from foundationdb_tpu.runtime.tlog import TLog
+    loop = RealLoop()
+    t = NetTransport(loop)
+    t.serve("tlog", TLog(loop))
+    print(t.addr[1], flush=True)
+
+    async def forever():
+        while True:
+            await loop.sleep(3600)
+
+    loop.run(forever(), timeout=120)
+""")
+
+
+class TestCrossProcess:
+    def test_tlog_across_processes_and_peer_death(self):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd="/root/repo",
+        )
+        try:
+            port = int(proc.stdout.readline())
+            loop = RealLoop()
+            client = NetTransport(loop)
+            ep = client.endpoint(("127.0.0.1", port), "tlog")
+
+            async def main():
+                await ep.push(
+                    0, 3, {0: [Mutation(M.ADD, b"c", b"\x01" * 8)]}, 0
+                )
+                entries, end, _ = await ep.peek(0, 1)
+                assert end == 3 and entries[0][0] == 3
+                # Kill the server with an RPC parked server-side (a push
+                # with a chain gap waits for its predecessor forever):
+                # the dropped connection must break the pending future.
+                fut = ep.push(10, 11, {0: []}, 0)
+                await loop.sleep(0.2)  # ensure the request is parked remotely
+                proc.kill()
+                proc.wait()
+                with pytest.raises((BrokenPromise, FdbError)):
+                    await fut
+                return "ok"
+
+            assert loop.run(main(), timeout=60) == "ok"
+            client.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+PIPELINE_SERVER = textwrap.dedent("""
+    from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
+    from foundationdb_tpu.runtime.commit_proxy import CommitProxy
+    from foundationdb_tpu.runtime.grv_proxy import GrvProxy
+    from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+    from foundationdb_tpu.runtime.resolver import Resolver
+    from foundationdb_tpu.runtime.sequencer import Sequencer
+    from foundationdb_tpu.runtime.shardmap import KeyShardMap
+    from foundationdb_tpu.runtime.storage import StorageServer
+    from foundationdb_tpu.runtime.tlog import TLog
+
+    loop = RealLoop()
+    t = NetTransport(loop)
+    # Every role-to-role hop rides real TCP (self-endpoints through the
+    # listener), proving the sim-shaped call surface end to end.
+    t.serve("sequencer", Sequencer(loop))
+    t.serve("resolver0", Resolver(loop, CPUSkipListConflictSet()))
+    t.serve("tlog0", TLog(loop))
+    seq_ep = t.endpoint(t.addr, "sequencer")
+    res_ep = t.endpoint(t.addr, "resolver0")
+    tlog_ep = t.endpoint(t.addr, "tlog0")
+    ss = StorageServer(loop, tag=0, tlog_ep=tlog_ep)
+    t.serve("storage0", ss)
+    proxy = CommitProxy(loop, seq_ep, [res_ep], KeyShardMap([], tags=[0]),
+                        [tlog_ep], KeyShardMap([], tags=[0]))
+    grv = GrvProxy(loop, seq_ep)
+    t.serve("commit_proxy", proxy)
+    t.serve("grv_proxy", grv)
+    loop.spawn(proxy.run(), name="proxy.run")
+    loop.spawn(grv.run(), name="grv.run")
+    loop.spawn(ss.run(), name="ss.run")
+    print(t.addr[1], flush=True)
+
+    async def forever():
+        while True:
+            await loop.sleep(3600)
+
+    loop.run(forever(), timeout=120)
+""")
+
+
+class TestCrossProcessPipeline:
+    def test_full_commit_pipeline_over_tcp(self):
+        """GRV -> commit -> resolve -> tlog -> storage read, every hop over
+        real TCP against a separate server process running unmodified role
+        objects — the deployment mode the flow docstring promises."""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", PIPELINE_SERVER],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd="/root/repo",
+        )
+        try:
+            port = int(proc.stdout.readline())
+            loop = RealLoop()
+            client = NetTransport(loop)
+            addr = ("127.0.0.1", port)
+            grv = client.endpoint(addr, "grv_proxy")
+            proxy = client.endpoint(addr, "commit_proxy")
+            storage = client.endpoint(addr, "storage0")
+
+            from foundationdb_tpu.core.types import single_key_range
+            from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+
+            async def main():
+                rv = await grv.get_read_version()
+                res = await proxy.commit(CommitRequest(
+                    read_version=rv,
+                    mutations=[Mutation(M.SET_VALUE, b"apple", b"1")],
+                    write_ranges=[single_key_range(b"apple")],
+                ))
+                assert res.version > rv
+                rv2 = await grv.get_read_version()
+                assert rv2 >= res.version
+                got = await storage.get(b"apple", rv2)
+                assert got == b"1", got
+                # Read-write conflict at the stale snapshot crosses the wire
+                # with its reference error code.
+                with pytest.raises(FdbError) as ei:
+                    await proxy.commit(CommitRequest(
+                        read_version=rv,
+                        mutations=[Mutation(M.SET_VALUE, b"apple", b"2")],
+                        read_ranges=[single_key_range(b"apple")],
+                        write_ranges=[single_key_range(b"apple")],
+                    ))
+                assert ei.value.code == 1020  # not_committed
+                return "ok"
+
+            assert loop.run(main(), timeout=60) == "ok"
+            client.close()
+        finally:
+            proc.kill()
+            proc.wait()
