@@ -1,0 +1,131 @@
+"""ctypes surface over the native networked C client (native/netclient.cpp).
+
+The C library is the deliverable — a C program links it and talks to the
+cluster over TCP with no Python anywhere (the parity target is the
+reference's bindings/c/fdb_c.cpp network client). This wrapper exists so
+Python tests (and Python users who want the C data path) can drive it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import Mutation
+from foundationdb_tpu.core.types import KeyRange
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from foundationdb_tpu.native import load_library
+
+        lib = load_library("netclient")
+        lib.fnet_connect.restype = ctypes.c_void_p
+        lib.fnet_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.fnet_close.argtypes = [ctypes.c_void_p]
+        lib.fnet_get_read_version.restype = ctypes.c_int64
+        lib.fnet_get_read_version.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.fnet_commit.restype = ctypes.c_int64
+        lib.fnet_commit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, i32p, u8p, i64p, u8p, i64p,
+            ctypes.c_int32, u8p, i64p, u8p, i64p,
+            ctypes.c_int32, u8p, i64p, u8p, i64p,
+        ]
+        lib.fnet_get.restype = ctypes.c_int32
+        lib.fnet_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_int64,
+            ctypes.c_int64, u8p, ctypes.c_int64, i64p,
+        ]
+        _LIB = lib
+    return _LIB
+
+
+def _flat(blobs: list[bytes]):
+    """(data u8[], offsets i64[n+1]) ctypes views for a list of byte strings."""
+    offs = np.zeros(len(blobs) + 1, np.int64)
+    for i, b in enumerate(blobs):
+        offs[i + 1] = offs[i] + len(b)
+    data = np.frombuffer(b"".join(blobs), np.uint8) if blobs else np.zeros(1, np.uint8)
+    data = np.ascontiguousarray(data)
+    return (
+        data, offs,
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+
+
+class NetClient:
+    """One TCP connection to a cluster transport; blocking calls."""
+
+    def __init__(self, host: str, port: int,
+                 grv_service: bytes = b"grv_proxy",
+                 proxy_service: bytes = b"commit_proxy",
+                 storage_service: bytes = b"storage0"):
+        self._h = _lib().fnet_connect(host.encode(), port)
+        if not self._h:
+            raise ConnectionError(f"cannot connect to {host}:{port}")
+        self.grv_service = grv_service
+        self.proxy_service = proxy_service
+        self.storage_service = storage_service
+
+    def close(self) -> None:
+        if self._h:
+            _lib().fnet_close(self._h)
+            self._h = None
+
+    def get_read_version(self) -> int:
+        v = _lib().fnet_get_read_version(self._h, self.grv_service)
+        if v < 0:
+            raise FdbError(f"get_read_version failed", code=int(-v))
+        return int(v)
+
+    def commit(self, read_version: int, mutations: list[Mutation],
+               read_ranges: list[KeyRange] = (),
+               write_ranges: list[KeyRange] = ()) -> int:
+        mtypes = np.asarray([int(m.type) for m in mutations], np.int32)
+        if mtypes.size == 0:
+            mtypes = np.zeros(1, np.int32)
+        p1 = _flat([m.param1 for m in mutations])
+        p2 = _flat([m.param2 for m in mutations])
+        rb = _flat([r.begin for r in read_ranges])
+        re_ = _flat([r.end for r in read_ranges])
+        wb = _flat([r.begin for r in write_ranges])
+        we = _flat([r.end for r in write_ranges])
+        v = _lib().fnet_commit(
+            self._h, self.proxy_service, read_version,
+            len(mutations),
+            mtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            p1[2], p1[3], p2[2], p2[3],
+            len(read_ranges), rb[2], rb[3], re_[2], re_[3],
+            len(write_ranges), wb[2], wb[3], we[2], we[3],
+        )
+        if v < 0:
+            raise FdbError("commit failed", code=int(-v))
+        return int(v)
+
+    def get(self, key: bytes, version: int) -> bytes | None:
+        buf = np.zeros(1 << 20, np.uint8)
+        out_len = ctypes.c_int64(0)
+        rc = _lib().fnet_get(
+            self._h, self.storage_service,
+            np.frombuffer(key, np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)
+            ) if key else ctypes.cast(buf.ctypes.data, ctypes.POINTER(ctypes.c_uint8)),
+            len(key), version,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            buf.size, ctypes.byref(out_len),
+        )
+        if rc == 1:
+            return None
+        if rc < 0:
+            raise FdbError("get failed", code=int(-rc))
+        return bytes(buf[: out_len.value])

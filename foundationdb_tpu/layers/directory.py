@@ -349,14 +349,20 @@ class DirectoryLayer:
 
     async def _create_or_open(self, tr, path: tuple, layer: bytes,
                               prefix: bytes | None = None, *,
-                              allow_create: bool, allow_open: bool) -> DirectorySubspace:
+                              allow_create: bool, allow_open: bool,
+                              _resolved: tuple | None = None) -> DirectorySubspace:
         if not path:
             raise DirectoryError("the root directory cannot be opened")
-        owner, path, node = await self._find_owner(tr, path)
-        if owner is not self:
-            return await owner._create_or_open(
-                tr, path, layer, prefix,
-                allow_create=allow_create, allow_open=allow_open)
+        if _resolved is None:
+            owner, path, node = await self._find_owner(tr, path)
+            if owner is not self:
+                # Hand the already-resolved node down — no second walk.
+                return await owner._create_or_open(
+                    tr, path, layer, prefix,
+                    allow_create=allow_create, allow_open=allow_open,
+                    _resolved=(path, node))
+        else:
+            path, node = _resolved
         await self._check_version(tr, write=False)
         if node is not None:
             if not allow_open:
@@ -424,11 +430,14 @@ class DirectoryLayer:
                 return True
         return False
 
-    async def list(self, tr, path=()) -> list[str]:
+    async def list(self, tr, path=(), *, _resolved=None) -> list[str]:
         await self._check_version(tr, write=False)
-        owner, path, node = await self._find_owner(tr, _to_path(path))
-        if owner is not self:
-            return await owner.list(tr, path)
+        if _resolved is None:
+            owner, path, node = await self._find_owner(tr, _to_path(path))
+            if owner is not self:
+                return await owner.list(tr, path, _resolved=(path, node))
+        else:
+            path, node = _resolved
         if node is None:
             raise DirectoryDoesNotExist(f"{path!r} does not exist")
         if path and (await self._layer_of(tr, node)) == b"partition":
@@ -441,9 +450,7 @@ class DirectoryLayer:
 
     async def exists(self, tr, path) -> bool:
         await self._check_version(tr, write=False)
-        owner, path, node = await self._find_owner(tr, _to_path(path))
-        if owner is not self:
-            return await owner.exists(tr, path)
+        _owner, _path, node = await self._find_owner(tr, _to_path(path))
         return node is not None
 
     async def move(self, tr, old_path, new_path) -> DirectorySubspace:
@@ -472,16 +479,19 @@ class DirectoryLayer:
         tr.clear(old_parent.pack((_SUBDIRS, old_path[-1])))
         return self._contents(new_path, old_node, await self._layer_of(tr, old_node))
 
-    async def remove(self, tr, path) -> bool:
+    async def remove(self, tr, path, *, _resolved=None) -> bool:
         """Remove the directory, its contents, and all subdirectories.
         Returns False if it didn't exist (reference: remove_if_exists)."""
         await self._check_version(tr, write=True)
         path = _to_path(path)
         if not path:
             raise DirectoryError("the root directory cannot be removed")
-        owner, path, node = await self._find_owner(tr, path)
-        if owner is not self:
-            return await owner.remove(tr, path)
+        if _resolved is None:
+            owner, path, node = await self._find_owner(tr, path)
+            if owner is not self:
+                return await owner.remove(tr, path, _resolved=(path, node))
+        else:
+            path, node = _resolved
         if node is None:
             return False
         await self._remove_recursive(tr, node)

@@ -1,0 +1,320 @@
+// Networked C client: speaks the runtime's RPC wire protocol over TCP.
+//
+// The reference's C client (bindings/c/fdb_c.cpp) connects to the cluster
+// over the network and drives the full GRV/commit/read path; this is the
+// TPU-framework equivalent against runtime/net.py's transport. The frame
+// and tag formats mirror runtime/wire.py exactly (length-prefixed frames,
+// tagged values, registered message structs); FdbError crosses back as its
+// numeric code so C callers see the same retryable error space as Python
+// clients.
+//
+// Blocking, one-outstanding-request-per-connection by design: the C client
+// is a foreign-runtime guest without the flow loop; callers wanting
+// pipelining open more connections (exactly how fdb_c's network thread is
+// the concurrency boundary there).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+// wire.py tags
+constexpr uint8_t T_NONE = 0x00, T_TRUE = 0x01, T_FALSE = 0x02, T_INT = 0x03,
+                  T_BIGINT = 0x04, T_FLOAT = 0x05, T_BYTES = 0x06,
+                  T_STR = 0x07, T_LIST = 0x08, T_TUPLE = 0x09, T_DICT = 0x0A,
+                  T_STRUCT = 0x0B, T_ERROR = 0x0C;
+// wire.py struct registry ids
+constexpr uint16_t S_MUTATION = 1, S_KEYRANGE = 2, S_COMMIT_REQ = 5;
+
+constexpr int64_t ERR_INTERNAL = 1500;   // internal_error
+constexpr int64_t ERR_BROKEN = 1100;     // broken_promise (connection lost)
+
+struct Conn {
+  int fd = -1;
+  uint64_t next_id = 1;
+};
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void u8(uint8_t v) { d.push_back(v); }
+  void u16(uint16_t v) { put(&v, 2); }
+  void u32(uint32_t v) { put(&v, 4); }
+  void i64(int64_t v) { put(&v, 8); }
+  void put(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    d.insert(d.end(), b, b + n);
+  }
+  void tag_int(int64_t v) { u8(T_INT); i64(v); }
+  void tag_bool(bool v) { u8(v ? T_TRUE : T_FALSE); }
+  void tag_bytes(const uint8_t* p, int64_t n) {
+    u8(T_BYTES); u32(static_cast<uint32_t>(n)); put(p, n);
+  }
+  void tag_str(const char* s) {
+    size_t n = strlen(s);
+    u8(T_STR); u32(static_cast<uint32_t>(n)); put(s, n);
+  }
+  void seq_header(uint8_t tag, uint32_t count) { u8(tag); u32(count); }
+  void struct_header(uint16_t sid) { u8(T_STRUCT); u16(sid); }
+};
+
+// -- reply parsing -----------------------------------------------------------
+
+struct Cur {
+  const uint8_t* p;
+  size_t n, pos = 0;
+  bool ok = true;
+  bool need(size_t k) {
+    if (pos + k > n) { ok = false; return false; }
+    return true;
+  }
+  uint8_t u8() { if (!need(1)) return 0; return p[pos++]; }
+  uint16_t u16() { if (!need(2)) return 0; uint16_t v; memcpy(&v, p + pos, 2); pos += 2; return v; }
+  uint32_t u32() { if (!need(4)) return 0; uint32_t v; memcpy(&v, p + pos, 4); pos += 4; return v; }
+  int64_t i64() { if (!need(8)) return 0; int64_t v; memcpy(&v, p + pos, 8); pos += 8; return v; }
+};
+
+// Generic skip of one tagged value.
+bool skip_value(Cur& c) {
+  uint8_t t = c.u8();
+  if (!c.ok) return false;
+  switch (t) {
+    case T_NONE: case T_TRUE: case T_FALSE: return true;
+    case T_INT: case T_FLOAT: c.i64(); return c.ok;
+    case T_BIGINT: {
+      uint32_t n = c.u32();
+      if (!c.need(1 + n)) return false;
+      c.pos += 1 + n;
+      return true;
+    }
+    case T_BYTES: case T_STR: {
+      uint32_t n = c.u32();
+      if (!c.need(n)) return false;
+      c.pos += n;
+      return true;
+    }
+    case T_LIST: case T_TUPLE: {
+      uint32_t n = c.u32();
+      for (uint32_t i = 0; i < n && c.ok; i++) if (!skip_value(c)) return false;
+      return c.ok;
+    }
+    case T_DICT: {
+      uint32_t n = c.u32();
+      for (uint32_t i = 0; i < n && c.ok; i++) {
+        if (!skip_value(c) || !skip_value(c)) return false;
+      }
+      return c.ok;
+    }
+    case T_STRUCT: c.u16(); return skip_value(c);
+    case T_ERROR: {
+      c.u16();
+      uint32_t n = c.u32();
+      if (!c.need(n)) return false;
+      c.pos += n;
+      return true;
+    }
+    default: return false;
+  }
+}
+
+// -- socket IO ---------------------------------------------------------------
+
+bool write_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool read_all(int fd, uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+// One round trip: frame out, frame in. Returns the reply payload (the
+// value inside (RSP, msg_id, ok, value)) via `out`; on ok=false returns
+// the FdbError code as a negative number; 0 on success.
+int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
+                   Cur& value_cur) {
+  uint32_t len = static_cast<uint32_t>(req.d.size());
+  uint8_t hdr[4];
+  memcpy(hdr, &len, 4);
+  if (!write_all(c->fd, hdr, 4) || !write_all(c->fd, req.d.data(), len))
+    return -ERR_BROKEN;
+  if (!read_all(c->fd, hdr, 4)) return -ERR_BROKEN;
+  uint32_t rlen;
+  memcpy(&rlen, hdr, 4);
+  if (rlen > (64u << 20)) return -ERR_INTERNAL;
+  out.resize(rlen);
+  if (!read_all(c->fd, out.data(), rlen)) return -ERR_BROKEN;
+
+  Cur cur{out.data(), out.size()};
+  // (RSP=1, msg_id, ok, value) as a tuple
+  if (cur.u8() != T_TUPLE || cur.u32() != 4) return -ERR_INTERNAL;
+  if (cur.u8() != T_INT || cur.i64() != 1) return -ERR_INTERNAL;  // kind
+  if (!skip_value(cur)) return -ERR_INTERNAL;                     // msg_id
+  uint8_t okt = cur.u8();
+  if (okt == T_FALSE) {
+    // value is an FdbError (or anything): extract the code if possible.
+    if (cur.u8() == T_ERROR) {
+      uint16_t code = cur.u16();
+      return -static_cast<int64_t>(code ? code : ERR_INTERNAL);
+    }
+    return -ERR_INTERNAL;
+  }
+  if (okt != T_TRUE) return -ERR_INTERNAL;
+  value_cur = cur;  // positioned at the value
+  return 0;
+}
+
+void req_header(Buf& b, Conn* c, const char* service, const char* method,
+                uint32_t n_args) {
+  b.seq_header(T_TUPLE, 5);       // (REQ, msg_id, service, method, args)
+  b.tag_int(0);                   // kind = request
+  b.tag_int(static_cast<int64_t>(c->next_id++));
+  b.tag_str(service);
+  b.tag_str(method);
+  b.seq_header(T_LIST, n_args);
+}
+
+void pack_range(Buf& b, const uint8_t* begin, int64_t blen,
+                const uint8_t* end, int64_t elen) {
+  b.struct_header(S_KEYRANGE);
+  b.seq_header(T_TUPLE, 2);
+  b.tag_bytes(begin, blen);
+  b.tag_bytes(end, elen);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fnet_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Conn* c = new Conn();
+  c->fd = fd;
+  return c;
+}
+
+void fnet_close(void* h) {
+  Conn* c = static_cast<Conn*>(h);
+  if (!c) return;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+// >= 0: read version; < 0: -fdb_error_code
+int64_t fnet_get_read_version(void* h, const char* grv_service) {
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  req_header(b, c, grv_service, "get_read_version", 0);
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = round_trip(c, b, reply, v);
+  if (rc < 0) return rc;
+  if (v.u8() != T_INT) return -ERR_INTERNAL;
+  return v.i64();
+}
+
+// Commit a transaction. Mutations/ranges are flat arrays with offset
+// tables (offsets have n+1 entries; item i is bytes [off[i], off[i+1])).
+// >= 0: commit version; < 0: -fdb_error_code (e.g. -1020 not_committed).
+int64_t fnet_commit(
+    void* h, const char* proxy_service, int64_t read_version,
+    int32_t n_mutations, const int32_t* mtypes,
+    const uint8_t* p1, const int64_t* p1_off,
+    const uint8_t* p2, const int64_t* p2_off,
+    int32_t n_reads, const uint8_t* rb, const int64_t* rb_off,
+    const uint8_t* re, const int64_t* re_off,
+    int32_t n_writes, const uint8_t* wb, const int64_t* wb_off,
+    const uint8_t* we, const int64_t* we_off) {
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  req_header(b, c, proxy_service, "commit", 1);
+  b.struct_header(S_COMMIT_REQ);
+  b.seq_header(T_TUPLE, 5);
+  b.tag_int(read_version);
+  b.seq_header(T_LIST, static_cast<uint32_t>(n_mutations));
+  for (int32_t i = 0; i < n_mutations; i++) {
+    b.struct_header(S_MUTATION);
+    b.seq_header(T_TUPLE, 3);
+    b.tag_int(mtypes[i]);
+    b.tag_bytes(p1 + p1_off[i], p1_off[i + 1] - p1_off[i]);
+    b.tag_bytes(p2 + p2_off[i], p2_off[i + 1] - p2_off[i]);
+  }
+  b.seq_header(T_LIST, static_cast<uint32_t>(n_reads));
+  for (int32_t i = 0; i < n_reads; i++)
+    pack_range(b, rb + rb_off[i], rb_off[i + 1] - rb_off[i],
+               re + re_off[i], re_off[i + 1] - re_off[i]);
+  b.seq_header(T_LIST, static_cast<uint32_t>(n_writes));
+  for (int32_t i = 0; i < n_writes; i++)
+    pack_range(b, wb + wb_off[i], wb_off[i + 1] - wb_off[i],
+               we + we_off[i], we_off[i + 1] - we_off[i]);
+  b.tag_bool(false);  // report_conflicting_keys
+
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = round_trip(c, b, reply, v);
+  if (rc < 0) return rc;
+  // CommitResult struct: (version, batch_order)
+  if (v.u8() != T_STRUCT) return -ERR_INTERNAL;
+  v.u16();
+  if (v.u8() != T_TUPLE || v.u32() < 1) return -ERR_INTERNAL;
+  if (v.u8() != T_INT) return -ERR_INTERNAL;
+  return v.i64();
+}
+
+// Point read at a version. Returns 0 (found, *out_len set), 1 (no value),
+// or < 0: -fdb_error_code. out_cap too small -> -ERR_INTERNAL with
+// *out_len set to the required size.
+int32_t fnet_get(void* h, const char* storage_service, const uint8_t* key,
+                 int64_t key_len, int64_t version, uint8_t* out,
+                 int64_t out_cap, int64_t* out_len) {
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  req_header(b, c, storage_service, "get", 2);
+  b.tag_bytes(key, key_len);
+  b.tag_int(version);
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = round_trip(c, b, reply, v);
+  if (rc < 0) return static_cast<int32_t>(rc);
+  uint8_t t = v.u8();
+  if (t == T_NONE) return 1;
+  if (t != T_BYTES) return static_cast<int32_t>(-ERR_INTERNAL);
+  uint32_t n = v.u32();
+  *out_len = n;
+  if (!v.need(n) || static_cast<int64_t>(n) > out_cap)
+    return static_cast<int32_t>(-ERR_INTERNAL);
+  memcpy(out, v.p + v.pos, n);
+  return 0;
+}
+
+}  // extern "C"

@@ -241,6 +241,9 @@ class NetTransport:
             sock.connect(addr)
         except BlockingIOError:
             pass  # completes asynchronously; sends queue in wbuf meanwhile
+        except OSError:
+            sock.close()  # synchronous failure: don't leak the fd
+            raise
         conn = _Conn(self, sock)
         self._conns[addr] = conn
         self._all_conns.add(conn)
@@ -249,13 +252,14 @@ class NetTransport:
     def _call(self, addr: tuple, service: str, method: str, args: tuple) -> Future:
         p = Promise()
         try:
-            conn = self._connect(addr)
             self._next_id += 1
             msg_id = self._next_id
+            # Serialize BEFORE registering: a TypeError here must not leave
+            # a dead pending entry that only a disconnect would release.
+            frame = wire.dumps((_REQ, msg_id, service, method, list(args)))
+            conn = self._connect(addr)
             conn.pending[msg_id] = p
-            conn.send_frame(
-                wire.dumps((_REQ, msg_id, service, method, list(args)))
-            )
+            conn.send_frame(frame)
         except (OSError, BrokenPromise) as e:
             p.fail(BrokenPromise(f"connect to {addr} failed: {e}"))
         except TypeError as e:  # unserializable argument — not retryable
@@ -312,8 +316,7 @@ class NetTransport:
             reply(False, FdbError(f"{type(e).__name__}: {e}", code=1500))
             return
         if hasattr(res, "__await__") or isinstance(res, Future):
-            task = self.loop.spawn(res if isinstance(res, Future) else res,
-                                   name=f"rpc.{service}.{method}")
+            task = self.loop.spawn(res, name=f"rpc.{service}.{method}")
 
             def on_done(f: Future) -> None:
                 if f.is_error():

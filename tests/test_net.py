@@ -257,3 +257,54 @@ class TestCrossProcessPipeline:
         finally:
             proc.kill()
             proc.wait()
+
+
+class TestNativeCClient:
+    def test_c_client_full_path(self):
+        """The native C client (netclient.cpp) drives GRV/commit/read over
+        TCP against the cluster transport — the reference's fdb_c network
+        client parity path, no Python in the client data plane."""
+        from foundationdb_tpu.client.net_client import NetClient
+        from foundationdb_tpu.core.types import single_key_range
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", PIPELINE_SERVER],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd="/root/repo",
+        )
+        try:
+            port = int(proc.stdout.readline())
+            c = NetClient("127.0.0.1", port)
+            rv = c.get_read_version()
+            assert rv >= 0
+            cv = c.commit(
+                rv,
+                [Mutation(M.SET_VALUE, b"ckey", b"cvalue")],
+                write_ranges=[single_key_range(b"ckey")],
+            )
+            assert cv > rv
+            rv2 = c.get_read_version()
+            assert rv2 >= cv
+            assert c.get(b"ckey", rv2) == b"cvalue"
+            assert c.get(b"nokey", rv2) is None
+            # Conflict crosses the C ABI with the reference error code.
+            with pytest.raises(FdbError) as ei:
+                c.commit(
+                    rv,
+                    [Mutation(M.SET_VALUE, b"ckey", b"other")],
+                    read_ranges=[single_key_range(b"ckey")],
+                    write_ranges=[single_key_range(b"ckey")],
+                )
+            assert ei.value.code == 1020
+            # Atomic op through the C client.
+            cv2 = c.commit(
+                rv2,
+                [Mutation(M.ADD, b"ctr", (7).to_bytes(8, "little"))],
+                write_ranges=[single_key_range(b"ctr")],
+            )
+            rv3 = c.get_read_version()
+            assert int.from_bytes(c.get(b"ctr", rv3), "little") == 7
+            c.close()
+        finally:
+            proc.kill()
+            proc.wait()
