@@ -76,27 +76,65 @@ def log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 
-def init_backend(retries: int = 3, backoff_s: float = 10.0) -> tuple[str, str | None]:
+def init_backend(retries: int = 3, backoff_s: float = 10.0,
+                 probe_timeout_s: float = 180.0) -> tuple[str, str | None]:
     """Returns (platform, error_or_None). Tries the configured backend
-    (axon/TPU via env) with retries; on persistent failure drops the axon
-    PJRT factory and forces CPU so the bench still produces a number."""
+    (axon/TPU via env) with retries; on persistent failure OR HANG drops
+    the axon PJRT factory and forces CPU so the bench still produces a
+    number. The hang path matters: a wedged tunnel blocks jax.devices()
+    forever (no exception), which would otherwise hang the whole bench
+    with no JSON emitted."""
+    import threading
+
     import jax
 
     from foundationdb_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
 
+    def probe() -> tuple[str, str | None] | None:
+        """devices() in a daemon thread with a deadline; None on timeout."""
+        box: list = []
+
+        def target():
+            try:
+                jax.devices()
+                box.append((jax.default_backend(), None))
+            except Exception as e:  # noqa: BLE001
+                box.append((None, f"{type(e).__name__}: {e}"))
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        t.join(probe_timeout_s)
+        return box[0] if box else None
+
     err = None
     for attempt in range(retries):
-        try:
-            devs = jax.devices()
-            return jax.default_backend(), None
-        except Exception as e:  # backend init is exactly where round 1 died
-            err = f"{type(e).__name__}: {e}"
-            log(f"[init] backend attempt {attempt + 1}/{retries} failed: "
-                f"{err.splitlines()[0][:200]}")
-            if attempt + 1 < retries:
-                time.sleep(backoff_s)
+        got = probe()
+        if got is None:
+            # A hung tunnel will not un-hang on retry, and the stuck thread
+            # may hold jax's backend-init lock — an in-process CPU fallback
+            # could deadlock on it. Re-exec with the force-CPU flag (handled
+            # at the top of main before any backend init).
+            err = f"backend init hung for {probe_timeout_s:.0f}s"
+            log(f"[init] backend attempt {attempt + 1}/{retries}: {err}; "
+                "re-executing with FDB_TPU_FORCE_CPU=1")
+            import os
+
+            if os.environ.get("FDB_TPU_FORCE_CPU") != "1":
+                env = dict(os.environ, FDB_TPU_FORCE_CPU="1")
+                sys.stderr.flush()
+                sys.stdout.flush()
+                os.execve(sys.executable, [sys.executable] + sys.argv, env)
+            break
+        platform, perr = got
+        if platform is not None:
+            return platform, None
+        err = perr
+        log(f"[init] backend attempt {attempt + 1}/{retries} failed: "
+            f"{err.splitlines()[0][:200]}")
+        if attempt + 1 < retries:
+            time.sleep(backoff_s)
     log("[init] falling back to CPU backend")
     try:
         jax.config.update("jax_platforms", "cpu")
@@ -380,6 +418,22 @@ def run_cpu(batches, mode: ModeConfig = MODES["ycsb"]) -> tuple[float, int]:
 
 
 def main() -> None:
+    import os
+
+    if os.environ.get("FDB_TPU_FORCE_CPU") == "1":
+        # Set by the hang-recovery re-exec (init_backend): neutralize the
+        # tunneled backend BEFORE anything can touch it.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            import jax._src.xla_bridge as xb
+
+            xb._backend_factories.pop("axon", None)
+        except (ImportError, AttributeError):
+            pass
+        log("[init] FDB_TPU_FORCE_CPU=1: axon backend disabled, using CPU")
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--txns", type=int, default=1_000_000)
     ap.add_argument("--keys", type=int, default=1 << 16)
@@ -403,6 +457,32 @@ def main() -> None:
         "mode": args.mode,
         "resolvers": args.resolvers,
     }
+
+    # Whole-run watchdog: whatever hangs (a wedged remote-compile service,
+    # a stuck transfer), the driver still gets ONE parseable JSON line with
+    # everything measured so far (e.g. the CPU baseline).
+    import threading
+
+    deadline = float(os.environ.get("FDB_TPU_BENCH_DEADLINE_S", "2400"))
+    bench_done = threading.Event()
+
+    emit_lock = threading.Lock()
+
+    def watchdog():
+        if bench_done.wait(deadline):
+            return  # normal completion: main's finally printed the JSON
+        with emit_lock:
+            if bench_done.is_set():
+                return  # lost the race to the finally-path by a hair
+            result["error"] = (
+                f"bench watchdog fired after {deadline:.0f}s; "
+                + str(result.get("error", "likely hung on the TPU tunnel"))
+            )
+            result["valid"] = False
+            print(json.dumps(result), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
 
     try:
         window = max(1, args.window)
@@ -479,7 +559,9 @@ def main() -> None:
         log(tb)
         result["error"] = tb.splitlines()[-1][:500] if tb else "unknown"
     finally:
-        print(json.dumps(result), flush=True)
+        with emit_lock:  # exactly ONE JSON line prints, watchdog or us
+            bench_done.set()
+            print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

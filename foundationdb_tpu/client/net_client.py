@@ -113,19 +113,24 @@ class NetClient:
         return int(v)
 
     def get(self, key: bytes, version: int) -> bytes | None:
-        buf = np.zeros(1 << 20, np.uint8)
-        out_len = ctypes.c_int64(0)
-        rc = _lib().fnet_get(
-            self._h, self.storage_service,
-            np.frombuffer(key, np.uint8).ctypes.data_as(
-                ctypes.POINTER(ctypes.c_uint8)
-            ) if key else ctypes.cast(buf.ctypes.data, ctypes.POINTER(ctypes.c_uint8)),
-            len(key), version,
-            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            buf.size, ctypes.byref(out_len),
-        )
-        if rc == 1:
-            return None
-        if rc < 0:
+        cap = 1 << 20
+        for _attempt in range(2):
+            buf = np.zeros(cap, np.uint8)
+            out_len = ctypes.c_int64(0)
+            kbuf = np.frombuffer(key, np.uint8) if key else np.zeros(1, np.uint8)
+            rc = _lib().fnet_get(
+                self._h, self.storage_service,
+                kbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                len(key), version,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                buf.size, ctypes.byref(out_len),
+            )
+            if rc == 1:
+                return None
+            if rc == 0:
+                return bytes(buf[: out_len.value])
+            if rc == -1500 and cap < out_len.value <= (64 << 20):
+                cap = int(out_len.value)  # C layer reported the needed size
+                continue
             raise FdbError("get failed", code=int(-rc))
-        return bytes(buf[: out_len.value])
+        raise FdbError("get failed after resize", code=1500)

@@ -448,9 +448,15 @@ class DirectoryLayer:
         sub = node.subspace((_SUBDIRS,))
         return [sub.unpack(k)[0] for k, _ in await tr.get_range(begin, end)]
 
-    async def exists(self, tr, path) -> bool:
+    async def exists(self, tr, path, *, _resolved=None) -> bool:
         await self._check_version(tr, write=False)
-        _owner, _path, node = await self._find_owner(tr, _to_path(path))
+        if _resolved is None:
+            owner, path, node = await self._find_owner(tr, _to_path(path))
+            if owner is not self:
+                # Delegate so the partition's own version check still runs.
+                return await owner.exists(tr, path, _resolved=(path, node))
+        else:
+            _path, node = _resolved
         return node is not None
 
     async def move(self, tr, old_path, new_path) -> DirectorySubspace:

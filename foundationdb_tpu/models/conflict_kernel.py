@@ -151,42 +151,33 @@ def _overlap_rows(
     wb: jax.Array,
     we: jax.Array,
     write_live: jax.Array,
-    block: int = 512,
 ) -> jax.Array:
     """M rows [N, B] for a slice of reader txns vs ALL writer txns.
 
     rows_*: [N, R] rank-space read intervals; wb/we/write_live: [B, Q].
-    Blockwise over the row slice to bound the [block, R, B, Q] intermediate.
-    """
+    One fused [N, B] elementwise term per (read-slot, write-slot) pair —
+    no 4D intermediate, no serialized map: XLA fuses the R·Q compares into
+    a single memory-bound pass over the output matrix."""
     n, r = rows_rb.shape
-    b = wb.shape[0]
-    block = min(block, n)
-    n_blocks = -(-n // block)
-    pad = n_blocks * block - n
-    rb_p = jnp.pad(rows_rb, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
-    re_p = jnp.pad(rows_re, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
-    live_p = jnp.pad(rows_live, ((0, pad), (0, 0))).reshape(n_blocks, block, r)
-
-    def one_block(args):
-        brb, bre, blive = args  # [block, R]
-        # [block, R, 1, 1] vs [1, 1, B, Q]
-        o = (brb[:, :, None, None] < we[None, None]) & (
-            wb[None, None] < bre[:, :, None, None]
-        )
-        o = o & blive[:, :, None, None] & write_live[None, None]
-        return jnp.any(o, axis=(1, 3))  # [block, B]
-
-    m = jax.lax.map(one_block, (rb_p, re_p, live_p))
-    return m.reshape(n_blocks * block, b)[:n]
+    b, q = wb.shape
+    m = jnp.zeros((n, b), jnp.bool_)
+    for i in range(r):
+        rbi = rows_rb[:, i, None]
+        rei = rows_re[:, i, None]
+        livei = rows_live[:, i, None]
+        for j in range(q):
+            t = (rbi < we[None, :, j]) & (wb[None, :, j] < rei)
+            m = m | (t & livei & write_live[None, :, j])
+    return m
 
 
-def _pairwise_overlap(batch: BatchTensors, block: int = 512) -> jax.Array:
+def _pairwise_overlap(batch: BatchTensors) -> jax.Array:
     """M[i, j] (bool [B, B]): some read range of txn i overlaps some write
     range of txn j."""
     rb, re_, wb, we = _endpoint_ranks(batch)
     read_live = batch.read_mask & (rb < re_)  # [B, R]
     write_live = batch.write_mask & (wb < we)  # [B, Q]
-    return _overlap_rows(rb, re_, read_live, wb, we, write_live, block)
+    return _overlap_rows(rb, re_, read_live, wb, we, write_live)
 
 
 def _wave_accept(base: jax.Array, m: jax.Array) -> jax.Array:
@@ -255,9 +246,11 @@ def _paint_and_compact(
     two sorted sequences are then interleaved by rank arithmetic (the
     merge-path construction: each element's output slot is its own index
     plus its cross-rank in the other sequence, history winning ties), and
-    the surviving boundaries are compacted to the front with a prefix-sum
-    scatter. TPU sorts are the expensive primitive here — this removes both
-    full-history sorts the first version of this kernel did per batch."""
+    the surviving boundaries are compacted to the front by gathering the
+    j-th kept entry (binary search into the keep prefix-sum). Everything is
+    sorts-of-small + gathers: no full-history sort (the first version of
+    this kernel re-sorted all of C per batch) and no large scatters (XLA
+    TPU scatters serialize; gathers tile onto the VPU)."""
     c, w = state.keys.shape
     b, q, _ = batch.write_begin.shape
     e2 = b * q
@@ -286,28 +279,24 @@ def _paint_and_compact(
         new_keys, new_delta, new_oldv
     )
 
-    # Merge-path: output slot = own index + cross-rank. 'left' on the new
-    # side / 'right' on the history side puts history entries before equal
-    # new entries — a collision-free permutation of [0, n) even with
-    # duplicate keys on either side.
-    pos_h = jnp.arange(c, dtype=jnp.int32) + searchsorted_words(
-        snew, state.keys, side="left"
-    )
+    # Merge-path, scatter-free (TPU scatters serialize badly; gathers tile).
+    # pos_n[j] = output slot of sorted-new[j] = j + its cross-rank in the
+    # history ('right' side puts history entries before equal new entries —
+    # a collision-free permutation of [0, n) even with duplicate keys).
+    # Each output slot then derives its source by rank arithmetic: slot i
+    # holds new[k] iff pos_n[k] == i, else history[i - #new_slots_before_i].
     pos_n = jnp.arange(n2, dtype=jnp.int32) + searchsorted_words(
         state.keys, snew, side="right"
     )
+    idx = jnp.arange(n, dtype=jnp.int32)
+    cnt_le = jnp.searchsorted(pos_n, idx, side="right").astype(jnp.int32)
+    k_new = jnp.maximum(cnt_le - 1, 0)
+    from_new = (cnt_le > 0) & (pos_n[k_new] == idx)
+    hist_idx = jnp.clip(idx - cnt_le, 0, c - 1)  # exact for non-new slots
 
-    skeys = (
-        jnp.full((n, w), INT32_MAX, jnp.int32)
-        .at[pos_h].set(state.keys)
-        .at[pos_n].set(snew)
-    )
-    sdelta = jnp.zeros((n,), jnp.int32).at[pos_n].set(sdelta_new)
-    soldv = (
-        jnp.full((n,), NEG_VERSION, jnp.int32)
-        .at[pos_h].set(state.versions)
-        .at[pos_n].set(soldv_new)
-    )
+    skeys = jnp.where(from_new[:, None], snew[k_new], state.keys[hist_idx])
+    sdelta = jnp.where(from_new, sdelta_new[k_new], 0)
+    soldv = jnp.where(from_new, soldv_new[k_new], state.versions[hist_idx])
 
     covered = jnp.cumsum(sdelta) > 0
     is_inf = jnp.all(skeys == INT32_MAX, axis=-1)
@@ -334,17 +323,19 @@ def _paint_and_compact(
     first_live = jnp.argmax(~is_inf)  # index of smallest real key (= min key)
     keep = keep.at[first_live].set(True)
 
-    # Compact survivors to the front by prefix-sum scatter (no sort): each
-    # kept entry's destination is the count of kept entries before it.
-    dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    dest = jnp.where(keep, dest, n)  # dropped / out-of-capacity → oob
-    fkeys = (
-        jnp.full((c, w), INT32_MAX, jnp.int32)
-        .at[dest].set(skeys, mode="drop")
+    # Compact survivors to the front, gather-style: output slot j pulls the
+    # (j+1)-th kept entry (binary search into the keep prefix-sum) — the
+    # scatter-free dual of a prefix-sum scatter compaction.
+    keep_cum = jnp.cumsum(keep.astype(jnp.int32))  # [n], non-decreasing
+    n_used = keep_cum[-1]
+    out_j = jnp.arange(c, dtype=jnp.int32)
+    src = jnp.searchsorted(keep_cum, out_j + 1, side="left").astype(jnp.int32)
+    src = jnp.clip(src, 0, n - 1)
+    live_out = out_j < n_used
+    fkeys = jnp.where(
+        live_out[:, None], skeys[src], jnp.full((w,), INT32_MAX, jnp.int32)
     )
-    fv = jnp.full((c,), NEG_VERSION, jnp.int32).at[dest].set(newv, mode="drop")
-
-    n_used = jnp.sum(keep).astype(jnp.int32)
+    fv = jnp.where(live_out, newv[src], NEG_VERSION)
     overflow = state.overflow | (n_used > c)
     return ConflictState(
         keys=fkeys,

@@ -152,6 +152,7 @@ bool read_all(int fd, uint8_t* p, size_t n) {
 // the FdbError code as a negative number; 0 on success.
 int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
                    Cur& value_cur) {
+  if (c->fd < 0) return -ERR_BROKEN;
   uint32_t len = static_cast<uint32_t>(req.d.size());
   uint8_t hdr[4];
   memcpy(hdr, &len, 4);
@@ -160,7 +161,13 @@ int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
   if (!read_all(c->fd, hdr, 4)) return -ERR_BROKEN;
   uint32_t rlen;
   memcpy(&rlen, hdr, 4);
-  if (rlen > (64u << 20)) return -ERR_INTERNAL;
+  if (rlen > (64u << 20)) {
+    // Cannot resync without draining the oversized frame: break the conn
+    // so later calls fail cleanly instead of parsing stale payload bytes.
+    ::close(c->fd);
+    c->fd = -1;
+    return -ERR_BROKEN;
+  }
   out.resize(rlen);
   if (!read_all(c->fd, out.data(), rlen)) return -ERR_BROKEN;
 

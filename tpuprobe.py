@@ -1,0 +1,9 @@
+import time, sys
+from foundationdb_tpu.utils import enable_compilation_cache
+enable_compilation_cache()
+import jax, jax.numpy as jnp
+t0=time.perf_counter(); d = jax.devices(); print(f"devices {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+t0=time.perf_counter()
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((259,259)))
+float(x)
+print(f"compile+run: {time.perf_counter()-t0:.1f}s", file=sys.stderr)

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon tunnel every 5 min; log recovery.
+while true; do
+  S=$(date +%s)
+  timeout 300 python - <<'PYEOF' >> /root/repo/tpuwatch.log 2>&1
+import time, sys
+t0=time.perf_counter()
+import jax
+d = jax.devices()
+print(f"{time.strftime('%H:%M:%S')} devices ok in {time.perf_counter()-t0:.1f}s: {d}", flush=True)
+import jax.numpy as jnp
+import numpy as np
+t0=time.perf_counter()
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((int(np.random.randint(200,400)),)*2))
+float(x)
+print(f"{time.strftime('%H:%M:%S')} RECOVERED compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+PYEOF
+  if grep -q RECOVERED /root/repo/tpuwatch.log 2>/dev/null; then
+    echo "$(date +%H:%M:%S) tunnel healthy — watcher exiting" >> /root/repo/tpuwatch.log
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) probe failed (rc=$?)" >> /root/repo/tpuwatch.log
+  sleep 300
+done
