@@ -27,12 +27,15 @@ from dataclasses import dataclass, field
 from foundationdb_tpu.runtime.flow import all_of
 from foundationdb_tpu.sim.workloads import (
     AtomicOpsWorkload,
+    ChangeFeedWorkload,
     ConflictRangeWorkload,
     CycleWorkload,
     FaultInjector,
     MakoWorkload,
     RandomReadWriteWorkload,
     TPCCNewOrderWorkload,
+    VersionStampWorkload,
+    WatchesWorkload,
     WorkloadMetrics,
 )
 
@@ -68,6 +71,19 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "clientCount": "n_clients",
     }),
     "ConflictRange": (ConflictRangeWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "Watches": (WatchesWorkload, {
+        "keyCount": "n_keys",
+        "rounds": "n_rounds",
+    }),
+    "VersionStamp": (VersionStampWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "ChangeFeed": (ChangeFeedWorkload, {
+        "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
     }),

@@ -702,3 +702,232 @@ async def run_workload(cluster, db, workload: Workload,
             await cluster.loop.sleep(0.25)
     await workload.check(db)
     return workload.metrics
+
+
+class WatchesWorkload(Workload):
+    """Watch semantics under concurrent mutation (reference:
+    Watches.actor.cpp): watcher clients arm a watch on a key, mutator
+    clients change it, and every armed watch must FIRE (spurious fires are
+    legal; a hung watch is the bug). After each fire the watcher re-reads
+    and re-arms. Checks: every round completed, and the final value equals
+    the mutators' last write."""
+
+    name = "watches"
+
+    def __init__(self, seed: int = 0, n_keys: int = 4, n_rounds: int = 12):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_rounds = n_rounds
+
+    def _key(self, i: int) -> bytes:
+        return b"watch/%04d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_keys):
+                tr.set(self._key(i), b"init")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        fired = [0] * self.n_keys
+        done = [False] * self.n_keys
+
+        MAX_REARMS = 200  # bounded: a wedged cluster must FAIL, not hang
+
+        async def watcher(i: int):
+            try:
+                for _ in range(self.n_rounds):
+                    for attempt in range(MAX_REARMS):
+                        try:
+                            async def arm(tr):
+                                return await tr.watch(self._key(i))
+
+                            slot = await self._run_txn(db, arm)
+                            await slot
+                            break
+                        except FdbError as e:
+                            if not e.retryable:
+                                raise
+                            await cluster.loop.sleep(0.05)  # re-arm
+                    else:
+                        raise WorkloadFailed(
+                            f"watch {i}: {MAX_REARMS} re-arms exhausted"
+                        )
+                    fired[i] += 1
+                    self.metrics.ops += 1
+            finally:
+                done[i] = True  # success OR failure: release the mutator
+
+        async def mutator(i: int):
+            # Keep mutating until the watcher is satisfied: a watch armed
+            # just after our final write would otherwise hang forever.
+            r = 0
+            while not done[i]:
+                async def body(tr, r=r):
+                    tr.set(self._key(i), b"round/%05d" % r)
+
+                await self._run_txn(db, body)
+                r += 1
+                await cluster.loop.sleep(0.02)
+
+        await all_of(
+            [cluster.loop.spawn(watcher(i), name=f"watch.w{i}")
+             for i in range(self.n_keys)]
+            + [cluster.loop.spawn(mutator(i), name=f"watch.m{i}")
+               for i in range(self.n_keys)]
+        )
+        self.metrics.extra["fired"] = list(fired)
+        if any(f < self.n_rounds for f in fired):
+            raise WorkloadFailed(f"watches hung: fired={fired}")
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_keys):
+                v = await tr.get(self._key(i))
+                if v is None or not v.startswith(b"round/"):
+                    raise WorkloadFailed(f"watch key {i} lost: {v!r}")
+
+        await self._run_txn(db, body)
+
+
+class VersionStampWorkload(Workload):
+    """Versionstamped-key ordering (reference: VersionStamp.actor.cpp):
+    every txn appends via SET_VERSIONSTAMPED_KEY and records the stamp
+    get_versionstamp() reports. Check: the database holds exactly the
+    committed rows, under exactly the reported keys, and their key order
+    equals commit order (stamps are monotone in commit version)."""
+
+    name = "versionstamp"
+
+    def __init__(self, seed: int = 0, n_txns: int = 40, n_clients: int = 4):
+        super().__init__(seed)
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self._committed: list[tuple[bytes, bytes]] = []  # (stamp, payload)
+
+    async def run(self, db, cluster) -> None:
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for j in range(counts[cid]):
+                payload = b"c%02d-%04d" % (cid, j)
+
+                async def body(tr, payload=payload):
+                    key = b"vs/" + b"\x00" * 10 + struct.pack("<I", 3)
+                    tr.atomic_op(
+                        MutationType.SET_VERSIONSTAMPED_KEY, key, payload
+                    )
+                    return tr
+
+                tr = await self._run_txn(db, body)
+                self._committed.append((tr.get_versionstamp(), payload))
+                self.metrics.ops += 1
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"vs.client{i}")
+             for i in range(self.n_clients)]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            return await tr.get_range(b"vs/", b"vs0", limit=100_000)
+
+        rows = await self._run_txn(db, body)
+        expect = sorted(
+            (b"vs/" + stamp, payload) for stamp, payload in self._committed
+        )
+        if rows != expect:
+            raise WorkloadFailed(
+                f"versionstamp mismatch: {len(rows)} rows vs "
+                f"{len(expect)} committed"
+            )
+        # Stamps must be strictly monotone in commit order per client chain.
+        by_payload = {p: s for s, p in self._committed}
+        for cid in range(self.n_clients):
+            chain = [s for p, s in sorted(by_payload.items())
+                     if p.startswith(b"c%02d-" % cid)]
+            if chain != sorted(chain) or len(set(chain)) != len(chain):
+                raise WorkloadFailed("stamps not monotone within a client")
+
+
+class ChangeFeedWorkload(Workload):
+    """Change-feed correctness (reference: the change-feed variants of
+    fdbserver/workloads/): register a feed over the workload's range,
+    run concurrent writes, then REPLAY the feed in version order into a
+    model and require the model to equal the database's final state —
+    every committed mutation must appear exactly once, ordered."""
+
+    name = "changefeed"
+
+    def __init__(self, seed: int = 0, n_keys: int = 8, n_txns: int = 40,
+                 n_clients: int = 4):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+
+    def _key(self, i: int) -> bytes:
+        return b"cf/%04d" % i
+
+    async def setup(self, db) -> None:
+        # Register on every storage server: each captures its shard's
+        # slice of the range (clears are clipped server-side).
+        for i, ss in enumerate(db.cluster.storages):
+            ss.register_change_feed(b"wl-feed", b"cf/", b"cf0")
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for j in range(counts[cid]):
+                op = rng.random()
+                k = self._key(rng.randrange(self.n_keys))
+
+                async def body(tr, op=op, k=k, cid=cid, j=j):
+                    if op < 0.6:
+                        tr.set(k, b"v%02d-%04d" % (cid, j))
+                    elif op < 0.8:
+                        tr.atomic_op(
+                            MutationType.ADD, k, struct.pack("<q", 1)
+                        )
+                    else:
+                        tr.clear(k)
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"cf.client{i}")
+             for i in range(self.n_clients)]
+        )
+
+    async def check(self, db) -> None:
+        from foundationdb_tpu.core.mutations import Mutation
+
+        # Let storage pull loops drain fully.
+        await db.cluster.loop.sleep(0.5)
+        entries: list[tuple[int, Mutation]] = []
+        for ss in db.cluster.storages:
+            entries.extend(ss.read_change_feed(b"wl-feed", 0))
+        entries.sort(key=lambda e: e[0])
+        model: dict[bytes, bytes] = {}
+        for _v, m in entries:
+            if m.type == MutationType.SET_VALUE:
+                model[m.param1] = m.param2
+            elif m.type == MutationType.CLEAR_RANGE:
+                for k in [k for k in model if m.param1 <= k < m.param2]:
+                    del model[k]
+            else:
+                raise WorkloadFailed(f"feed leaked raw atomic op: {m!r}")
+
+        async def body(tr):
+            return await tr.get_range(b"cf/", b"cf0", limit=100_000)
+
+        rows = dict(await self._run_txn(db, body))
+        if model != rows:
+            raise WorkloadFailed(
+                f"feed replay diverged: model {len(model)} keys vs "
+                f"db {len(rows)} keys"
+            )
