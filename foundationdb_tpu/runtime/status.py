@@ -47,7 +47,7 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
     tlog_vers = [spawn(_safe(ep.get_version()), name="status.tlog") for ep in tlog_eps]
     storage_ms = [spawn(_safe(ep.metrics()), name="status.ss") for ep in storage_eps]
     rate_t = (
-        spawn(_safe(ratekeeper_ep.get_rate()), name="status.rk")
+        spawn(_safe(ratekeeper_ep.get_rates()), name="status.rk")
         if ratekeeper_ep is not None
         else None
     )
@@ -112,10 +112,12 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
     doc["qos"]["worst_storage_version_lag"] = max_lag
 
     if rate_t is not None:
-        rate = await rate_t
+        rates = await rate_t
         doc["qos"]["ratekeeper"] = {
-            "reachable": rate is not None,
-            "tps_limit": rate,
+            "reachable": rates is not None,
+            # Full multi-signal picture (reference status reports the
+            # limiting reason + both priority lanes' budgets).
+            **(rates or {}),
         }
 
     seq_ver = await seq_t

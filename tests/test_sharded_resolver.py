@@ -74,3 +74,34 @@ def test_sharded_equals_single_device():
             for _ in range(24)
         ]
         assert a.resolve(txns, cv) == b.resolve(txns, cv)
+
+
+def test_windowed_resolve_parity():
+    """resolve_wire_window (k batches per dispatch via lax.scan) must agree
+    with per-batch resolve_wire on BOTH engines — the window path is the
+    bench's production dispatch mode."""
+    from foundationdb_tpu.models.conflict_set import encode_resolve_batch
+
+    rng = np.random.default_rng(23)
+    kw = dict(capacity=512, batch_size=16, max_read_ranges=4,
+              max_write_ranges=4, max_key_bytes=8)
+    window = ShardedConflictSet(n_shards=4, **kw)
+    seq_single = TPUConflictSet(**kw)
+    seq_sharded = make_sharded(4, capacity=512, batch_size=16)
+
+    k, count = 4, 16
+    cvs = [10, 21, 35, 36]
+    batches = [
+        [rand_txn(rng, read_version=int(rng.integers(0, cv)), alphabet=64,
+                  max_len=3) for _ in range(count)]
+        for cv in cvs
+    ]
+    wire = b"".join(encode_resolve_batch(txns) for txns in batches)
+    got = window.resolve_wire_window(wire, cvs, count)
+    assert got.shape == (k, count)
+
+    for i, (cv, txns) in enumerate(zip(cvs, batches)):
+        expect_single = seq_single.resolve(txns, cv)
+        expect_sharded = seq_sharded.resolve(txns, cv)
+        assert [int(v) for v in got[i]] == [int(v) for v in expect_single]
+        assert expect_single == expect_sharded
