@@ -103,3 +103,47 @@ class ProcessKilled(FdbError):
 
 
 _RETRYABLE = {1001, 1007, 1009, 1020, 1021, 1211}
+
+
+def _code_registry() -> dict[int, type[FdbError]]:
+    """code → registered subclass, discovered from the class tree so new
+    error classes are picked up without a manual table. Classes that reuse
+    the base class's code (1500, internal_error — e.g. sim harness or layer
+    errors without their own reference code) are excluded: a generic
+    transport fault must never decode as one of them. For distinct codes
+    the first class encountered wins (codes are unique in practice)."""
+    reg: dict[int, type[FdbError]] = {FdbError.code: FdbError}
+    stack: list[type[FdbError]] = [FdbError]
+    while stack:
+        cls = stack.pop()
+        for sub in cls.__subclasses__():
+            if sub.code != FdbError.code:
+                reg.setdefault(sub.code, sub)
+            stack.append(sub)
+    return reg
+
+
+_CODE_TO_CLASS: dict[int, type[FdbError]] = _code_registry()
+
+
+def make_error(code: int, message: str = "") -> FdbError:
+    """Reconstruct the registered FdbError subclass for a numeric code.
+
+    The wire format carries only (code, message); client retry logic
+    dispatches on the *class* (e.g. WrongShardServer → refresh shard map),
+    so decode must restore subclass identity. Unknown codes fall back to
+    the base class with the code preserved.
+
+    Misses are NOT negative-cached (beyond the pinned 1500→FdbError entry
+    that covers generic transport faults): a subclass imported after the
+    first decode of its code must still be reconstructible later, so rare
+    unknown codes pay a class-tree rescan per decode instead of pinning a
+    stale base-class mapping forever.
+    """
+    cls = _CODE_TO_CLASS.get(code)
+    if cls is None:
+        _CODE_TO_CLASS.update(_code_registry())
+        cls = _CODE_TO_CLASS.get(code)
+    if cls is None or cls is FdbError:
+        return FdbError(message, code=code)
+    return cls(message)

@@ -144,6 +144,11 @@ def _endpoint_ranks(batch: BatchTensors) -> tuple[jax.Array, ...]:
     return rb, re_, wb, we
 
 
+# Above this many (read-slot × write-slot) pairs the unrolled overlap form
+# is replaced by one vectorized 4D reduce (compile time / program size cap).
+_OVERLAP_UNROLL_LIMIT = 64
+
+
 def _overlap_rows(
     rows_rb: jax.Array,
     rows_re: jax.Array,
@@ -157,9 +162,20 @@ def _overlap_rows(
     rows_*: [N, R] rank-space read intervals; wb/we/write_live: [B, Q].
     One fused [N, B] elementwise term per (read-slot, write-slot) pair —
     no 4D intermediate, no serialized map: XLA fuses the R·Q compares into
-    a single memory-bound pass over the output matrix."""
+    a single memory-bound pass over the output matrix.
+
+    Program size grows as R·Q under the unrolled form, so large range
+    limits (e.g. tpcc's 12×8) switch to a single vectorized 4D reduce:
+    one [N, R, B, Q] compare + any-reduce, constant program size at the
+    cost of a fusible 4D intermediate."""
     n, r = rows_rb.shape
     b, q = wb.shape
+    if r * q > _OVERLAP_UNROLL_LIMIT:
+        t = (rows_rb[:, :, None, None] < we[None, None, :, :]) & (
+            wb[None, None, :, :] < rows_re[:, :, None, None]
+        )
+        live = rows_live[:, :, None, None] & write_live[None, None, :, :]
+        return jnp.any(t & live, axis=(1, 3))
     m = jnp.zeros((n, b), jnp.bool_)
     for i in range(r):
         rbi = rows_rb[:, i, None]

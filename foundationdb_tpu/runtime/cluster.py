@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.flow import Loop, rpc
 
 
 class Heartbeat:
@@ -30,6 +30,7 @@ class Heartbeat:
     failure-detection delay, which is the failure signal (reference:
     failureDetectionServer / TransportData heartbeats)."""
 
+    @rpc
     async def ping(self) -> str:
         return "pong"
 
@@ -92,10 +93,12 @@ class ClusterController:
 
     # -- client face ----------------------------------------------------------
 
+    @rpc
     async def get_client_info(self) -> ClientDBInfo:
         g = self.generation
         return ClientDBInfo(g.epoch, tuple(g.grv_proxy_eps), tuple(g.commit_proxy_eps))
 
+    @rpc
     async def request_recovery(self, epoch: int, reason: str) -> None:
         """A role observed the transaction pipeline wedged (e.g. a version-
         chain gap after lost pushes) — something heartbeats cannot see, since
@@ -113,6 +116,7 @@ class ClusterController:
             name="cc.requested_recovery",
         )
 
+    @rpc
     async def get_status(self) -> dict:
         """Controller section of the status document (runtime/status.py)."""
         g = self.generation

@@ -26,7 +26,7 @@ from foundationdb_tpu.core.mutations import (
 )
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
 from foundationdb_tpu.runtime.backup import BACKUP_TAG
-from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of
+from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of, rpc
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 
 
@@ -86,14 +86,17 @@ class CommitProxy:
 
     # -- client face ----------------------------------------------------------
 
+    @rpc
     async def commit(self, req: CommitRequest) -> CommitResult:
         p = Promise()
         self._queue.append((req, p))
         return await p.future
 
+    @rpc
     async def set_backup_enabled(self, enabled: bool) -> None:
         self.backup_enabled = enabled
 
+    @rpc
     async def get_metrics(self) -> dict:
         """Status inputs (reference: commit proxy stats in status json)."""
         return {

@@ -30,7 +30,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from foundationdb_tpu.core.errors import FdbError
-from foundationdb_tpu.runtime.flow import Loop, all_of
+from foundationdb_tpu.runtime.flow import Loop, all_of, rpc
 
 
 class Deposed(FdbError):
@@ -52,6 +52,7 @@ class Coordinator:
         self.accepted_ballot: Ballot = ZERO_BALLOT
         self.accepted_value: dict | None = None
 
+    @rpc
     async def precommit(self, ballot: Ballot) -> tuple[bool, Ballot, dict | None]:
         ballot = tuple(ballot)
         if ballot > self.promised:
@@ -59,6 +60,7 @@ class Coordinator:
             return True, self.accepted_ballot, self.accepted_value
         return False, self.accepted_ballot, self.accepted_value
 
+    @rpc
     async def commit(self, ballot: Ballot, value: dict) -> bool:
         ballot = tuple(ballot)
         if ballot >= self.promised and ballot > self.accepted_ballot:
@@ -68,6 +70,7 @@ class Coordinator:
             return True
         return False
 
+    @rpc
     async def get_leader(self) -> dict | None:
         """Client bootstrap: this coordinator's view of the registry. Any
         single coordinator may be slightly stale; clients just need an

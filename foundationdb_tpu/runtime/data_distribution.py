@@ -28,7 +28,7 @@ mutation flow) goes through endpoints and is fault-injectable.
 from __future__ import annotations
 
 from foundationdb_tpu.core.types import KeyRange
-from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.flow import Loop, rpc
 
 MAX_MOVE_RETRIES = 3
 
@@ -49,6 +49,7 @@ class DataDistributor:
         self.move_failures = 0
         self._moving = False
 
+    @rpc
     async def get_metrics(self) -> dict:
         return {
             "splits": self.splits,

@@ -247,6 +247,18 @@ async def _await_future(f: Future):
 # -- combinators (reference: flow genericactors.actor.h) ----------------------
 
 
+def rpc(fn):
+    """Mark a role method as remotely callable over the real transport.
+
+    NetTransport.serve() exposes ONLY marked methods (or an explicit
+    allowlist); internal helpers and administrative mutators stay private
+    to the process. Defined here (not net.py) so role modules can import
+    it without touching socket code or wire's struct registry.
+    """
+    fn._rpc_exported = True
+    return fn
+
+
 def ready(value: Any = None) -> Future:
     f = Future()
     f._finish(_DONE, value)

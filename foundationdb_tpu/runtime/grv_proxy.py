@@ -13,7 +13,7 @@ admitted default-priority request.
 
 from __future__ import annotations
 
-from foundationdb_tpu.runtime.flow import Loop, Promise
+from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 
 PRIORITY_DEFAULT = "default"
 PRIORITY_BATCH = "batch"
@@ -37,11 +37,13 @@ class GrvProxy:
         self._batch_rate = unlimited
         self.grvs_served = 0
 
+    @rpc
     async def get_read_version(self, priority: str = PRIORITY_DEFAULT) -> int:
         p = Promise()
         (self._batch_queue if priority == PRIORITY_BATCH else self._queue).append(p)
         return await p.future
 
+    @rpc
     async def get_metrics(self) -> dict:
         """Status inputs (reference: GrvProxy metrics in status json)."""
         return {

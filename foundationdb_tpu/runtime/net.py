@@ -32,13 +32,29 @@ import time
 
 _SOFT_ERRNOS = (errno.EAGAIN, errno.EINPROGRESS, errno.ENOTCONN, errno.EALREADY)
 
-from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.errors import FdbError, TransactionTooLarge
 from foundationdb_tpu.runtime import wire
-from foundationdb_tpu.runtime.flow import BrokenPromise, Future, Loop, Promise
+from foundationdb_tpu.runtime.flow import (
+    BrokenPromise, Future, Loop, Promise, rpc,
+)
+
+__all__ = ["RealLoop", "NetTransport", "RemoteEndpoint", "rpc", "rpc_methods",
+           "MAX_FRAME"]
 
 _LEN = struct.Struct("<I")
 _REQ, _RSP = 0, 1
 MAX_FRAME = 64 << 20
+
+
+def rpc_methods(obj: object) -> frozenset[str]:
+    """The @rpc-marked method names of an object's class."""
+    cls = type(obj)
+    return frozenset(
+        name
+        for name in dir(cls)
+        if not name.startswith("_")
+        and getattr(getattr(cls, name, None), "_rpc_exported", False)
+    )
 
 
 class RealLoop(Loop):
@@ -129,6 +145,13 @@ class _Conn:
     def send_frame(self, payload: bytes) -> None:
         if self.closed:
             raise BrokenPromise("connection closed")
+        if len(payload) > MAX_FRAME:
+            # The receiver drops the whole connection on an oversized frame
+            # (failing every pending request); fail just this one instead,
+            # before any bytes hit the socket. Non-retryable.
+            raise TransactionTooLarge(
+                f"frame of {len(payload)} bytes exceeds {MAX_FRAME}"
+            )
         self.wbuf += _LEN.pack(len(payload)) + payload
         self._flush()
 
@@ -205,7 +228,7 @@ class NetTransport:
 
     def __init__(self, loop: RealLoop, host: str = "127.0.0.1", port: int = 0):
         self.loop = loop
-        self._services: dict[str, object] = {}
+        self._services: dict[str, tuple[object, frozenset[str]]] = {}
         self._conns: dict[tuple, _Conn] = {}  # outbound, by remote addr
         self._all_conns: set[_Conn] = set()
         self._next_id = 0
@@ -216,8 +239,21 @@ class NetTransport:
 
     # -- server side ------------------------------------------------------
 
-    def serve(self, name: str, obj: object) -> None:
-        self._services[name] = obj
+    def serve(self, name: str, obj: object,
+              methods: "frozenset[str] | set[str] | None" = None) -> None:
+        """Expose `obj` to TCP peers under `name`.
+
+        Only methods named in `methods` (or, by default, those marked with
+        the @rpc decorator on the class) are dispatchable — the rest of the
+        object surface stays private to the process.
+        """
+        allow = frozenset(methods) if methods is not None else rpc_methods(obj)
+        if not allow:
+            raise ValueError(
+                f"serve({name!r}): no @rpc-marked methods on "
+                f"{type(obj).__name__} and no explicit allowlist given"
+            )
+        self._services[name] = (obj, allow)
 
     def _accept(self, _sock) -> None:
         try:
@@ -259,11 +295,17 @@ class NetTransport:
             frame = wire.dumps((_REQ, msg_id, service, method, list(args)))
             conn = self._connect(addr)
             conn.pending[msg_id] = p
-            conn.send_frame(frame)
-        except (OSError, BrokenPromise) as e:
+            try:
+                conn.send_frame(frame)
+            except FdbError:
+                conn.pending.pop(msg_id, None)  # oversized frame: fail only us
+                raise
+        except OSError as e:
             p.fail(BrokenPromise(f"connect to {addr} failed: {e}"))
         except TypeError as e:  # unserializable argument — not retryable
             p.fail(FdbError(f"unserializable RPC argument: {e}", code=1500))
+        except FdbError as e:  # incl. BrokenPromise, oversized-frame
+            p.fail(e)
         return p.future
 
     # -- dispatch ---------------------------------------------------------
@@ -290,17 +332,21 @@ class NetTransport:
                 return
             try:
                 conn.send_frame(wire.dumps((_RSP, msg_id, ok, value)))
-            except (BrokenPromise, TypeError) as e:
-                if ok:  # unserializable result: report instead of vanishing
+            except (TypeError, FdbError) as e:  # FdbError incl. BrokenPromise
+                if ok:  # unserializable/oversized result: report, don't vanish
                     try:
                         conn.send_frame(wire.dumps(
                             (_RSP, msg_id, False, FdbError(str(e), code=1500))
                         ))
-                    except BrokenPromise:
+                    except FdbError:
                         pass
 
-        obj = self._services.get(service)
-        if obj is None or method.startswith("_"):
+        entry = self._services.get(service)
+        if entry is None:
+            reply(False, FdbError(f"no service {service}.{method}", code=1500))
+            return
+        obj, allow = entry
+        if method not in allow:
             reply(False, FdbError(f"no service {service}.{method}", code=1500))
             return
         try:

@@ -14,7 +14,7 @@ long before interactive traffic feels anything.
 
 from __future__ import annotations
 
-from foundationdb_tpu.runtime.flow import Loop, all_of
+from foundationdb_tpu.runtime.flow import Loop, all_of, rpc
 from foundationdb_tpu.runtime.sequencer import VERSIONS_PER_SECOND
 
 
@@ -98,10 +98,12 @@ class Ratekeeper:
             self.limiting_reason = reason
         return worst
 
+    @rpc
     async def get_rate(self) -> float:
         """GRV proxies poll this as their admission budget (txns/sec)."""
         return self.tps_limit
 
+    @rpc
     async def get_rates(self) -> dict:
         """Both lanes + the governing signal (status json reports these)."""
         return {

@@ -12,7 +12,7 @@ all exposing resolve(txns, commit_version, oldest_version) → verdicts.
 from __future__ import annotations
 
 from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
-from foundationdb_tpu.runtime.flow import Loop, Promise
+from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 
 
@@ -28,6 +28,7 @@ class Resolver:
         self.batches_resolved = 0
         self.txns_resolved = 0
 
+    @rpc
     async def resolve(
         self,
         prev_version: int,
@@ -65,6 +66,7 @@ class Resolver:
     def version(self) -> int:
         return self._version
 
+    @rpc
     async def get_metrics(self) -> dict:
         """Status inputs (reference: resolver stats in status json)."""
         return {

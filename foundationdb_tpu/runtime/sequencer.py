@@ -9,7 +9,7 @@ safely above everything the previous epoch could have committed.
 
 from __future__ import annotations
 
-from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.flow import Loop, rpc
 
 VERSIONS_PER_SECOND = 1_000_000
 EPOCH_VERSION_JUMP = 90 * VERSIONS_PER_SECOND  # reference: MAX_VERSIONS_IN_FLIGHT
@@ -32,6 +32,7 @@ class Sequencer:
         self._base_version = self._version
         self._epoch_start = loop.now
 
+    @rpc
     async def get_commit_version(self) -> tuple[int, int]:
         """→ (prev_version, version): one per proxy batch; strictly advancing,
         paced by virtual time so the version clock tracks ~1M/s."""
@@ -42,11 +43,13 @@ class Sequencer:
         self._version = max(prev + 1, clock)
         return prev, self._version
 
+    @rpc
     async def report_committed(self, version: int) -> None:
         """Commit proxies report fully-durable batch versions (reference:
         master's liveCommittedVersion updated via ReportRawCommittedVersion)."""
         self._committed = max(self._committed, version)
 
+    @rpc
     async def get_live_committed_version(self) -> int:
         """GRV proxies read this as the snapshot read version."""
         return self._committed

@@ -28,7 +28,7 @@ from foundationdb_tpu.core.errors import (
     WrongShardServer,
 )
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
-from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of
+from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 
 
@@ -468,6 +468,7 @@ class StorageServer:
         else:
             raise ValueError(f"storage cannot apply mutation {m.type!r}")
 
+    @rpc
     async def snapshot_range(
         self, begin: bytes, end: bytes
     ) -> tuple[int, list[tuple[bytes, bytes]]]:
@@ -480,6 +481,7 @@ class StorageServer:
                 rows.append((k, val))
         return v, rows
 
+    @rpc
     async def fetch_keys(self, begin: bytes, end: bytes, src_ep) -> int:
         """Destination side of a shard move: copy [begin, end) from `src_ep`.
 
@@ -609,6 +611,7 @@ class StorageServer:
                 f"shard acquired above read version {version}"
             )
 
+    @rpc
     async def shard_stats(self, begin: bytes, end: bytes) -> dict:
         """DataDistributor inputs: byte size + a median split key
         (reference: StorageMetrics / splitMetrics)."""
@@ -652,11 +655,13 @@ class StorageServer:
                     self._version_waiters.remove(entry)
                 raise FutureVersion(f"read at {version} > applied {self._version}")
 
+    @rpc
     async def get(self, key: bytes, version: int) -> bytes | None:
         await self._check_version(version)
         self._check_serving(key, key + b"\x00", version)
         return self.map.at(key, version)
 
+    @rpc
     async def get_range(
         self,
         begin: bytes,
@@ -679,6 +684,7 @@ class StorageServer:
                     break
         return out
 
+    @rpc
     async def wait_for_version(self, version: int) -> None:
         """Park until the pull loop has applied through `version`."""
         if version <= self._version:
@@ -687,6 +693,7 @@ class StorageServer:
         self._version_waiters.append((version, p))
         await p.future
 
+    @rpc
     async def watch(self, key: bytes, value: bytes | None) -> int:
         """Resolves (with the triggering version) once the key's value is
         observed ≠ `value` (reference: storage watch at the latest version).
@@ -721,6 +728,7 @@ class StorageServer:
             elif f.begin <= m.param1 < f.end:
                 f.add(version, m)
 
+    @rpc
     def register_change_feed(self, feed_id: bytes, begin: bytes, end: bytes) -> None:
         """Start retaining this range's mutations under `feed_id`. Re-registration
         with the same range is idempotent (reference: change feed registration
@@ -732,6 +740,7 @@ class StorageServer:
             return
         self._feeds[feed_id] = ChangeFeed(feed_id, begin, end)
 
+    @rpc
     def read_change_feed(
         self, feed_id: bytes, begin_version: int, end_version: int | None = None
     ) -> list[tuple[int, Mutation]]:
@@ -746,6 +755,7 @@ class StorageServer:
         hi = self._version + 1 if end_version is None else end_version
         return [e for e in f.entries if begin_version <= e[0] < hi]
 
+    @rpc
     async def wait_change_feed(self, feed_id: bytes, after_version: int) -> int:
         """Park until the feed holds a mutation above `after_version`;
         returns that mutation's version. Destroying OR stopping the feed
@@ -762,6 +772,7 @@ class StorageServer:
             f.waiters.append(p)
             await p.future
 
+    @rpc
     def pop_change_feed(self, feed_id: bytes, version: int) -> None:
         """Discard feed data below `version` (the reader has durably
         consumed it — the feed analogue of tlog pop)."""
@@ -769,6 +780,7 @@ class StorageServer:
         f.pop_version = max(f.pop_version, version)
         f.entries = [e for e in f.entries if e[0] >= f.pop_version]
 
+    @rpc
     def stop_change_feed(self, feed_id: bytes) -> None:
         """Stop capturing; retained entries stay readable until destroy.
         Parked waiters are failed — no future capture can ever wake them."""
@@ -778,6 +790,7 @@ class StorageServer:
         for p in waiters:
             p.fail(ChangeFeedCancelled(f"feed {feed_id!r} stopped"))
 
+    @rpc
     def destroy_change_feed(self, feed_id: bytes) -> None:
         f = self._feeds.pop(feed_id, None)
         if f is not None:
@@ -790,6 +803,7 @@ class StorageServer:
             raise ChangeFeedCancelled(f"no change feed {feed_id!r}")
         return f
 
+    @rpc
     async def metrics(self) -> dict:
         """Ratekeeper inputs (reference: StorageQueuingMetricsReply — the
         real ratekeeper smooths version lag, DURABILITY lag (applied but not

@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from foundationdb_tpu.core.mutations import Mutation
-from foundationdb_tpu.runtime.flow import Loop, Promise
+from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 
 
 @dataclass(frozen=True)
@@ -83,6 +83,7 @@ class TLog:
         # above it may be an unacked suffix recovery could roll back.
         self.known_committed = 0
 
+    @rpc
     async def push(
         self,
         prev_version: int,
@@ -124,6 +125,7 @@ class TLog:
             w.send(None)
         return version
 
+    @rpc
     async def peek(
         self, tag: int, begin_version: int, limit: int = 1000
     ) -> tuple[list[tuple[int, list[Mutation]]], int, int]:
@@ -143,6 +145,7 @@ class TLog:
                     return out, out[-1][0], self.known_committed
         return out, self._version, self.known_committed
 
+    @rpc
     async def pop(self, tag: int, version: int) -> None:
         """Storage server `tag` is durable through `version`; trim entries
         every live tag has popped past. A tag that has pushed entries but
@@ -168,6 +171,7 @@ class TLog:
                 # suffix a restart still needs — rewrite the file to it.
                 self.disk.rewrite([(e.version, e.tagged) for e in self._log])
 
+    @rpc
     async def lock(self) -> int:
         """Recovery: refuse further pushes; → end version (reference:
         TLogLockResult.end)."""
@@ -178,9 +182,11 @@ class TLog:
         self._waiters.clear()
         return self._version
 
+    @rpc
     async def get_version(self) -> int:
         return self._version
 
+    @rpc
     async def metrics(self) -> dict:
         """Ratekeeper inputs (reference: TLogQueuingMetricsReply — queue
         bytes is the un-popped suffix some storage server still needs)."""
@@ -190,6 +196,7 @@ class TLog:
             "queue_entries": len(self._log),
         }
 
+    @rpc
     async def retire_tag(self, tag: int) -> None:
         """Forget a tag that will never pull again (backup stopped): its
         last pop would otherwise pin the trim floor forever. Persistent —
@@ -200,10 +207,12 @@ class TLog:
         self._popped.pop(tag, None)
         self._trim()
 
+    @rpc
     async def register_tag(self, tag: int) -> None:
         """Un-retire a tag (a NEW backup starting after a stopped one)."""
         self._retired.discard(tag)
 
+    @rpc
     async def recover_entries(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
         """Recovery salvage: the un-popped suffix of the log — everything
         some storage server may not have applied yet (valid once locked)."""

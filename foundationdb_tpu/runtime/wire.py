@@ -17,7 +17,7 @@ from __future__ import annotations
 import struct
 from typing import Any, Callable
 
-from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.errors import FdbError, make_error
 
 _T_NONE = 0x00
 _T_TRUE = 0x01
@@ -168,7 +168,11 @@ def unpack_obj(buf: bytes | memoryview, pos: int = 0) -> tuple[Any, int]:
         code = _u16.unpack_from(buf, pos)[0]
         n = _u32.unpack_from(buf, pos + 2)[0]
         msg = bytes(buf[pos + 6 : pos + 6 + n]).decode("utf-8")
-        return FdbError(msg, code=code), pos + 6 + n
+        # Reconstruct the registered subclass: client retry logic dispatches
+        # on class (WrongShardServer → shard-map refresh, ProcessKilled →
+        # cluster refresh), so decoding to the base class would silently
+        # change retry behavior between sim and TCP transports.
+        return make_error(code, msg), pos + 6 + n
     raise ValueError(f"unknown wire tag {tag:#x}")
 
 

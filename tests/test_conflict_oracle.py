@@ -144,3 +144,27 @@ def test_commit_version_must_advance():
     cs.resolve([], 10)
     with pytest.raises(ValueError):
         cs.resolve([], 10)
+
+
+def test_wide_range_limits_match_oracle():
+    """R*Q above _OVERLAP_UNROLL_LIMIT (tpcc-scale 12x8=96) switches
+    _overlap_rows to the vectorized 4D reduce — verdicts must be identical
+    to the oracle (and hence to the unrolled form)."""
+    from foundationdb_tpu.models import conflict_kernel as ck
+
+    assert 12 * 8 > ck._OVERLAP_UNROLL_LIMIT  # the fallback is actually hit
+    rng = np.random.default_rng(11)
+    cs = TPUConflictSet(capacity=512, batch_size=16, max_read_ranges=12,
+                        max_write_ranges=8, max_key_bytes=8)
+    oracle = OracleConflictSet()
+    cv = 500
+    for batch_i in range(6):
+        cv += int(rng.integers(1, 30))
+        txns = [
+            rand_txn(rng, read_version=int(rng.integers(max(0, cv - 100), cv)),
+                     n_ranges=10)
+            for _ in range(int(rng.integers(1, 16)))
+        ]
+        got = cs.resolve(txns, cv)
+        want = oracle.resolve(txns, cv)
+        assert got == want, f"batch {batch_i}: {got} != {want}"

@@ -18,7 +18,7 @@ from foundationdb_tpu.core.mutations import Mutation, MutationType as M
 from foundationdb_tpu.core.types import KeyRange, Verdict
 from foundationdb_tpu.runtime import wire
 from foundationdb_tpu.runtime.flow import BrokenPromise
-from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+from foundationdb_tpu.runtime.net import MAX_FRAME, NetTransport, RealLoop, rpc
 from foundationdb_tpu.runtime.tlog import TLog
 
 
@@ -49,14 +49,20 @@ class TestWireFormat:
 
 
 class Echo:
+    @rpc
     async def echo(self, x):
         return x
 
+    @rpc
     def sync_echo(self, x):  # non-async methods also serve
         return x
 
+    @rpc
     async def boom(self):
         raise FdbError("nope", code=1007)
+
+    def not_exported(self):  # unmarked: must be invisible to peers
+        return "secret"
 
 
 class TestInProcessTcp:
@@ -78,6 +84,106 @@ class TestInProcessTcp:
                 await ep.no_such_method()
             with pytest.raises(FdbError):
                 await client.endpoint(server.addr, "nope").echo(1)
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=30) == "ok"
+        finally:
+            server.close()
+            client.close()
+
+    def test_unexported_method_denied(self):
+        """Unmarked methods are invisible to TCP peers (advisor r2: the whole
+        object surface must not be dispatchable)."""
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("echo", Echo())
+        ep = client.endpoint(server.addr, "echo")
+
+        async def main():
+            with pytest.raises(FdbError) as ei:
+                await ep.not_exported()
+            assert "no service" in str(ei.value)
+            # Explicit allowlist narrows further: only `echo` is reachable.
+            server.serve("narrow", Echo(), methods={"echo"})
+            nep = client.endpoint(server.addr, "narrow")
+            assert await nep.echo(1) == 1
+            with pytest.raises(FdbError):
+                await nep.sync_echo(1)
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=30) == "ok"
+        finally:
+            server.close()
+            client.close()
+
+    def test_serve_requires_marked_surface(self):
+        loop = RealLoop()
+        server = NetTransport(loop)
+        try:
+            with pytest.raises(ValueError):
+                server.serve("bare", object())
+        finally:
+            server.close()
+
+    def test_error_subclass_crosses_wire(self):
+        """T_ERROR decodes to the registered subclass so class-dispatching
+        retry logic (WrongShardServer → shard-map refresh) behaves the same
+        over TCP as in the sim (advisor r2, medium)."""
+        from foundationdb_tpu.core.errors import (
+            CommitUnknownResult, NotCommitted, TransactionTooOld,
+            WrongShardServer,
+        )
+
+        for err in [WrongShardServer("moved"), NotCommitted(),
+                    TransactionTooOld("old"), CommitUnknownResult()]:
+            back = wire.loads(wire.dumps(err))
+            assert type(back) is type(err), (err, back)
+            assert back.code == err.code
+        # Unknown codes still round-trip as the base class.
+        back = wire.loads(wire.dumps(FdbError("custom", code=4321)))
+        assert type(back) is FdbError and back.code == 4321
+
+        class Thrower:
+            @rpc
+            async def moved(self):
+                raise WrongShardServer("not mine")
+
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("t", Thrower())
+        ep = client.endpoint(server.addr, "t")
+
+        async def main():
+            with pytest.raises(WrongShardServer):
+                await ep.moved()
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=30) == "ok"
+        finally:
+            server.close()
+            client.close()
+
+    def test_oversized_request_fails_only_itself(self):
+        """A frame over MAX_FRAME fails its own future with a non-retryable
+        error and leaves the connection (and other in-flight RPCs) alive."""
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("echo", Echo())
+        ep = client.endpoint(server.addr, "echo")
+
+        async def main():
+            big = b"\x00" * (MAX_FRAME + 1)
+            with pytest.raises(FdbError) as ei:
+                await ep.echo(big)
+            assert not ei.value.retryable
+            # The connection survived: a normal RPC still works.
+            assert await ep.sync_echo(42) == 42
             return "ok"
 
         try:
