@@ -1,0 +1,227 @@
+r"""Interactive cluster shell: the `fdbcli` analogue.
+
+Reference: fdbcli/fdbcli.actor.cpp — a line-oriented shell over the client
+library: reads route to storage shards at a GRV snapshot, writes go through
+a full client transaction (grab GRV → commit via a commit proxy), `status`
+aggregates role metrics. Like fdbcli, mutations require `writemode on`
+first.
+
+    python -m foundationdb_tpu.cli --cluster cluster.json
+    python -m foundationdb_tpu.cli --cluster cluster.json \
+        --exec 'writemode on; set hello world; get hello; status'
+
+Key/value literals support fdbcli-style \xNN escapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import shlex
+import sys
+
+from foundationdb_tpu.client.ryw import Database, RYWTransaction
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+from foundationdb_tpu.runtime.shardmap import KeyShardMap
+from foundationdb_tpu.server import load_spec, parse_addr
+
+
+def open_cluster(spec_path: str):
+    """Connect to a deployed cluster: returns (loop, transport, db)."""
+    spec = load_spec(spec_path)
+    loop = RealLoop()
+    t = NetTransport(loop)
+
+    def eps(role: str, service: str | None = None):
+        return [t.endpoint(parse_addr(a), service or role)
+                for a in spec[role]]
+
+    db = Database(
+        loop,
+        [t.endpoint(parse_addr(a), "grv_proxy") for a in spec["proxy"]],
+        [t.endpoint(parse_addr(a), "commit_proxy") for a in spec["proxy"]],
+        KeyShardMap.uniform(len(spec["storage"])),
+        eps("storage"),
+    )
+    db.transaction_class = RYWTransaction
+    return loop, t, db
+
+
+def unescape(s: str) -> bytes:
+    """fdbcli-style literals: printable chars plus \\xNN escapes."""
+    out = bytearray()
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 3 < len(s) and s[i + 1] == "x":
+            out.append(int(s[i + 2 : i + 4], 16))
+            i += 4
+        else:
+            out += s[i].encode("utf-8")
+            i += 1
+    return bytes(out)
+
+
+def escape(b: bytes) -> str:
+    return "".join(
+        chr(c) if 32 <= c < 127 and c != 0x5C else f"\\x{c:02x}" for c in b
+    )
+
+
+HELP = """\
+get KEY                 read a key at a fresh snapshot
+getrange BEGIN END [N]  read up to N (default 25) pairs in [BEGIN, END)
+set KEY VALUE           write a key (requires `writemode on`)
+clear KEY               clear a key (requires `writemode on`)
+clearrange BEGIN END    clear a range (requires `writemode on`)
+writemode on|off        allow/forbid mutations (fdbcli semantics)
+status                  cluster role metrics (JSON)
+help                    this text
+exit / quit             leave"""
+
+
+class Shell:
+    def __init__(self, spec_path: str):
+        self.spec = load_spec(spec_path)
+        self.loop, self.t, self.db = open_cluster(spec_path)
+        self.writemode = False
+
+    def run_cmd(self, line: str) -> str | None:
+        """Execute one command line; returns output text (None = exit)."""
+        try:
+            parts = shlex.split(line, posix=True)
+        except ValueError as e:
+            return f"ERROR: {e}"
+        if not parts:
+            return ""
+        cmd, *args = parts
+        cmd = cmd.lower()
+        try:
+            return self._dispatch(cmd, args)
+        except FdbError as e:
+            return f"ERROR: {e} ({e.code})"
+        except (TimeoutError, OSError) as e:
+            return f"ERROR: {type(e).__name__}: {e}"
+
+    def _await(self, coro, timeout: float = 15.0):
+        return self.loop.run(coro, timeout=timeout)
+
+    def _dispatch(self, cmd: str, args: list[str]) -> str | None:
+        if cmd in ("exit", "quit"):
+            return None
+        if cmd == "help":
+            return HELP
+        if cmd == "writemode":
+            if args not in (["on"], ["off"]):
+                return "usage: writemode on|off"
+            self.writemode = args == ["on"]
+            return ""
+        if cmd == "get":
+            (key,) = args
+            async def go():
+                return await self.db.transaction().get(unescape(key))
+            v = self._await(go())
+            return (f"`{key}' is `{escape(v)}'" if v is not None
+                    else f"`{key}': not found")
+        if cmd == "getrange":
+            begin, end = args[0], args[1]
+            limit = int(args[2]) if len(args) > 2 else 25
+            async def go():
+                return await self.db.transaction().get_range(
+                    unescape(begin), unescape(end), limit=limit
+                )
+            rows = self._await(go())
+            return "\n".join(
+                f"`{escape(k)}' is `{escape(v)}'" for k, v in rows
+            ) or "(empty)"
+        if cmd in ("set", "clear", "clearrange"):
+            if not self.writemode:
+                return ("ERROR: writemode must be enabled to set or clear "
+                        "keys in the database (2112)")
+            async def go():
+                tr = self.db.transaction()
+                if cmd == "set":
+                    tr.set(unescape(args[0]), unescape(args[1]))
+                elif cmd == "clear":
+                    tr.clear(unescape(args[0]))
+                else:
+                    tr.clear_range(unescape(args[0]), unescape(args[1]))
+                await tr.commit()
+            self._await(go())
+            return "Committed"
+        if cmd == "status":
+            return json.dumps(self._status(), indent=1, sort_keys=True)
+        return f"ERROR: unknown command `{cmd}' (try help)"
+
+    def _status(self) -> dict:
+        """Aggregate role metrics over their TCP endpoints (the deployed-
+        cluster slice of runtime/status.py's \\xff\\xff/status/json)."""
+        out: dict = {"roles": {}}
+
+        def probe(role: str, service: str, method: str):
+            for i, addr in enumerate(self.spec.get(role) or []):
+                ep = self.t.endpoint(parse_addr(addr), service)
+                name = f"{role}{i}"
+                try:
+                    out["roles"][name] = self._await(
+                        getattr(ep, method)(), timeout=5.0
+                    )
+                except (FdbError, TimeoutError) as e:
+                    out["roles"][name] = {"unreachable": str(e)}
+
+        probe("sequencer", "sequencer", "get_live_committed_version")
+        probe("proxy", "commit_proxy", "get_metrics")
+        probe("proxy", "grv_proxy", "get_metrics")
+        probe("tlog", "tlog", "metrics")
+        probe("storage", "storage", "metrics")
+        probe("resolver", "resolver", "get_metrics")
+        probe("ratekeeper", "ratekeeper", "get_rates")
+        return out
+
+    def close(self) -> None:
+        self.t.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.cli",
+        description="Cluster shell (fdbcli analogue).",
+    )
+    ap.add_argument("--cluster", required=True)
+    ap.add_argument("--exec", dest="exec_cmds", default=None,
+                    help="semicolon-separated commands; exit after running")
+    args = ap.parse_args(argv)
+
+    sh = Shell(args.cluster)
+    try:
+        if args.exec_cmds is not None:
+            rc = 0
+            for line in re.split(r";\s*", args.exec_cmds):
+                if not line.strip():
+                    continue
+                out = sh.run_cmd(line)
+                if out is None:
+                    break
+                if out:
+                    print(out, flush=True)
+                if out.startswith("ERROR"):
+                    rc = 1
+            return rc
+        print("fdb-tpu cli — `help' for commands", flush=True)
+        while True:
+            try:
+                line = input("fdb> ")
+            except EOFError:
+                return 0
+            out = sh.run_cmd(line)
+            if out is None:
+                return 0
+            if out:
+                print(out, flush=True)
+    finally:
+        sh.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

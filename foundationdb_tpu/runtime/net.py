@@ -213,8 +213,9 @@ class RemoteEndpoint:
         if method.startswith("_"):
             raise AttributeError(method)
 
-        def call(*args) -> Future:
-            return self._t._call(self._addr, self._service, method, args)
+        def call(*args, **kwargs) -> Future:
+            return self._t._call(self._addr, self._service, method, args,
+                                 kwargs)
 
         call.__name__ = method
         return call
@@ -285,14 +286,18 @@ class NetTransport:
         self._all_conns.add(conn)
         return conn
 
-    def _call(self, addr: tuple, service: str, method: str, args: tuple) -> Future:
+    def _call(self, addr: tuple, service: str, method: str, args: tuple,
+              kwargs: dict | None = None) -> Future:
         p = Promise()
         try:
             self._next_id += 1
             msg_id = self._next_id
             # Serialize BEFORE registering: a TypeError here must not leave
             # a dead pending entry that only a disconnect would release.
-            frame = wire.dumps((_REQ, msg_id, service, method, list(args)))
+            # Kwargs ride as a trailing element; peers without them (the C
+            # client) send the 5-element form, which _dispatch also accepts.
+            msg = (_REQ, msg_id, service, method, list(args))
+            frame = wire.dumps(msg + (kwargs,) if kwargs else msg)
             conn = self._connect(addr)
             conn.pending[msg_id] = p
             try:
@@ -313,8 +318,9 @@ class NetTransport:
     def _on_frame(self, conn: _Conn, frame: bytes) -> None:
         kind, msg_id, *rest = wire.loads(frame)
         if kind == _REQ:
-            service, method, args = rest
-            self._dispatch(conn, msg_id, service, method, args)
+            service, method, args = rest[:3]
+            kwargs = rest[3] if len(rest) > 3 else None
+            self._dispatch(conn, msg_id, service, method, args, kwargs)
         else:
             ok, value = rest
             p = conn.pending.pop(msg_id, None)
@@ -326,7 +332,7 @@ class NetTransport:
                 p.fail(value if isinstance(value, FdbError) else FdbError(str(value)))
 
     def _dispatch(self, conn: _Conn, msg_id: int, service: str, method: str,
-                  args: list) -> None:
+                  args: list, kwargs: dict | None = None) -> None:
         def reply(ok: bool, value) -> None:
             if conn.closed:
                 return
@@ -351,7 +357,7 @@ class NetTransport:
             return
         try:
             fn = getattr(obj, method)
-            res = fn(*args)
+            res = fn(*args, **(kwargs or {}))
         except AttributeError:
             reply(False, FdbError(f"no method {service}.{method}", code=1500))
             return

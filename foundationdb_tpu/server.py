@@ -1,0 +1,267 @@
+"""Deployable server: launch cluster roles as OS processes over real TCP.
+
+The reference's `fdbserver` binary (fdbserver/fdbserver.actor.cpp) runs any
+role (or several) in one process, wired together by the cluster file. This
+is that entry point for the TPU framework: the SAME role objects the sim
+drives (SURVEY §2) served over runtime/net.py's transport.
+
+    python -m foundationdb_tpu.server --cluster cluster.json --role storage --index 0
+
+Cluster spec (the cluster-file analogue) is a JSON file every process and
+client reads:
+
+    {
+      "sequencer": ["127.0.0.1:4500"],
+      "resolver":  ["127.0.0.1:4510"],
+      "tlog":      ["127.0.0.1:4540", "127.0.0.1:4541"],
+      "storage":   ["127.0.0.1:4550", "127.0.0.1:4551"],
+      "proxy":     ["127.0.0.1:4520", "127.0.0.1:4521"],
+      "ratekeeper": [],
+      "engine": "cpu"
+    }
+
+Wiring is static from the spec (v1: no recruitment over TCP — the sim owns
+failure/recovery testing; this is the deployment data plane):
+
+- `proxy` is the stateless class: each proxy process hosts a CommitProxy
+  AND a GrvProxy (reference: stateless fdbserver class), plus a ReadRouter
+  that forwards get/get_range/watch to the owning storage shard so
+  single-connection clients (the native C client) need only one address.
+- storage[i] has tag i and pulls from tlog[i % n_tlogs]; commit proxies
+  push every batch to every tlog (replicated logs, as the sim does).
+- shard maps are derived deterministically from the spec
+  (KeyShardMap.uniform over the storage/resolver counts), so every process
+  and client agrees without a metadata service.
+
+Service names are unindexed ("sequencer", "tlog", ...): the address
+already identifies the instance. The ReadRouter is also served under the
+alias "storage0" for the C client's default service naming.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from foundationdb_tpu.runtime.flow import ActorCancelled, rpc
+from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+from foundationdb_tpu.runtime.shardmap import KeyShardMap
+
+ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy", "ratekeeper")
+
+
+def load_spec(path: str) -> dict:
+    with open(path) as f:
+        spec = json.load(f)
+    for role in ("sequencer", "resolver", "tlog", "storage", "proxy"):
+        if not spec.get(role):
+            raise ValueError(f"cluster spec missing role {role!r}")
+    return spec
+
+
+def parse_addr(s: str) -> tuple[str, int]:
+    host, port = s.rsplit(":", 1)
+    return host, int(port)
+
+
+def make_conflict_set(engine: str):
+    """Resolver engine: 'tpu' is the production kernel; 'cpu' (C++ skiplist)
+    keeps a cluster deployable on hosts with no accelerator."""
+    if engine == "tpu":
+        from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+        return TPUConflictSet()
+    if engine == "cpu":
+        from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
+
+        return CPUSkipListConflictSet()
+    if engine == "oracle":
+        from foundationdb_tpu.sim.oracle import OracleConflictSet
+
+        return OracleConflictSet()
+    raise ValueError(f"unknown engine {engine!r}")
+
+
+class ReadRouter:
+    """Client-facing read surface on proxy processes: forwards reads to the
+    owning storage shard. Lets one-connection clients (netclient.cpp) drive
+    the full path without per-shard connections; richer clients (cli.py,
+    client/transaction.py) talk to storage endpoints directly."""
+
+    def __init__(self, storage_map: KeyShardMap, storage_eps: list):
+        self.map = storage_map
+        self.eps = storage_eps
+
+    def _ep(self, key: bytes):
+        return self.eps[self.map.tag_for_key(key)]
+
+    @rpc
+    async def get(self, key: bytes, version: int):
+        return await self._ep(key).get(key, version)
+
+    @rpc
+    async def get_range(self, begin: bytes, end: bytes, version: int,
+                        limit: int = 10_000, reverse: bool = False):
+        rows: list = []
+        shards = [
+            s for s in self.map.shards
+            if s.range.begin < end and begin < s.range.end
+        ]
+        for s in (reversed(shards) if reverse else shards):
+            lo = max(begin, s.range.begin)
+            hi = min(end, s.range.end)
+            got = await self.eps[s.tag].get_range(
+                lo, hi, version, limit=limit, reverse=reverse
+            )
+            rows.extend(got)
+            if len(rows) >= limit:
+                return rows[:limit]
+        return rows
+
+    @rpc
+    async def watch(self, key: bytes, value):
+        return await self._ep(key).watch(key, value)
+
+    @rpc
+    async def wait_for_version(self, version: int) -> None:
+        for ep in self.eps:
+            await ep.wait_for_version(version)
+
+
+def _supervise(loop: RealLoop, name: str, make_coro):
+    """Run a role actor forever, restarting on failure (a peer that is not
+    up yet surfaces as BrokenPromise; deployment boots in any order)."""
+
+    async def runner():
+        while True:
+            try:
+                await make_coro()
+                return
+            except ActorCancelled:
+                raise
+            except Exception as e:  # noqa: BLE001 — supervisor boundary
+                print(f"[{name}] actor failed: {type(e).__name__}: {e}; "
+                      "restarting in 0.5s", file=sys.stderr, flush=True)
+                await loop.sleep(0.5)
+
+    loop.spawn(runner(), name=f"supervise.{name}")
+
+
+def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
+               index: int, data_dir: str | None) -> None:
+    """Construct and serve one role instance on transport `t`."""
+    seq_addr = parse_addr(spec["sequencer"][0])
+    n_storages = len(spec["storage"])
+    n_tlogs = len(spec["tlog"])
+    resolver_map = KeyShardMap.uniform(len(spec["resolver"]))
+    storage_map = KeyShardMap.uniform(n_storages)
+
+    def eps(role_name: str, service: str | None = None):
+        service = service or role_name
+        return [t.endpoint(parse_addr(a), service) for a in spec[role_name]]
+
+    if role == "sequencer":
+        from foundationdb_tpu.runtime.sequencer import Sequencer
+
+        t.serve("sequencer", Sequencer(loop))
+    elif role == "resolver":
+        from foundationdb_tpu.runtime.resolver import Resolver
+
+        engine = spec.get("engine", "cpu")
+        t.serve("resolver", Resolver(loop, make_conflict_set(engine)))
+    elif role == "tlog":
+        from foundationdb_tpu.runtime.tlog import TLog
+
+        disk = (os.path.join(data_dir, f"tlog{index}.q")
+                if data_dir else None)
+        t.serve("tlog", TLog(loop, disk_path=disk))
+    elif role == "storage":
+        from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
+        from foundationdb_tpu.runtime.storage import StorageServer
+
+        tlog_eps = eps("tlog")
+        kv = (KeyValueStoreSQLite(
+                  os.path.join(data_dir, f"storage{index}.db"))
+              if data_dir else None)
+        ss = StorageServer(
+            loop, tag=index, tlog_ep=tlog_eps[index % n_tlogs],
+            tlog_replicas=tlog_eps, kvstore=kv,
+        )
+        t.serve("storage", ss)
+        _supervise(loop, f"storage{index}.run", ss.run)
+    elif role == "proxy":
+        from foundationdb_tpu.runtime.commit_proxy import CommitProxy
+        from foundationdb_tpu.runtime.grv_proxy import GrvProxy
+
+        seq_ep = t.endpoint(seq_addr, "sequencer")
+        rk = spec.get("ratekeeper") or []
+        rk_ep = t.endpoint(parse_addr(rk[0]), "ratekeeper") if rk else None
+        proxy = CommitProxy(
+            loop, seq_ep, eps("resolver"), resolver_map,
+            eps("tlog"), storage_map,
+        )
+        grv = GrvProxy(loop, seq_ep, rk_ep)
+        router = ReadRouter(storage_map, eps("storage"))
+        t.serve("commit_proxy", proxy)
+        t.serve("grv_proxy", grv)
+        t.serve("read_router", router)
+        t.serve("storage0", router)  # C client default service name
+        _supervise(loop, f"proxy{index}.run", proxy.run)
+        _supervise(loop, f"grv{index}.run", grv.run)
+    elif role == "ratekeeper":
+        from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
+
+        rk = Ratekeeper(loop, eps("storage"), eps("tlog"))
+        t.serve("ratekeeper", rk)
+        _supervise(loop, "ratekeeper.run", rk.run)
+    else:
+        raise ValueError(f"unknown role {role!r}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.server",
+        description="Serve cluster roles over TCP (fdbserver analogue).",
+    )
+    ap.add_argument("--cluster", required=True, help="cluster spec JSON path")
+    ap.add_argument("--role", required=True, choices=ROLES)
+    ap.add_argument("--index", type=int, default=0,
+                    help="which address of the role's list is mine")
+    ap.add_argument("--data-dir", default=None,
+                    help="durable state directory (tlog disk queue, "
+                         "storage sqlite); default: memory only")
+    args = ap.parse_args(argv)
+
+    spec = load_spec(args.cluster)
+    addrs = spec.get(args.role) or []
+    if not 0 <= args.index < len(addrs):
+        raise SystemExit(
+            f"--index {args.index} out of range for role {args.role} "
+            f"({len(addrs)} addresses in spec)"
+        )
+    host, port = parse_addr(addrs[args.index])
+    if args.data_dir:
+        os.makedirs(args.data_dir, exist_ok=True)
+
+    loop = RealLoop()
+    t = NetTransport(loop, host=host, port=port)
+    build_role(loop, t, spec, args.role, args.index, args.data_dir)
+    print(f"ready {args.role}{args.index} on {t.addr[0]}:{t.addr[1]}",
+          flush=True)
+
+    async def forever():
+        while True:
+            await loop.sleep(3600)
+
+    try:
+        loop.run(forever(), timeout=float("inf"))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        t.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Boot a local multi-process cluster (the VERDICT r2 "deployable cluster"
+# shape: 1 sequencer, 1 resolver, 2 tlogs, 2 storages, 2 proxies) and wait
+# until the cli can commit against it.
+#
+#   scripts/start_cluster.sh [CLUSTER_DIR]
+#
+# Writes CLUSTER_DIR/cluster.json (default /tmp/fdb_tpu_cluster), launches
+# the role processes, and leaves them running; pids in CLUSTER_DIR/pids.
+# Stop with: kill $(cat CLUSTER_DIR/pids)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR="${1:-/tmp/fdb_tpu_cluster}"
+BASE_PORT="${FDB_TPU_BASE_PORT:-4500}"
+mkdir -p "$DIR"
+SPEC="$DIR/cluster.json"
+
+python - "$SPEC" "$BASE_PORT" <<'EOF'
+import json, sys
+spec_path, base = sys.argv[1], int(sys.argv[2])
+ports = iter(range(base, base + 32))
+spec = {
+    "sequencer": [f"127.0.0.1:{next(ports)}"],
+    "resolver": [f"127.0.0.1:{next(ports)}"],
+    "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+    "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+    "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+    "ratekeeper": [],
+    "engine": "cpu",
+}
+json.dump(spec, open(spec_path, "w"), indent=1)
+print(spec_path)
+EOF
+
+: > "$DIR/pids"
+launch() { # role index
+  JAX_PLATFORMS=cpu python -m foundationdb_tpu.server \
+    --cluster "$SPEC" --role "$1" --index "$2" \
+    >> "$DIR/$1$2.log" 2>&1 &
+  echo $! >> "$DIR/pids"
+}
+
+launch sequencer 0
+launch resolver 0
+launch tlog 0
+launch tlog 1
+launch storage 0
+launch storage 1
+launch proxy 0
+launch proxy 1
+
+# Wait until a client transaction commits end to end.
+for i in $(seq 1 30); do
+  if JAX_PLATFORMS=cpu python -m foundationdb_tpu.cli --cluster "$SPEC" \
+      --exec 'writemode on; set __boot__ ok; get __boot__' 2>/dev/null \
+      | grep -q "is .ok"; then
+    echo "cluster up: $SPEC"
+    exit 0
+  fi
+  sleep 1
+done
+echo "cluster failed to come up; logs in $DIR" >&2
+exit 1

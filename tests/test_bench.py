@@ -57,7 +57,7 @@ def test_bench_stream_three_way_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches
     )
-    _, tpu_conf, overflowed = bench.run_tpu_wire(
+    _, tpu_conf, overflowed, tpu_lat = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1
     )
     assert not overflowed
@@ -66,7 +66,7 @@ def test_bench_stream_three_way_parity():
     cpu_batches = bench.marshal_cpu_batches(
         n_batches, read_ids, write_ids, write_mask, lag
     )
-    _, cpu_conf = bench.run_cpu(cpu_batches)
+    _, cpu_conf, _cpu_lat = bench.run_cpu(cpu_batches)
 
     # Oracle on the same stream.
     oracle = OracleConflictSet()
@@ -98,14 +98,14 @@ def test_mode_streams_three_way_parity():
         assert blob[: int(ends[mode.batch])].tobytes() == \
             encode_resolve_batch(txns), mode_name
 
-        _, tpu_conf, overflow = bench.run_tpu_wire(
+        _, tpu_conf, overflow, _lat = bench.run_tpu_wire(
             n_batches, 1 << 14, blob, ends, repeats=1, mode=mode
         )
         assert not overflow
         cpu_batches = bench.marshal_cpu_batches(
             n_batches, read_ids, write_ids, write_mask, lag, mode
         )
-        _, cpu_conf = bench.run_cpu(cpu_batches, mode)
+        _, cpu_conf, _cpu_lat = bench.run_cpu(cpu_batches, mode)
         oracle = OracleConflictSet()
         got = oracle.resolve(txns, 1, 0)
         oracle_conf = sum(1 for v in got if v.name == "CONFLICT")
@@ -124,10 +124,40 @@ def test_sharded_resolver_mode_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, conf1, _ = bench.run_tpu_wire(
+    _, conf1, _, _l1 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=1
     )
-    _, conf4, _ = bench.run_tpu_wire(
+    _, conf4, _, _l4 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=4
     )
     assert conf1 == conf4
+
+
+def test_latency_and_roofline_fields():
+    """run_tpu_wire/run_cpu report per-dispatch latencies and
+    roofline_estimate yields finite, positive bounds for every mode."""
+    mode = bench.MODES["ycsb"]
+    n_batches = 2
+    n = n_batches * mode.batch
+    read_ids, write_ids, write_mask, lag = bench.gen_workload(
+        n, 512, seed=23, mode=mode
+    )
+    blob, ends = bench.build_wire_stream(
+        read_ids, write_ids, write_mask, lag, n_batches, mode
+    )
+    _, _, _, lat = bench.run_tpu_wire(
+        n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, window=1
+    )
+    assert len(lat) == n_batches and all(v > 0 for v in lat)
+    cpu_batches = bench.marshal_cpu_batches(
+        n_batches, read_ids, write_ids, write_mask, lag, mode
+    )
+    _, _, cpu_lat = bench.run_cpu(cpu_batches, mode)
+    assert len(cpu_lat) == n_batches and all(v > 0 for v in cpu_lat)
+    for m in bench.MODES.values():
+        r = bench.roofline_estimate(m, 1 << 18)
+        assert r["bound"] in ("vpu", "mxu", "hbm")
+        assert r["projected_peak_txns_per_sec"] > 0
+        assert all(r[k] > 0 for k in
+                   ("int_ops_per_batch", "mxu_flops_per_batch",
+                    "bytes_per_batch"))

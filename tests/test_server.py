@@ -1,0 +1,201 @@
+"""Deployable cluster e2e: OS-process roles over real TCP + cli + C client.
+
+The VERDICT r2 "ship a deployable cluster" milestone: boots the
+fdbserver-analogue (`python -m foundationdb_tpu.server`) as separate OS
+processes per role, then drives it three ways — the Python client library,
+the cli (fdbcli analogue), and the native C client (netclient.cpp) — all
+against the same running cluster. Reference shape:
+fdbserver/fdbserver.actor.cpp + fdbcli/fdbcli.actor.cpp.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """1 sequencer, 1 resolver, 2 tlogs, 2 storages, 2 proxies — each an
+    OS process; yields the spec path."""
+    tmp = tmp_path_factory.mktemp("cluster")
+    ports = iter(free_ports(8))
+    spec = {
+        "sequencer": [f"127.0.0.1:{next(ports)}"],
+        "resolver": [f"127.0.0.1:{next(ports)}"],
+        "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "ratekeeper": [],
+        "engine": "cpu",
+    }
+    spec_path = tmp / "cluster.json"
+    spec_path.write_text(json.dumps(spec))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        for role, addrs in spec.items():
+            if role in ("engine",):
+                continue
+            for i in range(len(addrs)):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "foundationdb_tpu.server",
+                     "--cluster", str(spec_path), "--role", role,
+                     "--index", str(i)],
+                    cwd=REPO, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                ))
+        # Readiness: every process prints "ready ..." once listening.
+        deadline = time.monotonic() + 30
+        for p in procs:
+            line = p.stdout.readline()
+            assert "ready" in line, line
+            assert time.monotonic() < deadline, "cluster boot timed out"
+        yield str(spec_path)
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait()
+
+
+def run_cli(spec_path: str, cmds: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.cli",
+         "--cluster", spec_path, "--exec", cmds],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+class TestDeployedCluster:
+    def test_python_client_commit_read(self, cluster):
+        """The client library commits and reads against OS-process roles."""
+        from foundationdb_tpu.cli import open_cluster
+
+        loop, t, db = open_cluster(cluster)
+        try:
+            async def main():
+                tr = db.transaction()
+                tr.set(b"deploy/k1", b"v1")
+                tr.set(b"\x90spans-shard2", b"v2")  # second storage shard
+                await tr.commit()
+                tr2 = db.transaction()
+                assert await tr2.get(b"deploy/k1") == b"v1"
+                assert await tr2.get(b"\x90spans-shard2") == b"v2"
+                rows = await tr2.get_range(b"deploy/", b"deploy0")
+                assert (b"deploy/k1", b"v1") in rows
+                return "ok"
+
+            assert loop.run(main(), timeout=60) == "ok"
+        finally:
+            t.close()
+
+    def test_conflict_detected_across_processes(self, cluster):
+        from foundationdb_tpu.cli import open_cluster
+        from foundationdb_tpu.core.errors import NotCommitted
+
+        loop, t, db = open_cluster(cluster)
+        try:
+            async def main():
+                tr1 = db.transaction()
+                tr2 = db.transaction()
+                await tr1.get(b"conf/k")
+                await tr2.get(b"conf/k")
+                tr1.set(b"conf/k", b"a")
+                tr2.set(b"conf/k", b"b")
+                await tr1.commit()
+                with pytest.raises(NotCommitted):
+                    await tr2.commit()
+                return "ok"
+
+            assert loop.run(main(), timeout=60) == "ok"
+        finally:
+            t.close()
+
+    def test_cli_roundtrip_and_writemode(self, cluster):
+        r = run_cli(cluster, "set nope x")
+        assert "writemode must be enabled" in r.stdout and r.returncode == 1
+        r = run_cli(
+            cluster,
+            "writemode on; set cli/key cli-val; get cli/key; "
+            "getrange cli/ cli0; clear cli/key; get cli/key",
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "`cli/key' is `cli-val'" in r.stdout
+        assert "not found" in r.stdout  # after the clear
+
+    def test_cli_status(self, cluster):
+        r = run_cli(cluster, "status")
+        assert r.returncode == 0, r.stdout + r.stderr
+        status = json.loads(r.stdout)
+        roles = status["roles"]
+        for want in ("sequencer0", "proxy0", "proxy1", "tlog0", "tlog1",
+                     "storage0", "storage1", "resolver0"):
+            assert want in roles, sorted(roles)
+            assert "unreachable" not in str(roles[want]), roles[want]
+
+    def test_c_client_against_deployed_cluster(self, cluster):
+        """The native C client commits through a proxy process's gateway
+        surface (grv_proxy + commit_proxy + read router) — the VERDICT r2
+        'C client commits against it' criterion."""
+        from foundationdb_tpu.client.net_client import NetClient
+        from foundationdb_tpu.core.errors import FdbError
+        from foundationdb_tpu.core.mutations import Mutation, MutationType as M
+        from foundationdb_tpu.core.types import single_key_range
+
+        spec = json.loads(open(cluster).read())
+        host, port = spec["proxy"][0].rsplit(":", 1)
+        c = NetClient(host, int(port))
+        try:
+            rv = c.get_read_version()
+            cv = c.commit(
+                rv,
+                [Mutation(M.SET_VALUE, b"c/deployed", b"yes")],
+                write_ranges=[single_key_range(b"c/deployed")],
+            )
+            assert cv > rv
+            rv2 = c.get_read_version()
+            assert c.get(b"c/deployed", rv2) == b"yes"
+            # Keys on the second shard route through the read router too.
+            cv2 = c.commit(
+                rv2,
+                [Mutation(M.SET_VALUE, b"\xa0far-shard", b"routed")],
+                write_ranges=[single_key_range(b"\xa0far-shard")],
+            )
+            rv3 = c.get_read_version()
+            assert rv3 >= cv2
+            assert c.get(b"\xa0far-shard", rv3) == b"routed"
+            with pytest.raises(FdbError) as ei:
+                c.commit(
+                    rv,
+                    [Mutation(M.SET_VALUE, b"c/deployed", b"no")],
+                    read_ranges=[single_key_range(b"c/deployed")],
+                    write_ranges=[single_key_range(b"c/deployed")],
+                )
+            assert ei.value.code == 1020
+        finally:
+            c.close()
