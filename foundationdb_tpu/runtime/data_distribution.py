@@ -47,6 +47,7 @@ class DataDistributor:
         self.merges = 0
         self.moves = 0
         self.move_failures = 0
+        self.repairs = 0
         self._moving = False
 
     @rpc
@@ -56,6 +57,7 @@ class DataDistributor:
             "merges": self.merges,
             "moves": self.moves,
             "move_failures": self.move_failures,
+            "repairs": self.repairs,
             "shards": self.cluster.storage_map.n_shards,
         }
 
@@ -74,6 +76,8 @@ class DataDistributor:
         are fetched once and reused by the split, merge, and rebalance
         decisions (shard_stats is a full key-walk on the storage server —
         re-fetching per decision would triple control-plane load)."""
+        await self._repair_teams()
+
         m = self.cluster.storage_map
         shards = m.shards
         stats = [await self._shard_stats(s) for s in shards]
@@ -116,6 +120,36 @@ class DataDistributor:
             t for t in range(len(self.cluster.storage_eps))
             if f"storage{t}" not in dead
         ]
+
+    async def _repair_teams(self) -> None:
+        """Restore the replication factor after permanent replica loss.
+
+        Reference: DDTeamCollection marks teams containing a failed server
+        unhealthy and the DDQueue relocates their shards onto healthy
+        teams. Here: any shard whose team has a dead member is moved to
+        (survivors + least-indexed spare live storages), which re-copies
+        the shard via the normal dual-tag fetch_keys window — no operator
+        action. Shards with no live replica are unrecoverable and left
+        for recovery/restore; with no spare capacity the shard stays
+        degraded and is retried next pass."""
+        live = set(self._live_tags())
+        m = self.cluster.storage_map
+        for shard in list(m.shards):
+            dead = [t for t in shard.team if t not in live]
+            if not dead:
+                continue
+            survivors = [t for t in shard.team if t in live]
+            if not survivors:
+                continue  # all replicas lost: nothing to copy from
+            want = max(len(shard.team), self.replication)
+            spares = sorted(live - set(shard.team))
+            dst = tuple((survivors + spares)[:want])
+            if len(dst) <= len(survivors):
+                continue  # no spare capacity: stay degraded, retry later
+            await self.move_shard(shard.range.begin, shard.range.end, dst)
+            self.repairs += 1
+            return  # one repair per pass: the move mutates the shard map,
+            # so the remaining snapshot is stale; next pass (0.4s) continues
 
     async def _maybe_rebalance(self, per_shard: list[tuple]) -> None:
         if self._moving:
@@ -180,7 +214,11 @@ class DataDistributor:
         union = tuple(src_team) + tuple(newcomers)
         m.set_team(begin, end, union)
         try:
-            src_ep = self.cluster.storage_eps[src_team[0]]
+            # Fetch from a LIVE source replica (repair moves start from
+            # teams that just lost a member — src_team[0] may be the body).
+            live = set(self._live_tags())
+            src_tag = next((t for t in src_team if t in live), src_team[0])
+            src_ep = self.cluster.storage_eps[src_tag]
             snap_versions: dict[int, int] = {}
             for tag in newcomers:
                 dst_ep = self.cluster.storage_eps[tag]

@@ -88,7 +88,7 @@ class TestClusterRestart:
 
     def test_full_cluster_restart_reads_committed_data(self, tmp_path):
         d = str(tmp_path)
-        c1 = SimCluster(seed=301, data_dir=d, n_tlogs=2)
+        c1 = SimCluster(seed=301, data_dir=d, n_tlogs=2, n_replicas=2)
         db1 = open_database(c1)
         self._commit_keys(c1, db1, b"dur/", 30)
 
@@ -105,7 +105,7 @@ class TestClusterRestart:
         assert any(s._durable_version > 0 for s in c1.storages)
 
         # The whole cluster "crashes": the old loop is simply abandoned.
-        c2 = SimCluster(seed=302, data_dir=d, n_tlogs=2)
+        c2 = SimCluster(seed=302, data_dir=d, n_tlogs=2, n_replicas=2)
         assert c2.controller.generation.epoch >= 2  # restart = new epoch
         db2 = open_database(c2)
         assert self._read_all(c2, db2, b"dur/", 30) == "ok"
@@ -114,26 +114,26 @@ class TestClusterRestart:
         """Crash BEFORE any storage flush: acked commits live only in the
         tlogs' disk queues — the fsync-before-ack contract must be enough."""
         d = str(tmp_path)
-        c1 = SimCluster(seed=303, data_dir=d)
+        c1 = SimCluster(seed=303, data_dir=d, n_replicas=2)
         db1 = open_database(c1)
         self._commit_keys(c1, db1, b"log/", 10)  # no settle: no flush window
 
-        c2 = SimCluster(seed=304, data_dir=d)
+        c2 = SimCluster(seed=304, data_dir=d, n_replicas=2)
         db2 = open_database(c2)
         assert self._read_all(c2, db2, b"log/", 10) == "ok"
 
     def test_double_restart(self, tmp_path):
         d = str(tmp_path)
-        c1 = SimCluster(seed=305, data_dir=d)
+        c1 = SimCluster(seed=305, data_dir=d, n_replicas=2)
         db1 = open_database(c1)
         self._commit_keys(c1, db1, b"a/", 8)
 
-        c2 = SimCluster(seed=306, data_dir=d)
+        c2 = SimCluster(seed=306, data_dir=d, n_replicas=2)
         db2 = open_database(c2)
         assert self._read_all(c2, db2, b"a/", 8) == "ok"
         self._commit_keys(c2, db2, b"b/", 8)
 
-        c3 = SimCluster(seed=307, data_dir=d)
+        c3 = SimCluster(seed=307, data_dir=d, n_replicas=2)
         db3 = open_database(c3)
         assert self._read_all(c3, db3, b"a/", 8) == "ok"
         assert self._read_all(c3, db3, b"b/", 8) == "ok"
@@ -141,11 +141,11 @@ class TestClusterRestart:
 
     def test_restart_new_writes_then_read_old(self, tmp_path):
         d = str(tmp_path)
-        c1 = SimCluster(seed=308, data_dir=d, n_tlogs=2)
+        c1 = SimCluster(seed=308, data_dir=d, n_tlogs=2, n_replicas=2)
         db1 = open_database(c1)
         self._commit_keys(c1, db1, b"mix/", 12)
 
-        c2 = SimCluster(seed=309, data_dir=d, n_tlogs=2)
+        c2 = SimCluster(seed=309, data_dir=d, n_tlogs=2, n_replicas=2)
         db2 = open_database(c2)
 
         async def main():
@@ -194,7 +194,7 @@ class TestDurableGapAcrossRecovery:
         cluster crash: pops/salvage floors respect the durable version, so
         the gap rides into the new epoch's disk queues."""
         d = str(tmp_path)
-        c1 = SimCluster(seed=310, data_dir=d, n_tlogs=2)
+        c1 = SimCluster(seed=310, data_dir=d, n_tlogs=2, n_replicas=2)
         db1 = open_database(c1)
 
         async def phase1():
@@ -217,7 +217,7 @@ class TestDurableGapAcrossRecovery:
 
         assert run(c1, phase1()) == "ok"
 
-        c2 = SimCluster(seed=311, data_dir=d, n_tlogs=2)
+        c2 = SimCluster(seed=311, data_dir=d, n_tlogs=2, n_replicas=2)
         db2 = open_database(c2)
 
         async def check():

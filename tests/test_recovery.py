@@ -14,6 +14,10 @@ from foundationdb_tpu.sim.cluster import SimCluster
 
 
 def make_db(seed=0, **kw):
+    # Replicated defaults (VERDICT r2 item 3): recovery must hold with
+    # k=2 storage teams, not just the single-replica special case.
+    kw.setdefault("n_storages", 2)
+    kw.setdefault("n_replicas", 2)
     c = SimCluster(seed=seed, **kw)
     return c, open_database(c)
 
