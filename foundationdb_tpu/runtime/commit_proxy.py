@@ -112,7 +112,12 @@ class CommitProxy:
             await self.loop.sleep(self.BATCH_INTERVAL)
             if not self._queue:
                 continue
-            batch, self._queue = self._queue[: self.MAX_BATCH], self._queue[self.MAX_BATCH :]
+            # BUGGIFY: degenerate one-txn batches exercise the version
+            # chain/reply paths at maximum batch rate (reference: BUGGIFY'd
+            # COMMIT_TRANSACTION_BATCH_COUNT_MAX).
+            max_batch = 1 if self.loop.buggify("commit_proxy.tiny_batch") \
+                else self.MAX_BATCH
+            batch, self._queue = self._queue[:max_batch], self._queue[max_batch:]
             # One version per batch; fetched in the batcher (not the spawned
             # worker) so batches acquire chain positions in queue order.
             try:
@@ -170,6 +175,10 @@ class CommitProxy:
             verdicts = await self._resolve(batch, prev_version, version)
             tagged = self._assemble(batch, verdicts, version)
             kc = self._known_committed
+            if self.loop.buggify("commit_proxy.slow_push"):
+                # Delayed push: later batches' pushes overtake ours at the
+                # tlogs, exercising their version-chain parking.
+                await self.loop.sleep(self.loop.rng.uniform(0, 0.05))
             await all_of(
                 [
                     self.loop.spawn(

@@ -81,6 +81,12 @@ class DataDistributor:
         m = self.cluster.storage_map
         shards = m.shards
         stats = [await self._shard_stats(s) for s in shards]
+        # Publish per-shard bytes for density consumers (resolver split
+        # derivation at recovery reads this — see cluster._derive_resolver_map).
+        self.cluster.dd_shard_bytes = [
+            (s.range.begin, s.range.end, st["bytes"])
+            for s, st in zip(shards, stats)
+        ]
 
         split_ranges = []
         for s, st in zip(shards, stats):

@@ -176,6 +176,28 @@ class Loop:
         self._current: Task | None = None
         self._tasks_by_process: dict[str, set[Task]] = {}
         self.dead_processes: set[str] = set()
+        # BUGGIFY (reference: flow/Buggify.h): OFF by default (production
+        # and plain tests see zero behavior change); the sim campaign
+        # enables it to fire rare timing/size perturbations inside role
+        # code. Per-site activation is decided once per run from the
+        # seeded RNG, so a failing seed replays identically.
+        self.buggify_enabled = False
+        self._buggify_sites: dict[str, bool] = {}
+
+    def buggify(self, site: str, activate_p: float = 0.25,
+                fire_p: float = 0.25) -> bool:
+        """True when the named injection site should misbehave right now.
+
+        Mirrors the reference's two-level scheme: a site is ACTIVATED for
+        the whole run with `activate_p`, and an activated site FIRES with
+        `fire_p` per evaluation. All draws come from the loop RNG —
+        deterministic under the run's seed."""
+        if not self.buggify_enabled:
+            return False
+        active = self._buggify_sites.get(site)
+        if active is None:
+            active = self._buggify_sites[site] = self.rng.random() < activate_p
+        return active and self.rng.random() < fire_p
 
     # -- time
     @property

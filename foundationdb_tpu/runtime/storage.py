@@ -225,6 +225,10 @@ class StorageServer:
         self._running = True
         last_gc = self.loop.now
         while True:
+            if self.loop.buggify("storage.slow_pull"):
+                # A lagging puller: reads hit FutureVersion waits, the
+                # tlog queue grows, ratekeeper sees durability lag.
+                await self.loop.sleep(self.loop.rng.uniform(0, 0.1))
             try:
                 gen, tlog = self._tlog_gen, self.tlog
                 entries, end_version, known_committed = await tlog.peek(

@@ -137,6 +137,12 @@ class TLog:
         truncated by `limit` (then the last returned version). Idle tags
         advance through mutation-free versions this way — the reference's
         empty peek replies carrying the tlog version."""
+        if self.loop.buggify("tlog.slow_peek"):
+            # Late peeks = storage lag spikes: ratekeeper smoothing,
+            # FutureVersion waits, and pop-floor logic all get exercised.
+            await self.loop.sleep(self.loop.rng.uniform(0, 0.1))
+        if self.loop.buggify("tlog.tiny_peek"):
+            limit = 1  # single-entry pages: pull-loop pagination on trial
         out = []
         for e in self._log:
             if e.version >= begin_version and tag in e.tagged:

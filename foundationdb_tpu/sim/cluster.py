@@ -279,6 +279,34 @@ class SimCluster:
 
     # -- recruiter interface (called by ClusterController / recovery) ---------
 
+    def _derive_resolver_map(self) -> KeyShardMap:
+        """Density-driven resolver splits (reference: CommitProxyServer
+        resolver ranges kept balanced from DD metrics): split the
+        keyspace at the byte-weighted quantiles of DataDistribution's
+        last shard-stats pass, so each resolver owns ~equal observed
+        load instead of equal key prefixes. Safe ONLY at recruitment —
+        resolver histories reset with the generation, so moving the
+        split cannot separate a read from the history of the writes it
+        must be checked against."""
+        from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+        n = self.n_resolvers
+        stats = getattr(self, "dd_shard_bytes", None)  # [(begin, end, bytes)]
+        total = sum(b for _, _, b in stats) if stats else 0
+        if n <= 1 or not total:
+            return KeyShardMap.uniform(n)
+        picks: list[bytes] = []
+        acc, d = 0, 1
+        for _begin, end, nbytes in stats:  # shards in key order
+            acc += nbytes
+            while d < n and acc * n >= d * total:
+                if end != MAX_KEY and (not picks or end > picks[-1]):
+                    picks.append(end)  # split at this shard's end boundary
+                d += 1
+        if len(picks) != n - 1:
+            return KeyShardMap.uniform(n)  # too few distinct boundaries
+        return KeyShardMap(picks, tags=list(range(n)))
+
     def recruit_generation(
         self, epoch: int, recovery_version: int, seed_entries: list
     ) -> Generation:
@@ -310,6 +338,11 @@ class SimCluster:
             if run:
                 self.loop.spawn(obj.run(), process=process, name=f"{name}.run")
             return ep
+
+        if epoch > 1:
+            # Re-split resolver ranges from observed density at recovery
+            # (fresh resolver histories make the move safe).
+            self.resolver_map = self._derive_resolver_map()
 
         self.sequencer = Sequencer(self.loop, epoch, recovery_version)
         assert self.sequencer.last_handed_out == start_version

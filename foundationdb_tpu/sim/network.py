@@ -55,6 +55,11 @@ class SimNetwork:
         self.max_latency = max_latency
         self._objects: dict[str, Any] = {}  # endpoint name -> role object
         self._partitions: set[frozenset] = set()
+        # Clogs: slow-but-alive links (reference: sim2's clogging — the
+        # failure mode BETWEEN healthy and partitioned that shakes out
+        # timeout/ordering assumptions). pair -> (latency multiplier,
+        # virtual-time expiry).
+        self._clogs: dict[frozenset, tuple[float, float]] = {}
 
     # -- topology -------------------------------------------------------------
 
@@ -84,6 +89,17 @@ class SimNetwork:
 
     def heal_all(self) -> None:
         self._partitions.clear()
+        self._clogs.clear()
+
+    def clog(self, a: str, b: str, factor: float = 50.0,
+             duration: float = 1.0) -> None:
+        """Inflate latency on the a↔b link by `factor` for `duration`
+        virtual seconds. The link stays ALIVE: RPCs arrive late rather
+        than failing, so no failure detector trips — the hard case."""
+        self._clogs[frozenset((a, b))] = (factor, self.loop.now + duration)
+
+    def unclog(self, a: str, b: str) -> None:
+        self._clogs.pop(frozenset((a, b)), None)
 
     def _unreachable(self, src: str, dst: str) -> bool:
         return (
@@ -91,8 +107,18 @@ class SimNetwork:
             or (src != dst and frozenset((src, dst)) in self._partitions)
         )
 
-    def _latency(self) -> float:
-        return self.loop.rng.uniform(self.min_latency, self.max_latency)
+    def _latency(self, src: str | None = None, dst: str | None = None) -> float:
+        base = self.loop.rng.uniform(self.min_latency, self.max_latency)
+        if src is None or not self._clogs:
+            return base
+        entry = self._clogs.get(frozenset((src, dst)))
+        if entry is None:
+            return base
+        factor, until = entry
+        if self.loop.now >= until:
+            del self._clogs[frozenset((src, dst))]
+            return base
+        return base * factor
 
     # -- RPC ------------------------------------------------------------------
 
@@ -137,7 +163,7 @@ class SimNetwork:
                 else:
                     reply.send(task.result())
 
-            loop.sleep(self._latency()).add_done_callback(finish)
+            loop.sleep(self._latency(ep.process, src)).add_done_callback(finish)
 
-        loop.sleep(self._latency()).add_done_callback(deliver)
+        loop.sleep(self._latency(src, ep.process)).add_done_callback(deliver)
         return reply.future

@@ -1,0 +1,132 @@
+"""Simulation campaign runner: every TOML spec × N seeds, one command.
+
+Reference: the TestHarness/Joshua loop around `fdbserver -r simulation` —
+run a spec under many seeds, report the failing (spec, seed) pairs with
+an exact replay command (same seed → same trace, including the fault and
+clog schedules).
+
+    python -m foundationdb_tpu.sim.run tests/specs --seeds 50
+    python -m foundationdb_tpu.sim.run tests/specs/Cycle.toml \
+        --seeds 1 --seed-base 1234 --buggify --clog 0.7   # replay one
+
+Each (spec-file, seed) runs in a fresh process (seeds fan out over
+--jobs workers); --buggify arms the in-role BUGGIFY sites and --clog
+adds slow-but-alive link injection on top of whatever the spec asks for.
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # campaign never needs a TPU
+
+import argparse
+import sys
+import traceback
+from concurrent.futures import ProcessPoolExecutor, as_completed
+
+
+def run_one(spec_path: str, seed: int, buggify: bool,
+            clog: float | None) -> tuple[str, int, list[tuple[str, bool, str]]]:
+    """Run every [[test]] of one spec file at one seed in THIS process.
+    Returns (spec_path, seed, [(title, ok, detail), ...])."""
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.sim.cluster import SimCluster
+    from foundationdb_tpu.sim.specs import load_spec, run_spec_test
+
+    out: list[tuple[str, bool, str]] = []
+    for spec in load_spec(spec_path):
+        if buggify:
+            spec.buggify = True
+        if clog is not None and spec.clog_interval is None:
+            spec.clog_interval = clog
+        c = SimCluster(seed=seed, n_tlogs=2, n_storages=2)
+        db = open_database(c)
+        try:
+            r = c.loop.run(run_spec_test(spec, c, db), timeout=3000)
+            detail = ", ".join(
+                f"{name}={m.txns_committed}tx" for name, m in r.metrics.items()
+            )
+            if r.kills:
+                detail += f" kills={r.kills}"
+            out.append((spec.title, True, detail))
+        except Exception:
+            out.append((spec.title, False, traceback.format_exc(limit=8)))
+    return spec_path, seed, out
+
+
+def collect_specs(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += sorted(
+                os.path.join(p, f) for f in os.listdir(p) if f.endswith(".toml")
+            )
+        else:
+            files.append(p)
+    if not files:
+        raise SystemExit(f"no .toml specs under {paths}")
+    return files
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.sim.run",
+        description="Run every TOML spec × N seeds (TestHarness analogue).",
+    )
+    ap.add_argument("specs", nargs="+", help="spec .toml files or directories")
+    ap.add_argument("--seeds", type=int, default=10)
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed (failing seeds replay with "
+                         "--seeds 1 --seed-base SEED)")
+    ap.add_argument("--buggify", action="store_true",
+                    help="arm in-role BUGGIFY sites in every test")
+    ap.add_argument("--clog", type=float, default=None, metavar="INTERVAL",
+                    help="add slow-link clogging at this mean interval (s)")
+    ap.add_argument("--jobs", type=int, default=min(8, os.cpu_count() or 1))
+    args = ap.parse_args(argv)
+
+    files = collect_specs(args.specs)
+    jobs = [(f, args.seed_base + s) for f in files for s in range(args.seeds)]
+    print(f"campaign: {len(files)} specs x {args.seeds} seeds = "
+          f"{len(jobs)} runs on {args.jobs} workers", flush=True)
+
+    failures: list[tuple[str, int, str, str]] = []
+    done = 0
+    with ProcessPoolExecutor(max_workers=args.jobs) as pool:
+        futs = {
+            pool.submit(run_one, f, seed, args.buggify, args.clog): (f, seed)
+            for f, seed in jobs
+        }
+        for fut in as_completed(futs):
+            f, seed = futs[fut]
+            done += 1
+            try:
+                _, _, results = fut.result()
+            except Exception as e:  # worker crash counts as failure
+                results = [("<worker>", False, f"{type(e).__name__}: {e}")]
+            for title, ok, detail in results:
+                if ok:
+                    print(f"[{done}/{len(jobs)}] ok   {f}:{title} "
+                          f"seed={seed} {detail}", flush=True)
+                else:
+                    failures.append((f, seed, title, detail))
+                    print(f"[{done}/{len(jobs)}] FAIL {f}:{title} seed={seed}",
+                          flush=True)
+
+    if failures:
+        print(f"\n{len(failures)} FAILURES:", flush=True)
+        for f, seed, title, detail in failures:
+            flags = " --buggify" if args.buggify else ""
+            if args.clog is not None:
+                flags += f" --clog {args.clog}"
+            print(f"--- {f}:{title} seed={seed}\n{detail}\n"
+                  f"replay: python -m foundationdb_tpu.sim.run {f} "
+                  f"--seeds 1 --seed-base {seed}{flags}", flush=True)
+        return 1
+    print("all green", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

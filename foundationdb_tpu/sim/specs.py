@@ -98,6 +98,8 @@ class TestSpec:
     partition_interval: float | None = None
     max_kills: int = 0
     include_controller: bool = False
+    clog_interval: float | None = None  # slow-but-alive link injection
+    buggify: bool = False  # enable in-role BUGGIFY sites for this test
 
 
 @dataclass
@@ -135,6 +137,8 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             partition_interval=test.get("partitionInterval"),
             max_kills=test.get("maxKills", 0),
             include_controller=test.get("killController", False),
+            clog_interval=test.get("clogInterval"),
+            buggify=test.get("buggify", False),
         ))
     return specs
 
@@ -143,16 +147,19 @@ async def run_spec_test(spec: TestSpec, cluster, db) -> SpecResult:
     """setup all → run all CONCURRENTLY (± faults) → quiesce → check all —
     the reference's multi-workload test execution order."""
     result = SpecResult(spec.title)
+    if spec.buggify:
+        cluster.loop.buggify_enabled = True
     for w in spec.workloads:
         await w.setup(db)
     faults = None
-    if spec.max_kills > 0 or spec.partition_interval:
+    if spec.max_kills > 0 or spec.partition_interval or spec.clog_interval:
         faults = FaultInjector(
             cluster,
             kill_interval=spec.kill_interval or 2.0,
             partition_interval=spec.partition_interval or 1.3,
             max_kills=spec.max_kills,
             include_controller=spec.include_controller,
+            clog_interval=spec.clog_interval or 0.0,
         )
         fault_task = cluster.loop.spawn(faults.run(), name="spec.faults")
     await all_of([

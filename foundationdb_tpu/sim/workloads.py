@@ -610,7 +610,9 @@ class FaultInjector:
 
     def __init__(self, cluster, kill_interval: float = 2.0,
                  partition_interval: float = 1.3, partition_length: float = 0.8,
-                 max_kills: int = 2, include_controller: bool = False):
+                 max_kills: int = 2, include_controller: bool = False,
+                 clog_interval: float = 0.0, clog_length: float = 0.8,
+                 clog_factor: float = 100.0):
         self.cluster = cluster
         self.kill_interval = kill_interval
         self.partition_interval = partition_interval
@@ -620,8 +622,13 @@ class FaultInjector:
         # rival candidate must win election and recover (the hardest
         # failure mode of the reference — CC loss).
         self.include_controller = include_controller
+        # Clogging (slow-but-alive links): 0 = off.
+        self.clog_interval = clog_interval
+        self.clog_length = clog_length
+        self.clog_factor = clog_factor
         self.kills: list[str] = []
         self.partitions = 0
+        self.clogs = 0
         self._stop = False
 
     def stop(self) -> None:
@@ -631,6 +638,8 @@ class FaultInjector:
         loop = self.cluster.loop
         rng = loop.rng
         loop.spawn(self._partitioner(), name="faults.partitioner")
+        if self.clog_interval > 0:
+            loop.spawn(self._clogger(), name="faults.clogger")
         while not self._stop and len(self.kills) < self.max_kills:
             await loop.sleep(self.kill_interval * (0.5 + rng.random()))
             if self._stop:
@@ -683,6 +692,31 @@ class FaultInjector:
             self.partitions += 1
             await loop.sleep(self.partition_length)
             self.cluster.net.heal(a, b)
+
+    async def _clogger(self) -> None:
+        """Slow-but-alive links: RPCs between a random pair take ~clog_factor
+        longer for clog_length — no failure detector fires, every timeout
+        and ordering assumption in between is on trial (reference: sim2's
+        clogging, the bug-richest fault mode)."""
+        loop = self.cluster.loop
+        rng = loop.rng
+        while not self._stop:
+            await loop.sleep(self.clog_interval * (0.5 + rng.random()))
+            if self._stop:
+                return
+            gen = self.cluster.controller.generation
+            procs = sorted(gen.heartbeat_eps) + [
+                f"storage{i}" for i in range(len(self.cluster.storages))
+            ] + ["<main>"]  # client-side links clog too
+            a = procs[rng.randrange(len(procs))]
+            b = procs[rng.randrange(len(procs))]
+            if a == b:
+                continue
+            self.cluster.net.clog(
+                a, b, factor=self.clog_factor,
+                duration=self.clog_length * (0.5 + rng.random()),
+            )
+            self.clogs += 1
 
 
 async def run_workload(cluster, db, workload: Workload,

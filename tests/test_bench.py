@@ -57,7 +57,7 @@ def test_bench_stream_three_way_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches
     )
-    _, tpu_conf, overflowed, tpu_lat = bench.run_tpu_wire(
+    _, tpu_conf, overflowed, tpu_lat, _occ = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1
     )
     assert not overflowed
@@ -98,7 +98,7 @@ def test_mode_streams_three_way_parity():
         assert blob[: int(ends[mode.batch])].tobytes() == \
             encode_resolve_batch(txns), mode_name
 
-        _, tpu_conf, overflow, _lat = bench.run_tpu_wire(
+        _, tpu_conf, overflow, _lat, _occ = bench.run_tpu_wire(
             n_batches, 1 << 14, blob, ends, repeats=1, mode=mode
         )
         assert not overflow
@@ -124,13 +124,14 @@ def test_sharded_resolver_mode_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, conf1, _, _l1 = bench.run_tpu_wire(
+    _, conf1, _, _l1, _o1 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=1
     )
-    _, conf4, _, _l4 = bench.run_tpu_wire(
+    _, conf4, _, _l4, occ4 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=4
     )
     assert conf1 == conf4
+    assert len(occ4) == 4  # sharded run reports occupancy
 
 
 def test_latency_and_roofline_fields():
@@ -145,7 +146,7 @@ def test_latency_and_roofline_fields():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, _, _, lat = bench.run_tpu_wire(
+    _, _, _, lat, _occ = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, window=1
     )
     assert len(lat) == n_batches and all(v > 0 for v in lat)

@@ -105,3 +105,103 @@ def test_windowed_resolve_parity():
         expect_sharded = seq_sharded.resolve(txns, cv)
         assert [int(v) for v in got[i]] == [int(v) for v in expect_single]
         assert expect_single == expect_sharded
+
+
+class TestDensitySplits:
+    def test_density_splits_quantiles_and_fallbacks(self):
+        from foundationdb_tpu.parallel.sharded_resolver import (
+            density_splits, interior_uniform,
+        )
+
+        # Zipf-ish sample concentrated low in the keyspace: quantile splits
+        # must land inside the hot region, not at uniform prefixes.
+        rng = np.random.default_rng(3)
+        ids = np.minimum(rng.geometric(0.01, 4096), 4000)
+        sample = [int(i).to_bytes(8, "big") for i in ids]
+        splits = density_splits(4, sample)
+        assert len(splits) == 3 and splits == sorted(splits)
+        assert all(s < (4001).to_bytes(8, "big") for s in splits)
+        # Degenerate samples fall back to uniform prefixes.
+        assert density_splits(4, [b"k"] * 100) == interior_uniform(4)
+        assert density_splits(4, []) == interior_uniform(4)
+
+    def test_density_splits_balance_occupancy(self):
+        """Under a skewed key stream, quantile splits keep per-shard
+        history occupancy within ~2x; uniform splits leave it pathological
+        (VERDICT r2 weak-4's done-criterion)."""
+        from foundationdb_tpu.parallel.sharded_resolver import density_splits
+
+        rng = np.random.default_rng(11)
+        n_txns, cv = 512, 0
+        ids = np.minimum(rng.zipf(1.3, (n_txns, 2)) - 1, 2000)
+        keyss = [
+            [int(i).to_bytes(8, "big") for i in row] for row in ids
+        ]
+
+        def run(splits, reshard_every=0):
+            cs = ShardedConflictSet(
+                n_shards=4, splits=splits, capacity=4096, batch_size=16,
+                max_read_ranges=2, max_write_ranges=2, max_key_bytes=12,
+            )
+            v = 0
+            seen: list[bytes] = []
+            for i in range(0, n_txns, 16):
+                v += 1
+                batch_keys = keyss[i : i + 16]
+                seen += [k for ks in batch_keys for k in ks]
+                txns = [
+                    TxnConflictInfo(
+                        read_version=v - 1,
+                        read_ranges=[KeyRange(k, k + b"\x00") for k in ks],
+                        write_ranges=[KeyRange(k, k + b"\x00") for k in ks],
+                    )
+                    for ks in batch_keys
+                ]
+                cs.resolve(txns, v)
+                if reshard_every and v % reshard_every == 0:
+                    # The between-windows re-split path: quantiles of ALL
+                    # keys observed so far (what DD density feedback gives
+                    # the proxy in the runtime analogue).
+                    cs.reshard(density_splits(4, seen))
+            return cs.shard_occupancy()
+
+        sample = [k for ks in keyss[:128] for k in ks]
+        occ_uniform = run(None)
+        # Uniform first-byte splits put EVERY 8-byte int key in shard 0.
+        assert max(occ_uniform[1:]) <= 1, occ_uniform
+        # Static quantiles of an early sample already help massively…
+        occ_static = run(density_splits(4, sample))
+        assert max(occ_static) <= 8 * max(1, min(occ_static))
+        # …and periodic re-splits from the full observed stream land the
+        # done-criterion: per-shard occupancy within ~2x.
+        occ_resplit = run(density_splits(4, sample), reshard_every=8)
+        lo, hi = min(occ_resplit), max(occ_resplit)
+        assert hi <= 2 * lo, (occ_resplit, occ_static, occ_uniform)
+
+    def test_reshard_preserves_verdicts(self):
+        """reshard() between batches must not change any verdict: the
+        history is re-clipped, not altered."""
+        from foundationdb_tpu.parallel.sharded_resolver import density_splits
+
+        rng = np.random.default_rng(17)
+        a = make_sharded(4, capacity=1024)
+        b = make_sharded(4, capacity=1024)
+        oracle = OracleConflictSet()
+        cv = 0
+        seen_keys: list[bytes] = []
+        for step in range(8):
+            cv += int(rng.integers(1, 10))
+            txns = [rand_txn(rng, read_version=max(0, cv - 5))
+                    for _ in range(int(rng.integers(1, 24)))]
+            for t in txns:
+                for r in t.read_ranges + t.write_ranges:
+                    seen_keys.append(r.begin)
+            va = a.resolve(txns, cv)
+            vb = b.resolve(txns, cv)
+            want = oracle.resolve(txns, cv)
+            assert va == vb == want, step
+            if step % 3 == 2:  # re-split mid-stream from observed keys
+                b.reshard(density_splits(4, seen_keys))
+        assert not a.overflowed and not b.overflowed
+        # The resharded engine actually moved its bounds at least once.
+        assert b._interior_splits is not None
