@@ -38,9 +38,11 @@ from foundationdb_tpu.core.types import (
 
 SPECIAL_KEY_PREFIX = b"\xff\xff"
 STATUS_JSON_KEY = b"\xff\xff/status/json"
+CONFLICTING_KEYS_PREFIX = b"\xff\xff/transaction/conflicting_keys/"
 from foundationdb_tpu.core.errors import (
     KeyOutsideLegalRange,
     KeyTooLarge,
+    NotCommitted,
     TransactionTooLarge,
     ValueTooLarge,
     WrongShardServer,
@@ -246,7 +248,22 @@ class Transaction:
     def __init__(self, db: Database):
         self.db = db
         self._backoff = 0.01
+        # Options survive resets, like reference options on a retry loop.
+        self.report_conflicting_keys = False  # fdb option 712
+        self.tags: set[str] = set()  # fdb option TAG (ratekeeper throttling)
         self._reset()
+
+    def set_option(self, name: str, value: str | None = None) -> None:
+        """Transaction options (reference: fdb_transaction_set_option);
+        only the ones this client implements."""
+        if name == "report_conflicting_keys":
+            self.report_conflicting_keys = True
+        elif name == "tag":
+            if not value:
+                raise FdbError("tag option requires a value", code=2006)
+            self.tags.add(value)
+        else:
+            raise FdbError(f"unknown transaction option {name!r}", code=2006)
 
     def _reset(self) -> None:
         self._read_version: int | None = None
@@ -256,6 +273,7 @@ class Transaction:
         self._committed: tuple[int, int] | None = None  # (version, batch_order)
         self._pending_watches: list[tuple[bytes, bytes | None]] = []
         self._watch_futures: list = []
+        self._conflicting_ranges: list[tuple[bytes, bytes]] = []
 
     # -- versions -------------------------------------------------------------
 
@@ -264,7 +282,9 @@ class Transaction:
             try:
                 self._read_version = await self.db._pick(
                     self.db.grv_proxies
-                ).get_read_version()
+                ).get_read_version(
+                    "default", sorted(self.tags) if self.tags else None
+                )
             except BrokenPromise as e:
                 # Dead/retired GRV proxy: retryable — on_error refreshes the
                 # proxy list from the controller before the next attempt.
@@ -311,7 +331,29 @@ class Transaction:
 
             doc = await fetch_status(self.db.cluster)
             return json.dumps(doc).encode()
+        if key.startswith(CONFLICTING_KEYS_PREFIX):
+            for k, v in self._conflicting_rows():
+                if k == key:
+                    return v
+            return None
         return None
+
+    def _conflicting_rows(self) -> list[tuple[bytes, bytes]]:
+        """\\xff\\xff/transaction/conflicting_keys/ rows from the last
+        failed commit attempt: merged conflicting ranges as boundary
+        markers — range begins valued \\x01, range ends \\x00 (the
+        reference's exact format)."""
+        merged: list[tuple[bytes, bytes]] = []
+        for b, e in sorted(self._conflicting_ranges):
+            if merged and b <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((b, e))
+        rows: list[tuple[bytes, bytes]] = []
+        for b, e in merged:
+            rows.append((CONFLICTING_KEYS_PREFIX + b, b"\x01"))
+            rows.append((CONFLICTING_KEYS_PREFIX + e, b"\x00"))
+        return rows
 
     async def get_range(
         self,
@@ -325,6 +367,14 @@ class Transaction:
         covers only what the result depends on: up to the last key returned
         when the limit truncates the scan (reference: getRange conflict-range
         trimming in NativeAPI)."""
+        if begin.startswith(SPECIAL_KEY_PREFIX):
+            rows = [
+                (k, v) for k, v in self._conflicting_rows()
+                if begin <= k < end
+            ]
+            if reverse:
+                rows.reverse()
+            return rows[:limit] if limit > 0 else rows
         version = await self.get_read_version()
         cap = limit if limit > 0 else 1 << 30
         rows = await self.db.read_range(begin, end, version, cap, reverse)
@@ -455,9 +505,17 @@ class Transaction:
             mutations=list(self.mutations),
             read_ranges=list(self.read_ranges),
             write_ranges=list(self.write_ranges),
+            report_conflicting_keys=self.report_conflicting_keys,
         )
         try:
             res = await self.db._pick(self.db.commit_proxies).commit(req)
+        except NotCommitted as e:
+            # Stash the resolver's conflicting ranges for this attempt:
+            # readable via \xff\xff/transaction/conflicting_keys/ until
+            # the next reset (reference: SpecialKeySpace module backed by
+            # the commit reply's conflictingKRIndices).
+            self._conflicting_ranges = list(e.conflicting_ranges or [])
+            raise
         except BrokenPromise as e:
             # Proxy died mid-commit: the batch may or may not have reached
             # the tlogs — exactly commit_unknown_result.

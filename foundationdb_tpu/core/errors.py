@@ -12,6 +12,9 @@ class FdbError(Exception):
     """Base error with an fdb-compatible numeric code."""
 
     code: int = 1500  # internal_error
+    # Optional structured payload that crosses the wire with the error
+    # (wire.py emits the extended T_ERROREX tag only when this is set).
+    wire_extra = None
 
     def __init__(self, message: str = "", code: int | None = None):
         super().__init__(message or type(self).__name__)
@@ -24,9 +27,25 @@ class FdbError(Exception):
 
 
 class NotCommitted(FdbError):
-    """Transaction conflicted with another transaction (error 1020)."""
+    """Transaction conflicted with another transaction (error 1020).
+
+    When the client requested report_conflicting_keys, the resolver's
+    conflicting read ranges ride along (reference: conflictingKRIndices
+    in the commit reply feeding \\xff\\xff/transaction/conflicting_keys/).
+    """
 
     code = 1020
+
+    def __init__(self, message: str = "",
+                 conflicting_ranges: "list[tuple[bytes, bytes]] | None" = None,
+                 code: int | None = None):
+        super().__init__(message, code)
+        if conflicting_ranges is not None:
+            self.wire_extra = [tuple(r) for r in conflicting_ranges]
+
+    @property
+    def conflicting_ranges(self) -> "list[tuple[bytes, bytes]] | None":
+        return self.wire_extra
 
 
 class TransactionTooOld(FdbError):

@@ -31,7 +31,7 @@ namespace {
 constexpr uint8_t T_NONE = 0x00, T_TRUE = 0x01, T_FALSE = 0x02, T_INT = 0x03,
                   T_BIGINT = 0x04, T_FLOAT = 0x05, T_BYTES = 0x06,
                   T_STR = 0x07, T_LIST = 0x08, T_TUPLE = 0x09, T_DICT = 0x0A,
-                  T_STRUCT = 0x0B, T_ERROR = 0x0C;
+                  T_STRUCT = 0x0B, T_ERROR = 0x0C, T_ERROREX = 0x0D;
 // wire.py struct registry ids
 constexpr uint16_t S_MUTATION = 1, S_KEYRANGE = 2, S_COMMIT_REQ = 5;
 
@@ -114,11 +114,14 @@ bool skip_value(Cur& c) {
       return c.ok;
     }
     case T_STRUCT: c.u16(); return skip_value(c);
-    case T_ERROR: {
+    case T_ERROR: case T_ERROREX: {
       c.u16();
       uint32_t n = c.u32();
       if (!c.need(n)) return false;
       c.pos += n;
+      // T_ERROREX carries a trailing structured payload (e.g. conflicting
+      // key ranges); the C surface reports only the code, so skip it.
+      if (t == T_ERROREX) return skip_value(c);
       return true;
     }
     default: return false;
@@ -179,7 +182,8 @@ int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
   uint8_t okt = cur.u8();
   if (okt == T_FALSE) {
     // value is an FdbError (or anything): extract the code if possible.
-    if (cur.u8() == T_ERROR) {
+    uint8_t et = cur.u8();
+    if (et == T_ERROR || et == T_ERROREX) {
       uint16_t code = cur.u16();
       return -static_cast<int64_t>(code ? code : ERR_INTERNAL);
     }

@@ -172,7 +172,9 @@ class CommitProxy:
         version: int,
     ) -> None:
         try:
-            verdicts = await self._resolve(batch, prev_version, version)
+            verdicts, conflicting = await self._resolve(
+                batch, prev_version, version
+            )
             tagged = self._assemble(batch, verdicts, version)
             kc = self._known_committed
             if self.loop.buggify("commit_proxy.slow_push"):
@@ -217,7 +219,9 @@ class CommitProxy:
                 p.fail(TransactionTooOld())
             else:
                 self.txns_conflicted += 1
-                p.fail(NotCommitted())
+                p.fail(NotCommitted(
+                    conflicting_ranges=conflicting.get(i)
+                ))
 
     RPC_RETRIES = 4  # worst case ~4.4s — must finish under WEDGE_TIMEOUT
 
@@ -239,7 +243,7 @@ class CommitProxy:
         batch: list[tuple[CommitRequest, Promise]],
         prev_version: int,
         version: int,
-    ) -> list[Verdict]:
+    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]]]:
         """Fan the batch out to every resolver (filtered to its key shard)
         and AND the verdicts. Conflicts are never missed: any read/write
         overlap lands on whichever resolver owns those keys. As in the
@@ -277,15 +281,23 @@ class CommitProxy:
             ]
         )
         combined: list[Verdict] = []
+        conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
         for i in range(len(batch)):
-            vs = [reply[i] for reply in replies]
+            vs = [verdicts[i] for verdicts, _conf in replies]
             if Verdict.TOO_OLD in vs:
                 combined.append(Verdict.TOO_OLD)
             elif Verdict.CONFLICT in vs:
                 combined.append(Verdict.CONFLICT)
+                # Union the per-resolver conflicting ranges (each resolver
+                # reports only its own key shard's clipped subranges).
+                ranges = [
+                    r for _v, conf in replies for r in conf.get(i, [])
+                ]
+                if ranges:
+                    conflicting[i] = ranges
             else:
                 combined.append(Verdict.COMMITTED)
-        return combined
+        return combined, conflicting
 
     def _assemble(
         self,

@@ -24,23 +24,33 @@ class GrvProxy:
     RATE_POLL_INTERVAL = 0.1
     MAX_TOKENS = 2000.0
 
+    MAX_TAG_TOKENS = 100.0
+
     def __init__(self, loop: Loop, sequencer_ep, ratekeeper_ep=None):
         self.loop = loop
         self.sequencer = sequencer_ep
         self.ratekeeper = ratekeeper_ep
-        self._queue: list[Promise] = []
-        self._batch_queue: list[Promise] = []
+        # Queue entries: (promise, txn tags) — tags from the TAG
+        # transaction option (reference: TagThrottle at the GRV proxy).
+        self._queue: list[tuple[Promise, tuple[str, ...]]] = []
+        self._batch_queue: list[tuple[Promise, tuple[str, ...]]] = []
         self._tokens = self.MAX_TOKENS
         self._batch_tokens = self.MAX_TOKENS
         unlimited = float("inf") if ratekeeper_ep is None else 0.0
         self._rate = unlimited
         self._batch_rate = unlimited
+        self._tag_rates: dict[str, float] = {}  # quota'd tags only
+        self._tag_tokens: dict[str, float] = {}
         self.grvs_served = 0
+        self.tag_throttled = 0  # admissions deferred by a tag bucket
 
     @rpc
-    async def get_read_version(self, priority: str = PRIORITY_DEFAULT) -> int:
+    async def get_read_version(self, priority: str = PRIORITY_DEFAULT,
+                               tags: list[str] | None = None) -> int:
         p = Promise()
-        (self._batch_queue if priority == PRIORITY_BATCH else self._queue).append(p)
+        entry = (p, tuple(tags or ()))
+        (self._batch_queue if priority == PRIORITY_BATCH
+         else self._queue).append(entry)
         return await p.future
 
     @rpc
@@ -50,13 +60,36 @@ class GrvProxy:
             "grvs_served": self.grvs_served,
             "queued": len(self._queue),
             "batch_queued": len(self._batch_queue),
+            "tag_throttled": self.tag_throttled,
         }
 
-    def _admit(self, queue: list[Promise], tokens: float) -> tuple[list, float]:
-        n = len(queue) if tokens == float("inf") else int(min(len(queue), tokens))
-        if n and tokens != float("inf"):
-            tokens -= n
-        return queue[:n], tokens
+    def _admit(self, queue: list, tokens: float) -> tuple[list, list, float]:
+        """Admit in arrival order, gated by the lane bucket AND every tag
+        bucket the request carries. A tag-starved request stays queued (in
+        order) without blocking untagged traffic behind it — that's the
+        whole point of per-tag throttling (reference: tag-throttled GRV
+        requests wait in their own queue)."""
+        admitted: list[Promise] = []
+        kept: list = []
+        for p, tags in queue:
+            if tokens != float("inf") and tokens < 1:
+                kept.append((p, tags))
+                continue
+            starved = [
+                t for t in tags
+                if t in self._tag_tokens and self._tag_tokens[t] < 1
+            ]
+            if starved:
+                self.tag_throttled += 1
+                kept.append((p, tags))
+                continue
+            for t in tags:
+                if t in self._tag_tokens:
+                    self._tag_tokens[t] -= 1
+            if tokens != float("inf"):
+                tokens -= 1
+            admitted.append(p)
+        return admitted, kept, tokens
 
     async def run(self) -> None:
         self.loop.spawn(self._rate_poller(), name="grv.rate_poller")
@@ -70,14 +103,20 @@ class GrvProxy:
                     self.MAX_TOKENS,
                     self._batch_tokens + self._batch_rate * self.BATCH_INTERVAL,
                 )
+            for tag, rate in self._tag_rates.items():
+                self._tag_tokens[tag] = min(
+                    self.MAX_TAG_TOKENS,
+                    self._tag_tokens.get(tag, 0.0)
+                    + rate * self.BATCH_INTERVAL,
+                )
             if not self._queue and not self._batch_queue:
                 continue
-            admitted, self._tokens = self._admit(self._queue, self._tokens)
-            self._queue = self._queue[len(admitted):]
-            b_admitted, self._batch_tokens = self._admit(
+            admitted, self._queue, self._tokens = self._admit(
+                self._queue, self._tokens
+            )
+            b_admitted, self._batch_queue, self._batch_tokens = self._admit(
                 self._batch_queue, self._batch_tokens
             )
-            self._batch_queue = self._batch_queue[len(b_admitted):]
             batch = admitted + b_admitted
             if not batch:
                 continue
@@ -99,6 +138,13 @@ class GrvProxy:
                 rates = await self.ratekeeper.get_rates()
                 self._rate = rates["tps_limit"]
                 self._batch_rate = rates["batch_tps_limit"]
+                tag_rates = rates.get("tag_rates", {})
+                # Drop buckets for cleared quotas so those tags go back
+                # to unlimited.
+                self._tag_rates = dict(tag_rates)
+                self._tag_tokens = {
+                    t: self._tag_tokens.get(t, 0.0) for t in tag_rates
+                }
             except Exception:
                 pass  # keep last known rate while ratekeeper is unreachable
             await self.loop.sleep(self.RATE_POLL_INTERVAL)

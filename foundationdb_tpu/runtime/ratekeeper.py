@@ -46,6 +46,18 @@ class Ratekeeper:
         self.worst_storage_queue = 0
         self.worst_tlog_queue = 0
         self.limiting_reason = "none"
+        # Per-tag tps quotas (reference: TagThrottleApi manual throttles in
+        # \xff\x02/throttle/): enforced by the GRV proxies' per-tag buckets.
+        self.tag_quotas: dict[str, float] = {}
+
+    @rpc
+    async def set_tag_quota(self, tag: str, tps: float | None) -> None:
+        """Set (or clear with None) a transaction tag's tps quota —
+        the ThrottleApi `throttle on tag` analogue."""
+        if tps is None:
+            self.tag_quotas.pop(tag, None)
+        else:
+            self.tag_quotas[tag] = float(tps)
 
     async def run(self) -> None:
         while True:
@@ -114,4 +126,5 @@ class Ratekeeper:
             "worst_durability_lag": self.worst_durability_lag,
             "worst_storage_queue_bytes": self.worst_storage_queue,
             "worst_tlog_queue_bytes": self.worst_tlog_queue,
+            "tag_rates": dict(self.tag_quotas),
         }

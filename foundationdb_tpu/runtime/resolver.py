@@ -35,7 +35,10 @@ class Resolver:
         version: int,
         txns: list[TxnConflictInfo],
         oldest_version: int | None = None,
-    ) -> list[Verdict]:
+    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]]]:
+        """→ (verdicts, conflicting): conflicting maps a txn's batch index
+        to its conflicting read ranges, for txns that set
+        report_conflicting_keys and got CONFLICT."""
         while self._version != prev_version:
             if prev_version < self._version:
                 # Retransmit of a batch whose reply was lost (proxy↔resolver
@@ -51,16 +54,30 @@ class Resolver:
         if oldest_version is None:
             oldest_version = max(0, version - MVCC_WINDOW_VERSIONS)
         verdicts = self.cs.resolve(txns, version, oldest_version)
+        # Conflicting read ranges for txns that asked (reference: the
+        # reply's conflictingKRIndices). Engines that track exact ranges
+        # (oracle) report them; others degrade to the conservative
+        # superset of all the txn's read ranges.
+        exact = getattr(self.cs, "last_conflicting", None)
+        conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
+        for i, (t, v) in enumerate(zip(txns, verdicts)):
+            if v != Verdict.CONFLICT or not t.report_conflicting_keys:
+                continue
+            ranges = exact.get(i) if exact is not None else None
+            if ranges is None:
+                ranges = [r for r in t.read_ranges if not r.empty]
+            conflicting[i] = [(r.begin, r.end) for r in ranges]
         self.batches_resolved += 1
         self.txns_resolved += len(txns)
         self._version = version
-        self._replies[version] = verdicts
+        reply = (verdicts, conflicting)
+        self._replies[version] = reply
         if len(self._replies) > self.REPLY_CACHE_SIZE:
             del self._replies[min(self._replies)]
         w = self._waiters.pop(version, None)
         if w is not None:
             w.send(None)
-        return verdicts
+        return reply
 
     @property
     def version(self) -> int:

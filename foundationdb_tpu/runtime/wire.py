@@ -32,6 +32,7 @@ _T_TUPLE = 0x09
 _T_DICT = 0x0A
 _T_STRUCT = 0x0B  # registered dataclass/enum
 _T_ERROR = 0x0C  # FdbError (code + message)
+_T_ERROREX = 0x0D  # FdbError with structured payload (code + msg + extra)
 
 _u32 = struct.Struct("<I")
 _i64 = struct.Struct("<q")
@@ -102,10 +103,13 @@ def pack_obj(obj: Any, out: bytearray) -> None:
             pack_obj(v, out)
     elif isinstance(obj, FdbError):
         msg = str(obj).encode("utf-8")
-        out.append(_T_ERROR)
+        extra = obj.wire_extra
+        out.append(_T_ERROR if extra is None else _T_ERROREX)
         out += _u16.pack(obj.code)
         out += _u32.pack(len(msg))
         out += msg
+        if extra is not None:
+            pack_obj(extra, out)
     elif t in _STRUCT_IDS:
         sid = _STRUCT_IDS[t]
         out.append(_T_STRUCT)
@@ -164,15 +168,19 @@ def unpack_obj(buf: bytes | memoryview, pos: int = 0) -> tuple[Any, int]:
         if entry is None:
             raise ValueError(f"unknown wire struct id {sid}")
         return entry[2](fields), pos
-    if tag == _T_ERROR:
+    if tag in (_T_ERROR, _T_ERROREX):
         code = _u16.unpack_from(buf, pos)[0]
         n = _u32.unpack_from(buf, pos + 2)[0]
         msg = bytes(buf[pos + 6 : pos + 6 + n]).decode("utf-8")
+        pos += 6 + n
         # Reconstruct the registered subclass: client retry logic dispatches
         # on class (WrongShardServer → shard-map refresh, ProcessKilled →
         # cluster refresh), so decoding to the base class would silently
         # change retry behavior between sim and TCP transports.
-        return make_error(code, msg), pos + 6 + n
+        err = make_error(code, msg)
+        if tag == _T_ERROREX:
+            err.wire_extra, pos = unpack_obj(buf, pos)
+        return err, pos
     raise ValueError(f"unknown wire tag {tag:#x}")
 
 

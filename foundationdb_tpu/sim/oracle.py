@@ -22,6 +22,10 @@ class OracleConflictSet:
     def __init__(self) -> None:
         self.history: list[tuple[KeyRange, int]] = []
         self.oldest_version = 0
+        # Exact conflicting read ranges of the LAST resolve call, by txn
+        # index — only recorded for txns that asked (report_conflicting_keys;
+        # reference: conflictingKRIndices in ResolveTransactionBatchReply).
+        self.last_conflicting: dict[int, list[KeyRange]] = {}
 
     def resolve(
         self,
@@ -33,18 +37,24 @@ class OracleConflictSet:
             self.oldest_version = max(self.oldest_version, oldest_version)
         verdicts: list[Verdict] = []
         accepted_writes: list[KeyRange] = []
-        for t in txns:
+        self.last_conflicting = {}
+        for i, t in enumerate(txns):
             reads = [r for r in t.read_ranges if not r.empty]
             if reads and t.read_version < self.oldest_version:
                 verdicts.append(Verdict.TOO_OLD)
                 continue
-            conflict = any(
-                r.overlaps(w) and v > t.read_version
-                for (w, v) in self.history
-                for r in reads
-            ) or any(r.overlaps(w) for w in accepted_writes for r in reads)
-            if conflict:
+
+            def bad(r: KeyRange, t=t, accepted=accepted_writes) -> bool:
+                return any(
+                    r.overlaps(w) and v > t.read_version
+                    for (w, v) in self.history
+                ) or any(r.overlaps(w) for w in accepted)
+
+            conflicting = [r for r in reads if bad(r)]
+            if conflicting:
                 verdicts.append(Verdict.CONFLICT)
+                if t.report_conflicting_keys:
+                    self.last_conflicting[i] = conflicting
                 continue
             verdicts.append(Verdict.COMMITTED)
             accepted_writes.extend(w for w in t.write_ranges if not w.empty)

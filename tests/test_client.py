@@ -390,3 +390,119 @@ class TestGuardPaths:
             return "ok"
 
         assert run(c, main()) == "ok"
+
+
+class TestConflictingKeys:
+    def test_conflicting_keys_after_1020(self):
+        """With the REPORT_CONFLICTING_KEYS option set, a 1020 populates
+        \\xff\\xff/transaction/conflicting_keys/ with the resolver's
+        conflicting read ranges as \\x01/\\x00 boundary markers (reference:
+        SpecialKeySpace ConflictingKeysImpl fed by conflictingKRIndices)."""
+        from foundationdb_tpu.client.transaction import CONFLICTING_KEYS_PREFIX
+        from foundationdb_tpu.core.errors import NotCommitted
+
+        c, db = make_db(40)
+
+        async def main():
+            t0 = db.transaction()
+            t0.set(b"ck/a", b"0")
+            t0.set(b"ck/other", b"0")
+            await t0.commit()
+
+            tr = db.transaction()
+            tr.set_option("report_conflicting_keys")
+            await tr.get(b"ck/a")       # will conflict
+            await tr.get(b"ck/other")   # will not
+            # Interloper writes ck/a between our read and our commit.
+            t2 = db.transaction()
+            t2.set(b"ck/a", b"1")
+            await t2.commit()
+            tr.set(b"ck/mine", b"x")
+            with pytest.raises(NotCommitted):
+                await tr.commit()
+            rows = await tr.get_range(
+                CONFLICTING_KEYS_PREFIX, CONFLICTING_KEYS_PREFIX + b"\xff"
+            )
+            assert rows == [
+                (CONFLICTING_KEYS_PREFIX + b"ck/a", b"\x01"),
+                (CONFLICTING_KEYS_PREFIX + b"ck/a\x00", b"\x00"),
+            ], rows
+            # Point read works too; unrelated keys report nothing.
+            assert await tr.get(
+                CONFLICTING_KEYS_PREFIX + b"ck/a"
+            ) == b"\x01"
+            assert not any(b"ck/other" in k for k, _ in rows)
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_conflicting_ranges_survive_tcp(self):
+        """The T_ERROREX wire tag carries the ranges across the real
+        transport with subclass identity intact."""
+        from foundationdb_tpu.core.errors import NotCommitted
+        from foundationdb_tpu.runtime import wire
+
+        e = NotCommitted(conflicting_ranges=[(b"a", b"b"), (b"c", b"d")])
+        back = wire.loads(wire.dumps(e))
+        assert type(back) is NotCommitted
+        assert back.conflicting_ranges == [(b"a", b"b"), (b"c", b"d")]
+        # Payload-less errors still use the compact T_ERROR form.
+        assert wire.dumps(NotCommitted())[0] == 0x0C
+
+    def test_no_option_no_ranges(self):
+        """Without the option the resolver reports nothing (no free work
+        on the hot path)."""
+        from foundationdb_tpu.core.errors import NotCommitted
+
+        c, db = make_db(41)
+
+        async def main():
+            t0 = db.transaction()
+            t0.set(b"nk/a", b"0")
+            await t0.commit()
+            tr = db.transaction()
+            await tr.get(b"nk/a")
+            t2 = db.transaction()
+            t2.set(b"nk/a", b"1")
+            await t2.commit()
+            tr.set(b"nk/b", b"x")
+            with pytest.raises(NotCommitted) as ei:
+                await tr.commit()
+            assert ei.value.conflicting_ranges is None
+            rows = await tr.get_range(b"\xff\xff/transaction/", b"\xff\xff/transaction0")
+            assert rows == []
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+
+class TestTagOption:
+    def test_tagged_transaction_throttled_end_to_end(self):
+        """The TAG option rides GRV requests through the cluster; a
+        ratekeeper quota on that tag slows exactly those transactions."""
+        c, db = make_db(42)
+
+        async def main():
+            await c.ratekeeper.set_tag_quota("analytics", 5.0)
+            await c.loop.sleep(0.3)  # GRV proxies poll rates every 0.1s
+            # 8 sequential tagged txns at 5 tps must take >= ~1.2s of
+            # virtual time (the bucket pre-accrues at most ~1.5 tokens).
+            t0 = c.loop.now
+            for i in range(8):
+                tr = db.transaction()
+                tr.set_option("tag", "analytics")
+                tr.set(b"tag/k%d" % i, b"v")
+                await tr.commit()
+            tagged_took = c.loop.now - t0
+            assert tagged_took > 1.0, tagged_took
+            assert c.grv_proxies[0].tag_throttled > 0
+            # Untagged txns through the same proxy are unaffected.
+            t1 = c.loop.now
+            for i in range(8):
+                tr = db.transaction()
+                tr.set(b"tag/u%d" % i, b"v")
+                await tr.commit()
+            assert c.loop.now - t1 < 0.25 * tagged_took
+            return "ok"
+
+        assert run(c, main()) == "ok"

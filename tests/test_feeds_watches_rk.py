@@ -263,3 +263,91 @@ class TestGrvPriorityLanes:
         got = loop.run(main(), timeout=10)
         assert got["default"] == 42
         assert "batch" not in got  # zero batch budget → still queued
+
+
+class TestTagThrottling:
+    def test_hot_tag_capped_while_others_flow(self):
+        """Per-tag quotas (reference: TagThrottle enforced at the GRV
+        proxy): a quota'd hot tag is admitted at ~its tps while untagged
+        traffic flows unthrottled through the same proxy."""
+        loop = Loop(seed=0)
+
+        class RkWithTags(FakeRatekeeper):
+            async def get_rates(self):
+                r = await super().get_rates()
+                r["tag_rates"] = {"hot": 10.0}
+                return r
+
+        proxy = GrvProxy(loop, FakeSequencer(), RkWithTags(1e6, 1e6))
+        served = {"hot": 0, "plain": 0}
+
+        async def client(tag, n):
+            for _ in range(n):
+                await proxy.get_read_version(
+                    "default", [tag] if tag else None
+                )
+                served[tag or "plain"] += 1
+
+        async def main():
+            loop.spawn(proxy.run(), name="grv")
+            await loop.sleep(0.15)  # poller fetched tag rates
+            h = loop.spawn(client("hot", 200), name="hot")
+            p = loop.spawn(client(None, 200), name="plain")
+            await loop.sleep(2.0)
+            h.cancel()
+            _ = p
+            return dict(served)
+
+        got = loop.run(main(), timeout=60)
+        # Untagged: all 200 long before the deadline. Hot: ~10 tps * 2s,
+        # give slack for refill granularity.
+        assert got["plain"] == 200, got
+        assert got["hot"] <= 30, got
+        assert got["hot"] >= 5, got  # but not starved entirely
+        assert proxy.tag_throttled > 0
+
+    def test_quota_cleared_restores_flow(self):
+        loop = Loop(seed=0)
+
+        class ToggleRk(FakeRatekeeper):
+            tag_rates = {"hot": 5.0}
+
+            async def get_rates(self):
+                r = await super().get_rates()
+                r["tag_rates"] = dict(self.tag_rates)
+                return r
+
+        rk = ToggleRk(1e6, 1e6)
+        proxy = GrvProxy(loop, FakeSequencer(), rk)
+
+        async def main():
+            loop.spawn(proxy.run(), name="grv")
+            await loop.sleep(0.15)
+            t0 = loop.now
+            await proxy.get_read_version("default", ["hot"])
+            throttled_wait = loop.now - t0
+            assert throttled_wait > 0.05  # had to wait for the bucket
+            rk.tag_rates = {}  # quota cleared (ThrottleApi off)
+            await loop.sleep(0.15)  # poller refresh
+            t1 = loop.now
+            for _ in range(20):
+                await proxy.get_read_version("default", ["hot"])
+            assert loop.now - t1 < 0.5  # unlimited again
+            return "ok"
+
+        assert loop.run(main(), timeout=60) == "ok"
+
+    def test_ratekeeper_tag_quota_api(self):
+        loop = Loop(seed=0)
+        rk = Ratekeeper(loop, [], [])
+
+        async def main():
+            await rk.set_tag_quota("hot", 25.0)
+            rates = await rk.get_rates()
+            assert rates["tag_rates"] == {"hot": 25.0}
+            await rk.set_tag_quota("hot", None)
+            rates = await rk.get_rates()
+            assert rates["tag_rates"] == {}
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
