@@ -225,11 +225,17 @@ class DataDistributor:
             live = set(self._live_tags())
             src_tag = next((t for t in src_team if t in live), src_team[0])
             src_ep = self.cluster.storage_eps[src_tag]
+            # The snapshot must reflect everything committed BEFORE the
+            # dual-tag window opened: mutations up to this floor were
+            # tagged only for the old team, so a lagging source
+            # snapshotting below it would lose them for the newcomers
+            # (e.g. resurrect a cleared key).
+            floor = await self._retry(self.cluster.tlog_eps[0].get_version)
             snap_versions: dict[int, int] = {}
             for tag in newcomers:
                 dst_ep = self.cluster.storage_eps[tag]
                 snap_versions[tag] = await self._retry(
-                    lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep)
+                    lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep, floor)
                 )
             # Every newcomer must be applied past its snapshot before it can
             # answer reads issued after the flip (fetch_keys itself already

@@ -474,9 +474,19 @@ class StorageServer:
 
     @rpc
     async def snapshot_range(
-        self, begin: bytes, end: bytes
+        self, begin: bytes, end: bytes, min_version: int | None = None
     ) -> tuple[int, list[tuple[bytes, bytes]]]:
-        """Source side of fetchKeys: the range at our applied version."""
+        """Source side of fetchKeys: the range at our applied version.
+
+        `min_version` makes the snapshot wait until our pull loop has
+        applied at least that version (reference: fetchKeys reads at a
+        fetchVersion at/above the move version). Without it, a lagging
+        source could snapshot a state OLDER than mutations already
+        committed for this range whose tags the destination does not
+        carry — e.g. a clear committed before the move began would be
+        silently resurrected."""
+        if min_version is not None:
+            await self.wait_for_version(min_version)
         v = self._version
         rows = []
         for k in self.map.range_keys(begin, end):
@@ -486,7 +496,8 @@ class StorageServer:
         return v, rows
 
     @rpc
-    async def fetch_keys(self, begin: bytes, end: bytes, src_ep) -> int:
+    async def fetch_keys(self, begin: bytes, end: bytes, src_ep,
+                         min_version: int | None = None) -> int:
         """Destination side of a shard move: copy [begin, end) from `src_ep`.
 
         The caller (DataDistributor) must already have dual-tagged the range
@@ -497,7 +508,9 @@ class StorageServer:
         f = FetchState(begin, end)
         self._fetching.append(f)
         try:
-            snap_version, rows = await src_ep.snapshot_range(begin, end)
+            snap_version, rows = await src_ep.snapshot_range(
+                begin, end, min_version
+            )
             # Reconcile existing history with the snapshot instead of
             # purging: when a shard is RE-acquired within the read window,
             # the old history still serves in-window readers through the

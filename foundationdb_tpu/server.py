@@ -213,7 +213,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
     elif role == "ratekeeper":
         from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
 
-        rk = Ratekeeper(loop, eps("storage"), eps("tlog"))
+        rk = Ratekeeper(loop, eps("storage"), eps("tlog"),
+                        proxy_eps=eps("proxy", "commit_proxy"))
         t.serve("ratekeeper", rk)
         _supervise(loop, "ratekeeper.run", rk.run)
     else:

@@ -415,6 +415,10 @@ class SimCluster:
             host(f"commit_proxy{i}{sfx}", f"commit_proxy{i}", c, run=True)
             for i, c in enumerate(self.commit_proxies)
         ]
+        if self.ratekeeper is not None:
+            # Proxies recruit after the ratekeeper; hand it their endpoints
+            # so it can measure committed-txn throughput (calibration).
+            self.ratekeeper.proxies = list(self.commit_proxy_eps)
 
         # Hand storage servers to the new generation: roll back anything
         # applied above the recovery version (their old tlog's lost suffix)

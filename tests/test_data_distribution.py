@@ -251,7 +251,7 @@ class TestFetchRedelivery:
         dest.init_served([])
 
         class FakeSrc:
-            async def snapshot_range(self, begin, end):
+            async def snapshot_range(self, begin, end, min_version=None):
                 return 10, [(b"a/k", b"snapval")]  # ahead of dest's cursor
 
         async def main():

@@ -189,9 +189,19 @@ class TestDeployedCluster:
             rv3 = c.get_read_version()
             assert rv3 >= cv2
             assert c.get(b"\xa0far-shard", rv3) == b"routed"
+            # Conflict check needs a snapshot older than an interfering
+            # write but inside the ~5s MVCC window — take it fresh here
+            # (the earlier `rv` can be past the window by now: the version
+            # clock runs on wall time).
+            rv4 = c.get_read_version()
+            c.commit(
+                rv4,
+                [Mutation(M.SET_VALUE, b"c/deployed", b"interferer")],
+                write_ranges=[single_key_range(b"c/deployed")],
+            )
             with pytest.raises(FdbError) as ei:
                 c.commit(
-                    rv,
+                    rv4,
                     [Mutation(M.SET_VALUE, b"c/deployed", b"no")],
                     read_ranges=[single_key_range(b"c/deployed")],
                     write_ranges=[single_key_range(b"c/deployed")],
