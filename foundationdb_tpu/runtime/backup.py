@@ -228,10 +228,24 @@ class BackupAgent:
         self.container.snapshot_complete = True
 
     async def stop(self) -> None:
-        """End the backup: stop dual-tagging and retire the backup tag so
-        the tlogs' trim floor is not pinned forever."""
+        """End the backup: stop dual-tagging, DRAIN the log worker through
+        everything committed while the backup was active, then retire the
+        backup tag so the tlogs' trim floor is not pinned forever.
+
+        The drain is the contract that makes stop() meaningful (reference:
+        discontinueBackup waits for the log to reach the stop version):
+        without it, mutations already committed — pushed to the tlogs but
+        not yet peeked by the worker (e.g. under slow-peek timing) — would
+        silently miss the container, and a restore would resurrect older
+        values of those keys."""
         await self._set_proxies(False)
         if self._worker:
+            try:
+                target = await self.cluster.sequencer_ep.get_live_committed_version()
+            except Exception:
+                target = 0  # sequencer unreachable: keep legacy behavior
+            while self._worker._version < target:
+                await self.cluster.loop.sleep(0.01)
             self._worker.stop()
         self.cluster.backup_worker = None
         # Persistent retirement: future generations' tlogs are constructed

@@ -27,12 +27,15 @@ from dataclasses import dataclass, field
 from foundationdb_tpu.runtime.flow import all_of
 from foundationdb_tpu.sim.workloads import (
     AtomicOpsWorkload,
+    BackupRestoreWorkload,
     ChangeFeedWorkload,
     ConflictRangeWorkload,
     CycleWorkload,
     FaultInjector,
+    IncrementWorkload,
     MakoWorkload,
     RandomReadWriteWorkload,
+    SelectorCorrectnessWorkload,
     TPCCNewOrderWorkload,
     VersionStampWorkload,
     WatchesWorkload,
@@ -83,6 +86,21 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "clientCount": "n_clients",
     }),
     "ChangeFeed": (ChangeFeedWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "Increment": (IncrementWorkload, {
+        "counterCount": "n_counters",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "SelectorCorrectness": (SelectorCorrectnessWorkload, {
+        "keyCount": "n_keys",
+        "queryCount": "n_queries",
+        "clientCount": "n_clients",
+    }),
+    "BackupRestore": (BackupRestoreWorkload, {
         "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "clientCount": "n_clients",

@@ -965,3 +965,253 @@ class ChangeFeedWorkload(Workload):
                 f"feed replay diverged: model {len(model)} keys vs "
                 f"db {len(rows)} keys"
             )
+
+
+class IncrementWorkload(Workload):
+    """Atomic-increment conservation (reference: Increment.actor.cpp):
+    clients ADD 1 to random counters; quiesced, the counters must sum to
+    EXACTLY the committed-op count. Lost, torn, or double-applied atomic
+    ops all break the sum. (Run clean — an unknown-result retry of an
+    atomic op legitimately double-applies, as in the reference.)"""
+
+    name = "increment"
+
+    def __init__(self, seed: int = 0, n_counters: int = 8, n_txns: int = 40,
+                 n_clients: int = 4):
+        super().__init__(seed)
+        self.n_counters = n_counters
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+
+    def _key(self, i: int) -> bytes:
+        return b"incr/%04d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"incr/", b"incr0")  # own the prefix
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                i = rng.randrange(self.n_counters)
+                j = rng.randrange(self.n_counters)
+
+                async def body(tr, i=i, j=j):
+                    one = struct.pack("<q", 1)
+                    tr.atomic_op(MutationType.ADD, self._key(i), one)
+                    tr.atomic_op(MutationType.ADD, self._key(j), one)
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 2
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"incr.client{i}")
+             for i in range(self.n_clients)]
+        )
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            total = 0
+            for i in range(self.n_counters):
+                v = await tr.get(self._key(i))
+                total += struct.unpack("<q", v)[0] if v is not None else 0
+            return total
+
+        total = await self._run_txn(db, body)
+        if total != self.metrics.ops:
+            raise WorkloadFailed(
+                f"increment sum {total} != committed ops {self.metrics.ops}"
+            )
+
+
+class SelectorCorrectnessWorkload(Workload):
+    """Key-selector + limited/reverse range reads vs a sorted in-memory
+    model (reference: SelectorCorrectness.actor.cpp): populate a known key
+    set, then fire random firstGreaterOrEqual/lastLessThan selectors with
+    random offsets and random limited scans; every answer must equal the
+    model's."""
+
+    name = "selectors"
+
+    def __init__(self, seed: int = 0, n_keys: int = 24, n_queries: int = 60,
+                 n_clients: int = 3):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_queries = n_queries
+        self.n_clients = n_clients
+        self.keys: list[bytes] = []
+
+    async def setup(self, db) -> None:
+        self.keys = [b"sel/%04d" % (3 * i) for i in range(self.n_keys)]
+
+        async def body(tr):
+            # Own the prefix: a previous test in the same spec file may
+            # have left keys here (tests share the cluster, as in the
+            # reference's multi-test TOML runs).
+            tr.clear_range(b"sel/", b"sel0")
+            for k in self.keys:
+                tr.set(k, b"v" + k[-4:])
+
+        await self._run_txn(db, body)
+
+    def _model_resolve(self, anchor: bytes, or_equal: bool, offset: int) -> bytes:
+        """The reference selector semantics over the sorted model."""
+        import bisect
+
+        from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+        ks = self.keys
+        if offset >= 1:
+            start = anchor + b"\x00" if or_equal else anchor
+            i = bisect.bisect_left(ks, start) + (offset - 1)
+            return ks[i] if i < len(ks) else MAX_KEY
+        back = 1 - offset
+        end = anchor + b"\x00" if or_equal else anchor
+        i = bisect.bisect_left(ks, end) - back
+        return ks[i] if i >= 0 else b""
+
+    async def run(self, db, cluster) -> None:
+        from foundationdb_tpu.client.transaction import KeySelector
+
+        rng = cluster.loop.rng
+        counts = self._split(self.n_queries, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                anchor = b"sel/%04d" % rng.randrange(3 * self.n_keys + 2)
+                or_equal = rng.random() < 0.5
+                offset = rng.randrange(-3, 4)
+                kind = rng.random()
+
+                async def body(tr, anchor=anchor, or_equal=or_equal,
+                               offset=offset, kind=kind):
+                    if kind < 0.5:
+                        from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+                        got = await tr.get_key(
+                            KeySelector(anchor, or_equal, offset)
+                        )
+                        want = self._model_resolve(anchor, or_equal, offset)
+                        # A resolution escaping our prefix lands on some
+                        # OTHER workload's key (the db resolves selectors
+                        # over the whole keyspace); the model only knows
+                        # the direction then.
+                        ok = (
+                            got == want
+                            or (want == b"" and got < b"sel/")
+                            or (want == MAX_KEY and got >= b"sel0")
+                        )
+                        if not ok:
+                            raise WorkloadFailed(
+                                f"selector({anchor!r},{or_equal},{offset}) "
+                                f"= {got!r}, model says {want!r}"
+                            )
+                    else:
+                        limit = 1 + int(kind * 10)
+                        reverse = kind > 0.8
+                        rows = await tr.get_range(
+                            b"sel/", anchor, limit=limit, reverse=reverse
+                        )
+                        model = [k for k in self.keys if k < anchor]
+                        if reverse:
+                            model.reverse()
+                        model = model[:limit]
+                        if [k for k, _ in rows] != model:
+                            raise WorkloadFailed(
+                                f"range(sel/..{anchor!r} lim={limit} "
+                                f"rev={reverse}) mismatch"
+                            )
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"sel.client{i}")
+             for i in range(self.n_clients)]
+        )
+
+
+class BackupRestoreWorkload(Workload):
+    """Backup under live writes, restore elsewhere, compare keyspaces
+    (reference: BackupToDBCorrectness.actor.cpp): a continuous backup and
+    a rolling snapshot run WHILE writer clients mutate; after stop, the
+    container restores into a fresh cluster on the same sim loop and the
+    two keyspaces must match exactly at the restorable version."""
+
+    name = "backup_restore"
+
+    def __init__(self, seed: int = 0, n_keys: int = 20, n_txns: int = 30,
+                 n_clients: int = 3):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self._container = None
+
+    def _key(self, i: int) -> bytes:
+        return b"bk/%04d" % i
+
+    async def run(self, db, cluster) -> None:
+        from foundationdb_tpu.runtime.backup import BackupAgent
+
+        async def seed(tr):
+            for i in range(self.n_keys):
+                tr.set(self._key(i), b"seed")
+
+        await self._run_txn(db, seed)
+        agent = BackupAgent(cluster, db)
+        await agent.start()
+
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for j in range(counts[cid]):
+                i = rng.randrange(self.n_keys)
+
+                async def body(tr, i=i, cid=cid, j=j):
+                    tr.set(self._key(i), b"w%02d-%04d" % (cid, j))
+                    if rng.random() < 0.2:
+                        tr.clear(self._key(rng.randrange(self.n_keys)))
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        writers = [
+            cluster.loop.spawn(client(i), name=f"bk.client{i}")
+            for i in range(self.n_clients)
+        ]
+        await agent.snapshot(b"bk/", b"bk0")  # rolls while writers run
+        await all_of(writers)
+        await agent.stop()
+        self._container = agent.container
+
+    async def check(self, db) -> None:
+        from foundationdb_tpu.client.ryw import open_database
+        from foundationdb_tpu.runtime.backup import restore
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        if self._container is None or \
+                self._container.restorable_version() is None:
+            raise WorkloadFailed("backup produced no restorable version")
+        # Fresh destination cluster on the SAME loop (the sim stays one
+        # deterministic world).
+        dst_c = SimCluster(loop=db.loop, seed=self.seed + 9999)
+        dst = open_database(dst_c)
+        await restore(dst, self._container)
+
+        async def dump(tr):
+            return await tr.get_range(b"bk/", b"bk0", limit=100_000)
+
+        src_rows = await self._run_txn(db, dump)
+        dst_rows = await dst.run(dump)
+        if src_rows != dst_rows:
+            raise WorkloadFailed(
+                f"restore mismatch: src {len(src_rows)} rows vs dst "
+                f"{len(dst_rows)} rows"
+            )
