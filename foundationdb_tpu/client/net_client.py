@@ -40,6 +40,10 @@ def _lib():
             ctypes.c_int32, u8p, i64p, u8p, i64p,
             ctypes.c_int32, u8p, i64p, u8p, i64p,
         ]
+        lib.fnet_commit_send.restype = ctypes.c_uint64
+        lib.fnet_commit_send.argtypes = lib.fnet_commit.argtypes
+        lib.fnet_commit_wait.restype = ctypes.c_int64
+        lib.fnet_commit_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.fnet_get.restype = ctypes.c_int32
         lib.fnet_get.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_int64,
@@ -88,9 +92,7 @@ class NetClient:
             raise FdbError(f"get_read_version failed", code=int(-v))
         return int(v)
 
-    def commit(self, read_version: int, mutations: list[Mutation],
-               read_ranges: list[KeyRange] = (),
-               write_ranges: list[KeyRange] = ()) -> int:
+    def _commit_args(self, read_version, mutations, read_ranges, write_ranges):
         mtypes = np.asarray([int(m.type) for m in mutations], np.int32)
         if mtypes.size == 0:
             mtypes = np.zeros(1, np.int32)
@@ -100,7 +102,9 @@ class NetClient:
         re_ = _flat([r.end for r in read_ranges])
         wb = _flat([r.begin for r in write_ranges])
         we = _flat([r.end for r in write_ranges])
-        v = _lib().fnet_commit(
+        # Keep the arrays alive through the C call.
+        keepalive = (mtypes, p1, p2, rb, re_, wb, we)
+        args = (
             self._h, self.proxy_service, read_version,
             len(mutations),
             mtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
@@ -108,6 +112,35 @@ class NetClient:
             len(read_ranges), rb[2], rb[3], re_[2], re_[3],
             len(write_ranges), wb[2], wb[3], we[2], we[3],
         )
+        return args, keepalive
+
+    def commit(self, read_version: int, mutations: list[Mutation],
+               read_ranges: list[KeyRange] = (),
+               write_ranges: list[KeyRange] = ()) -> int:
+        args, _keep = self._commit_args(
+            read_version, mutations, read_ranges, write_ranges
+        )
+        v = _lib().fnet_commit(*args)
+        if v < 0:
+            raise FdbError("commit failed", code=int(-v))
+        return int(v)
+
+    def commit_send(self, read_version: int, mutations: list[Mutation],
+                    read_ranges: list[KeyRange] = (),
+                    write_ranges: list[KeyRange] = ()) -> int:
+        """Pipelined commit: send and return a request id without waiting.
+        Any number may be outstanding on this connection; collect each
+        with commit_wait (any order)."""
+        args, _keep = self._commit_args(
+            read_version, mutations, read_ranges, write_ranges
+        )
+        req = _lib().fnet_commit_send(*args)
+        if req == 0:
+            raise FdbError("commit send failed", code=1100)
+        return int(req)
+
+    def commit_wait(self, req_id: int) -> int:
+        v = _lib().fnet_commit_wait(self._h, req_id)
         if v < 0:
             raise FdbError("commit failed", code=int(-v))
         return int(v)

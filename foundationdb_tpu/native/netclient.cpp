@@ -15,6 +15,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <cstdlib>
 #include <string>
 #include <vector>
@@ -41,6 +42,9 @@ constexpr int64_t ERR_BROKEN = 1100;     // broken_promise (connection lost)
 struct Conn {
   int fd = -1;
   uint64_t next_id = 1;
+  // Replies that arrived while waiting for a different request id —
+  // the pipelining stash (multiple requests in flight on one conn).
+  std::map<uint64_t, std::vector<uint8_t>> stash;
 };
 
 struct Buf {
@@ -150,35 +154,25 @@ bool read_all(int fd, uint8_t* p, size_t n) {
   return true;
 }
 
-// One round trip: frame out, frame in. Returns the reply payload (the
-// value inside (RSP, msg_id, ok, value)) via `out`; on ok=false returns
-// the FdbError code as a negative number; 0 on success.
-int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
-                   Cur& value_cur) {
-  if (c->fd < 0) return -ERR_BROKEN;
+// Send one request frame (no wait). Returns false on IO failure.
+bool send_frame(Conn* c, const Buf& req) {
+  if (c->fd < 0) return false;
   uint32_t len = static_cast<uint32_t>(req.d.size());
   uint8_t hdr[4];
   memcpy(hdr, &len, 4);
-  if (!write_all(c->fd, hdr, 4) || !write_all(c->fd, req.d.data(), len))
-    return -ERR_BROKEN;
-  if (!read_all(c->fd, hdr, 4)) return -ERR_BROKEN;
-  uint32_t rlen;
-  memcpy(&rlen, hdr, 4);
-  if (rlen > (64u << 20)) {
-    // Cannot resync without draining the oversized frame: break the conn
-    // so later calls fail cleanly instead of parsing stale payload bytes.
-    ::close(c->fd);
-    c->fd = -1;
-    return -ERR_BROKEN;
-  }
-  out.resize(rlen);
-  if (!read_all(c->fd, out.data(), rlen)) return -ERR_BROKEN;
+  return write_all(c->fd, hdr, 4) && write_all(c->fd, req.d.data(), len);
+}
 
-  Cur cur{out.data(), out.size()};
-  // (RSP=1, msg_id, ok, value) as a tuple
+// Parse a reply frame (RSP=1, msg_id, ok, value). Fills msg_id; on ok
+// positions value_cur at the value and returns 0; on ok=false returns
+// the FdbError code negated.
+int64_t parse_reply(std::vector<uint8_t>& frame, Cur& value_cur,
+                    uint64_t* msg_id) {
+  Cur cur{frame.data(), frame.size()};
   if (cur.u8() != T_TUPLE || cur.u32() != 4) return -ERR_INTERNAL;
   if (cur.u8() != T_INT || cur.i64() != 1) return -ERR_INTERNAL;  // kind
-  if (!skip_value(cur)) return -ERR_INTERNAL;                     // msg_id
+  if (cur.u8() != T_INT) return -ERR_INTERNAL;  // msg_id (our ids are ints)
+  *msg_id = static_cast<uint64_t>(cur.i64());
   uint8_t okt = cur.u8();
   if (okt == T_FALSE) {
     // value is an FdbError (or anything): extract the code if possible.
@@ -194,14 +188,67 @@ int64_t round_trip(Conn* c, const Buf& req, std::vector<uint8_t>& out,
   return 0;
 }
 
-void req_header(Buf& b, Conn* c, const char* service, const char* method,
-                uint32_t n_args) {
+// Wait for the reply to `want`: replies for OTHER in-flight requests are
+// stashed (pipelining — fdb_c keeps many requests outstanding the same
+// way; here ordering is cooperative rather than threaded).
+int64_t recv_reply_for(Conn* c, uint64_t want, std::vector<uint8_t>& out,
+                       Cur& value_cur) {
+  auto it = c->stash.find(want);
+  if (it != c->stash.end()) {
+    out = std::move(it->second);
+    c->stash.erase(it);
+    uint64_t id;
+    return parse_reply(out, value_cur, &id);
+  }
+  while (true) {
+    if (c->fd < 0) return -ERR_BROKEN;
+    uint8_t hdr[4];
+    if (!read_all(c->fd, hdr, 4)) return -ERR_BROKEN;
+    uint32_t rlen;
+    memcpy(&rlen, hdr, 4);
+    if (rlen > (64u << 20)) {
+      // Cannot resync without draining the oversized frame: break the
+      // conn so later calls fail cleanly instead of parsing stale bytes.
+      ::close(c->fd);
+      c->fd = -1;
+      return -ERR_BROKEN;
+    }
+    std::vector<uint8_t> frame(rlen);
+    if (!read_all(c->fd, frame.data(), rlen)) return -ERR_BROKEN;
+    // Peek the msg_id without consuming the frame.
+    Cur cur{frame.data(), frame.size()};
+    if (cur.u8() != T_TUPLE || cur.u32() != 4) return -ERR_INTERNAL;
+    if (cur.u8() != T_INT || cur.i64() != 1) return -ERR_INTERNAL;
+    if (cur.u8() != T_INT) return -ERR_INTERNAL;
+    uint64_t id = static_cast<uint64_t>(cur.i64());
+    if (id == want) {
+      out = std::move(frame);
+      uint64_t got;
+      return parse_reply(out, value_cur, &got);
+    }
+    c->stash[id] = std::move(frame);
+  }
+}
+
+// One round trip: frame out, matching frame in. Returns the reply payload
+// (the value inside (RSP, msg_id, ok, value)) via `out`; on ok=false
+// returns the FdbError code as a negative number; 0 on success.
+int64_t round_trip(Conn* c, const Buf& req, uint64_t id,
+                   std::vector<uint8_t>& out, Cur& value_cur) {
+  if (!send_frame(c, req)) return -ERR_BROKEN;
+  return recv_reply_for(c, id, out, value_cur);
+}
+
+uint64_t req_header(Buf& b, Conn* c, const char* service, const char* method,
+                    uint32_t n_args) {
+  uint64_t id = c->next_id++;
   b.seq_header(T_TUPLE, 5);       // (REQ, msg_id, service, method, args)
   b.tag_int(0);                   // kind = request
-  b.tag_int(static_cast<int64_t>(c->next_id++));
+  b.tag_int(static_cast<int64_t>(id));
   b.tag_str(service);
   b.tag_str(method);
   b.seq_header(T_LIST, n_args);
+  return id;
 }
 
 void pack_range(Buf& b, const uint8_t* begin, int64_t blen,
@@ -245,10 +292,10 @@ void fnet_close(void* h) {
 int64_t fnet_get_read_version(void* h, const char* grv_service) {
   Conn* c = static_cast<Conn*>(h);
   Buf b;
-  req_header(b, c, grv_service, "get_read_version", 0);
+  uint64_t id = req_header(b, c, grv_service, "get_read_version", 0);
   std::vector<uint8_t> reply;
   Cur v{nullptr, 0};
-  int64_t rc = round_trip(c, b, reply, v);
+  int64_t rc = round_trip(c, b, id, reply, v);
   if (rc < 0) return rc;
   if (v.u8() != T_INT) return -ERR_INTERNAL;
   return v.i64();
@@ -257,8 +304,8 @@ int64_t fnet_get_read_version(void* h, const char* grv_service) {
 // Commit a transaction. Mutations/ranges are flat arrays with offset
 // tables (offsets have n+1 entries; item i is bytes [off[i], off[i+1])).
 // >= 0: commit version; < 0: -fdb_error_code (e.g. -1020 not_committed).
-int64_t fnet_commit(
-    void* h, const char* proxy_service, int64_t read_version,
+static uint64_t build_commit_req(
+    Buf& b, Conn* c, const char* proxy_service, int64_t read_version,
     int32_t n_mutations, const int32_t* mtypes,
     const uint8_t* p1, const int64_t* p1_off,
     const uint8_t* p2, const int64_t* p2_off,
@@ -266,9 +313,7 @@ int64_t fnet_commit(
     const uint8_t* re, const int64_t* re_off,
     int32_t n_writes, const uint8_t* wb, const int64_t* wb_off,
     const uint8_t* we, const int64_t* we_off) {
-  Conn* c = static_cast<Conn*>(h);
-  Buf b;
-  req_header(b, c, proxy_service, "commit", 1);
+  uint64_t id = req_header(b, c, proxy_service, "commit", 1);
   b.struct_header(S_COMMIT_REQ);
   b.seq_header(T_TUPLE, 5);
   b.tag_int(read_version);
@@ -289,17 +334,71 @@ int64_t fnet_commit(
     pack_range(b, wb + wb_off[i], wb_off[i + 1] - wb_off[i],
                we + we_off[i], we_off[i + 1] - we_off[i]);
   b.tag_bool(false);  // report_conflicting_keys
+  return id;
+}
 
-  std::vector<uint8_t> reply;
-  Cur v{nullptr, 0};
-  int64_t rc = round_trip(c, b, reply, v);
-  if (rc < 0) return rc;
-  // CommitResult struct: (version, batch_order)
+// CommitResult struct: (version, batch_order) -> commit version.
+static int64_t parse_commit_value(Cur& v) {
   if (v.u8() != T_STRUCT) return -ERR_INTERNAL;
   v.u16();
   if (v.u8() != T_TUPLE || v.u32() < 1) return -ERR_INTERNAL;
   if (v.u8() != T_INT) return -ERR_INTERNAL;
   return v.i64();
+}
+
+int64_t fnet_commit(
+    void* h, const char* proxy_service, int64_t read_version,
+    int32_t n_mutations, const int32_t* mtypes,
+    const uint8_t* p1, const int64_t* p1_off,
+    const uint8_t* p2, const int64_t* p2_off,
+    int32_t n_reads, const uint8_t* rb, const int64_t* rb_off,
+    const uint8_t* re, const int64_t* re_off,
+    int32_t n_writes, const uint8_t* wb, const int64_t* wb_off,
+    const uint8_t* we, const int64_t* we_off) {
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  uint64_t id = build_commit_req(
+      b, c, proxy_service, read_version, n_mutations, mtypes, p1, p1_off,
+      p2, p2_off, n_reads, rb, rb_off, re, re_off, n_writes, wb, wb_off,
+      we, we_off);
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = round_trip(c, b, id, reply, v);
+  if (rc < 0) return rc;
+  return parse_commit_value(v);
+}
+
+// Pipelined commit: send without waiting. Returns the request id (> 0)
+// or 0 on send failure; pass the id to fnet_commit_wait. Any number of
+// sends may be outstanding on one connection; waits may happen in any
+// order (replies for other ids are stashed).
+uint64_t fnet_commit_send(
+    void* h, const char* proxy_service, int64_t read_version,
+    int32_t n_mutations, const int32_t* mtypes,
+    const uint8_t* p1, const int64_t* p1_off,
+    const uint8_t* p2, const int64_t* p2_off,
+    int32_t n_reads, const uint8_t* rb, const int64_t* rb_off,
+    const uint8_t* re, const int64_t* re_off,
+    int32_t n_writes, const uint8_t* wb, const int64_t* wb_off,
+    const uint8_t* we, const int64_t* we_off) {
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  uint64_t id = build_commit_req(
+      b, c, proxy_service, read_version, n_mutations, mtypes, p1, p1_off,
+      p2, p2_off, n_reads, rb, rb_off, re, re_off, n_writes, wb, wb_off,
+      we, we_off);
+  if (!send_frame(c, b)) return 0;
+  return id;
+}
+
+// >= 0: commit version; < 0: -fdb_error_code.
+int64_t fnet_commit_wait(void* h, uint64_t req_id) {
+  Conn* c = static_cast<Conn*>(h);
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = recv_reply_for(c, req_id, reply, v);
+  if (rc < 0) return rc;
+  return parse_commit_value(v);
 }
 
 // Point read at a version. Returns 0 (found, *out_len set), 1 (no value),
@@ -310,12 +409,12 @@ int32_t fnet_get(void* h, const char* storage_service, const uint8_t* key,
                  int64_t out_cap, int64_t* out_len) {
   Conn* c = static_cast<Conn*>(h);
   Buf b;
-  req_header(b, c, storage_service, "get", 2);
+  uint64_t id = req_header(b, c, storage_service, "get", 2);
   b.tag_bytes(key, key_len);
   b.tag_int(version);
   std::vector<uint8_t> reply;
   Cur v{nullptr, 0};
-  int64_t rc = round_trip(c, b, reply, v);
+  int64_t rc = round_trip(c, b, id, reply, v);
   if (rc < 0) return static_cast<int32_t>(rc);
   uint8_t t = v.u8();
   if (t == T_NONE) return 1;
