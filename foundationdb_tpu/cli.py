@@ -76,6 +76,8 @@ set KEY VALUE           write a key (requires `writemode on`)
 clear KEY               clear a key (requires `writemode on`)
 clearrange BEGIN END    clear a range (requires `writemode on`)
 writemode on|off        allow/forbid mutations (fdbcli semantics)
+throttle tag NAME TPS   cap transactions carrying tag NAME at TPS
+unthrottle tag NAME     clear a tag quota
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -150,6 +152,20 @@ class Shell:
                 await tr.commit()
             self._await(go())
             return "Committed"
+        if cmd in ("throttle", "unthrottle"):
+            # fdbcli `throttle on tag <name>` analogue (manual TagThrottle).
+            if len(args) < 2 or args[0] != "tag" or (
+                cmd == "throttle" and len(args) != 3
+            ):
+                return (f"usage: {cmd} tag NAME" +
+                        (" TPS" if cmd == "throttle" else ""))
+            rks = self.spec.get("ratekeeper") or []
+            if not rks:
+                return "ERROR: no ratekeeper in the cluster spec"
+            ep = self.t.endpoint(parse_addr(rks[0]), "ratekeeper")
+            tps = float(args[2]) if cmd == "throttle" else None
+            self._await(ep.set_tag_quota(args[1], tps))
+            return ("Throttled" if tps is not None else "Unthrottled")
         if cmd == "status":
             return json.dumps(self._status(), indent=1, sort_keys=True)
         return f"ERROR: unknown command `{cmd}' (try help)"

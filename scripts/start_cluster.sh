@@ -26,7 +26,7 @@ spec = {
     "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
     "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
     "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
-    "ratekeeper": [],
+    "ratekeeper": [f"127.0.0.1:{next(ports)}"],
     "engine": "cpu",
 }
 json.dump(spec, open(spec_path, "w"), indent=1)
@@ -49,6 +49,7 @@ launch storage 0
 launch storage 1
 launch proxy 0
 launch proxy 1
+launch ratekeeper 0
 
 # Wait until a client transaction commits end to end.
 for i in $(seq 1 30); do

@@ -40,14 +40,14 @@ def cluster(tmp_path_factory):
     """1 sequencer, 1 resolver, 2 tlogs, 2 storages, 2 proxies — each an
     OS process; yields the spec path."""
     tmp = tmp_path_factory.mktemp("cluster")
-    ports = iter(free_ports(8))
+    ports = iter(free_ports(9))
     spec = {
         "sequencer": [f"127.0.0.1:{next(ports)}"],
         "resolver": [f"127.0.0.1:{next(ports)}"],
         "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
         "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
         "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
-        "ratekeeper": [],
+        "ratekeeper": [f"127.0.0.1:{next(ports)}"],
         "engine": "cpu",
     }
     spec_path = tmp / "cluster.json"
@@ -147,6 +147,20 @@ class TestDeployedCluster:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "`cli/key' is `cli-val'" in r.stdout
         assert "not found" in r.stdout  # after the clear
+
+    def test_cli_throttle_tag(self, cluster):
+        """fdbcli-style manual tag throttling against the deployed
+        ratekeeper role."""
+        r = run_cli(cluster, "throttle tag batchjobs 25")
+        assert r.returncode == 0 and "Throttled" in r.stdout, r.stdout
+        r = run_cli(cluster, "status")
+        status = json.loads(r.stdout)
+        assert status["roles"]["ratekeeper0"]["tag_rates"] == \
+            {"batchjobs": 25.0}
+        r = run_cli(cluster, "unthrottle tag batchjobs")
+        assert "Unthrottled" in r.stdout
+        r = run_cli(cluster, "status")
+        assert json.loads(r.stdout)["roles"]["ratekeeper0"]["tag_rates"] == {}
 
     def test_cli_status(self, cluster):
         r = run_cli(cluster, "status")
