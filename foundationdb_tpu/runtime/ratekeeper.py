@@ -106,26 +106,43 @@ class Ratekeeper:
     async def _calibrate(self) -> None:
         """Derive the tps ceiling from MEASURED role throughput instead of
         a constant (VERDICT r2 weak-5): smooth the commit proxies'
-        txns_committed delta into measured_tps; while any signal degrades
-        (queues/lag growing — _scale < 1) pull the ceiling down to just
-        above what the roles demonstrably service, and while healthy and
+        txns_committed delta into measured_tps; while a signal degrades
+        AND the proxies hold a backlog (the flow is admission-limited,
+        not a background cause like a DD move), pull the ceiling down to
+        just above what the roles demonstrably service; while healthy and
         running near the ceiling, probe it upward. The min-over-reasons
         linear scale then operates on a ceiling that tracks real capacity
-        (reference: Ratekeeper's smoothed actualTps feeding tpsLimit)."""
+        (reference: Ratekeeper's smoothed actualTps feeding tpsLimit).
+
+        Failure containment: an unreachable proxy only skips THIS poll's
+        calibration sample — the caller still updates the signal-based
+        limits (a proxy outage must never freeze throttling). A committed
+        count below the baseline means the proxy set changed (recovery
+        swapped generations, counters restarted): re-baseline instead of
+        injecting a spurious zero-rate sample."""
         if not self.proxies:
             return
-        ms = await all_of([p.get_metrics() for p in self.proxies])
+        ms = []
+        for p in self.proxies:
+            try:
+                ms.append(await p.get_metrics())
+            except Exception:
+                self._last_committed = None  # membership degraded: re-baseline
+                return
         committed = sum(m.get("txns_committed", 0) for m in ms)
-        if self._last_committed is None:
+        backlog = sum(m.get("queued", 0) for m in ms)
+        if self._last_committed is None or committed < self._last_committed:
             self._last_committed = committed
             return
-        rate = max(0.0, committed - self._last_committed) / self.POLL_INTERVAL
+        rate = (committed - self._last_committed) / self.POLL_INTERVAL
         self._last_committed = committed
         a = self.EWMA_ALPHA
         self.measured_tps = (1 - a) * self.measured_tps + a * rate
-        if self._scale(1.0) < 1.0:
-            # Some signal is degrading: the current admission exceeds what
-            # the roles service — converge the ceiling onto measurement.
+        if self._scale(1.0) < 1.0 and backlog > 0:
+            # Degrading under backlog: admission exceeds what the roles
+            # service — converge the ceiling onto measurement. (Without
+            # backlog, measured_tps is just DEMAND; clamping to it would
+            # collapse the ceiling on any background blip.)
             self.base_tps = min(
                 self.base_tps,
                 max(self.MIN_TPS, self.measured_tps * self.BACKOFF_MARGIN),

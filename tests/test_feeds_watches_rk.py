@@ -391,7 +391,11 @@ class TestCalibration:
         class SatProxy:
             def get_metrics(self):
                 async def get():
-                    return {"txns_committed": int(world.committed)}
+                    # Admission above capacity piles a commit backlog at
+                    # the proxy, the admission-limited indicator.
+                    backlog = int(max(0.0, rk.tps_limit - CAPACITY))
+                    return {"txns_committed": int(world.committed),
+                            "queued": backlog}
 
                 return loop.spawn(get(), name="sat_proxy.metrics")
 
@@ -455,3 +459,79 @@ class TestCalibration:
 
         rates = loop.run(main(), timeout=600)
         assert rates["base_tps"] > 2_000.0, rates  # probed well past start
+
+    def test_background_blip_does_not_collapse_ceiling(self):
+        """A soft-threshold signal WITHOUT proxy backlog (a DD move, a
+        backup) must not clamp the ceiling to the (low) demand level
+        (code review r3): demand is not capacity."""
+        loop = Loop(seed=0)
+        committed = {"n": 0.0}
+
+        class IdleProxy:
+            def get_metrics(self):
+                async def get():
+                    return {"txns_committed": int(committed["n"]),
+                            "queued": 0}
+
+                return loop.spawn(get(), name="idle_proxy.metrics")
+
+        class BlippyStorage:
+            def __init__(self):
+                self.queue_bytes = 0
+
+            def metrics(self):
+                async def get():
+                    return {"version_lag": 0, "durability_lag": 0,
+                            "queue_bytes": self.queue_bytes}
+
+                return loop.spawn(get(), name="blippy.metrics")
+
+        s = BlippyStorage()
+        rk = Ratekeeper(loop, [s], [], proxy_eps=[IdleProxy()])
+
+        async def main():
+            loop.spawn(rk.run(), name="rk")
+
+            async def demand():
+                while True:
+                    committed["n"] += 1000 * 0.05  # 1k tps of demand
+                    await loop.sleep(0.05)
+
+            loop.spawn(demand(), name="demand")
+            await loop.sleep(1.0)
+            s.queue_bytes = int(Ratekeeper.SQ_SOFT * 2)  # the blip
+            await loop.sleep(1.0)
+            s.queue_bytes = 0
+            await loop.sleep(0.5)
+            return await rk.get_rates()
+
+        rates = loop.run(main(), timeout=600)
+        # Ceiling survives the blip near its starting point (not ~1.1k).
+        assert rates["base_tps"] > 0.5 * Ratekeeper.BASE_TPS, rates
+
+    def test_proxy_outage_does_not_freeze_signal_throttling(self):
+        """An unreachable commit proxy skips calibration but must NOT stop
+        the queue/lag signals from updating the limits (code review r3)."""
+        loop = Loop(seed=0)
+
+        class DeadProxy:
+            def get_metrics(self):
+                async def get():
+                    raise RuntimeError("unreachable stand-in")
+
+                return loop.spawn(get(), name="dead_proxy.metrics")
+
+        s = FakeStorage()
+        s.loop = loop
+        rk = Ratekeeper(loop, [s], [], proxy_eps=[DeadProxy()])
+
+        async def main():
+            loop.spawn(rk.run(), name="rk")
+            await loop.sleep(0.5)
+            assert (await rk.get_rates())["tps_limit"] == Ratekeeper.BASE_TPS
+            s.m["queue_bytes"] = Ratekeeper.SQ_HARD  # saturate the signal
+            await loop.sleep(0.5)
+            return await rk.get_rates()
+
+        rates = loop.run(main(), timeout=600)
+        assert rates["tps_limit"] == 0.0, rates  # throttling still reacts
