@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""True on-device phase timing for the conflict kernel.
+
+The axon tunnel adds ~2.5-10 ms per dispatch and its block_until_ready
+does not actually block (measured r3: a 134 MB matvec "completed" in 35 us),
+so naive per-call timing measures the tunnel, not the chip. Here every
+phase is looped K times INSIDE one jitted program (fori_loop/scan) and we
+difference two K values — one dispatch each, real completion forced by
+fetching a scalar — so both the dispatch overhead and the fetch RTT cancel.
+
+Writes a JSON line to stdout; human detail to stderr.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.models import conflict_kernel as ck
+    from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+    t0 = time.perf_counter()
+    dev = jax.devices()
+    log(f"devices {dev} in {time.perf_counter()-t0:.1f}s")
+
+    C, B, R, Q = 262144, 8192, 2, 1
+    rng = np.random.default_rng(0)
+    cs = TPUConflictSet(capacity=C, batch_size=B, max_read_ranges=R,
+                        max_write_ranges=Q, max_key_bytes=12,
+                        window_versions=64)
+    W = cs.state.keys.shape[1]
+
+    def rand_keys(n):
+        k = np.zeros((n, W), np.int32)
+        k[:, 0] = rng.integers(0, 1 << 16, size=n).astype(np.int32)
+        k[:, 1] = rng.integers(0, 1 << 30, size=n).astype(np.int32)
+        return k
+
+    rb = rand_keys(B * R).reshape(B, R, W)
+    re_ = rb.copy()
+    re_[:, :, 1] += 1
+    wb = rand_keys(B * Q).reshape(B, Q, W)
+    we = wb.copy()
+    we[:, :, 1] += 1
+    batch = ck.BatchTensors(
+        read_begin=jnp.asarray(rb), read_end=jnp.asarray(re_),
+        read_mask=jnp.ones((B, R), bool),
+        write_begin=jnp.asarray(wb), write_end=jnp.asarray(we),
+        write_mask=jnp.asarray(rng.random(size=(B, Q)) < 0.5),
+        read_version=jnp.zeros((B,), jnp.int32),
+        txn_mask=jnp.ones((B,), bool))
+    state = cs.state
+    m0 = jax.jit(ck._pairwise_overlap)(batch)
+    acc0 = jax.jit(ck._wave_accept)(jnp.asarray(np.ones((B,), bool)), m0)
+
+    results = {}
+
+    def chain(label, step, init, k1=2, k2=10):
+        """step: carry -> carry. Times (T(k2)-T(k1))/(k2-k1)."""
+        ts = {}
+        for k in (k1, k2):
+            @jax.jit
+            def run(c, k=k):
+                def body(i, c):
+                    return step(c)
+                c = jax.lax.fori_loop(0, k, body, c)
+                return jax.tree_util.tree_reduce(
+                    lambda a, b: a + jnp.sum(jnp.ravel(b).astype(jnp.float32)),
+                    c, jnp.float32(0))
+            tc = time.perf_counter()
+            float(run(init))  # compile + settle
+            tcomp = time.perf_counter() - tc
+            best = float("inf")
+            for _ in range(3):
+                t = time.perf_counter()
+                float(run(init))
+                best = min(best, time.perf_counter() - t)
+            ts[k] = best
+            log(f"  {label} k={k}: warm {best*1000:.1f} ms (compile {tcomp:.1f}s)")
+        per = (ts[k2] - ts[k1]) / (k2 - k1) * 1000
+        log(f"{label:28s} {per:9.2f} ms/iter ON DEVICE")
+        results[label] = round(per, 3)
+
+    def pert(a):
+        """int32 that is always 0 at runtime but opaque to XLA.
+
+        Every phase carry `a` is a sum of booleans, so min(a, 0) == 0 —
+        but XLA cannot prove the sign, so feeding this into a phase input
+        makes each iteration data-dependent on the previous one and
+        defeats while-loop invariant code motion (which would otherwise
+        hoist the phase and leave the loop timing nothing)."""
+        return jnp.minimum(a.astype(jnp.int32), 0)
+
+    # Full resolve (state evolves exactly like production).
+    chain("resolve_batch",
+          lambda c: (ck.resolve_batch(c[0], batch, c[1], jnp.int32(0))[1],
+                     c[1] + 1),
+          (state, jnp.int32(1)))
+    # Phases: each iteration's inputs are perturbed by a runtime-zero
+    # derived from the carry, so the loop body cannot be hoisted.
+    chain("history_conflicts",
+          lambda a: a + jnp.sum(ck._history_conflicts(
+              state, batch._replace(
+                  read_version=batch.read_version + pert(a)))
+              .astype(jnp.float32)),
+          jnp.float32(0))
+    chain("pairwise_overlap",
+          lambda a: a + jnp.sum(ck._pairwise_overlap(
+              batch._replace(read_begin=batch.read_begin + pert(a)))
+              .astype(jnp.float32)),
+          jnp.float32(0))
+    chain("wave_accept",
+          lambda a: a + jnp.sum(
+              ck._wave_accept(jnp.ones((B,), bool) ^ (pert(a) > 0), m0)
+              .astype(jnp.float32)),
+          jnp.float32(0))
+    chain("paint_and_compact",
+          lambda st: ck._paint_and_compact(st, batch, acc0, jnp.int32(5),
+                                           jnp.int32(0)),
+          state)
+    chain("endpoint_ranks",
+          lambda a: a + jnp.sum(ck._endpoint_ranks(
+              batch._replace(read_begin=batch.read_begin + pert(a)))[0]
+              .astype(jnp.float32)),
+          jnp.float32(0))
+
+    # Tunnel characteristics.
+    nop = jax.jit(lambda x: x + 1)
+    int(nop(jnp.int32(0)))
+    t = time.perf_counter()
+    v = jnp.int32(0)
+    for _ in range(20):
+        v = nop(v)
+    int(v)
+    results["dispatch_ms"] = round((time.perf_counter() - t) / 20 * 1000, 3)
+    big = np.zeros((64 << 20) // 4, np.int32)
+    t = time.perf_counter()
+    d = jax.device_put(big)
+    int(d[0])  # block_until_ready lies through the tunnel; a fetch doesn't
+    t1 = time.perf_counter()
+    np.asarray(d)
+    t2 = time.perf_counter()
+    results["h2d_MBps"] = round(64 / (t1 - t), 1)
+    results["d2h_MBps"] = round(64 / (t2 - t1), 1)
+    log(f"dispatch {results['dispatch_ms']}ms  h2d {results['h2d_MBps']}MB/s"
+        f"  d2h {results['d2h_MBps']}MB/s")
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
