@@ -363,6 +363,7 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
     B = mode.batch
+    warm_batches = max(0, min(warm_batches, (len(txn_ends) - 1) // B - 1))
     cs = TPUConflictSet(
         capacity=capacity, batch_size=B, max_read_ranges=mode.n_reads,
         max_write_ranges=mode.n_writes, max_key_bytes=KEY_BYTES,
@@ -387,10 +388,10 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
         return out
 
     hist = timeit("history_check", ck._phase_history_jit, state, batch)
-    m = timeit("pairwise_overlap", ck._phase_overlap_jit, batch)
+    ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
     floor, too_old = ck.too_old_mask(state, batch, oldest)
     base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
-    acc = timeit("wave_accept", ck._phase_wave_jit, base, m)
+    acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
     timeit("paint_compact", ck._phase_paint_jit, state, batch, acc, cv, oldest)
     full = jax.jit(ck.resolve_batch)  # non-donating twin for repeat timing
     timeit("full_resolve", full, state, batch, cv, oldest)

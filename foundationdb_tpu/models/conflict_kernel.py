@@ -187,13 +187,119 @@ def _overlap_rows(
     return m
 
 
-def _pairwise_overlap(batch: BatchTensors) -> jax.Array:
-    """M[i, j] (bool [B, B]): some read range of txn i overlaps some write
-    range of txn j."""
+def endpoint_ranks_live(batch: BatchTensors) -> tuple[jax.Array, ...]:
+    """(rb, re, read_live, wb, we, write_live): endpoint ranks plus the
+    liveness masks (slot populated AND range non-empty in rank space) —
+    the shared precursor of every acceptance path."""
     rb, re_, wb, we = _endpoint_ranks(batch)
     read_live = batch.read_mask & (rb < re_)  # [B, R]
     write_live = batch.write_mask & (wb < we)  # [B, Q]
-    return _overlap_rows(rb, re_, read_live, wb, we, write_live)
+    return rb, re_, read_live, wb, we, write_live
+
+
+def _pairwise_overlap(batch: BatchTensors) -> jax.Array:
+    """M[i, j] (bool [B, B]): some read range of txn i overlaps some write
+    range of txn j."""
+    return _overlap_rows(*endpoint_ranks_live(batch))
+
+
+# Block size for the block-sequential acceptance scan. Within a block the
+# wave relaxation runs on a [G, G] tile (0.5 MB at G=512 — VMEM-resident);
+# cross-block influence is a single [G, B] matvec per block. This bounds
+# the data-dependent round count by G per block AND shrinks each round's
+# traffic from [B, B] (134 MB at B=8192) to [G, G], which matters on
+# high-conflict workloads (mako Zipf-0.99, 95% conflicts) where acceptance
+# chains are deep and the full-matrix wave paid 268 MB per round.
+_ACCEPT_BLOCK = 512
+
+
+def _block_scan_accept(base, xs_rows, make_rows):
+    """Shared block-scan body for both acceptance entry points.
+
+    Exact sequential-order acceptance (equivalent to _wave_accept and to
+    the reference's sequential ConflictBatch order): process blocks of G
+    txns in order (lax.scan); a block's candidates are first demoted by
+    accepted writers in EARLIER blocks (one [G, B] @ [B] matvec against
+    the accepted-so-far vector — later blocks contribute zeros), then the
+    within-block order is resolved by the [G, G] wave. All predecessors
+    of a block outside it are fully determined when the block runs, so
+    the result is exact.
+
+    xs_rows: pytree whose leaves have leading axis nblk; make_rows maps
+    one slice of it to that block's [G, B] overlap rows.
+    """
+    b = base.shape[0]
+    g = min(_ACCEPT_BLOCK, b)
+    nblk = b // g
+
+    def body(acc, xs):
+        rows_x, base_k, k = xs
+        rows_k = make_rows(rows_x)  # [G, B]
+        prior_hit = (
+            jax.lax.dot(
+                rows_k.astype(jnp.bfloat16),
+                acc.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            > 0.0
+        )
+        sub = jax.lax.dynamic_slice(rows_k, (jnp.int32(0), k * g), (g, g))
+        acc_k = _wave_accept(base_k & ~prior_hit, sub)
+        acc = jax.lax.dynamic_update_slice(acc, acc_k, (k * g,))
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        body,
+        jnp.zeros_like(base),
+        (
+            xs_rows,
+            base.reshape(nblk, g),
+            jnp.arange(nblk, dtype=jnp.int32),
+        ),
+    )
+    return acc
+
+
+def _block_accept(base: jax.Array, m: jax.Array) -> jax.Array:
+    """Block-scan acceptance over a materialized [B, B] overlap matrix."""
+    b = base.shape[0]
+    g = min(_ACCEPT_BLOCK, b)
+    if b % g:
+        return _wave_accept(base, m)
+    return _block_scan_accept(
+        base, m.reshape(b // g, g, b), lambda rows_k: rows_k
+    )
+
+
+def _block_accept_fused(
+    base: jax.Array,
+    rb: jax.Array,
+    re_: jax.Array,
+    read_live: jax.Array,
+    wb: jax.Array,
+    we: jax.Array,
+    write_live: jax.Array,
+) -> jax.Array:
+    """_block_accept with the overlap rows computed in-scan from rank
+    intervals: the [B, B] matrix is never materialized — each block builds
+    its own [G, B] slice from the [B, R]/[B, Q] rank vectors (a few KB),
+    saving the ~200 MB/batch of matrix write+read at B=8192."""
+    b = base.shape[0]
+    g = min(_ACCEPT_BLOCK, b)
+    if b % g:
+        m = _overlap_rows(rb, re_, read_live, wb, we, write_live)
+        return _wave_accept(base, m)
+    nblk = b // g
+    r = rb.shape[1]
+    return _block_scan_accept(
+        base,
+        (
+            rb.reshape(nblk, g, r),
+            re_.reshape(nblk, g, r),
+            read_live.reshape(nblk, g, r),
+        ),
+        lambda x: _overlap_rows(x[0], x[1], x[2], wb, we, write_live),
+    )
 
 
 def _wave_accept(base: jax.Array, m: jax.Array) -> jax.Array:
@@ -288,11 +394,17 @@ def _paint_and_compact(
     new_delta = jnp.concatenate(
         [valid.reshape(e2).astype(jnp.int32), -valid.reshape(e2).astype(jnp.int32)]
     )
-    seg = searchsorted_words(state.keys, new_keys, side="right") - 1
+    # ONE history search serves both uses below: cross_rank on the raw
+    # endpoints gives seg (containing segment), and — carried through the
+    # sort as a payload — its sorted permutation IS the cross-rank of the
+    # sorted endpoints (searchsorted of a permuted set permutes the same
+    # way), which the merge-path needs for pos_n.
+    cross_rank = searchsorted_words(state.keys, new_keys, side="right")
+    seg = cross_rank - 1
     new_oldv = state.versions[jnp.maximum(seg, 0)]
 
-    snew, sdelta_new, soldv_new = sort_keys_with_payload(
-        new_keys, new_delta, new_oldv
+    snew, sdelta_new, soldv_new, scross = sort_keys_with_payload(
+        new_keys, new_delta, new_oldv, cross_rank
     )
 
     # Merge-path, scatter-free (TPU scatters serialize badly; gathers tile).
@@ -301,9 +413,7 @@ def _paint_and_compact(
     # a collision-free permutation of [0, n) even with duplicate keys).
     # Each output slot then derives its source by rank arithmetic: slot i
     # holds new[k] iff pos_n[k] == i, else history[i - #new_slots_before_i].
-    pos_n = jnp.arange(n2, dtype=jnp.int32) + searchsorted_words(
-        state.keys, snew, side="right"
-    )
+    pos_n = jnp.arange(n2, dtype=jnp.int32) + scross
     idx = jnp.arange(n, dtype=jnp.int32)
     cnt_le = jnp.searchsorted(pos_n, idx, side="right").astype(jnp.int32)
     k_new = jnp.maximum(cnt_le - 1, 0)
@@ -430,9 +540,8 @@ def resolve_batch(
     """
     floor, too_old = too_old_mask(state, batch, new_oldest)
     hist_conflict = _history_conflicts(state, batch)
-    m = _pairwise_overlap(batch)
     base = batch.txn_mask & ~too_old & ~hist_conflict
-    accepted = _wave_accept(base, m)
+    accepted = _block_accept_fused(base, *endpoint_ranks_live(batch))
     verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     new_state = _paint_and_compact(state, batch, accepted, commit_version, floor)
     return verdicts, new_state
@@ -505,13 +614,13 @@ def _phase_history_jit(state, batch):
 
 
 @jax.jit
-def _phase_overlap_jit(batch):
-    return _pairwise_overlap(batch)
+def _phase_ranks_jit(batch):
+    return endpoint_ranks_live(batch)
 
 
 @jax.jit
-def _phase_wave_jit(base, m):
-    return _wave_accept(base, m)
+def _phase_accept_jit(base, rb, re_, read_live, wb, we, write_live):
+    return _block_accept_fused(base, rb, re_, read_live, wb, we, write_live)
 
 
 @jax.jit  # state NOT donated: profiling replays phases on the same state

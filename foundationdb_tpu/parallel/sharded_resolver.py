@@ -13,11 +13,10 @@ conflict). Here the same design is one SPMD program over
   (clip_batch), checks reads against its local history, and contributes
   conflict bits via ``psum`` — the tensor analogue of the proxy ANDing
   per-resolver verdicts;
-- the intra-batch overlap matrix is row-sharded across devices and
-  ``all_gather``ed (it depends only on the batch, so work — not state — is
-  what's being split);
-- the wave acceptance runs replicated (tiny matvecs; a per-round collective
-  would cost more than it saves) and every device paints its own shard's
+- intra-batch acceptance runs replicated with the fused block scan (it
+  depends only on the batch and the psum'd history bits; rebuilding each
+  block's [G, B] overlap rows from rank vectors is cheaper than moving a
+  [B, B] matrix over ICI) and every device paints its own shard's
   accepted writes.
 
 All host-side logic (packing, chunking, rebase bookkeeping) is inherited
@@ -89,40 +88,27 @@ def _row_sort_keys(a: np.ndarray) -> np.ndarray:
     return u.view([("k", f"V{4 * a.shape[-1]}")]).ravel()
 
 
-def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi, n_shards):
+def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi):
     """Per-device body (runs under shard_map; state/lo/hi are the local shard,
     batch is replicated)."""
     state = jax.tree.map(lambda x: x[0], state)  # drop leading device axis
     lo = lo[0]
     hi = hi[0]
 
-    b = batch.txn_mask.shape[0]
     floor, too_old = ck.too_old_mask(state, batch, new_oldest)
 
     local = ck.clip_batch(batch, lo, hi)
     hist_local = ck._history_conflicts(state, local)
     hist_conflict = jax.lax.psum(hist_local.astype(jnp.int32), AXIS) > 0
 
-    # Row-sharded intra-batch overlap: this device computes M rows for its
-    # slice of reader txns against ALL writers (unclipped: M is a pure
-    # function of the batch), then all-gathers the rows.
-    rb, re_, wb, we = ck._endpoint_ranks(batch)
-    read_live = batch.read_mask & (rb < re_)
-    write_live = batch.write_mask & (wb < we)
-    rows_per = b // n_shards
-    i0 = jax.lax.axis_index(AXIS) * rows_per
-    my_rows = ck._overlap_rows(
-        jax.lax.dynamic_slice_in_dim(rb, i0, rows_per),
-        jax.lax.dynamic_slice_in_dim(re_, i0, rows_per),
-        jax.lax.dynamic_slice_in_dim(read_live, i0, rows_per),
-        wb,
-        we,
-        write_live,
-    )
-    m = jax.lax.all_gather(my_rows, AXIS, axis=0, tiled=True)  # [B, B]
-
+    # Intra-batch acceptance is a pure function of the (unclipped) batch
+    # plus the psum'd history verdicts, so every device computes it
+    # redundantly with the fused block scan — the blocked [G, B] overlap
+    # rows are cheap to rebuild from rank vectors, while the earlier
+    # row-sharded design all-gathered a [B, B] matrix (67 MB at B=8192)
+    # over ICI only to run the full-matrix wave on every device anyway.
     base = batch.txn_mask & ~too_old & ~hist_conflict
-    accepted = ck._wave_accept(base, m)
+    accepted = ck._block_accept_fused(base, *ck.endpoint_ranks_live(batch))
     verdicts = ck.assemble_verdicts(too_old, batch.txn_mask, accepted)
 
     new_state = ck._paint_and_compact(state, local, accepted, commit_version, floor)
@@ -193,9 +179,7 @@ class ShardedConflictSet(TPUConflictSet):
         state_specs = ck.ConflictState(*(P(AXIS) for _ in ck.ConflictState._fields))
         batch_specs = ck.BatchTensors(*(P() for _ in ck.BatchTensors._fields))
         body = jax.shard_map(
-            lambda s, bt, cv, old, lo, hi: _sharded_resolve(
-                s, bt, cv, old, lo, hi, self.n_shards
-            ),
+            _sharded_resolve,
             mesh=self.mesh,
             in_specs=(state_specs, batch_specs, P(), P(), P(AXIS), P(AXIS)),
             out_specs=(P(), state_specs),

@@ -123,9 +123,11 @@ def main():
               batch._replace(read_begin=batch.read_begin + pert(a)))
               .astype(jnp.float32)),
           jnp.float32(0))
-    chain("wave_accept",
+    ranks_live = jax.jit(ck.endpoint_ranks_live)(batch)
+    chain("block_accept_fused",
           lambda a: a + jnp.sum(
-              ck._wave_accept(jnp.ones((B,), bool) ^ (pert(a) > 0), m0)
+              ck._block_accept_fused(
+                  jnp.ones((B,), bool) ^ (pert(a) > 0), *ranks_live)
               .astype(jnp.float32)),
           jnp.float32(0))
     chain("paint_and_compact",

@@ -168,3 +168,69 @@ def test_wide_range_limits_match_oracle():
         got = cs.resolve(txns, cv)
         want = oracle.resolve(txns, cv)
         assert got == want, f"batch {batch_i}: {got} != {want}"
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_multiblock_acceptance_matches_oracle(seed):
+    """batch_size > _ACCEPT_BLOCK so the production block-scan acceptance
+    runs with several blocks (cross-block matvec + dynamic_slice offsets
+    are live, not the degenerate nblk=1 case)."""
+    from foundationdb_tpu.models import conflict_kernel as ck
+
+    assert ck._ACCEPT_BLOCK < 1024
+    rng = np.random.default_rng(seed)
+    cs = TPUConflictSet(capacity=4096, batch_size=1024, max_read_ranges=2,
+                        max_write_ranges=2, max_key_bytes=8)
+    oracle = OracleConflictSet()
+    cv = 1000
+    for batch_i in range(3):
+        cv += int(rng.integers(1, 50))
+        # One full 1024-txn batch on a small hot keyspace: dense
+        # intra-batch conflicts across block boundaries.
+        txns = [
+            rand_txn(rng, read_version=int(rng.integers(max(0, cv - 100), cv)),
+                     n_ranges=2, alphabet=3, max_len=2)
+            for _ in range(1024)
+        ]
+        got = cs.resolve(txns, cv)
+        want = oracle.resolve(txns, cv)
+        assert got == want, f"batch {batch_i}: first diff at " \
+            f"{next(i for i, (g, w) in enumerate(zip(got, want)) if g != w)}"
+
+
+def test_block_accept_variants_agree():
+    """_wave_accept ≡ _block_accept ≡ _block_accept_fused on random rank
+    intervals spanning many blocks."""
+    import jax.numpy as jnp
+
+    from foundationdb_tpu.models import conflict_kernel as ck
+
+    rng = np.random.default_rng(11)
+    b, r, q, space = 2048, 2, 1, 64  # 4 blocks of 512, hot rank space
+    rb = rng.integers(0, space, size=(b, r)).astype(np.int32)
+    re_ = rb + rng.integers(1, 4, size=(b, r)).astype(np.int32)
+    wb = rng.integers(0, space, size=(b, q)).astype(np.int32)
+    we = wb + rng.integers(1, 4, size=(b, q)).astype(np.int32)
+    read_live = rng.random((b, r)) < 0.9
+    write_live = rng.random((b, q)) < 0.6
+    base = rng.random((b,)) < 0.95
+
+    m = np.asarray(ck._overlap_rows(
+        jnp.asarray(rb), jnp.asarray(re_), jnp.asarray(read_live),
+        jnp.asarray(wb), jnp.asarray(we), jnp.asarray(write_live)))
+    wave = np.asarray(ck._wave_accept(jnp.asarray(base), jnp.asarray(m)))
+    blk = np.asarray(ck._block_accept(jnp.asarray(base), jnp.asarray(m)))
+    fused = np.asarray(ck._block_accept_fused(
+        jnp.asarray(base), jnp.asarray(rb), jnp.asarray(re_),
+        jnp.asarray(read_live), jnp.asarray(wb), jnp.asarray(we),
+        jnp.asarray(write_live)))
+
+    # Python sequential oracle: the reference acceptance order.
+    acc = np.zeros(b, bool)
+    for i in range(b):
+        if not base[i]:
+            continue
+        acc[i] = not (m[i, :i] & acc[:i]).any()
+    assert (wave == acc).all()
+    assert (blk == acc).all()
+    assert (fused == acc).all()
