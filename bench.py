@@ -363,6 +363,9 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
     B = mode.batch
+    if (len(txn_ends) - 1) // B < 2:
+        log("[profile] skipped: need >= 2 batches of txns to profile")
+        return
     warm_batches = max(0, min(warm_batches, (len(txn_ends) - 1) // B - 1))
     cs = TPUConflictSet(
         capacity=capacity, batch_size=B, max_read_ranges=mode.n_reads,

@@ -22,6 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from foundationdb_tpu.runtime.flow import Loop, rpc
+from foundationdb_tpu.runtime.trace import Severity, trace
 
 
 class Heartbeat:
@@ -143,6 +144,8 @@ class ClusterController:
                 continue
             failed = await self._sweep(self.generation)
             if failed:
+                trace(self.loop).event(
+                    "WorkerFailureDetected", Severity.WARN, process=failed)
                 await self._recover(reason=f"process {failed!r} failed heartbeat")
 
     async def _sweep(self, gen: Generation) -> str | None:
@@ -168,6 +171,8 @@ class ClusterController:
         if self._recovering or self._deposed:
             return  # a concurrent trigger (sweep vs request) already won
         self._recovering = True
+        trace(self.loop).event("MasterRecoveryTriggered", Severity.WARN,
+                               reason=reason)
         try:
             # A deposed controller must not touch the cluster: confirm
             # leadership through the quorum before recruiting (reference:

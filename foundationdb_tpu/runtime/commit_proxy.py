@@ -28,6 +28,7 @@ from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
 from foundationdb_tpu.runtime.backup import BACKUP_TAG
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of, rpc
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
+from foundationdb_tpu.runtime.trace import Severity, trace
 
 
 @dataclass
@@ -156,6 +157,8 @@ class CommitProxy:
 
     async def _wedge_watchdog(self, version: int) -> None:
         await self.loop.sleep(self.WEDGE_TIMEOUT)
+        trace(self.loop).event("CommitBatchWedged", Severity.WARN_ALWAYS,
+                               version=version, timeout=self.WEDGE_TIMEOUT)
         if self.controller is not None:
             await self._request_recovery(f"commit batch@{version} wedged")
 

@@ -61,6 +61,7 @@ class RealLoop(Loop):
     """flow.Loop over wall-clock time + socket readiness."""
 
     MAX_IDLE_WAIT = 0.05  # bound each select() so new work is noticed
+    WALL_TIME = True  # `now` is monotonic; tracers add epoch WallTime stamps
 
     def __init__(self, seed: int = 0):
         super().__init__(seed=seed, start_time=time.monotonic())

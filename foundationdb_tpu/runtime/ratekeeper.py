@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from foundationdb_tpu.runtime.flow import Loop, all_of, rpc
 from foundationdb_tpu.runtime.sequencer import VERSIONS_PER_SECOND
+from foundationdb_tpu.runtime.trace import Severity, trace
 
 
 class Ratekeeper:
@@ -171,6 +172,12 @@ class Ratekeeper:
             if s < worst:
                 worst, reason = s, name
         if frac == 1.0:
+            if reason != self.limiting_reason:
+                trace(self.loop).event(
+                    "RkLimitReasonChanged",
+                    Severity.INFO if reason == "none" else Severity.WARN,
+                    reason=reason, previous=self.limiting_reason,
+                    scale=round(worst, 4))
             self.limiting_reason = reason
         return worst
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.runtime.cluster import Generation
 from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.trace import Severity, trace
 
 
 class RecoveryFailed(FdbError):
@@ -43,6 +44,8 @@ class RecoveryFailed(FdbError):
 
 
 async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generation:
+    trace(loop).event("MasterRecoveryState", state="locking_tlogs",
+                      epoch=epoch, old_tlogs=len(old.tlog_eps))
     # 1+2. Lock reachable tlogs; take the max frozen end version. Locks go
     # out in parallel so k unreachable tlogs cost ONE failure-detection
     # delay, not k — every extra second here widens the window in which
@@ -57,8 +60,12 @@ async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generat
         except Exception:
             continue  # dead/partitioned tlog — proceed with the rest
     if not locked:
+        trace(loop).event("MasterRecoveryFailed", Severity.WARN,
+                          epoch=epoch, reason="no_tlog_reachable")
         raise RecoveryFailed(f"epoch {epoch}: no old-generation tlog reachable")
     recovery_version, source_ep = max(locked, key=lambda e: e[0])
+    trace(loop).event("MasterRecoveryState", state="salvaging", epoch=epoch,
+                      recovery_version=recovery_version, locked=len(locked))
 
     # 3. Salvage the un-popped suffix from the most-advanced locked tlog.
     try:
@@ -69,6 +76,10 @@ async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generat
         ) from None
 
     # 4. Recruit the next generation (also re-points storage servers).
-    return recruiter.recruit_generation(
+    gen = recruiter.recruit_generation(
         epoch=epoch, recovery_version=recovery_version, seed_entries=seed_entries
     )
+    trace(loop).event("MasterRecoveryState", state="accepting_commits",
+                      epoch=epoch, recovery_version=recovery_version,
+                      salvaged=len(seed_entries))
+    return gen

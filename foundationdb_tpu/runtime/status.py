@@ -128,6 +128,17 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
     }
     doc["cluster"]["committed_version"] = seq_ver
 
+    # Trace rollup (reference: status surfaces recent TraceEvent errors and
+    # event counts from the cluster's trace logs).
+    tracer = getattr(cluster.loop, "tracer", None)
+    if tracer is not None:
+        from foundationdb_tpu.runtime.trace import Severity
+
+        doc["cluster"]["messages"] = tracer.recent(
+            min_severity=Severity.WARN, limit=20
+        )
+        doc["cluster"]["trace_event_counts"] = dict(tracer.counts)
+
     if cluster.controller.generation.epoch != epoch_before and _retries > 0:
         return await fetch_status(cluster, _retries - 1)  # mid-fetch recovery
     return doc

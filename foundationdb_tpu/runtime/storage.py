@@ -30,6 +30,7 @@ from foundationdb_tpu.core.errors import (
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
+from foundationdb_tpu.runtime.trace import trace
 
 
 class VersionedMap:
@@ -507,6 +508,7 @@ class StorageServer:
         Returns the snapshot version — the shard has no history below it."""
         f = FetchState(begin, end)
         self._fetching.append(f)
+        trace(self.loop).event("FetchKeysBegin", begin=begin, end=end)
         try:
             snap_version, rows = await src_ep.snapshot_range(
                 begin, end, min_version

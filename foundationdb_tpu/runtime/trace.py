@@ -1,0 +1,217 @@
+"""Structured trace events — the flow/Trace.cpp analogue.
+
+The reference's TraceEvent is the observability backbone: every role emits
+structured events (type + severity + detail fields) into per-process
+rolling trace files, and status/json surfaces recent errors and event
+counts. This is the same capability, host-side Python, shaped for this
+runtime:
+
+- ``TraceEvent("Type", Severity.WARN).detail(k, v).log(tracer)`` builder,
+  or the one-shot ``tracer.event("Type", **details)``.
+- One ``Tracer`` per ``flow.Loop``: events are stamped with the loop's
+  VIRTUAL time and the emitting task's process name, so sim traces are
+  deterministic and replayable under a seed (the property the reference
+  gets from sim2's virtualised clock). On a ``RealLoop`` (whose ``now``
+  is monotonic seconds, not epoch) records additionally carry a
+  ``WallTime`` epoch stamp so traces correlate across hosts and logs.
+- Sinks: an always-on ring buffer (status/json: recent errors, per-type
+  counts) plus an optional JSONL file sink with size-based rolling
+  (reference: trace.<address>.<seq>.json files, knob-rolled).
+
+Severity numbers follow the reference's public convention
+(flow/Trace.h: SevDebug/SevInfo/SevWarn/SevWarnAlways/SevError) since
+tooling keys off them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import Counter, deque
+from typing import Any, TextIO
+
+
+class Severity:
+    DEBUG = 5
+    INFO = 10
+    WARN = 20
+    WARN_ALWAYS = 30
+    ERROR = 40
+
+    _NAMES = {5: "Debug", 10: "Info", 20: "Warn", 30: "WarnAlways", 40: "Error"}
+
+    @classmethod
+    def name(cls, sev: int) -> str:
+        return cls._NAMES.get(sev, str(sev))
+
+
+class TraceEvent:
+    """Builder-style event (reference: TraceEvent(...).detail(...))."""
+
+    __slots__ = ("type", "severity", "details")
+
+    def __init__(self, type_: str, severity: int = Severity.INFO):
+        self.type = type_
+        self.severity = severity
+        self.details: dict[str, Any] = {}
+
+    def detail(self, key: str, value: Any) -> "TraceEvent":
+        self.details[key] = _jsonable(value)
+        return self
+
+    def error(self, exc: BaseException) -> "TraceEvent":
+        self.details["Error"] = type(exc).__name__
+        self.details["ErrorDescription"] = str(exc)
+        if self.severity < Severity.ERROR:
+            self.severity = Severity.ERROR
+        return self
+
+    def log(self, tracer: "Tracer") -> None:
+        tracer.emit(self)
+
+
+_RESERVED = frozenset({"Time", "Type", "Severity", "Process", "WallTime"})
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, bytes):
+        return v.decode("latin-1")
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+class Tracer:
+    """Per-loop event collector with ring buffer + optional rolling files.
+
+    Attach with ``Tracer(loop, ...)`` — it installs itself as
+    ``loop.tracer`` so role code reaches it via its loop without plumbing
+    an extra handle through every constructor (the reference's TraceEvent
+    is likewise ambient, a global logger bound to g_network's clock).
+    """
+
+    def __init__(
+        self,
+        loop,
+        trace_dir: str | None = None,
+        process: str | None = None,
+        roll_bytes: int = 10 << 20,
+        ring_size: int = 2048,
+        min_severity: int = Severity.DEBUG,
+    ):
+        self.loop = loop
+        self.trace_dir = trace_dir
+        self.process_override = process
+        self.roll_bytes = roll_bytes
+        self.min_severity = min_severity
+        self.ring: deque[dict] = deque(maxlen=ring_size)
+        self.counts: Counter[str] = Counter()
+        self._file: TextIO | None = None
+        self._file_bytes = 0
+        self._file_seq = 0
+        self._run_id: str | None = None
+        loop.tracer = self
+
+    # -- emit ---------------------------------------------------------------
+
+    def emit(self, ev: TraceEvent) -> None:
+        if ev.severity < self.min_severity:
+            return
+        cur = getattr(self.loop, "_current", None)
+        rec = {
+            "Time": round(self.loop.now, 6),
+            "Type": ev.type,
+            "Severity": ev.severity,
+            "Process": self.process_override
+            or (cur.process if cur is not None else "<main>"),
+        }
+        if getattr(self.loop, "WALL_TIME", False):
+            # RealLoop's now is monotonic; add an epoch stamp for
+            # cross-host correlation. Never added in sim — it would break
+            # same-seed trace determinism.
+            rec["WallTime"] = round(time.time(), 3)
+        for k, v in ev.details.items():
+            # Reserved stamp fields must survive colliding detail keys
+            # (a detail named Severity would otherwise corrupt filtering).
+            rec[f"Detail_{k}" if k in _RESERVED else k] = v
+        self.counts[ev.type] += 1
+        self.ring.append(rec)
+        if self.trace_dir is not None:
+            self._write(rec)
+
+    def event(self, type_: str, severity: int = Severity.INFO, **details) -> None:
+        ev = TraceEvent(type_, severity)
+        for k, v in details.items():
+            ev.detail(k, v)
+        self.emit(ev)
+
+    # -- query (status/json, tests) -----------------------------------------
+
+    def recent(self, min_severity: int = Severity.DEBUG, limit: int = 100) -> list[dict]:
+        out = [r for r in self.ring if r["Severity"] >= min_severity]
+        return out[-limit:]
+
+    def errors(self, limit: int = 20) -> list[dict]:
+        return self.recent(Severity.ERROR, limit)
+
+    # -- file sink ----------------------------------------------------------
+
+    def _write(self, rec: dict) -> None:
+        if self._file is None:
+            self._open_next()
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._file.write(line)
+        self._file_bytes += len(line)
+        if self._file_bytes >= self.roll_bytes:
+            self._file.close()
+            self._file = None
+
+    def _open_next(self) -> None:
+        os.makedirs(self.trace_dir, exist_ok=True)
+        proc = (self.process_override or "proc").replace("/", "_")
+        if self._run_id is None:
+            # Unique per Tracer lifetime: a restarted role must never
+            # truncate its predecessor's trace files (they hold exactly
+            # the diagnostics a crash investigation needs).
+            self._run_id = f"{int(time.time())}.{os.getpid()}"
+        self._file_seq += 1
+        path = os.path.join(
+            self.trace_dir,
+            f"trace.{proc}.{self._run_id}.{self._file_seq}.jsonl",
+        )
+        self._file = open(path, "w", encoding="utf-8", buffering=1)
+        self._file_bytes = 0
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class _NullTracer:
+    """Emit sink for loops with no Tracer attached: counts only.
+
+    Keeps call sites unconditional (``trace(loop).event(...)``) with near
+    zero overhead and no behavior change for code that never asks for
+    traces."""
+
+    __slots__ = ()
+
+    def emit(self, ev: TraceEvent) -> None:
+        pass
+
+    def event(self, type_: str, severity: int = Severity.INFO, **details) -> None:
+        pass
+
+
+_NULL = _NullTracer()
+
+
+def trace(loop) -> Tracer:
+    """The loop's tracer, or a no-op sink if none was attached."""
+    return getattr(loop, "tracer", _NULL)

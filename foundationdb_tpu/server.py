@@ -233,6 +233,9 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--data-dir", default=None,
                     help="durable state directory (tlog disk queue, "
                          "storage sqlite); default: memory only")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write rolling JSONL trace files here "
+                         "(reference: fdbserver --logdir)")
     args = ap.parse_args(argv)
 
     spec = load_spec(args.cluster)
@@ -247,8 +250,14 @@ def main(argv: list[str] | None = None) -> None:
         os.makedirs(args.data_dir, exist_ok=True)
 
     loop = RealLoop()
+    from foundationdb_tpu.runtime.trace import Tracer
+
+    tracer = Tracer(loop, trace_dir=args.trace_dir,
+                    process=f"{args.role}{args.index}")
     t = NetTransport(loop, host=host, port=port)
     build_role(loop, t, spec, args.role, args.index, args.data_dir)
+    tracer.event("ProgramStart", Role=args.role, Index=args.index,
+                 Address=f"{t.addr[0]}:{t.addr[1]}")
     print(f"ready {args.role}{args.index} on {t.addr[0]}:{t.addr[1]}",
           flush=True)
 

@@ -80,6 +80,13 @@ class SimCluster:
         # disk queues seed the new tlogs, storage reloads its snapshot.
         self.data_dir = data_dir
         self._restore = self._read_cluster_meta() if data_dir else None
+        # Ring-buffer tracer on every sim cluster: role trace events are
+        # queryable in tests/status with zero config (reference: TraceEvent
+        # always logs; sim asserts on trace lines).
+        from foundationdb_tpu.runtime.trace import Tracer
+
+        if not hasattr(self.loop, "tracer"):
+            Tracer(self.loop)
         self.net = SimNetwork(self.loop)
         self.engine = engine
         self.n_proxies = n_proxies

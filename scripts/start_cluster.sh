@@ -36,7 +36,7 @@ EOF
 : > "$DIR/pids"
 launch() { # role index
   JAX_PLATFORMS=cpu python -m foundationdb_tpu.server \
-    --cluster "$SPEC" --role "$1" --index "$2" \
+    --cluster "$SPEC" --role "$1" --index "$2" --trace-dir "$DIR/traces" \
     >> "$DIR/$1$2.log" 2>&1 &
   echo $! >> "$DIR/pids"
 }

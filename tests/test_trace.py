@@ -1,0 +1,138 @@
+"""Trace subsystem: TraceEvent semantics, determinism under seeds, file
+sink rolling, role integration (recovery/ratekeeper/controller events),
+and the status/json rollup (reference: flow/Trace.cpp + status messages).
+"""
+
+import json
+import os
+
+from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.trace import Severity, TraceEvent, Tracer, trace
+
+
+def test_event_builder_and_ring():
+    loop = Loop(seed=1)
+    t = Tracer(loop)
+    TraceEvent("CommitDone").detail("Version", 42).log(t)
+    t.event("Oops", Severity.ERROR, Key=b"\xff/x")
+    assert loop.tracer is t
+    recs = t.recent()
+    assert [r["Type"] for r in recs] == ["CommitDone", "Oops"]
+    assert recs[0]["Version"] == 42
+    assert recs[0]["Severity"] == Severity.INFO
+    assert recs[0]["Process"] == "<main>"
+    assert recs[1]["Key"] == "\xff/x"  # bytes become latin-1 text
+    assert t.errors() == [recs[1]]
+    assert t.counts["CommitDone"] == 1
+
+
+def test_severity_filter_and_null_sink():
+    loop = Loop(seed=1)
+    t = Tracer(loop, min_severity=Severity.WARN)
+    t.event("Chatty", Severity.DEBUG)
+    t.event("Louder", Severity.WARN)
+    assert [r["Type"] for r in t.recent()] == ["Louder"]
+    # A loop without a tracer gets the no-op sink — call sites never branch.
+    bare = Loop(seed=2)
+    trace(bare).event("IntoTheVoid", Severity.ERROR)
+    assert not hasattr(bare, "tracer")
+
+
+def test_events_stamped_with_virtual_time_and_process():
+    loop = Loop(seed=3)
+    t = Tracer(loop)
+
+    async def actor():
+        await loop.sleep(1.5)
+        trace(loop).event("FromActor")
+
+    loop.spawn(actor(), process="storage0", name="a")
+    loop.run(_drain(loop, 5.0))
+    [rec] = t.recent()
+    assert rec["Process"] == "storage0"
+    assert rec["Time"] == 1.5
+
+
+async def _drain(loop, dt):
+    await loop.sleep(dt)
+
+
+def test_file_sink_rolls(tmp_path):
+    loop = Loop(seed=4)
+    t = Tracer(loop, trace_dir=str(tmp_path), process="proxy1",
+               roll_bytes=200)
+    for i in range(20):
+        t.event("E", I=i)
+    t.close()
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) > 1  # rolled at least once
+    assert all(f.startswith("trace.proxy1.") for f in files)
+    recs = []
+    for f in files:
+        with open(tmp_path / f) as fh:
+            recs += [json.loads(line) for line in fh]
+    assert [r["I"] for r in recs] == list(range(20))
+
+
+async def _wait_for_epoch(c, epoch, interval=0.25):
+    while c.controller.generation.epoch < epoch:
+        await c.loop.sleep(interval)
+
+
+def test_sim_cluster_emits_recovery_trace_and_status_rollup():
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.runtime.status import fetch_status
+    from foundationdb_tpu.sim.cluster import SimCluster
+
+    c = SimCluster(seed=11, n_tlogs=2, n_storages=2)
+    tracer = c.loop.tracer
+    db = open_database(c)
+
+    async def scenario():
+        async def put_a(tr):
+            tr.set(b"a", b"1")
+
+        async def put_b(tr):
+            tr.set(b"b", b"2")
+
+        await db.run(put_a)
+        c.net.kill("tlog0")
+        await _wait_for_epoch(c, 2)
+        await db.run(put_b)
+        return await fetch_status(c)
+
+    doc = c.loop.run(scenario(), timeout=600)
+    types = [r["Type"] for r in tracer.recent(limit=1000)]
+    assert "WorkerFailureDetected" in types
+    assert "MasterRecoveryTriggered" in types
+    states = [r["state"] for r in tracer.recent(limit=1000)
+              if r["Type"] == "MasterRecoveryState"]
+    assert "locking_tlogs" in states and "accepting_commits" in states
+    # status rollup carries the warnings and the counts
+    msg_types = {m["Type"] for m in doc["cluster"]["messages"]}
+    assert "WorkerFailureDetected" in msg_types
+    assert doc["cluster"]["trace_event_counts"]["MasterRecoveryState"] >= 2
+
+
+def test_deterministic_trace_same_seed():
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.sim.cluster import SimCluster
+
+    def run(seed):
+        c = SimCluster(seed=seed, n_tlogs=2, n_storages=2)
+        db = open_database(c)
+
+        async def scenario():
+            tr = db.transaction()
+            tr.set(b"a", b"1")
+            await tr.commit()
+            c.net.kill("tlog0")
+            await _wait_for_epoch(c, 2)
+
+        c.loop.run(scenario(), timeout=600)
+        return [(r["Time"], r["Type"], r.get("state")) for r in
+                c.loop.tracer.recent(limit=1000)]
+
+    assert run(5) == run(5)
+    # and the trace actually contains events (not trivially equal-empty)
+    assert any(t == "MasterRecoveryTriggered" for _, t, _s in run(5))
