@@ -32,7 +32,9 @@ def run_one(spec_path: str, seed: int, buggify: bool,
     Returns (spec_path, seed, [(title, ok, detail), ...])."""
     from foundationdb_tpu.client.ryw import open_database
     from foundationdb_tpu.sim.cluster import SimCluster
-    from foundationdb_tpu.sim.specs import load_spec, run_spec_test
+    from foundationdb_tpu.sim.specs import (
+        cluster_kwargs, load_spec, run_spec_test,
+    )
 
     out: list[tuple[str, bool, str]] = []
     for spec in load_spec(spec_path):
@@ -40,7 +42,7 @@ def run_one(spec_path: str, seed: int, buggify: bool,
             spec.buggify = True
         if clog is not None and spec.clog_interval is None:
             spec.clog_interval = clog
-        c = SimCluster(seed=seed, n_tlogs=2, n_storages=2)
+        c = SimCluster(seed=seed, **cluster_kwargs(spec))
         db = open_database(c)
         try:
             r = c.loop.run(run_spec_test(spec, c, db), timeout=3000)

@@ -37,9 +37,12 @@ from foundationdb_tpu.sim.workloads import (
     RandomReadWriteWorkload,
     SelectorCorrectnessWorkload,
     TPCCNewOrderWorkload,
+    DDBalanceWorkload,
+    FuzzApiWorkload,
     VersionStampWorkload,
     WatchesWorkload,
     WorkloadMetrics,
+    WriteDuringReadWorkload,
 )
 
 # testName -> (workload class, TOML key -> constructor kwarg). Unknown TOML
@@ -105,7 +108,32 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
     }),
+    "WriteDuringRead": (WriteDuringReadWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "opsPerTransaction": "ops_per_txn",
+    }),
+    "FuzzApiCorrectness": (FuzzApiWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "opsPerTransaction": "ops_per_txn",
+    }),
+    "DDBalance": (DDBalanceWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "moveCount": "n_moves",
+    }),
 }
+
+
+# Base topology every spec runner starts from; [test.cluster] entries
+# override it. One definition so the pytest path and the campaign runner
+# exercise identical clusters for the same spec.
+BASE_CLUSTER = {"n_tlogs": 2, "n_storages": 2}
+
+
+def cluster_kwargs(spec: "TestSpec") -> dict:
+    return {**BASE_CLUSTER, **spec.cluster_opts}
 
 
 @dataclass
@@ -118,6 +146,10 @@ class TestSpec:
     include_controller: bool = False
     clog_interval: float | None = None  # slow-but-alive link injection
     buggify: bool = False  # enable in-role BUGGIFY sites for this test
+    # [test.cluster] table: tests needing a non-default cluster (e.g. the
+    # DataDistributor for DDBalance) declare it; the runner builds a fresh
+    # SimCluster with these kwargs for that test only.
+    cluster_opts: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -148,6 +180,20 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             }
             kwargs["seed"] = w.get("seed", test.get("seed", i))
             workloads.append(cls(**kwargs))
+        cluster_tbl = test.get("cluster", {})
+        cluster_map = {
+            "storages": "n_storages",
+            "tlogs": "n_tlogs",
+            "replicas": "n_replicas",
+            "proxies": "n_proxies",
+            "resolvers": "n_resolvers",
+            "coordinators": "n_coordinators",
+            "dataDistribution": "data_distribution",
+        }
+        cluster_opts = {
+            cluster_map[k]: v for k, v in cluster_tbl.items()
+            if k in cluster_map
+        }
         specs.append(TestSpec(
             title=test.get("testTitle", "untitled"),
             workloads=workloads,
@@ -157,6 +203,7 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             include_controller=test.get("killController", False),
             clog_interval=test.get("clogInterval"),
             buggify=test.get("buggify", False),
+            cluster_opts=cluster_opts,
         ))
     return specs
 
@@ -198,10 +245,17 @@ async def run_spec_test(spec: TestSpec, cluster, db) -> SpecResult:
 
 
 def run_spec(source: str | bytes, cluster, db) -> list[SpecResult]:
-    """Run every [[test]] in the spec against the given cluster."""
+    """Run every [[test]] in the spec against the given cluster (tests
+    with [test.cluster] requirements get their own fresh cluster)."""
     out = []
     for spec in load_spec(source):
-        out.append(
-            cluster.loop.run(run_spec_test(spec, cluster, db), timeout=3000)
-        )
+        c, d = cluster, db
+        if spec.cluster_opts:
+            from foundationdb_tpu.client.ryw import open_database
+            from foundationdb_tpu.sim.cluster import SimCluster
+
+            c = SimCluster(seed=cluster.loop.rng.randint(0, 1 << 30),
+                           **cluster_kwargs(spec))
+            d = open_database(c)
+        out.append(c.loop.run(run_spec_test(spec, c, d), timeout=3000))
     return out

@@ -26,6 +26,7 @@ optional faults) onto a cluster and returns its metrics.
 
 from __future__ import annotations
 
+import bisect
 import struct
 from dataclasses import dataclass, field
 
@@ -1215,3 +1216,302 @@ class BackupRestoreWorkload(Workload):
                 f"restore mismatch: src {len(src_rows)} rows vs dst "
                 f"{len(dst_rows)} rows"
             )
+
+
+class WriteDuringReadWorkload(Workload):
+    """RYW semantics fuzz (reference: WriteDuringRead.actor.cpp): inside
+    one transaction, interleave random sets / clears / clear_ranges /
+    atomic ops with random gets and range reads; every read must see the
+    transaction's own uncommitted mutations applied over the database
+    snapshot. On commit the model becomes the expected database state."""
+
+    name = "write_during_read"
+
+    def __init__(self, seed: int = 0, n_keys: int = 24, n_txns: int = 20,
+                 ops_per_txn: int = 12):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.ops_per_txn = ops_per_txn
+        self.model: dict[bytes, bytes] = {}  # committed state
+
+    def _key(self, rng) -> bytes:
+        return b"wdr/%03d" % rng.randrange(self.n_keys)
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"wdr/", b"wdr0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        for _ in range(self.n_txns):
+            plan = []  # decided OUTSIDE the retry loop → deterministic replay
+            for _o in range(self.ops_per_txn):
+                r = rng.random()
+                if r < 0.25:
+                    plan.append(("set", self._key(rng),
+                                 b"v%06d" % rng.randrange(1 << 20)))
+                elif r < 0.35:
+                    plan.append(("clear", self._key(rng), None))
+                elif r < 0.45:
+                    a, b = sorted((self._key(rng), self._key(rng)))
+                    plan.append(("clear_range", a, b))
+                elif r < 0.55:
+                    plan.append(("add", self._key(rng),
+                                 struct.pack("<q", rng.randrange(100))))
+                elif r < 0.8:
+                    plan.append(("get", self._key(rng), None))
+                else:
+                    a, b = sorted((self._key(rng), self._key(rng)))
+                    plan.append(("get_range", a, b))
+
+            async def body(tr, plan=plan):
+                # Txn-visible model is rebuilt from a snapshot range read
+                # each attempt, NOT carried across txns: an applied-but-
+                # unknown commit (fault injection) double-applies ADDs on
+                # retry, and a carried model would diverge from the
+                # database while both are individually correct. Reading
+                # the prefix keeps every in-txn RYW assertion exact.
+                local = dict(await tr.get_range(b"wdr/", b"wdr0"))
+                for op, a, b in plan:
+                    if op == "set":
+                        tr.set(a, b)
+                        local[a] = b
+                    elif op == "clear":
+                        tr.clear(a)
+                        local.pop(a, None)
+                    elif op == "clear_range":
+                        tr.clear_range(a, b)
+                        for k in [k for k in local if a <= k < b]:
+                            del local[k]
+                    elif op == "add":
+                        tr.atomic_op(MutationType.ADD, a, b)
+                        base = (local.get(a, b"") + b"\x00" * 8)[:8]
+                        total = (struct.unpack("<q", base)[0]
+                                 + struct.unpack("<q", b)[0])
+                        local[a] = struct.pack("<q", total)
+                    elif op == "get":
+                        got = await tr.get(a)
+                        want = local.get(a)
+                        if got != want:
+                            raise WorkloadFailed(
+                                f"RYW get({a!r}) = {got!r}, want {want!r}")
+                    elif op == "get_range":
+                        got = await tr.get_range(a, b)
+                        want = sorted(
+                            (k, v) for k, v in local.items() if a <= k < b)
+                        if got != want:
+                            raise WorkloadFailed(
+                                f"RYW range [{a!r},{b!r}) = {got!r}, "
+                                f"want {want!r}")
+                return local
+
+            self.model = await self._run_txn(db, body)
+            self.metrics.ops += len(plan)
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            return await tr.get_range(b"wdr/", b"wdr0")
+
+        rows = await self._run_txn(db, body)
+        want = sorted(self.model.items())
+        if rows != want:
+            raise WorkloadFailed(
+                f"final state {len(rows)} rows != model {len(want)} rows")
+
+
+class FuzzApiWorkload(Workload):
+    """Randomized API-surface fuzz vs a sequential model (reference:
+    FuzzApiCorrectness.actor.cpp, narrowed to the implemented surface):
+    single-client random transactions mixing mutations, snapshot and
+    conflict reads, limited/reverse ranges, and key selectors; each txn's
+    reads are checked against the model, and committed txns fold into it."""
+
+    name = "fuzz_api"
+
+    def __init__(self, seed: int = 0, n_keys: int = 40, n_txns: int = 30,
+                 ops_per_txn: int = 8):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.ops_per_txn = ops_per_txn
+        self.model: dict[bytes, bytes] = {}
+
+    def _key(self, rng) -> bytes:
+        return b"fuzz/%03d" % rng.randrange(self.n_keys)
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"fuzz/", b"fuzz0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        from foundationdb_tpu.client.transaction import KeySelector
+        from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+        rng = cluster.loop.rng
+        for _ in range(self.n_txns):
+            plan = []
+            for _o in range(self.ops_per_txn):
+                r = rng.random()
+                if r < 0.3:
+                    plan.append(("set", self._key(rng),
+                                 b"x%05d" % rng.randrange(99999)))
+                elif r < 0.4:
+                    plan.append(("clear", self._key(rng), None))
+                elif r < 0.6:
+                    plan.append(("get", self._key(rng),
+                                 rng.random() < 0.5))  # snapshot?
+                elif r < 0.8:
+                    a, b = sorted((self._key(rng), self._key(rng)))
+                    plan.append(("range", (a, b, rng.randrange(0, 6),
+                                           rng.random() < 0.5), None))
+                else:
+                    plan.append(("get_key", self._key(rng),
+                                 (rng.random() < 0.5, rng.randrange(-2, 3))))
+
+            async def body(tr, plan=plan):
+                local = dict(self.model)
+                for op, a, b in plan:
+                    if op == "set":
+                        tr.set(a, b)
+                        local[a] = b
+                    elif op == "clear":
+                        tr.clear(a)
+                        local.pop(a, None)
+                    elif op == "get":
+                        got = await tr.get(a, snapshot=b)
+                        if got != local.get(a):
+                            raise WorkloadFailed(
+                                f"fuzz get({a!r}) = {got!r}, "
+                                f"want {local.get(a)!r}")
+                    elif op == "range":
+                        ra, rb, limit, reverse = a
+                        got = await tr.get_range(ra, rb, limit=limit,
+                                                 reverse=reverse)
+                        rows = sorted(
+                            (k, v) for k, v in local.items() if ra <= k < rb)
+                        if reverse:
+                            rows.reverse()
+                        if limit > 0:
+                            rows = rows[:limit]
+                        if got != rows:
+                            raise WorkloadFailed(
+                                f"fuzz range {a} = {len(got)} rows, "
+                                f"want {len(rows)}")
+                    elif op == "get_key":
+                        or_equal, offset = b
+                        sel = KeySelector(a, or_equal, offset)
+                        got = await tr.get_key(sel)
+                        ks = sorted(local)
+                        anchor = a + (b"\x00" if or_equal else b"")
+                        if offset >= 1:
+                            i = bisect.bisect_left(ks, anchor) + (offset - 1)
+                            want = ks[i] if i < len(ks) else MAX_KEY
+                        else:
+                            i = bisect.bisect_left(ks, anchor) - (1 - offset)
+                            want = ks[i] if i >= 0 else b""
+                        # Clamp like the runtime: selectors resolving
+                        # outside the fuzz prefix see OTHER tests' keys —
+                        # only verify in-prefix answers.
+                        in_prefix = (want.startswith(b"fuzz/")
+                                     and got.startswith(b"fuzz/"))
+                        if in_prefix and got != want:
+                            raise WorkloadFailed(
+                                f"fuzz get_key({a!r},{or_equal},{offset}) "
+                                f"= {got!r}, want {want!r}")
+                return local
+
+            self.model = await self._run_txn(db, body)
+            self.metrics.ops += len(plan)
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            return await tr.get_range(b"fuzz/", b"fuzz0")
+
+        rows = await self._run_txn(db, body)
+        if rows != sorted(self.model.items()):
+            raise WorkloadFailed("fuzz final state diverged from model")
+
+
+class DDBalanceWorkload(Workload):
+    """Reads and writes racing shard moves (reference: DDBalance.actor.cpp):
+    clients hammer a key prefix while the DataDistributor is told to move
+    the hot shard between storage teams; every committed write must stay
+    readable throughout and afterwards. Requires data_distribution=True."""
+
+    name = "dd_balance"
+
+    def __init__(self, seed: int = 0, n_keys: int = 16, n_txns: int = 30,
+                 n_moves: int = 4):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_moves = n_moves
+        self.written: dict[bytes, bytes] = {}
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"ddb/", b"ddb0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        dd = getattr(cluster, "data_distributor", None)
+        if dd is None:
+            raise WorkloadFailed("DDBalance needs data_distribution=True")
+        rng = cluster.loop.rng
+        done = [False]
+
+        async def mover():
+            n_storages = len(cluster.storage_eps)
+            k = cluster.n_replicas
+            for m in range(self.n_moves):
+                dst = tuple((m + j) % n_storages for j in range(k))
+                try:
+                    await dd.move_shard(b"ddb/", b"ddb0", dst)
+                except Exception:
+                    pass  # racing recoveries may abort a move; keep going
+                await cluster.loop.sleep(0.5)
+            done[0] = True
+
+        async def writer():
+            i = 0
+            while not done[0] or i < self.n_txns:
+                k = b"ddb/%03d" % rng.randrange(self.n_keys)
+                v = b"m%06d" % i
+
+                async def body(tr, k=k, v=v):
+                    got_prev = await tr.get(k)
+                    # An applied-but-unknown commit retried by db.run may
+                    # legitimately observe ITS OWN value on the second
+                    # attempt — accept either.
+                    if got_prev not in (self.written.get(k), v):
+                        raise WorkloadFailed(
+                            f"dd_balance read {k!r} = {got_prev!r} "
+                            f"mid-move, want {self.written.get(k)!r}")
+                    tr.set(k, v)
+
+                await self._run_txn(db, body)
+                self.written[k] = v
+                self.metrics.ops += 1
+                i += 1
+                await cluster.loop.sleep(0.05)
+
+        await all_of([
+            cluster.loop.spawn(mover(), name="ddb.mover"),
+            cluster.loop.spawn(writer(), name="ddb.writer"),
+        ])
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            return await tr.get_range(b"ddb/", b"ddb0")
+
+        rows = await self._run_txn(db, body)
+        if rows != sorted(self.written.items()):
+            raise WorkloadFailed(
+                f"dd_balance final {len(rows)} rows != "
+                f"{len(self.written)} written")
