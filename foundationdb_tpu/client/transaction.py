@@ -43,6 +43,7 @@ from foundationdb_tpu.core.errors import (
     KeyOutsideLegalRange,
     KeyTooLarge,
     NotCommitted,
+    TransactionTimedOut,
     TransactionTooLarge,
     ValueTooLarge,
     WrongShardServer,
@@ -251,9 +252,14 @@ class Transaction:
         # Options survive resets, like reference options on a retry loop.
         self.report_conflicting_keys = False  # fdb option 712
         self.tags: set[str] = set()  # fdb option TAG (ratekeeper throttling)
+        self.timeout_ms: int | None = None  # option 500
+        self.retry_limit: int | None = None  # option 501
+        self.size_limit: int | None = None  # option 503
+        self.access_system_keys = False  # option 301
+        self._retries = 0  # attempts consumed by on_error (for retry_limit)
         self._reset()
 
-    def set_option(self, name: str, value: str | None = None) -> None:
+    def set_option(self, name: str, value=None) -> None:
         """Transaction options (reference: fdb_transaction_set_option);
         only the ones this client implements."""
         if name == "report_conflicting_keys":
@@ -262,10 +268,38 @@ class Transaction:
             if not value:
                 raise FdbError("tag option requires a value", code=2006)
             self.tags.add(value)
+        elif name == "timeout":
+            ms = int(value)
+            # Reference option 500: value 0 clears a previously-set timeout.
+            self.timeout_ms = ms if ms > 0 else None
+            if self.timeout_ms is not None:
+                self._deadline = self._start + self.timeout_ms / 1000.0
+        elif name == "retry_limit":
+            self.retry_limit = int(value)
+        elif name == "size_limit":
+            limit = int(value)
+            if not 32 <= limit <= MAX_TRANSACTION_SIZE:
+                # Rejected option must be a no-op.
+                raise FdbError(
+                    f"size_limit {value} outside [32, "
+                    f"{MAX_TRANSACTION_SIZE}]", code=2006)
+            self.size_limit = limit
+        elif name == "access_system_keys":
+            self.access_system_keys = True
         else:
             raise FdbError(f"unknown transaction option {name!r}", code=2006)
 
+    def _check_timeout(self) -> None:
+        if self.timeout_ms is not None and self.db.loop.now > self._deadline:
+            raise TransactionTimedOut(
+                f"transaction exceeded {self.timeout_ms}ms")
+
     def _reset(self) -> None:
+        # Timeout measures from creation/reset, like the reference (the
+        # option itself survives resets; the clock restarts per attempt).
+        self._start = self.db.loop.now
+        if self.timeout_ms is not None:
+            self._deadline = self._start + self.timeout_ms / 1000.0
         self._read_version: int | None = None
         self.mutations: list[Mutation] = []
         self.read_ranges: list[KeyRange] = []
@@ -278,6 +312,7 @@ class Transaction:
     # -- versions -------------------------------------------------------------
 
     async def get_read_version(self) -> int:
+        self._check_timeout()
         if self._read_version is None:
             try:
                 self._read_version = await self.db._pick(
@@ -434,13 +469,13 @@ class Transaction:
     # -- writes ---------------------------------------------------------------
 
     def set(self, key: bytes, value: bytes) -> None:
-        _check_writable_key(key)
+        _check_writable_key(key, self.access_system_keys)
         _check_value(value)
         self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
         self.write_ranges.append(single_key_range(key))
 
     def clear(self, key: bytes) -> None:
-        _check_writable_key(key)
+        _check_writable_key(key, self.access_system_keys)
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, key, key + b"\x00"))
         self.write_ranges.append(single_key_range(key))
 
@@ -448,9 +483,11 @@ class Transaction:
         r = KeyRange(begin, end)
         if r.empty:
             return
-        _check_writable_key(begin)
-        if end > b"\xff":
-            raise KeyOutsideLegalRange(f"clear_range end {end[:16]!r} beyond 0xff")
+        _check_writable_key(begin, self.access_system_keys)
+        end_cap = b"\xff\xff" if self.access_system_keys else b"\xff"
+        if end > end_cap:
+            raise KeyOutsideLegalRange(
+                f"clear_range end {end[:16]!r} beyond {end_cap!r}")
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
         self.write_ranges.append(r)
 
@@ -460,7 +497,7 @@ class Transaction:
             MutationType.SET_VERSIONSTAMPED_VALUE,
         ):
             raise ValueError(f"not an atomic op: {op!r}")
-        _check_writable_key(key)
+        _check_writable_key(key, self.access_system_keys)
         self.mutations.append(Mutation(op, key, param))
         if op == MutationType.SET_VERSIONSTAMPED_KEY:
             # The final key is unknown until commit: conflict over every key
@@ -498,8 +535,9 @@ class Transaction:
             len(r.begin) + len(r.end) + 16
             for r in self.read_ranges + self.write_ranges
         )
-        if size > MAX_TRANSACTION_SIZE:
-            raise TransactionTooLarge(f"{size} > {MAX_TRANSACTION_SIZE}")
+        cap = min(self.size_limit or MAX_TRANSACTION_SIZE, MAX_TRANSACTION_SIZE)
+        if size > cap:
+            raise TransactionTooLarge(f"{size} > {cap}")
         req = CommitRequest(
             read_version=version,
             mutations=list(self.mutations),
@@ -542,6 +580,9 @@ class Transaction:
         self._pending_watches, self._watch_futures = [], []
         if not isinstance(e, FdbError) or not e.retryable:
             raise e
+        self._retries += 1
+        if self.retry_limit is not None and self._retries > self.retry_limit:
+            raise e  # option 501: give up after N retries (reference)
         backoff = self._backoff
         self._backoff = min(self.MAX_BACKOFF, self._backoff * 2)
         self._reset()
@@ -557,12 +598,15 @@ def _check_key(key: bytes) -> None:
         raise KeyTooLarge(f"{len(key)} > {MAX_KEY_SIZE}")
 
 
-def _check_writable_key(key: bytes) -> None:
+def _check_writable_key(key: bytes, allow_system: bool = False) -> None:
     """Writes to the system keyspace (keys starting with 0xff) are illegal
-    without the access-system-keys option, which this client does not offer
-    (reference: error 2004 key_outside_legal_range on such mutations)."""
+    unless the transaction set the access_system_keys option (reference:
+    error 2004 key_outside_legal_range on such mutations). The
+    double-0xff special-key space is never directly writable."""
     _check_key(key)
-    if key.startswith(b"\xff"):
+    if key.startswith(SPECIAL_KEY_PREFIX):
+        raise KeyOutsideLegalRange(f"write to special key {key[:16]!r}")
+    if key.startswith(b"\xff") and not allow_system:
         raise KeyOutsideLegalRange(f"write to system key {key[:16]!r}")
 
 

@@ -115,6 +115,15 @@ class ChangeFeedPopped(FdbError):
     code = 2037
 
 
+class TransactionTimedOut(FdbError):
+    """The transaction's timeout option expired (error 1031). NOT
+    retryable: the reference's on_error re-raises it so the timeout
+    actually bounds the retry loop (a retryable 1031 would livelock once
+    backoff exceeds the timeout — every fresh attempt born expired)."""
+
+    code = 1031
+
+
 class ProcessKilled(FdbError):
     """Simulation-only: the role's process was killed mid-operation."""
 

@@ -1374,7 +1374,10 @@ class FuzzApiWorkload(Workload):
                                  (rng.random() < 0.5, rng.randrange(-2, 3))))
 
             async def body(tr, plan=plan):
-                local = dict(self.model)
+                # Snapshot-rebuilt per attempt (same hazard WriteDuringRead
+                # documents: an applied-but-unknown commit retried by
+                # db.run would diverge from a carried model).
+                local = dict(await tr.get_range(b"fuzz/", b"fuzz0"))
                 for op, a, b in plan:
                     if op == "set":
                         tr.set(a, b)

@@ -1,0 +1,163 @@
+"""Transaction options (timeout / retry_limit / size_limit /
+access_system_keys) and the locality API — reference: fdb option codes
+500/501/503/301, fdb.locality.* / Transaction::getEstimatedRangeSizeBytes.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.locality import (
+    get_addresses_for_key,
+    get_boundary_keys,
+    get_estimated_range_size_bytes,
+)
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.core.errors import (
+    FdbError,
+    KeyOutsideLegalRange,
+    TransactionTimedOut,
+    TransactionTooLarge,
+)
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_db(seed=0, **kw):
+    kw.setdefault("n_storages", 2)
+    c = SimCluster(seed=seed, **kw)
+    return c, open_database(c)
+
+
+class TestOptions:
+    def test_timeout_expires_and_is_retryable(self):
+        c, db = make_db(seed=1)
+
+        async def main():
+            tr = db.transaction()
+            tr.set_option("timeout", 50)  # 50ms of virtual time
+            await tr.get(b"k")
+            await c.loop.sleep(0.2)
+            with pytest.raises(TransactionTimedOut) as ei:
+                await tr.get(b"k2")
+            assert ei.value.code == 1031 and not ei.value.retryable
+            # NOT retryable: on_error must surface it so the timeout
+            # actually terminates retry loops (reference semantics).
+            with pytest.raises(TransactionTimedOut):
+                await tr.on_error(ei.value)
+            # timeout 0 clears the option; the transaction works again
+            # after an explicit reset via a fresh transaction.
+            tr2 = db.transaction()
+            tr2.set_option("timeout", 50)
+            tr2.set_option("timeout", 0)
+            await c.loop.sleep(0.2)
+            assert await tr2.get(b"k") is None
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_retry_limit_bounds_on_error(self):
+        c, db = make_db(seed=2)
+
+        async def main():
+            tr = db.transaction()
+            tr.set_option("retry_limit", 2)
+            err = FdbError("conflict", code=1020)  # retryable
+            await tr.on_error(err)
+            await tr.on_error(err)
+            with pytest.raises(FdbError):
+                await tr.on_error(err)  # third retry exceeds the limit
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_size_limit_caps_commit(self):
+        c, db = make_db(seed=3)
+
+        async def main():
+            tr = db.transaction()
+            # A rejected option value must be a no-op.
+            with pytest.raises(FdbError):
+                tr.set_option("size_limit", 10)
+            assert tr.size_limit is None
+            tr.set_option("size_limit", 200)
+            tr.set(b"k", b"v" * 300)
+            with pytest.raises(TransactionTooLarge):
+                await tr.commit()
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_access_system_keys_gates_writes(self):
+        c, db = make_db(seed=4)
+
+        async def main():
+            tr = db.transaction()
+            with pytest.raises(KeyOutsideLegalRange):
+                tr.set(b"\xff/conf/x", b"1")
+            tr.set_option("access_system_keys")
+            tr.set(b"\xff/conf/x", b"1")
+            await tr.commit()
+            got = await db.transaction().get(b"\xff/conf/x")
+            assert got == b"1"
+            # The \xff\xff special space stays unwritable regardless.
+            tr2 = db.transaction()
+            tr2.set_option("access_system_keys")
+            with pytest.raises(KeyOutsideLegalRange):
+                tr2.set(b"\xff\xff/nope", b"1")
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_metadata_version_pattern(self):
+        """The reference's \\xff/metadataVersion idiom: layers bump it with
+        SET_VERSIONSTAMPED_VALUE and watch/read it to invalidate caches."""
+        c, db = make_db(seed=5)
+        MV = b"\xff/metadataVersion"
+
+        async def main():
+            async def bump(tr):
+                tr.set_option("access_system_keys")
+                tr.atomic_op(MutationType.SET_VERSIONSTAMPED_VALUE, MV,
+                             b"\x00" * 10 + b"\x00\x00\x00\x00")
+
+            await db.run(bump)
+            v1 = await db.transaction().get(MV)
+            await db.run(bump)
+            v2 = await db.transaction().get(MV)
+            assert v1 is not None and v2 is not None and v2 > v1
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+
+class TestLocality:
+    def test_boundary_keys_and_addresses(self):
+        c, db = make_db(seed=6, n_storages=4)
+
+        async def main():
+            bounds = await get_boundary_keys(db, b"", b"\xff")
+            assert bounds and bounds[0] == b""
+            assert bounds == sorted(bounds)
+            addrs = await get_addresses_for_key(db.transaction(), b"some/key")
+            assert addrs and all(isinstance(a, str) for a in addrs)
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_estimated_range_size(self):
+        c, db = make_db(seed=7)
+
+        async def main():
+            async def fill(tr):
+                for i in range(32):
+                    tr.set(b"est/%03d" % i, b"x" * 100)
+
+            await db.run(fill)
+            est = await get_estimated_range_size_bytes(
+                db.transaction(), b"est/", b"est0")
+            assert est >= 32 * 100
+            empty = await get_estimated_range_size_bytes(
+                db.transaction(), b"zzz/", b"zzz0")
+            assert empty == 0
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
