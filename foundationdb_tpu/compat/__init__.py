@@ -1,0 +1,1 @@
+"""Compatibility shims for users of other FoundationDB surfaces."""

@@ -1,0 +1,435 @@
+"""Drop-in shim of the public ``fdb`` Python binding API.
+
+Reference: bindings/python/fdb/impl.py — the surface real FoundationDB
+applications code against: ``fdb.open()``, ``@fdb.transactional``,
+blocking ``tr[key]`` reads, slice range-reads, atomic-op helper methods,
+``fdb.tuple`` / ``fdb.Subspace`` / ``fdb.directory``. This module maps
+that surface onto this framework's async client so a reference user's
+application code runs unchanged:
+
+    import foundationdb_tpu.compat.fdb as fdb
+    fdb.api_version(710)
+    db = fdb.open("/path/cluster.json")   # or fdb.open(sim_cluster=c)
+
+    @fdb.transactional
+    def add_user(tr, name):
+        tr[fdb.tuple.pack(("user", name))] = b"1"
+
+The binding's blocking style is implemented by pumping the client's
+flow-Loop to completion per operation (the shim is for porting apps and
+tools, not for writing new high-concurrency actors — new code should use
+the native async client). Each ``@transactional`` call runs the standard
+retry loop, exactly like the reference decorator.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from foundationdb_tpu.client.ryw import RYWTransaction
+from foundationdb_tpu.client.ryw import open_database as _open_sim
+from foundationdb_tpu.client.transaction import KeySelector  # noqa: F401 (re-export)
+from foundationdb_tpu.core.errors import FdbError  # noqa: F401 (re-export)
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.layers import directory as _directory_impl
+from foundationdb_tpu.layers import tuple_layer as tuple  # noqa: A001 (fdb.tuple)
+from foundationdb_tpu.layers.tuple_layer import Subspace  # noqa: F401 (re-export)
+
+_api_version: int | None = None
+
+
+def api_version(version: int) -> None:
+    """Reference: fdb.api_version — must be called before open(); we accept
+    any version the reference python binding accepted (≥ 520)."""
+    global _api_version
+    if _api_version is not None and _api_version != version:
+        raise RuntimeError(f"API version already set to {_api_version}")
+    if version < 520:
+        raise RuntimeError(f"API version {version} not supported")
+    _api_version = version
+
+
+def open(cluster_file: str | None = None, *, sim_cluster=None) -> "Database":
+    """Connect and return a blocking Database facade.
+
+    cluster_file: a deployed cluster's spec JSON (served by
+    scripts/start_cluster.sh) — the reference's fdb.cluster analogue.
+    sim_cluster: alternatively, an in-process SimCluster.
+    """
+    if _api_version is None:
+        raise RuntimeError("fdb.api_version() must be called before open()")
+    if (cluster_file is None) == (sim_cluster is None):
+        raise ValueError("pass exactly one of cluster_file / sim_cluster")
+    if sim_cluster is not None:
+        return Database(sim_cluster.loop, _open_sim(sim_cluster))
+    from foundationdb_tpu.cli import open_cluster
+
+    loop, transport, db = open_cluster(cluster_file)
+    facade = Database(loop, db)
+    facade._transport = transport
+    return facade
+
+
+def transactional(func):
+    """Reference: @fdb.transactional — fn(db_or_tr, ...) runs under the
+    retry loop when handed a Database, or joins the caller's transaction
+    when handed a Transaction."""
+
+    @functools.wraps(func)
+    def wrapper(db_or_tr, *args, **kwargs):
+        if isinstance(db_or_tr, Transaction):
+            return func(db_or_tr, *args, **kwargs)
+        db: Database = db_or_tr
+
+        async def body(tr):
+            return func(Transaction(db, tr), *args, **kwargs)
+
+        return db._block(db._db.run(body))
+
+    return wrapper
+
+
+class Database:
+    """Blocking facade over the async Database (reference: fdb.Database).
+
+    Database-level sugar (db[key], db[a:b], db.get, …) each run as their
+    own one-shot retried transaction, like the reference."""
+
+    def __init__(self, loop, db):
+        self.loop = loop
+        self._db = db
+        self.options = _Options()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _block(self, coro, timeout: float = 600.0):
+        return self.loop.run(coro, timeout=timeout)
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self, self._db.transaction())
+
+    # -- one-shot sugar ------------------------------------------------------
+
+    def _oneshot(self, fn):
+        async def body(tr):
+            return await fn(tr)
+
+        return self._block(self._db.run(body))
+
+    def get(self, key: bytes):
+        return self._oneshot(lambda tr: tr.get(key))
+
+    def get_range(self, begin, end, limit: int = 0, reverse: bool = False):
+        async def body(tr):
+            b = (await tr.get_key(begin)) if isinstance(begin, KeySelector) \
+                else begin
+            e = (await tr.get_key(end)) if isinstance(end, KeySelector) \
+                else end
+            return await tr.get_range(b, e, limit=limit, reverse=reverse)
+
+        return self._block(self._db.run(body))
+
+    def get_key(self, sel: KeySelector):
+        return self._oneshot(lambda tr: tr.get_key(sel))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        async def body(tr):
+            tr.set(key, value)
+
+        self._block(self._db.run(body))
+
+    def clear(self, key: bytes) -> None:
+        async def body(tr):
+            tr.clear(key)
+
+        self._block(self._db.run(body))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        async def body(tr):
+            tr.clear_range(begin, end)
+
+        self._block(self._db.run(body))
+
+    def get_boundary_keys(self, begin: bytes, end: bytes):
+        from foundationdb_tpu.client.locality import get_boundary_keys
+
+        return self._block(get_boundary_keys(self._db, begin, end))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.get_range(key.start or b"", key.stop or b"\xff")
+        return self.get(key)
+
+    def __setitem__(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def __delitem__(self, key) -> None:
+        if isinstance(key, slice):
+            self.clear_range(key.start or b"", key.stop or b"\xff")
+        else:
+            self.clear(key)
+
+    def close(self) -> None:
+        t = getattr(self, "_transport", None)
+        if t is not None:
+            t.close()
+
+
+class Transaction:
+    """Blocking facade over one RYWTransaction (reference: fdb.Transaction).
+
+    Reads block until the value is available (the reference returns
+    futures whose .wait() the sugar calls implicitly — this shim goes
+    straight to the value, which is what idiomatic fdb-python code
+    observes)."""
+
+    def __init__(self, db: Database, tr: RYWTransaction):
+        self._dbf = db
+        self._tr = tr
+        self.options = _TransactionOptions(tr)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, key: bytes):
+        return self._dbf._block(self._tr.get(key))
+
+    def get_range(self, begin, end, limit: int = 0, reverse: bool = False,
+                  streaming_mode=None):
+        if isinstance(begin, KeySelector):
+            begin = self.get_key(begin)
+        if isinstance(end, KeySelector):
+            end = self.get_key(end)
+        return self._dbf._block(
+            self._tr.get_range(begin, end, limit=limit, reverse=reverse)
+        )
+
+    def get_range_startswith(self, prefix: bytes, **kw):
+        return self.get_range(prefix, _strinc(prefix), **kw)
+
+    def get_key(self, sel: KeySelector):
+        return self._dbf._block(self._tr.get_key(sel))
+
+    def get_read_version(self):
+        return self._dbf._block(self._tr.get_read_version())
+
+    def watch(self, key: bytes) -> "FutureWatch":
+        return FutureWatch(self._dbf, self._dbf._block(self._tr.watch(key)))
+
+    # -- writes --------------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._tr.set(key, value)
+
+    def clear(self, key: bytes) -> None:
+        self._tr.clear(key)
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.clear_range(begin, end)
+
+    def clear_range_startswith(self, prefix: bytes) -> None:
+        self._tr.clear_range(prefix, _strinc(prefix))
+
+    def set_read_version(self, version: int) -> None:
+        self._tr.set_read_version(version)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_read_conflict_range(begin, end)
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_write_conflict_range(begin, end)
+
+    def add_read_conflict_key(self, key: bytes) -> None:
+        self._tr.add_read_conflict_range(key, key + b"\x00")
+
+    def add_write_conflict_key(self, key: bytes) -> None:
+        self._tr.add_write_conflict_range(key, key + b"\x00")
+
+    # -- atomic ops (reference method names) ---------------------------------
+
+    def add(self, key, param):
+        self._tr.atomic_op(MutationType.ADD, key, param)
+
+    def bit_and(self, key, param):
+        self._tr.atomic_op(MutationType.AND, key, param)
+
+    def bit_or(self, key, param):
+        self._tr.atomic_op(MutationType.OR, key, param)
+
+    def bit_xor(self, key, param):
+        self._tr.atomic_op(MutationType.XOR, key, param)
+
+    def max(self, key, param):
+        self._tr.atomic_op(MutationType.MAX, key, param)
+
+    def min(self, key, param):
+        self._tr.atomic_op(MutationType.MIN, key, param)
+
+    def byte_max(self, key, param):
+        self._tr.atomic_op(MutationType.BYTE_MAX, key, param)
+
+    def byte_min(self, key, param):
+        self._tr.atomic_op(MutationType.BYTE_MIN, key, param)
+
+    def append_if_fits(self, key, param):
+        self._tr.atomic_op(MutationType.APPEND_IF_FITS, key, param)
+
+    def compare_and_clear(self, key, param):
+        self._tr.atomic_op(MutationType.COMPARE_AND_CLEAR, key, param)
+
+    def set_versionstamped_key(self, key, param):
+        self._tr.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key, param)
+
+    def set_versionstamped_value(self, key, param):
+        self._tr.atomic_op(MutationType.SET_VERSIONSTAMPED_VALUE, key, param)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def commit(self):
+        return self._dbf._block(self._tr.commit())
+
+    def on_error(self, e) -> None:
+        self._dbf._block(self._tr.on_error(e))
+
+    def reset(self) -> None:
+        self._tr._reset()
+
+    @property
+    def committed_version(self) -> int:
+        return self._tr.committed_version
+
+    def get_versionstamp(self) -> bytes:
+        return self._tr.get_versionstamp()
+
+    # -- sugar ---------------------------------------------------------------
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.get_range(key.start or b"", key.stop or b"\xff")
+        return self.get(key)
+
+    def __setitem__(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def __delitem__(self, key) -> None:
+        if isinstance(key, slice):
+            self.clear_range(key.start or b"", key.stop or b"\xff")
+        else:
+            self.clear(key)
+
+
+class _TransactionOptions:
+    """tr.options.set_* style (reference option setters)."""
+
+    def __init__(self, tr: RYWTransaction):
+        self._tr = tr
+
+    def set_timeout(self, ms: int) -> None:
+        self._tr.set_option("timeout", ms)
+
+    def set_retry_limit(self, n: int) -> None:
+        self._tr.set_option("retry_limit", n)
+
+    def set_size_limit(self, n: int) -> None:
+        self._tr.set_option("size_limit", n)
+
+    def set_access_system_keys(self) -> None:
+        self._tr.set_option("access_system_keys")
+
+    def set_report_conflicting_keys(self) -> None:
+        self._tr.set_option("report_conflicting_keys")
+
+    def set_tag(self, tag: str) -> None:
+        self._tr.set_option("tag", tag)
+
+    def __getattr__(self, name):
+        # Accept-and-ignore every other reference option setter, like
+        # db.options: ported apps set knobs this runtime has no use for
+        # (snapshot_ryw_disable, logging limits, ...), and an
+        # AttributeError inside a retry loop is worse than a no-op.
+        if name.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+class FutureWatch:
+    """Blocking handle for tr.watch() (reference: watches return a Future
+    whose .wait() blocks until the key changes)."""
+
+    def __init__(self, dbf: "Database", fut):
+        self._dbf = dbf
+        self._fut = fut
+
+    def wait(self, timeout: float = 600.0):
+        async def waiter():
+            return await self._fut
+
+        return self._dbf._block(waiter(), timeout=timeout)
+
+    def is_ready(self) -> bool:
+        return self._fut.done()
+
+    def cancel(self) -> None:  # parity stub: watches die with the client
+        pass
+
+
+class _Options:
+    """db.options — accepted and ignored where the runtime has no knob,
+    like the reference ignores many client options."""
+
+    def __getattr__(self, name):
+        if name.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+# The one canonical strinc lives in core.types.
+from foundationdb_tpu.core.types import strinc as _strinc  # noqa: E402
+
+
+class _DirectoryFacade:
+    """Blocking fdb.directory over the async DirectoryLayer. Methods take
+    (db_or_tr, path, ...) exactly like the reference's directory API."""
+
+    def __init__(self):
+        self._impl = _directory_impl.DirectoryLayer()
+
+    def _run(self, db_or_tr, fn):
+        if isinstance(db_or_tr, Transaction):
+            return db_or_tr._dbf._block(fn(db_or_tr._tr))
+        db: Database = db_or_tr
+
+        async def body(tr):
+            return await fn(tr)
+
+        return db._block(db._db.run(body))
+
+    def create_or_open(self, db_or_tr, path, layer: bytes = b""):
+        return self._run(
+            db_or_tr, lambda tr: self._impl.create_or_open(tr, path, layer)
+        )
+
+    def open(self, db_or_tr, path, layer: bytes = b""):
+        return self._run(db_or_tr, lambda tr: self._impl.open(tr, path, layer))
+
+    def create(self, db_or_tr, path, layer: bytes = b"",
+               prefix: bytes | None = None):
+        return self._run(
+            db_or_tr, lambda tr: self._impl.create(tr, path, layer, prefix)
+        )
+
+    def move(self, db_or_tr, old_path, new_path):
+        return self._run(
+            db_or_tr, lambda tr: self._impl.move(tr, old_path, new_path)
+        )
+
+    def remove(self, db_or_tr, path):
+        return self._run(db_or_tr, lambda tr: self._impl.remove(tr, path))
+
+    def exists(self, db_or_tr, path) -> bool:
+        return self._run(db_or_tr, lambda tr: self._impl.exists(tr, path))
+
+    def list(self, db_or_tr, path=()):
+        return self._run(db_or_tr, lambda tr: self._impl.list(tr, path))
+
+
+directory = _DirectoryFacade()

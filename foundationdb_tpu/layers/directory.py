@@ -7,7 +7,7 @@ by the high-contention allocator so deep paths don't produce long keys.
 
 Layout (identical to the reference so the on-disk format is recognisable):
 - node(prefix)           = node_ss[prefix]              (a Subspace)
-- root node              = node_ss[node_ss.key]
+- root node              = node_ss[node_ss.key()]
 - subdir pointer         node[0][name] -> child prefix
 - layer id               node[b"layer"] -> layer bytes
 - version                root_node[b"version"] -> 3x uint32 LE
@@ -65,7 +65,7 @@ class HighContentionAllocator:
         while True:
             start = 0
             kvs = await tr.get_range(
-                self.counters.key, strinc(self.counters.key), limit=1, reverse=True,
+                self.counters.key(), strinc(self.counters.key()), limit=1, reverse=True,
                 snapshot=True,
             )
             if kvs:
@@ -74,8 +74,8 @@ class HighContentionAllocator:
             window_advanced = False
             while True:
                 if window_advanced:
-                    tr.clear_range(self.counters.key, self.counters.pack((start,)))
-                    tr.clear_range(self.recent.key, self.recent.pack((start,)))
+                    tr.clear_range(self.counters.key(), self.counters.pack((start,)))
+                    tr.clear_range(self.recent.key(), self.recent.pack((start,)))
                 tr.atomic_op(
                     MutationType.ADD, self.counters.pack((start,)),
                     struct.pack("<q", 1),
@@ -95,7 +95,7 @@ class HighContentionAllocator:
                 candidate = start + rng.randrange(self._window_size(start))
                 # Has the window moved under us? (another allocator advanced it)
                 latest = await tr.get_range(
-                    self.counters.key, strinc(self.counters.key), limit=1,
+                    self.counters.key(), strinc(self.counters.key()), limit=1,
                     reverse=True, snapshot=True,
                 )
                 latest_start = self.counters.unpack(latest[0][0])[0] if latest else 0
@@ -165,7 +165,7 @@ class DirectorySubspace(Subspace):
         return await self.directory_layer.exists(tr, self._subpath(path))
 
     def __repr__(self) -> str:
-        return f"DirectorySubspace(path={self.path!r}, prefix={self.key!r})"
+        return f"DirectorySubspace(path={self.path!r}, prefix={self.key()!r})"
 
 
 class DirectoryPartition(DirectorySubspace):
@@ -211,6 +211,12 @@ class DirectoryPartition(DirectorySubspace):
     def _forbidden(self):
         raise DirectoryError(
             "a directory partition cannot be used as a subspace")
+
+    def key(self):
+        # Reference: "Cannot get key for the root of a directory
+        # partition" — the raw prefix would let callers write keys that
+        # interleave with the partition's node metadata.
+        self._forbidden()
 
     def pack(self, t: tuple = ()):
         self._forbidden()
@@ -263,7 +269,7 @@ class DirectoryLayer:
                  path: tuple = ()):
         self._node_ss = node_subspace or Subspace(raw_prefix=b"\xfe")
         self._content_ss = content_subspace or Subspace()
-        self._root_node = self._node_ss.subspace((self._node_ss.key,))
+        self._root_node = self._node_ss.subspace((self._node_ss.key(),))
         self._allocator = HighContentionAllocator(self._root_node[b"hca"])
         self._path = tuple(path)  # absolute path of this layer's root
         # (non-empty only for a partition's inner layer)
@@ -295,7 +301,7 @@ class DirectoryLayer:
         return self._node_ss.subspace((prefix,))
 
     def _prefix_of(self, node: Subspace) -> bytes:
-        return self._node_ss.unpack(node.key)[0]
+        return self._node_ss.unpack(node.key())[0]
 
     async def _check_version(self, tr, write: bool) -> None:
         raw = await tr.get(self._root_node.pack((b"version",)))
@@ -382,7 +388,7 @@ class DirectoryLayer:
             # orphaning data when the partition is moved/removed.
             raise DirectoryError("cannot specify a prefix in a partition")
         if prefix is None:
-            prefix = self._content_ss.key + await self._allocator.allocate(tr)
+            prefix = self._content_ss.key() + await self._allocator.allocate(tr)
             if await self._has_keys(tr, prefix):
                 raise DirectoryError(
                     f"allocated prefix {prefix!r} is not empty; database "
@@ -394,7 +400,7 @@ class DirectoryLayer:
         if len(path) > 1:
             parent = await self._create_or_open(tr, path[:-1], b"",
                                                 allow_create=True, allow_open=True)
-            parent_node = self._node_with_prefix(parent.key)
+            parent_node = self._node_with_prefix(parent.key())
         else:
             parent_node = self._root_node
         node = self._node_with_prefix(prefix)
@@ -419,7 +425,7 @@ class DirectoryLayer:
         if inside:
             return True
         before = await tr.get_range(
-            self._node_ss.key, self._node_ss.pack((prefix,)) + b"\x00",
+            self._node_ss.key(), self._node_ss.pack((prefix,)) + b"\x00",
             limit=1, reverse=True)
         for k, _ in before:
             try:
@@ -511,4 +517,4 @@ class DirectoryLayer:
             await self._remove_recursive(tr, self._node_with_prefix(child_prefix))
         prefix = self._prefix_of(node)
         tr.clear_range(prefix, strinc(prefix))  # contents
-        tr.clear_range(node.key, strinc(node.key))  # metadata
+        tr.clear_range(node.key(), strinc(node.key()))  # metadata

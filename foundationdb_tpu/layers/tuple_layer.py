@@ -316,8 +316,9 @@ class Subspace:
     def __init__(self, prefix_tuple: tuple = (), raw_prefix: bytes = b""):
         self._prefix = raw_prefix + pack(prefix_tuple)
 
-    @property
     def key(self) -> bytes:
+        """The subspace's raw prefix. A METHOD, matching the reference
+        python binding's Subspace.key() (porting apps call it)."""
         return self._prefix
 
     def pack(self, t: tuple = ()) -> bytes:

@@ -1,0 +1,168 @@
+"""The fdb-python compat shim: reference application idioms must run
+unchanged (reference: bindings/python/fdb/impl.py surface)."""
+
+import struct
+
+import pytest
+
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+@pytest.fixture()
+def fdb():
+    import foundationdb_tpu.compat.fdb as fdb
+
+    fdb.api_version(710)
+    return fdb
+
+
+@pytest.fixture()
+def db(fdb):
+    c = SimCluster(seed=42, n_storages=2)
+    return fdb.open(sim_cluster=c)
+
+
+def test_transactional_decorator_and_sugar(fdb, db):
+    @fdb.transactional
+    def add_user(tr, name, age):
+        tr[fdb.tuple.pack(("user", name))] = struct.pack("<I", age)
+
+    @fdb.transactional
+    def get_age(tr, name):
+        v = tr[fdb.tuple.pack(("user", name))]
+        return struct.unpack("<I", v)[0] if v is not None else None
+
+    add_user(db, "alice", 30)
+    add_user(db, "bob", 25)
+    assert get_age(db, "alice") == 30
+    assert get_age(db, "nobody") is None
+
+    # db-level sugar: one-shot transactions
+    db[b"plain"] = b"value"
+    assert db[b"plain"] == b"value"
+    del db[b"plain"]
+    assert db[b"plain"] is None
+
+
+def test_range_reads_and_subspace(fdb, db):
+    users = fdb.Subspace(("user",))
+
+    @fdb.transactional
+    def fill(tr):
+        for i in range(5):
+            tr[users.pack((i,))] = b"u%d" % i
+
+    @fdb.transactional
+    def scan(tr):
+        begin, end = users.range(())
+        return [(users.unpack(k)[0], v) for k, v in tr[begin:end]]
+
+    fill(db)
+    assert scan(db) == [(i, b"u%d" % i) for i in range(5)]
+
+    @fdb.transactional
+    def prefix_scan(tr):
+        return tr.get_range_startswith(users.key(), limit=3)
+
+    assert len(prefix_scan(db)) == 3
+
+
+def test_atomic_helpers_and_versionstamp(fdb, db):
+    @fdb.transactional
+    def bump(tr):
+        tr.add(b"ctr", struct.pack("<q", 5))
+        tr.max(b"hi", struct.pack("<q", 9))
+
+    bump(db)
+    bump(db)
+    assert struct.unpack("<q", db[b"ctr"])[0] == 10
+
+    tr = db.create_transaction()
+    tr.set_versionstamped_key(
+        b"log/" + b"\x00" * 10 + struct.pack("<I", 4), b"entry")
+    tr.commit()
+    stamped = db.get_range(b"log/", b"log0")
+    assert len(stamped) == 1 and stamped[0][1] == b"entry"
+    assert tr.get_versionstamp()  # 10 bytes, post-commit
+    assert tr.committed_version > 0
+
+
+def test_key_selectors(fdb, db):
+    for i in range(4):
+        db[b"sel%d" % i] = b"x"
+
+    tr = db.create_transaction()
+    k = tr.get_key(fdb.KeySelector.first_greater_or_equal(b"sel1"))
+    assert k == b"sel1"
+    k = tr.get_key(fdb.KeySelector.first_greater_than(b"sel1"))
+    assert k == b"sel2"
+    rows = tr.get_range(fdb.KeySelector.first_greater_or_equal(b"sel1"),
+                        fdb.KeySelector.first_greater_than(b"sel2"))
+    assert [r[0] for r in rows] == [b"sel1", b"sel2"]
+
+
+def test_directory_facade(fdb, db):
+    d = fdb.directory.create_or_open(db, ("app", "events"))
+    db[d.pack((1,))] = b"e1"
+    again = fdb.directory.open(db, ("app", "events"))
+    assert again.key() == d.key()
+    assert fdb.directory.exists(db, ("app", "events"))
+    assert fdb.directory.list(db, ("app",)) == ["events"]
+    fdb.directory.move(db, ("app", "events"), ("app", "archive"))
+    assert not fdb.directory.exists(db, ("app", "events"))
+    fdb.directory.remove(db, ("app",))
+    assert not fdb.directory.exists(db, ("app",))
+
+
+def test_transaction_options_and_retry(fdb, db):
+    attempts = []
+
+    @fdb.transactional
+    def with_options(tr):
+        tr.options.set_timeout(5000)
+        tr.options.set_size_limit(10_000)
+        attempts.append(1)
+        tr[b"opt"] = b"1"
+
+    with_options(db)
+    assert db[b"opt"] == b"1" and len(attempts) == 1
+
+
+def test_conflict_surface(fdb, db):
+    tr1 = db.create_transaction()
+    tr2 = db.create_transaction()
+    tr1.get(b"race")
+    tr2.get(b"race")
+    tr1[b"race"] = b"a"
+    tr2[b"race"] = b"b"
+    tr1.commit()
+    with pytest.raises(fdb.FdbError) as ei:
+        tr2.commit()
+    assert ei.value.code == 1020
+
+
+def test_db_get_range_accepts_selectors_and_watch_wait(fdb, db):
+    for i in range(3):
+        db[b"w%d" % i] = b"x"
+    rows = db.get_range(fdb.KeySelector.first_greater_or_equal(b"w1"), b"w3")
+    assert [r[0] for r in rows] == [b"w1", b"w2"]
+
+    tr = db.create_transaction()
+    f = tr.watch(b"w1")
+    tr.commit()
+    assert not f.is_ready()
+    db[b"w1"] = b"changed"
+    f.wait(timeout=60)
+
+    # unknown option setters are accepted and ignored, like db.options
+    tr2 = db.create_transaction()
+    tr2.options.set_snapshot_ryw_disable()
+    tr2.options.set_transaction_logging_max_field_length(100)
+
+
+def test_partition_key_forbidden(fdb, db):
+    from foundationdb_tpu.layers.directory import DirectoryError
+
+    part = fdb.directory.create_or_open(db, ("p",), layer=b"partition")
+    with pytest.raises(DirectoryError):
+        part.key()

@@ -169,7 +169,7 @@ class TestSubspace:
 
     def test_getitem_nesting(self):
         s = Subspace(("a",))["b"][3]
-        assert s.key == pack(("a", "b", 3))
+        assert s.key() == pack(("a", "b", 3))
 
     def test_strinc(self):
         assert strinc(b"a") == b"b"
@@ -202,7 +202,7 @@ class TestDirectoryLayer:
 
             async def check(tr):
                 d2 = await dl.open(tr, ("app", "users"))
-                assert d2.key == d.key
+                assert d2.key() == d.key()
                 assert await tr.get(d2.pack((42,))) == b"alice"
                 assert await dl.list(tr, ("app",)) == ["users"]
                 assert await dl.list(tr) == ["app"]
@@ -273,7 +273,7 @@ class TestDirectoryLayer:
 
             async def mv(tr):
                 moved = await dl.move(tr, ("a", "b"), ("c",))
-                assert moved.key == d.key  # prefix survives the move
+                assert moved.key() == d.key()  # prefix survives the move
 
             await db.run(mv)
 
@@ -296,7 +296,7 @@ class TestDirectoryLayer:
 
             async def mk(name):
                 async def body(tr):
-                    return (await dl.create_or_open(tr, name)).key
+                    return (await dl.create_or_open(tr, name)).key()
 
                 return await db.run(body)
 
@@ -336,17 +336,17 @@ class TestDirectoryPartitions:
             assert child.path == ("p", "users")
             # Child contents live under the partition prefix, metadata under
             # prefix + 0xfe.
-            assert child.key.startswith(part.key)
+            assert child.key().startswith(part._prefix)
 
             async def check(tr):
                 # Routing through the PARENT layer reaches into the partition.
                 again = await dl.open(tr, ("p", "users"))
-                assert again.key == child.key
+                assert again.key() == child.key()
                 assert await tr.get(again.pack((1,))) == b"alice"
                 assert await dl.list(tr, ("p",)) == ["users"]
                 assert await dl.exists(tr, ("p", "users"))
                 deep = await dl.create_or_open(tr, ("p", "a", "b"))
-                assert deep.key.startswith(part.key)
+                assert deep.key().startswith(part._prefix)
 
             await db.run(check)
             return "ok"
@@ -422,7 +422,7 @@ class TestDirectoryPartitions:
             async def gone(tr):
                 assert not await dl.exists(tr, ("p",))
                 # The partition's whole key range is cleared.
-                rows = await tr.get_range(part.key, part.key + b"\xff")
+                rows = await tr.get_range(part._prefix, part._prefix + b"\xff")
                 assert rows == []
                 return "ok"
 
