@@ -1,0 +1,137 @@
+"""fdbbackup analogue: snapshot / restore / describe a deployed cluster.
+
+Reference: the fdbbackup binary (fdbbackup/backup.actor.cpp) — the
+operator tool around FileBackupAgent. This tool speaks to any cluster the
+cli can reach (a spec JSON from scripts/start_cluster.sh) and uses the
+same BackupContainer file form as the sim's continuous backup:
+
+    python -m foundationdb_tpu.backup_tool snapshot \\
+        --cluster /tmp/fdb_tpu_cluster/cluster.json --out /tmp/b.fdbk
+    python -m foundationdb_tpu.backup_tool describe --in /tmp/b.fdbk
+    python -m foundationdb_tpu.backup_tool restore \\
+        --cluster ... --in /tmp/b.fdbk
+
+`snapshot` is a CONSISTENT cut: every chunk is read at one read version
+(reference: backup snapshots are consistent because the mutation log
+covers the scan window — with no continuous log, pinning one version is
+the equivalent guarantee). Chunked to stay under per-txn read budgets;
+TransactionTooOld from outliving the MVCC window fails the run cleanly.
+Continuous (mutation-log) backup is the sim BackupAgent's job
+(runtime/backup.py) — operator-driven file backup is what this tool adds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # tool never needs a TPU
+
+from foundationdb_tpu.runtime.backup import BackupContainer, RangeChunk, restore
+
+
+def _open(cluster_path: str):
+    from foundationdb_tpu.cli import open_cluster
+
+    return open_cluster(cluster_path)
+
+
+def cmd_snapshot(args) -> int:
+    loop, t, db = _open(args.cluster)
+    begin = args.begin.encode() if args.begin else b""
+    end = args.end.encode() if args.end else b"\xff"
+    container = BackupContainer()
+
+    async def run():
+        tr = db.transaction()
+        version = await tr.get_read_version()
+        cursor = begin
+        while cursor < end:
+            tr = db.transaction()
+            tr.set_read_version(version)  # one consistent cut
+            rows = await tr.get_range(cursor, end, limit=args.chunk)
+            nxt = (rows[-1][0] + b"\x00"
+                   if rows and len(rows) == args.chunk else end)
+            container.chunks.append(
+                RangeChunk(cursor, nxt, version, list(rows))
+            )
+            cursor = nxt
+        container.snapshot_complete = True
+        container.log_covered = version
+        return version
+
+    try:
+        version = loop.run(run(), timeout=args.timeout)
+    finally:
+        t.close()
+    container.save(args.out)
+    rows = sum(len(c.kvs) for c in container.chunks)
+    print(f"snapshot complete: version={version} chunks={len(container.chunks)} "
+          f"rows={rows} -> {args.out}")
+    return 0
+
+
+def cmd_describe(args) -> int:
+    c = BackupContainer.load(args.infile)
+    rows = sum(len(ch.kvs) for ch in c.chunks)
+    print(f"chunks={len(c.chunks)} rows={rows} "
+          f"log_entries={len(c.log)} log_covered={c.log_covered} "
+          f"snapshot_complete={c.snapshot_complete} "
+          f"restorable_version={c.restorable_version()}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    container = BackupContainer.load(args.infile)
+    if container.restorable_version() is None:
+        print("container is not restorable", file=sys.stderr)
+        return 1
+    loop, t, db = _open(args.cluster)
+    try:
+        loop.run(restore(db, container), timeout=args.timeout)
+    finally:
+        t.close()
+    print(f"restored to version {container.restorable_version()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.backup_tool",
+        description="Backup/restore a deployed cluster (fdbbackup analogue).",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("snapshot", help="consistent range snapshot to a file")
+    s.add_argument("--cluster", required=True)
+    s.add_argument("--out", required=True)
+    s.add_argument("--begin", default="")
+    s.add_argument("--end", default="")
+    def positive(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("chunk must be >= 1")
+        return n
+
+    s.add_argument("--chunk", type=positive, default=1000,
+                   help="rows per chunk transaction")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.set_defaults(fn=cmd_snapshot)
+
+    s = sub.add_parser("describe", help="print a backup file's contents")
+    s.add_argument("--in", dest="infile", required=True)
+    s.set_defaults(fn=cmd_describe)
+
+    s = sub.add_parser("restore", help="restore a backup file into a cluster")
+    s.add_argument("--cluster", required=True)
+    s.add_argument("--in", dest="infile", required=True)
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.set_defaults(fn=cmd_restore)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,8 +32,50 @@ from foundationdb_tpu.client.transaction import KeySelector  # noqa: F401 (re-ex
 from foundationdb_tpu.core.errors import FdbError  # noqa: F401 (re-export)
 from foundationdb_tpu.core.mutations import MutationType
 from foundationdb_tpu.layers import directory as _directory_impl
-from foundationdb_tpu.layers import tuple_layer as tuple  # noqa: A001 (fdb.tuple)
+from foundationdb_tpu.layers import tuple_layer as _tuple_layer
 from foundationdb_tpu.layers.tuple_layer import Subspace  # noqa: F401 (re-export)
+
+
+class _TupleNamespace:
+    """fdb.tuple: the layer module plus the binding's range() (a SLICE,
+    so ``tr[fdb.tuple.range(t)]`` scans the tuple's children)."""
+
+    def __getattr__(self, name):
+        return getattr(_tuple_layer, name)
+
+    @staticmethod
+    def range(t: "tuple" = ()) -> slice:
+        begin, end = _tuple_layer.range_of(t)
+        return slice(begin, end)
+
+
+tuple = _TupleNamespace()  # noqa: A001 (fdb.tuple)
+
+
+class StreamingMode:
+    """Reference streaming modes — accepted for signature parity; this
+    client always materializes the full (or limit-capped) result."""
+
+    want_all = -2
+    iterator = -1
+    exact = 0
+    small = 1
+    medium = 2
+    large = 3
+    serial = 4
+
+
+class _NetworkOptions:
+    """fdb.options — network-level option setters, accept-and-ignore
+    (the runtime has no TLS/trace knobs a ported app must set)."""
+
+    def __getattr__(self, name):
+        if name.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+options = _NetworkOptions()
 
 _api_version: int | None = None
 
@@ -119,7 +161,8 @@ class Database:
     def get(self, key: bytes):
         return self._oneshot(lambda tr: tr.get(key))
 
-    def get_range(self, begin, end, limit: int = 0, reverse: bool = False):
+    def get_range(self, begin, end, limit: int = 0, reverse: bool = False,
+                  streaming_mode=None):
         async def body(tr):
             b = (await tr.get_key(begin)) if isinstance(begin, KeySelector) \
                 else begin
@@ -187,6 +230,7 @@ class Transaction:
         self._dbf = db
         self._tr = tr
         self.options = _TransactionOptions(tr)
+        self.snapshot = _SnapshotView(self)
 
     # -- reads ---------------------------------------------------------------
 
@@ -315,6 +359,41 @@ class Transaction:
             self.clear_range(key.start or b"", key.stop or b"\xff")
         else:
             self.clear(key)
+
+
+class _SnapshotView:
+    """tr.snapshot — reads without read-conflict ranges (reference:
+    Transaction.snapshot)."""
+
+    def __init__(self, txn: "Transaction"):
+        self._txn = txn
+
+    def get(self, key: bytes):
+        return self._txn._dbf._block(self._txn._tr.get(key, snapshot=True))
+
+    def get_range(self, begin, end, limit: int = 0, reverse: bool = False,
+                  streaming_mode=None):
+        t = self._txn
+        if isinstance(begin, KeySelector):
+            begin = t._dbf._block(t._tr.get_key(begin, snapshot=True))
+        if isinstance(end, KeySelector):
+            end = t._dbf._block(t._tr.get_key(end, snapshot=True))
+        return t._dbf._block(
+            t._tr.get_range(begin, end, limit=limit, reverse=reverse,
+                            snapshot=True)
+        )
+
+    def get_range_startswith(self, prefix: bytes, **kw):
+        return self.get_range(prefix, _strinc(prefix), **kw)
+
+    def get_key(self, sel: KeySelector):
+        return self._txn._dbf._block(
+            self._txn._tr.get_key(sel, snapshot=True))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.get_range(key.start or b"", key.stop or b"\xff")
+        return self.get(key)
 
 
 class _TransactionOptions:

@@ -450,7 +450,8 @@ class DirectoryLayer:
             # Listing a partition lists the partition's own root.
             inner = _inner_layer(self._prefix_of(node), self._path + path)
             return await inner.list(tr, ())
-        begin, end = node.range((_SUBDIRS,))
+        sub_r = node.range((_SUBDIRS,))
+        begin, end = sub_r.start, sub_r.stop
         sub = node.subspace((_SUBDIRS,))
         return [sub.unpack(k)[0] for k, _ in await tr.get_range(begin, end)]
 
@@ -512,7 +513,8 @@ class DirectoryLayer:
         return True
 
     async def _remove_recursive(self, tr, node: Subspace) -> None:
-        begin, end = node.range((_SUBDIRS,))
+        sub_r = node.range((_SUBDIRS,))
+        begin, end = sub_r.start, sub_r.stop
         for _, child_prefix in await tr.get_range(begin, end):
             await self._remove_recursive(tr, self._node_with_prefix(child_prefix))
         prefix = self._prefix_of(node)

@@ -335,9 +335,11 @@ class Subspace:
     def contains(self, key: bytes) -> bool:
         return key.startswith(self._prefix)
 
-    def range(self, t: tuple = ()) -> tuple[bytes, bytes]:
+    def range(self, t: tuple = ()) -> slice:
+        """slice(begin, end) covering all tuples under this prefix — a
+        SLICE, like the reference binding, so ``tr[sub.range()]`` works."""
         p = self._prefix + pack(t)
-        return p + b"\x00", p + b"\xff"
+        return slice(p + b"\x00", p + b"\xff")
 
     def subspace(self, t: tuple) -> "Subspace":
         return Subspace(raw_prefix=self.pack(t))

@@ -54,8 +54,7 @@ def test_range_reads_and_subspace(fdb, db):
 
     @fdb.transactional
     def scan(tr):
-        begin, end = users.range(())
-        return [(users.unpack(k)[0], v) for k, v in tr[begin:end]]
+        return [(users.unpack(k)[0], v) for k, v in tr[users.range(())]]
 
     fill(db)
     assert scan(db) == [(i, b"u%d" % i) for i in range(5)]
@@ -166,3 +165,24 @@ def test_partition_key_forbidden(fdb, db):
     part = fdb.directory.create_or_open(db, ("p",), layer=b"partition")
     with pytest.raises(DirectoryError):
         part.key()
+
+
+def test_snapshot_view_and_streaming_mode(fdb, db):
+    for i in range(3):
+        db[b"sv%d" % i] = b"x"
+    tr = db.create_transaction()
+    assert tr.snapshot[b"sv1"] == b"x"
+    rows = tr.snapshot.get_range(b"sv0", b"sv3",
+                                 streaming_mode=fdb.StreamingMode.want_all)
+    assert len(rows) == 3
+    # snapshot reads add no read-conflict ranges: commit after a racing
+    # write still succeeds.
+    other = db.create_transaction()
+    other[b"sv1"] = b"y"
+    other.commit()
+    tr[b"unrelated"] = b"1"
+    tr.commit()
+    # tuple.range slice sugar + network options accept-and-ignore
+    db[fdb.tuple.pack(("tt", 1))] = b"a"
+    assert len(db.create_transaction()[fdb.tuple.range(("tt",))]) == 1
+    fdb.options.set_trace_enable("/tmp")

@@ -314,7 +314,7 @@ class TestLayersOnEmbedded:
         tr.set(s.pack(("user", 43)), pack(("bob", False)))
         tr.commit()
         tr = db.transaction()
-        b, e = s.range(("user",))
+        r = s.range(("user",)); b, e = r.start, r.stop
         rows = tr.get_range(b, e)
         assert len(rows) == 2
         assert s.unpack(rows[0][0]) == ("user", 42)
