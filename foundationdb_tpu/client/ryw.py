@@ -165,16 +165,22 @@ class RYWTransaction(Transaction):
         # Resolve against the merged view: scan a window around the anchor.
         # (The reference resolves selectors inside the RYW view the same way;
         # we reuse the merged get_range since our selector offsets are small.)
-        if sel.offset >= 1:
-            begin = sel.key + b"\x00" if sel.or_equal else sel.key
-            from foundationdb_tpu.runtime.shardmap import MAX_KEY
+        from foundationdb_tpu.runtime.shardmap import MAX_KEY
 
+        # User-keyspace confinement in BOTH directions without system
+        # access (see Transaction.get_key): system keys are neither
+        # returned nor read.
+        space_end = MAX_KEY if self.access_system_keys else b"\xff"
+        if sel.offset >= 1:
+            begin = min(sel.key + b"\x00" if sel.or_equal else sel.key,
+                        space_end)
             rows = await self.get_range(
-                begin, MAX_KEY, limit=sel.offset, snapshot=snapshot
+                begin, space_end, limit=sel.offset, snapshot=snapshot
             )
-            return rows[sel.offset - 1][0] if len(rows) >= sel.offset else MAX_KEY
+            return (rows[sel.offset - 1][0]
+                    if len(rows) >= sel.offset else MAX_KEY)
         back = 1 - sel.offset
-        end = sel.key + b"\x00" if sel.or_equal else sel.key
+        end = min(sel.key + b"\x00" if sel.or_equal else sel.key, space_end)
         rows = await self.get_range(b"", end, limit=back, reverse=True, snapshot=snapshot)
         return rows[back - 1][0] if len(rows) >= back else b""
 

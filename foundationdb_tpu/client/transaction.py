@@ -109,6 +109,11 @@ class Database:
         self.epoch = 1
         self._rr = 0
         self.transaction_class = Transaction  # ryw.open_database swaps in RYW
+        # Failure monitoring (reference: the client's FailureMonitor):
+        # storage endpoints that just failed are tried LAST for a TTL, so
+        # one dead replica costs one detection delay total — not one per
+        # read against its team.
+        self._ep_failed_at: dict[int, float] = {}
 
     async def refresh_client_info(self) -> None:
         """Re-fetch proxy endpoints from the cluster controller — how clients
@@ -148,6 +153,17 @@ class Database:
             self.storage_map = self.cluster.storage_map.clone()
 
     MAX_SHARD_RETRIES = 5
+    FAILED_EP_TTL = 4.0  # how long a failed replica is deprioritized
+
+    def _order_team(self, team):
+        """Team members with recently-failed replicas demoted to the end
+        (reference: FailureMonitor-aware load balancing)."""
+        now = self.loop.now
+
+        def bad(tag):
+            return now - self._ep_failed_at.get(tag, -1e9) < self.FAILED_EP_TTL
+
+        return sorted(team, key=bad)
 
     async def read_key(self, key: bytes, version: int):
         """Point read with replica failover + shard-map refresh: try every
@@ -156,10 +172,11 @@ class Database:
         for _ in range(self.MAX_SHARD_RETRIES):
             team = self.storage_map.team_for_key(key)
             wrong_shard = False
-            for tag in team:
+            for tag in self._order_team(team):
                 try:
                     return await self.storage_eps[tag].get(key, version)
                 except BrokenPromise:
+                    self._ep_failed_at[tag] = self.loop.now
                     continue  # dead/partitioned replica: try the next
                 except WrongShardServer:
                     wrong_shard = True
@@ -205,12 +222,13 @@ class Database:
         self, r: KeyRange, team, version: int, limit: int, reverse: bool
     ) -> list[tuple[bytes, bytes]]:
         last_wrong: Exception | None = None
-        for tag in team:
+        for tag in self._order_team(team):
             try:
                 return await self.storage_eps[tag].get_range(
                     r.begin, r.end, version, limit=limit, reverse=reverse
                 )
             except BrokenPromise:
+                self._ep_failed_at[tag] = self.loop.now
                 continue
             except WrongShardServer as e:
                 last_wrong = e
@@ -428,23 +446,33 @@ class Transaction:
 
     async def get_key(self, sel: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (reference: Transaction::getKey). Returns
-        b"" when the selector runs off the front, MAX_KEY off the back."""
+        b"" when the selector runs off the front, MAX_KEY off the back.
+
+        Without access_system_keys, resolution is confined to the user
+        keyspace [b"", b"\\xff"): BOTH scan directions stop at b"\\xff", so
+        system keys (e.g. the TimeKeeper's \\xff\\x02/ samples) can neither
+        be returned nor be included in the recorded read-conflict range —
+        otherwise every 10s system commit would spuriously conflict-abort
+        transactions whose selectors ran off the end of user data
+        (reference: getKey clamps non-system transactions to maxKey)."""
         version = await self.get_read_version()
         anchor = sel.key
+        space_end = MAX_KEY if self.access_system_keys else b"\xff"
         # Position 0 is "last key ≤/< anchor"; walk |offset| from there.
         if sel.offset >= 1:
             # forward: the offset-th key in order from (anchor, or_equal ? > : ≥)
-            begin = anchor + b"\x00" if sel.or_equal else anchor
-            rows = await self._scan_keys(begin, MAX_KEY, sel.offset, False, version)
+            begin = min(anchor + b"\x00" if sel.or_equal else anchor, space_end)
+            rows = await self._scan_keys(begin, space_end, sel.offset, False, version)
             result = rows[sel.offset - 1] if len(rows) >= sel.offset else MAX_KEY
         else:
             back = 1 - sel.offset  # how many keys back from the anchor
-            end = anchor + b"\x00" if sel.or_equal else anchor
+            end = min(anchor + b"\x00" if sel.or_equal else anchor, space_end)
             rows = await self._scan_keys(b"", end, back, True, version)
             result = rows[back - 1] if len(rows) >= back else b""
         if not snapshot:
-            # Result depends on the span between anchor and resolved key.
-            lo, hi = sorted((anchor, result))
+            # Result depends on the span between anchor and resolved key,
+            # clipped to the space actually scanned.
+            lo, hi = sorted((min(anchor, space_end), min(result, space_end)))
             self.read_ranges.append(KeyRange(lo, hi + b"\x00"))
         return result
 

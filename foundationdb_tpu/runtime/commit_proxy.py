@@ -51,6 +51,16 @@ class CommitResult:
 class CommitProxy:
     BATCH_INTERVAL = 0.002
     MAX_BATCH = 512
+    # Idle cadence: with no client commits, proxies still push EMPTY
+    # batches through sequencer→resolver→tlogs (reference: proxies commit
+    # empty batches at COMMIT_TRANSACTION_BATCH interval). This is what
+    # keeps versions flowing when the cluster is quiet: tlog/storage
+    # versions (and so MVCC GC floors and GRV freshness) advance smoothly
+    # instead of jumping a whole window at the next sparse commit — a
+    # 10s-interval committer (TimeKeeper) against a ~10s MVCC window
+    # otherwise expires every fresh read version the moment the next
+    # batch lands.
+    IDLE_BATCH_INTERVAL = 0.25
 
     def __init__(
         self,
@@ -109,16 +119,22 @@ class CommitProxy:
     # -- batch engine ---------------------------------------------------------
 
     async def run(self) -> None:
+        last_batch = self.loop.now
         while True:
             await self.loop.sleep(self.BATCH_INTERVAL)
             if not self._queue:
-                continue
-            # BUGGIFY: degenerate one-txn batches exercise the version
-            # chain/reply paths at maximum batch rate (reference: BUGGIFY'd
-            # COMMIT_TRANSACTION_BATCH_COUNT_MAX).
-            max_batch = 1 if self.loop.buggify("commit_proxy.tiny_batch") \
-                else self.MAX_BATCH
-            batch, self._queue = self._queue[:max_batch], self._queue[max_batch:]
+                if self.loop.now - last_batch < self.IDLE_BATCH_INTERVAL:
+                    continue
+                batch = []  # idle: empty batch keeps the version chain hot
+            else:
+                # BUGGIFY: degenerate one-txn batches exercise the version
+                # chain/reply paths at maximum batch rate (reference:
+                # BUGGIFY'd COMMIT_TRANSACTION_BATCH_COUNT_MAX).
+                max_batch = 1 if self.loop.buggify("commit_proxy.tiny_batch") \
+                    else self.MAX_BATCH
+                batch, self._queue = \
+                    self._queue[:max_batch], self._queue[max_batch:]
+            last_batch = self.loop.now
             # One version per batch; fetched in the batcher (not the spawned
             # worker) so batches acquire chain positions in queue order.
             try:

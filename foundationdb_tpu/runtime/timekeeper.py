@@ -1,0 +1,92 @@
+"""TimeKeeper: the version ↔ wall-clock map.
+
+Reference: the TimeKeeper actor inside ClusterController.actor.cpp —
+every ~10s it writes (clock seconds → committed version) into the system
+keyspace at ``\\xff\\x02/timeKeeper/map/``, bounded to a rolling window.
+Tooling uses it to turn "restore to 3:14pm" into a version. Same design
+here: an actor commits samples through the normal transaction path (so
+the map is as durable and replicated as any other data), plus client
+helpers to query it.
+
+Sim note: "wall clock" is the loop's time — virtual in simulation (so
+tests are deterministic), monotonic seconds on a RealLoop.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from foundationdb_tpu.runtime.trace import trace
+
+PREFIX = b"\xff\x02/timeKeeper/map/"
+PREFIX_END = PREFIX + b"\xff"
+DEFAULT_INTERVAL = 10.0  # reference: CLIENT_KNOBS->TIME_KEEPER_DELAY
+MAX_ENTRIES = 8640  # reference keeps ~a day at 10s samples
+
+
+def _key(seconds: float) -> bytes:
+    # Big-endian fixed width so byte order == numeric order.
+    return PREFIX + struct.pack(">Q", int(seconds))
+
+
+class TimeKeeper:
+    """Actor: periodically record (now → committed version)."""
+
+    def __init__(self, loop, db, interval: float = DEFAULT_INTERVAL):
+        self.loop = loop
+        self.db = db
+        self.interval = interval
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    async def run(self) -> None:
+        while not self._stopped:
+            try:
+                await self._tick()
+            except Exception as e:  # noqa: BLE001 — keep ticking across recoveries
+                trace(self.loop).event("TimeKeeperTickFailed",
+                                       Error=type(e).__name__)
+            await self.loop.sleep(self.interval)
+
+    async def _tick(self) -> None:
+        async def body(tr):
+            # Clock read INSIDE the attempt: a retry that crossed a long
+            # recovery must stamp the commit's actual time, or a stale
+            # timestamp pairs with a much newer version and
+            # version_for_time over-includes writes.
+            now = self.loop.now
+            tr.set_option("access_system_keys")
+            version = await tr.get_read_version()
+            tr.set(_key(now), struct.pack("<q", version))
+            # Trim the rolling window.
+            cutoff = now - MAX_ENTRIES * self.interval
+            if cutoff > 0:
+                tr.clear_range(PREFIX, _key(cutoff))
+            return version
+
+        await self.db.run(body)
+
+
+async def version_for_time(tr, seconds: float) -> int | None:
+    """Largest recorded version at-or-before `seconds` (None if the map
+    has no sample that old). Reference: versionFromTimeKeeper logic used
+    by fdbbackup's --timestamp restores."""
+    if seconds < 0:
+        return None
+    rows = await tr.get_range(PREFIX, _key(seconds) + b"\x00",
+                              limit=1, reverse=True)
+    if not rows:
+        return None
+    return struct.unpack("<q", rows[0][1])[0]
+
+
+async def time_for_version(tr, version: int) -> float | None:
+    """Earliest recorded sample whose version is >= `version` (None if
+    the map ends before it) — the inverse lookup."""
+    rows = await tr.get_range(PREFIX, PREFIX_END)
+    for k, v in rows:
+        if struct.unpack("<q", v)[0] >= version:
+            return float(struct.unpack(">Q", k[len(PREFIX):])[0])
+    return None

@@ -217,6 +217,22 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                         proxy_eps=eps("proxy", "commit_proxy"))
         t.serve("ratekeeper", rk)
         _supervise(loop, "ratekeeper.run", rk.run)
+        # TimeKeeper rides in the ratekeeper process (the deployed wiring
+        # has no cluster controller; reference hosts it in the CC).
+        from foundationdb_tpu.client.ryw import RYWTransaction
+        from foundationdb_tpu.client.transaction import Database
+        from foundationdb_tpu.runtime.timekeeper import TimeKeeper
+
+        tk_db = Database(
+            loop,
+            eps("proxy", "grv_proxy"),
+            eps("proxy", "commit_proxy"),
+            KeyShardMap.uniform(len(spec.get("storage") or [])),
+            eps("storage"),
+        )
+        tk_db.transaction_class = RYWTransaction
+        tk = TimeKeeper(loop, tk_db)
+        _supervise(loop, "timekeeper.run", tk.run)
     else:
         raise ValueError(f"unknown role {role!r}")
 

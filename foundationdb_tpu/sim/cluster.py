@@ -70,6 +70,7 @@ class SimCluster:
         n_coordinators: int = 0,
         n_cc_candidates: int = 3,
         data_dir: str | None = None,
+        timekeeper: bool = True,
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
@@ -169,6 +170,20 @@ class SimCluster:
                 self.data_distributor.run(),
                 process="data_distributor",
                 name="dd.run",
+            )
+
+        # TimeKeeper (reference: the actor inside ClusterController):
+        # version ↔ clock samples through the normal commit path. Spawned
+        # once — it survives recoveries via the client retry loop.
+        self.timekeeper = None
+        if timekeeper:
+            from foundationdb_tpu.client.ryw import open_database
+            from foundationdb_tpu.runtime.timekeeper import TimeKeeper
+
+            self.timekeeper = TimeKeeper(self.loop, open_database(self))
+            self.loop.spawn(
+                self.timekeeper.run(), process="timekeeper",
+                name="timekeeper.run",
             )
 
     # -- durable restart (reference: tlog DiskQueue + sqlite engine) ----------

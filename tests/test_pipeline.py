@@ -266,7 +266,9 @@ class TestCommitPipeline:
         assert c.loop.run(main(), timeout=120) == "ok"
 
     def test_throughput_many_txns(self):
-        c = SimCluster(seed=10, n_resolvers=2, n_storages=2)
+        # timekeeper off: the assertion counts EXACT committed txns.
+        c = SimCluster(seed=10, n_resolvers=2, n_storages=2,
+                       timekeeper=False)
         proxy, grv = c.commit_proxy_eps[0], c.grv_proxy_eps[0]
         N = 300
 

@@ -1,0 +1,112 @@
+"""TimeKeeper: version↔clock samples in the system keyspace (reference:
+the TimeKeeper actor in ClusterController.actor.cpp)."""
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.runtime.timekeeper import (
+    PREFIX,
+    PREFIX_END,
+    time_for_version,
+    version_for_time,
+)
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def test_samples_accumulate_and_lookups_work():
+    c = SimCluster(seed=21, n_storages=2)
+    db = open_database(c)
+
+    async def main():
+        await c.loop.sleep(35)  # > 3 sample intervals
+        tr = db.transaction()
+        rows = await tr.get_range(PREFIX, PREFIX_END)
+        assert len(rows) >= 3
+        # Lookup at "now" resolves to the newest sample's version.
+        v_now = await version_for_time(db.transaction(), c.loop.now)
+        assert v_now is not None
+        # A mid-run commit's version maps to a time within the run.
+        t2 = db.transaction()
+        t2.set(b"x", b"y")
+        await t2.commit()
+        cv = t2.committed_version
+        await c.loop.sleep(15)  # let a sample cover cv
+        ts = await time_for_version(db.transaction(), cv)
+        assert ts is not None and 0 < ts <= c.loop.now
+        # Monotone: version at an early time <= version now.
+        v_early = await version_for_time(db.transaction(), 12.0)
+        assert v_early is not None and v_early <= await version_for_time(
+            db.transaction(), c.loop.now
+        )
+        # Before any sample (negative time precedes the t=0 boot tick).
+        assert await version_for_time(db.transaction(), -1.0) is None
+        return "ok"
+
+    assert c.loop.run(main(), timeout=600) == "ok"
+
+
+def test_survives_recovery():
+    c = SimCluster(seed=22, n_tlogs=2, n_storages=2)
+    db = open_database(c)
+
+    async def main():
+        await c.loop.sleep(21)
+        c.net.kill("tlog0")
+        while c.controller.generation.epoch < 2:
+            await c.loop.sleep(0.25)
+
+        async def count(tr):
+            return len(await tr.get_range(PREFIX, PREFIX_END))
+
+        before = await db.run(count)
+        await c.loop.sleep(25)
+        after = await db.run(count)
+        assert after > before  # keeper kept sampling across the recovery
+        return "ok"
+
+    assert c.loop.run(main(), timeout=600) == "ok"
+
+
+def test_opt_out():
+    c = SimCluster(seed=23, timekeeper=False)
+    db = open_database(c)
+
+    async def main():
+        await c.loop.sleep(30)
+        return await db.transaction().get_range(PREFIX, PREFIX_END)
+
+    assert c.loop.run(main(), timeout=600) == []
+
+
+def test_selectors_confined_to_user_keyspace():
+    """System keys (TimeKeeper samples) must neither resolve from user
+    selectors nor enter their read-conflict ranges — a selector running
+    off the end of user data must not conflict with system commits."""
+    from foundationdb_tpu.client.transaction import KeySelector
+    from foundationdb_tpu.runtime.shardmap import MAX_KEY
+
+    c = SimCluster(seed=24, n_storages=2)
+    db = open_database(c)
+
+    async def main():
+        await c.loop.sleep(12)  # at least one TimeKeeper sample exists
+        tr = db.transaction()
+        tr.set(b"zz", b"1")
+        await tr.commit()
+        # Forward off the end: MAX_KEY, not a \xff\x02/ sample.
+        tr = db.transaction()
+        got = await tr.get_key(KeySelector.first_greater_than(b"zz"))
+        assert got == MAX_KEY, got
+        # Backward from beyond the user space: the last USER key.
+        got = await tr.get_key(KeySelector.last_less_than(b"\xff\xff"))
+        assert got == b"zz", got
+        # The conflict range from those selectors must not cover system
+        # keys: a system-keyspace commit between this txn's read version
+        # and its commit must NOT conflict it.
+        sys_tr = db.transaction()
+        sys_tr.set_option("access_system_keys")
+        sys_tr.set(b"\xff\x02/poke", b"1")
+        await sys_tr.commit()
+        tr.set(b"other", b"x")
+        await tr.commit()  # would raise NotCommitted if clamped wrong
+        return "ok"
+
+    assert c.loop.run(main(), timeout=600) == "ok"
