@@ -170,7 +170,7 @@ class RYWTransaction(Transaction):
         # User-keyspace confinement in BOTH directions without system
         # access (see Transaction.get_key): system keys are neither
         # returned nor read.
-        space_end = MAX_KEY if self.access_system_keys else b"\xff"
+        space_end = self._keyspace_end()
         if sel.offset >= 1:
             begin = min(sel.key + b"\x00" if sel.or_equal else sel.key,
                         space_end)

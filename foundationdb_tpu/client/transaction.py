@@ -444,6 +444,11 @@ class Transaction:
                 self.read_ranges.append(conflict)
         return rows
 
+    def _keyspace_end(self) -> bytes:
+        """Exclusive end of the keyspace this transaction may resolve
+        selectors in: the user keyspace unless access_system_keys."""
+        return MAX_KEY if self.access_system_keys else b"\xff"
+
     async def get_key(self, sel: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (reference: Transaction::getKey). Returns
         b"" when the selector runs off the front, MAX_KEY off the back.
@@ -457,7 +462,7 @@ class Transaction:
         (reference: getKey clamps non-system transactions to maxKey)."""
         version = await self.get_read_version()
         anchor = sel.key
-        space_end = MAX_KEY if self.access_system_keys else b"\xff"
+        space_end = self._keyspace_end()
         # Position 0 is "last key ≤/< anchor"; walk |offset| from there.
         if sel.offset >= 1:
             # forward: the offset-th key in order from (anchor, or_equal ? > : ≥)

@@ -49,6 +49,12 @@ class DataDistributor:
         self.move_failures = 0
         self.repairs = 0
         self._moving = False
+        # Maintenance exclusion (reference: fdbcli exclude / the excluded
+        # servers list in \xff/conf): excluded storages receive no new
+        # shards and their current shards are drained onto other teams;
+        # they remain valid COPY SOURCES while draining (they are alive —
+        # that is the point of graceful exclusion vs. a kill).
+        self.excluded: set[int] = set()
 
     @rpc
     async def get_metrics(self) -> dict:
@@ -59,7 +65,29 @@ class DataDistributor:
             "move_failures": self.move_failures,
             "repairs": self.repairs,
             "shards": self.cluster.storage_map.n_shards,
+            "excluded": sorted(self.excluded),
         }
+
+    # -- maintenance (reference: fdbcli exclude/include) ----------------------
+
+    @rpc
+    async def exclude(self, tag: int) -> None:
+        self.excluded.add(tag)
+
+    @rpc
+    async def include(self, tag: int) -> None:
+        self.excluded.discard(tag)
+
+    @rpc
+    async def is_drained(self, tag: int) -> bool:
+        """True when no shard's team contains `tag` — the safe-to-remove
+        signal the reference's `exclude` blocks on."""
+        return all(
+            tag not in sh.team for sh in self.cluster.storage_map.shards
+        )
+
+    def _placeable(self, tags) -> list[int]:
+        return [t for t in tags if t not in self.excluded]
 
     async def run(self) -> None:
         while True:
@@ -141,17 +169,26 @@ class DataDistributor:
         live = set(self._live_tags())
         m = self.cluster.storage_map
         for shard in list(m.shards):
-            dead = [t for t in shard.team if t not in live]
-            if not dead:
+            # Members needing replacement: dead, or excluded (draining).
+            unwanted = [
+                t for t in shard.team
+                if t not in live or t in self.excluded
+            ]
+            if not unwanted:
                 continue
-            survivors = [t for t in shard.team if t in live]
-            if not survivors:
+            keep = [t for t in shard.team
+                    if t in live and t not in self.excluded]
+            if not any(t in live for t in shard.team):
                 continue  # all replicas lost: nothing to copy from
             want = max(len(shard.team), self.replication)
-            spares = sorted(live - set(shard.team))
-            dst = tuple((survivors + spares)[:want])
-            if len(dst) <= len(survivors):
-                continue  # no spare capacity: stay degraded, retry later
+            spares = self._placeable(sorted(live - set(shard.team)))
+            dst = tuple((keep + spares)[:want])
+            # A repair must ADD at least one member beyond the keepers:
+            # with no spare capacity the shard stays degraded (dropping
+            # the dead/excluded member alone would be churn that cannot
+            # restore replication), retried next pass.
+            if len(dst) <= len(keep):
+                continue
             await self.move_shard(shard.range.begin, shard.range.end, dst)
             self.repairs += 1
             return  # one repair per pass: the move mutates the shard map,
@@ -160,7 +197,7 @@ class DataDistributor:
     async def _maybe_rebalance(self, per_shard: list[tuple]) -> None:
         if self._moving:
             return  # one move at a time (reference: bounded in-flight moves)
-        live = self._live_tags()
+        live = self._placeable(self._live_tags())  # never rebalance ONTO excluded
         if len(live) < 2:
             return
         load: dict[int, int] = {t: 0 for t in live}

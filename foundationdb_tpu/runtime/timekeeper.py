@@ -8,13 +8,16 @@ here: an actor commits samples through the normal transaction path (so
 the map is as durable and replicated as any other data), plus client
 helpers to query it.
 
-Sim note: "wall clock" is the loop's time — virtual in simulation (so
-tests are deterministic), monotonic seconds on a RealLoop.
+Clock choice: in simulation, samples key off the loop's VIRTUAL time
+(deterministic). On a RealLoop (whose `now` is process-local monotonic
+seconds — it restarts near zero each boot), samples key off EPOCH time
+instead, so a durable cluster's map stays ordered across host reboots.
 """
 
 from __future__ import annotations
 
 import struct
+import time
 
 from foundationdb_tpu.runtime.trace import trace
 
@@ -50,13 +53,20 @@ class TimeKeeper:
                                        Error=type(e).__name__)
             await self.loop.sleep(self.interval)
 
+    def _clock(self) -> float:
+        # Epoch on real deployments (monotonic restarts each boot and
+        # would sort new samples below a durable map's old ones); virtual
+        # loop time in the sim.
+        return time.time() if getattr(self.loop, "WALL_TIME", False) \
+            else self.loop.now
+
     async def _tick(self) -> None:
         async def body(tr):
             # Clock read INSIDE the attempt: a retry that crossed a long
             # recovery must stamp the commit's actual time, or a stale
             # timestamp pairs with a much newer version and
             # version_for_time over-includes writes.
-            now = self.loop.now
+            now = self._clock()
             tr.set_option("access_system_keys")
             version = await tr.get_read_version()
             tr.set(_key(now), struct.pack("<q", version))
@@ -75,8 +85,10 @@ async def version_for_time(tr, seconds: float) -> int | None:
     by fdbbackup's --timestamp restores."""
     if seconds < 0:
         return None
+    # snapshot=True: lookups need no conflict protection, and a recorded
+    # conflict range here would be invalidated by every 10s tick.
     rows = await tr.get_range(PREFIX, _key(seconds) + b"\x00",
-                              limit=1, reverse=True)
+                              limit=1, reverse=True, snapshot=True)
     if not rows:
         return None
     return struct.unpack("<q", rows[0][1])[0]
@@ -85,7 +97,7 @@ async def version_for_time(tr, seconds: float) -> int | None:
 async def time_for_version(tr, version: int) -> float | None:
     """Earliest recorded sample whose version is >= `version` (None if
     the map ends before it) — the inverse lookup."""
-    rows = await tr.get_range(PREFIX, PREFIX_END)
+    rows = await tr.get_range(PREFIX, PREFIX_END, snapshot=True)
     for k, v in rows:
         if struct.unpack("<q", v)[0] >= version:
             return float(struct.unpack(">Q", k[len(PREFIX):])[0])

@@ -217,8 +217,12 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                         proxy_eps=eps("proxy", "commit_proxy"))
         t.serve("ratekeeper", rk)
         _supervise(loop, "ratekeeper.run", rk.run)
-        # TimeKeeper rides in the ratekeeper process (the deployed wiring
-        # has no cluster controller; reference hosts it in the CC).
+        # TimeKeeper rides in the FIRST ratekeeper process only (the
+        # deployed wiring has no cluster controller; the reference hosts
+        # exactly one, in the CC — duplicates would double idle commits
+        # and overwrite each other's same-second samples).
+        if index != 0:
+            return
         from foundationdb_tpu.client.ryw import RYWTransaction
         from foundationdb_tpu.client.transaction import Database
         from foundationdb_tpu.runtime.timekeeper import TimeKeeper

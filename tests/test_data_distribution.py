@@ -458,3 +458,67 @@ class TestDensityResolverSplits:
             return "ok"
 
         assert run(c, main()) == "ok"
+
+
+class TestExcludeInclude:
+    def test_exclude_drains_and_include_readmits(self):
+        """fdbcli exclude analogue: excluding a storage drains all its
+        shards onto other teams (it stays a valid copy SOURCE while
+        draining); include makes it placeable again."""
+        c, db = make_db(seed=120, n_storages=3, n_replicas=2, n_tlogs=2)
+        dd = c.data_distributor
+        dd.SPLIT_BYTES = 1 << 30
+
+        async def main():
+            tr = db.transaction()
+            for i in range(20):
+                tr.set(b"\x06ex%04d" % i, b"e" * 40)
+            await tr.commit()
+            victim = c.storage_map.tag_for_key(b"\x06ex0000")
+            await dd.exclude(victim)
+            for _ in range(400):
+                if await dd.is_drained(victim):
+                    break
+                await c.loop.sleep(0.2)
+            assert await dd.is_drained(victim), c.storage_map.shards
+            assert (await dd.get_metrics())["excluded"] == [victim]
+
+            # Data survives the drain, via the retry loop.
+            async def check(tr):
+                for i in range(20):
+                    assert await tr.get(b"\x06ex%04d" % i) == b"e" * 40
+
+            await db.run(check)
+
+            # Re-admit: a later repair may place shards on it again.
+            await dd.include(victim)
+            assert (await dd.get_metrics())["excluded"] == []
+            return "ok"
+
+        assert run(c, main()) == "ok"
+
+    def test_excluded_not_used_for_repair_placement(self):
+        """A dead replica is repaired onto a NON-excluded spare."""
+        c, db = make_db(seed=121, n_storages=4, n_replicas=2, n_tlogs=2)
+        dd = c.data_distributor
+        dd.SPLIT_BYTES = 1 << 30
+
+        async def main():
+            tr = db.transaction()
+            tr.set(b"\x06k", b"v")
+            await tr.commit()
+            team = c.storage_map.team_for_key(b"\x06k")
+            victim = team[0]
+            spare_tags = [t for t in range(4) if t not in team]
+            await dd.exclude(spare_tags[0])  # the first-choice spare
+            c.net.kill(f"storage{victim}")
+            for _ in range(400):
+                t2 = c.storage_map.team_for_key(b"\x06k")
+                if victim not in t2 and len(t2) >= 2:
+                    break
+                await c.loop.sleep(0.2)
+            t2 = c.storage_map.team_for_key(b"\x06k")
+            assert victim not in t2 and spare_tags[0] not in t2, t2
+            return "ok"
+
+        assert run(c, main()) == "ok"
