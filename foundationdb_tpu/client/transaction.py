@@ -52,6 +52,20 @@ from foundationdb_tpu.runtime.commit_proxy import CommitRequest
 from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
 
 
+async def run_transaction_loop(tr, fn, max_retries: int = 50):
+    """THE canonical retry loop (reference: the on_error contract every
+    binding implements) — one definition shared by Database.run and
+    Tenant.run so their semantics can never diverge."""
+    for _ in range(max_retries):
+        try:
+            result = await fn(tr)
+            await tr.commit()
+            return result
+        except FdbError as e:
+            await tr.on_error(e)  # raises if not retryable
+    raise FdbError("retry limit reached", code=1021)
+
+
 @dataclass(frozen=True)
 class KeySelector:
     """Reference: fdbclient KeySelectorRef. Resolves to the key `offset`
@@ -246,15 +260,7 @@ class Database:
 
     async def run(self, fn, max_retries: int = 50):
         """Run `await fn(tr)` + commit with the standard retry loop."""
-        tr = self.transaction()
-        for _ in range(max_retries):
-            try:
-                result = await fn(tr)
-                await tr.commit()
-                return result
-            except FdbError as e:
-                await tr.on_error(e)  # raises if not retryable
-        raise FdbError("retry limit reached", code=1021)
+        return await run_transaction_loop(self.transaction(), fn, max_retries)
 
 
 class Transaction:
