@@ -39,6 +39,7 @@ from foundationdb_tpu.sim.workloads import (
     TPCCNewOrderWorkload,
     DDBalanceWorkload,
     FuzzApiWorkload,
+    TenantWorkload,
     VersionStampWorkload,
     WatchesWorkload,
     WorkloadMetrics,
@@ -117,6 +118,11 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "opsPerTransaction": "ops_per_txn",
+    }),
+    "Tenants": (TenantWorkload, {
+        "tenantCount": "n_tenants",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
     }),
     "DDBalance": (DDBalanceWorkload, {
         "keyCount": "n_keys",
