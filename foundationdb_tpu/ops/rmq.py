@@ -75,3 +75,64 @@ def _popcount32(x: jax.Array) -> jax.Array:
     x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
     x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
     return (x * jnp.uint32(0x01010101)) >> 24
+
+
+# ---------------------------------------------------------------------------
+# Two-level blocked RMQ: the PRODUCTION range-max for the conflict
+# kernel's history check (conflict_kernel._history_conflicts). Its BUILD
+# is ~3 passes over [N] (in-block prefix/suffix cummax + a small table
+# over block maxima) instead of the sparse table's log2(N) passes —
+# measured 3.5x cheaper for the build+query shape on CPU-XLA; queries pay
+# one [Nq, G] row gather for the same-block case. sparse_table remains
+# for small/top-level tables and for the on-chip A/B in
+# scripts/tpu_diag.py (the TPU may rank the designs differently).
+# ---------------------------------------------------------------------------
+
+RMQ_BLOCK = 256
+
+
+class BlockTable:
+    """Container for the blocked structure (host-built pytree of arrays)."""
+
+    def __init__(self, rows, prefix, suffix, top):
+        self.rows = rows  # [NB, G] original values, padded with neg_inf
+        self.prefix = prefix  # [NB, G] cummax from block start
+        self.suffix = suffix  # [NB, G] cummax toward block start
+        self.top = top  # sparse table over block maxima [L, NB]
+
+
+def block_table(values: jax.Array, neg_inf: int, block: int = RMQ_BLOCK) -> BlockTable:
+    n = values.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    v = jnp.concatenate(
+        [values, jnp.full((pad,), neg_inf, values.dtype)]) if pad else values
+    rows = v.reshape(nb, block)
+    prefix = jax.lax.cummax(rows, axis=1)
+    suffix = jax.lax.cummax(rows, axis=1, reverse=True)
+    top = sparse_table(rows.max(axis=1))
+    return BlockTable(rows, prefix, suffix, top)
+
+
+def range_max_blocked(bt: BlockTable, lo: jax.Array, hi: jax.Array,
+                      neg_inf: int, block: int = RMQ_BLOCK) -> jax.Array:
+    """max(values[lo:hi]) with numpy-slice semantics; empty -> neg_inf."""
+    valid = hi > lo
+    last = jnp.maximum(hi - 1, 0)
+    safe_lo = jnp.minimum(jnp.maximum(lo, 0), bt.rows.shape[0] * block - 1)
+    bl, il = safe_lo // block, safe_lo % block
+    bh, ih = last // block, last % block
+
+    # Cross-block: suffix of lo's block + prefix of hi's block + interior.
+    cross = jnp.maximum(bt.suffix[bl, il], bt.prefix[bh, ih])
+    interior = range_max(bt.top, bl + 1, bh, neg_inf)
+    cross = jnp.maximum(cross, interior)
+
+    # Same-block: masked max over row bl between il..ih.
+    row = bt.rows[bl]  # [Nq, G]
+    j = jnp.arange(block, dtype=jnp.int32)
+    mask = (j[None, :] >= il[..., None]) & (j[None, :] <= ih[..., None])
+    same = jnp.where(mask, row, neg_inf).max(axis=-1)
+
+    out = jnp.where(bl == bh, same, cross)
+    return jnp.where(valid, out, jnp.asarray(neg_inf, bt.rows.dtype))

@@ -1571,7 +1571,11 @@ class TenantWorkload(Workload):
         async def client(cid: int):
             for _ in range(counts[cid]):
                 name = b"wl%02d" % rng.randrange(self.n_tenants)
-                k = b"k%02d" % rng.randrange(6)
+                # Per-client key partition: the model records commit-REPLY
+                # order, which for a shared key can differ from commit-
+                # version order under delayed replies — distinct keys per
+                # client make the model exact (same pattern as ChangeFeed).
+                k = b"c%02d/k%02d" % (cid, rng.randrange(6))
                 v = name + b"/%05d" % rng.randrange(99999)
 
                 async def body(tr, k=k, v=v):

@@ -114,7 +114,11 @@ def main():
     # derived from the carry, so the loop body cannot be hoisted.
     chain("history_conflicts",
           lambda a: a + jnp.sum(ck._history_conflicts(
-              state, batch._replace(
+              # Perturb the STATE too: a loop-invariant state lets XLA
+              # hoist the per-batch sparse-table build (41 ms of CPU
+              # truth) out of the loop and under-attribute this phase.
+              state._replace(versions=state.versions + pert(a)),
+              batch._replace(
                   read_version=batch.read_version + pert(a)))
               .astype(jnp.float32)),
           jnp.float32(0))
@@ -138,6 +142,82 @@ def main():
           lambda a: a + jnp.sum(ck._endpoint_ranks(
               batch._replace(read_begin=batch.read_begin + pert(a)))[0]
               .astype(jnp.float32)),
+          jnp.float32(0))
+
+    # Primitive costs (same chain methodology): ranks the candidate
+    # optimizations — if gathers/searchsorted dominate, a pallas binary
+    # search pays; if sort dominates, deferred compaction pays; if the
+    # sparse-table build dominates, the two-level RMQ pays.
+    from foundationdb_tpu.ops.lex import (
+        searchsorted_words,
+        sort_keys_with_payload,
+    )
+    from foundationdb_tpu.ops.rmq import sparse_table
+
+    skeys3 = jnp.asarray(
+        np.sort(rng.integers(0, 2**31 - 1, size=(C, W), dtype=np.int32),
+                axis=0))
+    q3 = jnp.asarray(
+        rng.integers(0, 2**31 - 1, size=(2 * B, W), dtype=np.int32))
+    sortcols = [
+        jnp.asarray(rng.integers(0, 2**31 - 1, size=(6 * B,), dtype=np.int32))
+        for _ in range(4)
+    ]
+    versions = jnp.asarray(
+        rng.integers(0, 100, size=(C,), dtype=np.int32))
+    gidx = jnp.asarray(rng.integers(0, C, size=(2 * B,), dtype=np.int32))
+    mat = jnp.asarray(rng.random((B, B)), jnp.bfloat16)
+    vec = jnp.asarray(rng.random((B,)), jnp.bfloat16)
+
+    def g(a):
+        return jnp.minimum(a.astype(jnp.int32), 0)  # runtime-zero, opaque
+
+    chain("prim_searchsorted_C_16k",
+          lambda a: a + jnp.sum(searchsorted_words(
+              skeys3, q3 + g(a)).astype(jnp.float32)),
+          jnp.float32(0))
+    chain("prim_sort_49k_x4",
+          lambda a: a + jnp.sum(sort_keys_with_payload(
+              jnp.stack([sortcols[0] + g(a), sortcols[1], sortcols[2]],
+                        axis=-1), sortcols[3])[0].astype(jnp.float32)),
+          jnp.float32(0))
+    chain("prim_sparse_table_C",
+          lambda a: a + jnp.sum(sparse_table(versions + g(a))
+                                .astype(jnp.float32)),
+          jnp.float32(0))
+    # A/B: full history-conflict shape on both RMQ designs (build+query).
+    from foundationdb_tpu.ops.rmq import block_table, range_max, \
+        range_max_blocked
+
+    NEGV = -(2**31) + 1
+    qlo = jnp.asarray(rng.integers(0, C - 2, size=(2 * B,), dtype=np.int32))
+    qhi = jnp.asarray(
+        (np.asarray(qlo) + rng.integers(1, 3, size=2 * B)).astype(np.int32))
+
+    def rmq_sparse(a):
+        st = sparse_table(versions + g(a))
+        return a + jnp.sum(
+            range_max(st, qlo + g(a), qhi, NEGV).astype(jnp.float32))
+
+    def rmq_blocked(a):
+        bt = block_table(versions + g(a), NEGV)
+        return a + jnp.sum(
+            range_max_blocked(bt, qlo + g(a), qhi, NEGV)
+            .astype(jnp.float32))
+
+    chain("rmq_sparse_build+query", rmq_sparse, jnp.float32(0))
+    chain("rmq_blocked_build+query", rmq_blocked, jnp.float32(0))
+    chain("prim_gather_16k_rows",
+          lambda a: a + jnp.sum(skeys3[gidx + g(a)].astype(jnp.float32)),
+          jnp.float32(0))
+    chain("prim_matvec_bf16_B2",
+          lambda a: a + jnp.sum(jax.lax.dot(
+              mat, vec + jnp.minimum(a, 0).astype(jnp.bfloat16),
+              preferred_element_type=jnp.float32)),
+          jnp.float32(0))
+    chain("prim_cumsum_C",
+          lambda a: a + jnp.sum(jnp.cumsum(versions + g(a))
+                                .astype(jnp.float32)),
           jnp.float32(0))
 
     # Tunnel characteristics.

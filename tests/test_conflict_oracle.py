@@ -146,12 +146,14 @@ def test_commit_version_must_advance():
         cs.resolve([], 10)
 
 
-def test_wide_range_limits_match_oracle():
-    """R*Q above _OVERLAP_UNROLL_LIMIT (tpcc-scale 12x8=96) switches
-    _overlap_rows to the vectorized 4D reduce — verdicts must be identical
-    to the oracle (and hence to the unrolled form)."""
+def test_wide_range_limits_match_oracle(monkeypatch):
+    """R*Q above _OVERLAP_UNROLL_LIMIT switches _overlap_rows to the
+    vectorized 4D reduce — verdicts must be identical to the oracle (and
+    hence to the unrolled form). The limit is forced low so the fallback
+    stays covered now that tpcc-scale 12x8 rides the unrolled form."""
     from foundationdb_tpu.models import conflict_kernel as ck
 
+    monkeypatch.setattr(ck, "_OVERLAP_UNROLL_LIMIT", 16)
     assert 12 * 8 > ck._OVERLAP_UNROLL_LIMIT  # the fallback is actually hit
     rng = np.random.default_rng(11)
     cs = TPUConflictSet(capacity=512, batch_size=16, max_read_ranges=12,

@@ -25,3 +25,48 @@ def test_range_max_full_and_empty(rng):
     assert int(range_max(st, np.int32(0), np.int32(33), NEG)) == vals.max()
     assert int(range_max(st, np.int32(5), np.int32(5), NEG)) == NEG
     assert int(range_max(st, np.int32(7), np.int32(3), NEG)) == NEG
+
+
+class TestBlockedRMQ:
+    def test_matches_numpy_oracle(self, rng):
+        import jax.numpy as jnp
+
+        from foundationdb_tpu.ops.rmq import block_table, range_max_blocked
+
+        neg = -(2**31) + 1
+        for n in (1, 7, 255, 256, 257, 1000, 4096):
+            vals = rng.integers(-100, 100, size=n).astype("int32")
+            bt = block_table(jnp.asarray(vals), neg)
+            los = rng.integers(0, n, size=200).astype("int32")
+            lens = rng.integers(0, 40, size=200).astype("int32")
+            his = (los + lens).clip(0, n).astype("int32")
+            got = range_max_blocked(
+                bt, jnp.asarray(los), jnp.asarray(his), neg)
+            import numpy as np
+
+            want = np.array([
+                vals[lo:hi].max() if hi > lo else neg
+                for lo, hi in zip(los, his)
+            ], dtype="int32")
+            assert (np.asarray(got) == want).all(), n
+
+    def test_matches_sparse_table(self, rng):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from foundationdb_tpu.ops.rmq import (
+            block_table,
+            range_max,
+            range_max_blocked,
+            sparse_table,
+        )
+
+        neg = -(2**31) + 1
+        vals = rng.integers(-1000, 1000, size=8192).astype("int32")
+        st = sparse_table(jnp.asarray(vals))
+        bt = block_table(jnp.asarray(vals), neg)
+        los = rng.integers(0, 8192, size=1000).astype("int32")
+        his = (los + rng.integers(0, 3000, size=1000)).clip(0, 8192).astype("int32")
+        a = range_max(st, jnp.asarray(los), jnp.asarray(his), neg)
+        b = range_max_blocked(bt, jnp.asarray(los), jnp.asarray(his), neg)
+        assert (np.asarray(a) == np.asarray(b)).all()
