@@ -49,6 +49,12 @@ def _lib():
             ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_int64,
             ctypes.c_int64, u8p, ctypes.c_int64, i64p,
         ]
+        lib.fnet_get_range.restype = ctypes.c_int32
+        lib.fnet_get_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_int64,
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, u8p, ctypes.c_int64, i64p,
+        ]
         _LIB = lib
     return _LIB
 
@@ -167,3 +173,39 @@ class NetClient:
                 continue
             raise FdbError("get failed", code=int(-rc))
         raise FdbError("get failed after resize", code=1500)
+
+    def get_range(self, begin: bytes, end: bytes, version: int,
+                  limit: int = 10_000,
+                  reverse: bool = False) -> list[tuple[bytes, bytes]]:
+        """Rows in [begin, end) at `version` through the C wire client
+        (server side: the proxy ReadRouter fans out across shards)."""
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        cap = 1 << 20
+        for _attempt in range(2):
+            buf = np.zeros(cap, np.uint8)
+            used = ctypes.c_int64(0)
+            bb = np.frombuffer(begin, np.uint8) if begin else np.zeros(1, np.uint8)
+            eb = np.frombuffer(end, np.uint8) if end else np.zeros(1, np.uint8)
+            rc = _lib().fnet_get_range(
+                self._h, self.storage_service,
+                bb.ctypes.data_as(u8), len(begin),
+                eb.ctypes.data_as(u8), len(end),
+                version, limit, 1 if reverse else 0,
+                buf.ctypes.data_as(u8), buf.size, ctypes.byref(used),
+            )
+            if rc >= 0:
+                rows, pos, raw = [], 0, bytes(buf[: used.value])
+                for _ in range(rc):
+                    klen = int.from_bytes(raw[pos:pos + 4], "little")
+                    k = raw[pos + 4:pos + 4 + klen]
+                    pos += 4 + klen
+                    vlen = int.from_bytes(raw[pos:pos + 4], "little")
+                    v = raw[pos + 4:pos + 4 + vlen]
+                    pos += 4 + vlen
+                    rows.append((k, v))
+                return rows
+            if rc == -1500 and cap < used.value <= (64 << 20):
+                cap = int(used.value)
+                continue
+            raise FdbError("get_range failed", code=int(-rc))
+        raise FdbError("get_range failed after resize", code=1500)

@@ -427,4 +427,53 @@ int32_t fnet_get(void* h, const char* storage_service, const uint8_t* key,
   return 0;
 }
 
+// Range read at a version (reference: fdb_transaction_get_range through
+// fdb_c). Rows land in one packed output buffer:
+//   per row: u32 key_len, key bytes, u32 value_len, value bytes.
+// Returns the row count (>= 0), or < 0: -fdb_error_code; if the buffer
+// is too small, returns -ERR_INTERNAL with *out_used set to the
+// required size.
+int32_t fnet_get_range(void* h, const char* storage_service,
+                       const uint8_t* begin, int64_t blen,
+                       const uint8_t* end, int64_t elen,
+                       int64_t version, int32_t limit, int32_t reverse,
+                       uint8_t* out, int64_t out_cap, int64_t* out_used) {
+  *out_used = 0;  // malformed-reply errors must not leave resize-signal garbage
+  Conn* c = static_cast<Conn*>(h);
+  Buf b;
+  uint64_t id = req_header(b, c, storage_service, "get_range", 5);
+  b.tag_bytes(begin, blen);
+  b.tag_bytes(end, elen);
+  b.tag_int(version);
+  b.tag_int(limit);
+  b.tag_bool(reverse != 0);
+  std::vector<uint8_t> reply;
+  Cur v{nullptr, 0};
+  int64_t rc = round_trip(c, b, id, reply, v);
+  if (rc < 0) return static_cast<int32_t>(rc);
+  uint8_t t = v.u8();
+  if (t != T_LIST && t != T_TUPLE) return static_cast<int32_t>(-ERR_INTERNAL);
+  uint32_t rows = v.u32();
+  int64_t used = 0;
+  for (uint32_t i = 0; i < rows; i++) {
+    uint8_t rt = v.u8();
+    if ((rt != T_TUPLE && rt != T_LIST) || v.u32() != 2)
+      return static_cast<int32_t>(-ERR_INTERNAL);
+    for (int part = 0; part < 2; part++) {
+      if (v.u8() != T_BYTES) return static_cast<int32_t>(-ERR_INTERNAL);
+      uint32_t n = v.u32();
+      if (!v.need(n)) return static_cast<int32_t>(-ERR_INTERNAL);
+      if (used + 4 + n <= out_cap) {
+        memcpy(out + used, &n, 4);
+        memcpy(out + used + 4, v.p + v.pos, n);
+      }
+      used += 4 + n;
+      v.pos += n;
+    }
+  }
+  *out_used = used;
+  if (used > out_cap) return static_cast<int32_t>(-ERR_INTERNAL);
+  return static_cast<int32_t>(rows);
+}
+
 }  // extern "C"

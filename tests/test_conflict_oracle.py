@@ -153,23 +153,33 @@ def test_wide_range_limits_match_oracle(monkeypatch):
     stays covered now that tpcc-scale 12x8 rides the unrolled form."""
     from foundationdb_tpu.models import conflict_kernel as ck
 
+    import jax
+
     monkeypatch.setattr(ck, "_OVERLAP_UNROLL_LIMIT", 16)
-    assert 12 * 8 > ck._OVERLAP_UNROLL_LIMIT  # the fallback is actually hit
-    rng = np.random.default_rng(11)
-    cs = TPUConflictSet(capacity=512, batch_size=16, max_read_ranges=12,
-                        max_write_ranges=8, max_key_bytes=8)
-    oracle = OracleConflictSet()
-    cv = 500
-    for batch_i in range(6):
-        cv += int(rng.integers(1, 30))
-        txns = [
-            rand_txn(rng, read_version=int(rng.integers(max(0, cv - 100), cv)),
-                     n_ranges=10)
-            for _ in range(int(rng.integers(1, 16)))
-        ]
-        got = cs.resolve(txns, cv)
-        want = oracle.resolve(txns, cv)
-        assert got == want, f"batch {batch_i}: {got} != {want}"
+    # The module-level @jax.jit cache is keyed by shapes only: an earlier
+    # same-shape trace would make the patched limit a silent no-op (and
+    # our limit=16 trace would poison later tests) — clear both ways.
+    jax.clear_caches()
+    try:
+        assert 12 * 8 > ck._OVERLAP_UNROLL_LIMIT  # the fallback is hit
+        rng = np.random.default_rng(11)
+        cs = TPUConflictSet(capacity=512, batch_size=16, max_read_ranges=12,
+                            max_write_ranges=8, max_key_bytes=8)
+        oracle = OracleConflictSet()
+        cv = 500
+        for batch_i in range(6):
+            cv += int(rng.integers(1, 30))
+            txns = [
+                rand_txn(rng,
+                         read_version=int(rng.integers(max(0, cv - 100), cv)),
+                         n_ranges=10)
+                for _ in range(int(rng.integers(1, 16)))
+            ]
+            got = cs.resolve(txns, cv)
+            want = oracle.resolve(txns, cv)
+            assert got == want, f"batch {batch_i}: {got} != {want}"
+    finally:
+        jax.clear_caches()  # drop the limit=16 traces
 
 
 @pytest.mark.parametrize("seed", [7, 8])

@@ -221,6 +221,21 @@ class TestDeployedCluster:
                     write_ranges=[single_key_range(b"c/deployed")],
                 )
             assert ei.value.code == 1020
+
+            # Range read through the C wire client (read-router fanout,
+            # cross-shard, limit + reverse).
+            rv5 = c.get_read_version()
+            c.commit(rv5, [
+                Mutation(M.SET_VALUE, b"cr/%02d" % i, b"v%02d" % i)
+                for i in range(5)
+            ], write_ranges=[single_key_range(b"cr/%02d" % i)
+                             for i in range(5)])
+            rv6 = c.get_read_version()
+            rows = c.get_range(b"cr/", b"cr0", rv6)
+            assert rows == [(b"cr/%02d" % i, b"v%02d" % i)
+                            for i in range(5)]
+            assert c.get_range(b"cr/", b"cr0", rv6, limit=2) == rows[:2]
+            assert c.get_range(b"cr/", b"cr0", rv6, reverse=True)[0] == rows[-1]
         finally:
             c.close()
 
