@@ -78,6 +78,7 @@ clearrange BEGIN END    clear a range (requires `writemode on`)
 writemode on|off        allow/forbid mutations (fdbcli semantics)
 throttle tag NAME TPS   cap transactions carrying tag NAME at TPS
 unthrottle tag NAME     clear a tag quota
+kill ROLEN              ask a server process to exit (fdbcli kill)
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -166,6 +167,23 @@ class Shell:
             tps = float(args[2]) if cmd == "throttle" else None
             self._await(ep.set_tag_quota(args[1], tps))
             return ("Throttled" if tps is not None else "Unthrottled")
+        if cmd == "kill":
+            # fdbcli `kill` analogue: ask a server process to exit (the
+            # operator's supervisor — scripts/start_cluster.sh, systemd,
+            # fdbmonitor — decides whether it comes back).
+            if len(args) != 1 or not re.fullmatch(r"[a-z]+\d+", args[0]):
+                return "usage: kill ROLEN  (e.g. kill storage1)"
+            role = args[0].rstrip("0123456789")
+            idx = int(args[0][len(role):])
+            if f"{role}{idx}" != args[0]:
+                # Reject zero-padded names: `kill storage01` must not
+                # silently shut down storage1.
+                return f"ERROR: no process {args[0]} in the cluster spec"
+            addrs = self.spec.get(role) or []
+            if not 0 <= idx < len(addrs):
+                return f"ERROR: no process {args[0]} in the cluster spec"
+            ep = self.t.endpoint(parse_addr(addrs[idx]), "admin")
+            return self._await(ep.shutdown())
         if cmd == "status":
             return json.dumps(self._status(), indent=1, sort_keys=True)
         return f"ERROR: unknown command `{cmd}' (try help)"

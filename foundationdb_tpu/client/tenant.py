@@ -126,9 +126,14 @@ class Tenant:
 
     async def _resolve(self) -> bytes:
         if self._prefix is None:
-            tr = self.db.transaction()
-            tr.set_option("access_system_keys")
-            prefix = await tr.get(TENANT_MAP_PREFIX + self.name)
+            # Through the retry loop: a raw read here would surface
+            # transient errors (killed proxy, recovery in flight) as
+            # tenant failures — found by the buggify campaign.
+            async def body(tr):
+                tr.set_option("access_system_keys")
+                return await tr.get(TENANT_MAP_PREFIX + self.name)
+
+            prefix = await self.db.run(body)
             if prefix is None:
                 raise TenantNotFound(self.name)
             self._prefix = prefix

@@ -230,13 +230,25 @@ class DataDistributor:
         """Move [begin, end) to `dst_team` (must align with, or split to,
         shard boundaries). Safe under traffic and fault injection: aborts
         restore the source team and purge destination partial state."""
-        m = self.cluster.storage_map
-        if begin:
-            m.split_at(begin)
-        if end:
-            m.split_at(end)
-        for sub, src_team in list(m.split_range_teams(KeyRange(begin, end))):
-            await self._move_one(sub.begin, sub.end, src_team, tuple(dst_team))
+        # moveKeys lock (reference: the moveKeys lock serializes range
+        # movement): overlapping moves interleave their map flips and
+        # retire/serve transitions — the buggify campaign caught a leaver
+        # that was never retired because a concurrent move rewrote the
+        # team under it, leaving a stale replica answering reads.
+        while self._moving:
+            await self.loop.sleep(0.02)
+        self._moving = True
+        try:
+            m = self.cluster.storage_map
+            if begin:
+                m.split_at(begin)
+            if end:
+                m.split_at(end)
+            for sub, src_team in list(m.split_range_teams(KeyRange(begin, end))):
+                await self._move_one(sub.begin, sub.end, src_team,
+                                     tuple(dst_team))
+        finally:
+            self._moving = False
 
     async def _move_one(
         self,
@@ -250,7 +262,6 @@ class DataDistributor:
         m = self.cluster.storage_map
         newcomers = [t for t in dst_team if t not in src_team]
         leavers = [t for t in src_team if t not in dst_team]
-        self._moving = True
         # Open the dual-tag window: proxies now tag every mutation in the
         # range for src AND dst members, so newcomers' tag streams carry
         # all traffic concurrent with their snapshots.
@@ -262,12 +273,29 @@ class DataDistributor:
             live = set(self._live_tags())
             src_tag = next((t for t in src_team if t in live), src_team[0])
             src_ep = self.cluster.storage_eps[src_tag]
-            # The snapshot must reflect everything committed BEFORE the
-            # dual-tag window opened: mutations up to this floor were
-            # tagged only for the old team, so a lagging source
-            # snapshotting below it would lose them for the newcomers
-            # (e.g. resurrect a cleared key).
-            floor = await self._retry(self.cluster.tlog_eps[0].get_version)
+            # FENCE the dual-tag window: a commit batch that assembled its
+            # tags with the OLD map may still be in flight (delayed push)
+            # with a version ABOVE the tlog's current version — newcomers
+            # would receive it neither via their tag stream (not tagged)
+            # nor via a snapshot floored below it (the stale-read the
+            # buggify campaign caught). Every such batch's version is
+            # <= the sequencer's last handed-out version at this instant,
+            # and the version chain is gap-free, so once a tlog's version
+            # passes the fence all of them are durably pushed.
+            fence = await self._retry(
+                self.cluster.sequencer_ep.get_last_version
+            )
+            deadline = self.loop.now + 15.0
+            while True:
+                floor = await self._retry(self.cluster.tlog_eps[0].get_version)
+                if floor >= fence:
+                    break
+                if self.loop.now > deadline:
+                    raise TimeoutError(
+                        f"move fence {fence} not reached (tlog at {floor}) — "
+                        "chain wedged; recovery will unwind"
+                    )
+                await self.loop.sleep(0.05)
             snap_versions: dict[int, int] = {}
             for tag in newcomers:
                 dst_ep = self.cluster.storage_eps[tag]
@@ -297,8 +325,6 @@ class DataDistributor:
                 s.cancel_serve(begin, end)  # purged data must not be served
                 s.abort_fetch(begin, end)
             raise
-        finally:
-            self._moving = False
 
     async def _retry(self, make_call):
         backoff = 0.05

@@ -54,6 +54,13 @@ class Sequencer:
         """GRV proxies read this as the snapshot read version."""
         return self._committed
 
+    @rpc
+    async def get_last_version(self) -> int:
+        """Last handed-out commit version (no allocation). DataDistribution
+        uses it as a move FENCE: any commit batch that assembled its
+        mutation tags before a shard-map change holds a version <= this."""
+        return self._version
+
     @property
     def last_handed_out(self) -> int:
         return self._version

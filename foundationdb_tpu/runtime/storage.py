@@ -510,8 +510,17 @@ class StorageServer:
         self._fetching.append(f)
         trace(self.loop).event("FetchKeysBegin", begin=begin, end=end)
         try:
+            # The snapshot must be at/above OUR OWN applied version
+            # (reference: fetchKeys reads at fetchVersion >= data->version):
+            # with the dual-tag window open, this server may have already
+            # applied in-window mutations for the range; a snapshot below
+            # them would make the reconcile mistake those legitimate
+            # entries for aborted-move residue and purge committed writes
+            # (found by the buggify campaign under clogged, long-window
+            # moves).
+            snap_floor = max(min_version or 0, self._version)
             snap_version, rows = await src_ep.snapshot_range(
-                begin, end, min_version
+                begin, end, snap_floor
             )
             # Reconcile existing history with the snapshot instead of
             # purging: when a shard is RE-acquired within the read window,

@@ -276,20 +276,47 @@ def main(argv: list[str] | None = None) -> None:
                     process=f"{args.role}{args.index}")
     t = NetTransport(loop, host=host, port=port)
     build_role(loop, t, spec, args.role, args.index, args.data_dir)
+
+    from foundationdb_tpu.runtime.flow import Promise
+
+    class _Admin:
+        """Process-control surface (reference: fdbcli `kill` asks a
+        worker to exit; fdbmonitor restarts it)."""
+
+        def __init__(self):
+            self.stopped = Promise()
+
+        @rpc
+        async def shutdown(self) -> str:
+            tracer.event("ProcessShutdownRequested", Role=args.role,
+                         Index=args.index)
+            # Resolve AFTER replying: the @rpc reply is written when this
+            # coroutine returns; a zero-delay timer runs strictly later
+            # on the loop, so the exit can't race the reply flush.
+            loop.spawn(self._finish(), name="admin.shutdown")
+            return "shutting down"
+
+        async def _finish(self):
+            await loop.sleep(0)
+            self.stopped.send(None)
+
+    admin = _Admin()
+    t.serve("admin", admin)
     tracer.event("ProgramStart", Role=args.role, Index=args.index,
                  Address=f"{t.addr[0]}:{t.addr[1]}")
     print(f"ready {args.role}{args.index} on {t.addr[0]}:{t.addr[1]}",
           flush=True)
 
-    async def forever():
-        while True:
-            await loop.sleep(3600)
+    async def until_shutdown():
+        await admin.stopped.future
+        await loop.sleep(0.05)  # one select() round: reply bytes on the wire
 
     try:
-        loop.run(forever(), timeout=float("inf"))
+        loop.run(until_shutdown(), timeout=float("inf"))
     except KeyboardInterrupt:
         pass
     finally:
+        tracer.close()
         t.close()
 
 

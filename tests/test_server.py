@@ -265,3 +265,39 @@ class TestBackupTool:
         assert r.returncode == 0 and "restored" in r.stdout, r.stderr
         out = run_cli(cluster, "getrange bt/ bt0")
         assert "v1" in out.stdout and "v2" in out.stdout
+
+
+class TestAdminKill:
+    def test_cli_kill_stops_process(self, tmp_path_factory):
+        """fdbcli `kill` analogue: the admin shutdown RPC exits the target
+        process cleanly (its supervisor decides on restart)."""
+        tmp = tmp_path_factory.mktemp("killtest")
+        port = free_ports(1)[0]
+        spec = {
+            "sequencer": [f"127.0.0.1:{port}"],
+            "resolver": ["127.0.0.1:1"], "tlog": ["127.0.0.1:1"],
+            "storage": ["127.0.0.1:1"], "proxy": ["127.0.0.1:1"],
+        }
+        spec_path = tmp / "cluster.json"
+        spec_path.write_text(json.dumps(spec))
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.server",
+             "--cluster", str(spec_path), "--role", "sequencer",
+             "--index", "0"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            assert "ready" in p.stdout.readline()
+            out = subprocess.run(
+                [sys.executable, "-m", "foundationdb_tpu.cli",
+                 "--cluster", str(spec_path), "--exec", "kill sequencer0"],
+                cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                capture_output=True, text=True, timeout=60,
+            )
+            assert "shutting down" in out.stdout, out.stdout + out.stderr
+            assert p.wait(timeout=15) == 0  # clean exit
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
