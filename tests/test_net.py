@@ -461,3 +461,63 @@ class TestNativeCClientPipelining:
         finally:
             proc.kill()
             proc.wait()
+
+
+class TestWireFuzz:
+    def test_server_survives_garbage_frames(self):
+        """Malformed/hostile bytes on the wire must never take the server
+        down: each bad connection is dropped (or its frame rejected) and
+        well-formed clients keep working throughout (reference: fdbrpc
+        connection handling tolerates arbitrary peers)."""
+        import socket
+        import random
+
+        from foundationdb_tpu.runtime.flow import rpc
+        from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+
+        class Echo:
+            @rpc
+            async def ping(self, x):
+                return x
+
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("e", Echo())
+        ep = client.endpoint(server.addr, "e")
+        rng = random.Random(7)
+
+        def hostile(payload: bytes, with_len: bool = True):
+            s = socket.create_connection(server.addr, timeout=5)
+            try:
+                if with_len:
+                    s.sendall(len(payload).to_bytes(4, "little") + payload)
+                else:
+                    s.sendall(payload)
+            finally:
+                s.close()
+
+        async def main():
+            assert await ep.ping(41) == 41
+            # 1. random garbage with a plausible length prefix
+            for _ in range(10):
+                hostile(bytes(rng.randrange(256)
+                              for _ in range(rng.randrange(1, 200))))
+                assert await ep.ping(1) == 1
+            # 2. truncated length header / short frames
+            hostile(b"\x01", with_len=False)
+            hostile(b"", with_len=True)
+            # 3. absurd length prefix (> MAX_FRAME) then nothing
+            s = socket.create_connection(server.addr, timeout=5)
+            s.sendall((1 << 30).to_bytes(4, "little"))
+            s.close()
+            # 4. a VALID tuple header followed by nonsense values
+            hostile(b"\x09\x05\x00\x00\x00" + b"\xff" * 40)
+            assert await ep.ping(2) == 2
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=60) == "ok"
+        finally:
+            server.close()
+            client.close()
