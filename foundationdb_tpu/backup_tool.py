@@ -87,12 +87,15 @@ def cmd_restore(args) -> int:
     if container.restorable_version() is None:
         print("container is not restorable", file=sys.stderr)
         return 1
+    target = args.version  # None = latest restorable
     loop, t, db = _open(args.cluster)
     try:
-        loop.run(restore(db, container), timeout=args.timeout)
+        loop.run(restore(db, container, target_version=target),
+                 timeout=args.timeout)
     finally:
         t.close()
-    print(f"restored to version {container.restorable_version()}")
+    print(f"restored to version "
+          f"{target if target is not None else container.restorable_version()}")
     return 0
 
 
@@ -126,6 +129,9 @@ def main(argv=None) -> int:
     s = sub.add_parser("restore", help="restore a backup file into a cluster")
     s.add_argument("--cluster", required=True)
     s.add_argument("--in", dest="infile", required=True)
+    s.add_argument("--version", type=int, default=None,
+                   help="point-in-time target (reference: fdbrestore "
+                        "--version); default = latest restorable")
     s.add_argument("--timeout", type=float, default=600.0)
     s.set_defaults(fn=cmd_restore)
 

@@ -261,8 +261,13 @@ class TestBackupTool:
         assert "rows=2" in tool("describe", "--in", bk).stdout
 
         assert run_cli(cluster, "writemode on; clearrange bt/ bt0").returncode == 0
-        r = tool("restore", "--cluster", cluster, "--in", bk)
-        assert r.returncode == 0 and "restored" in r.stdout, r.stderr
+        desc = tool("describe", "--in", bk).stdout
+        rv = int(desc.split("restorable_version=")[1].split()[0])
+        # Point-in-time flag (fdbrestore --version analogue).
+        r = tool("restore", "--cluster", cluster, "--in", bk,
+                 "--version", str(rv))
+        assert r.returncode == 0 and f"restored to version {rv}" in r.stdout, \
+            r.stdout + r.stderr
         out = run_cli(cluster, "getrange bt/ bt0")
         assert "v1" in out.stdout and "v2" in out.stdout
 
