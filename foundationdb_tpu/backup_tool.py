@@ -28,7 +28,12 @@ import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # tool never needs a TPU
 
-from foundationdb_tpu.runtime.backup import BackupContainer, RangeChunk, restore
+from foundationdb_tpu.runtime.backup import (
+    BackupContainer,
+    RangeChunk,
+    RestoreError,
+    restore,
+)
 
 
 def _open(cluster_path: str):
@@ -90,12 +95,14 @@ def cmd_restore(args) -> int:
     target = args.version  # None = latest restorable
     loop, t, db = _open(args.cluster)
     try:
-        loop.run(restore(db, container, target_version=target),
-                 timeout=args.timeout)
+        restored = loop.run(restore(db, container, target_version=target),
+                            timeout=args.timeout)
+    except RestoreError as e:
+        print(f"restore failed: {e}", file=sys.stderr)
+        return 1
     finally:
         t.close()
-    print(f"restored to version "
-          f"{target if target is not None else container.restorable_version()}")
+    print(f"restored to version {restored}")
     return 0
 
 

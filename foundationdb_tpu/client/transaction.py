@@ -147,8 +147,13 @@ class Database:
             except Exception:
                 return  # still down: keep stale info, retry later
         self.epoch = info.epoch
-        self.grv_proxies = list(info.grv_proxy_eps)
-        self.commit_proxies = list(info.commit_proxy_eps)
+        # Mid-recovery the controller can publish an empty generation;
+        # keep the stale endpoints (they fail retryably) rather than
+        # adopting a list the client cannot route through at all.
+        if info.grv_proxy_eps:
+            self.grv_proxies = list(info.grv_proxy_eps)
+        if info.commit_proxy_eps:
+            self.commit_proxies = list(info.commit_proxy_eps)
 
     async def _relocate_controller(self) -> None:
         for ep in self.coordinator_eps:
@@ -252,6 +257,10 @@ class Database:
         raise ProcessKilled(f"no reachable storage replica for range {r.begin[:16]!r}")
 
     def _pick(self, eps: list):
+        if not eps:
+            # No known endpoints (fresh client against a recovering
+            # cluster): retryable — on_error refreshes the client info.
+            raise ProcessKilled("no known proxy endpoints")
         self._rr += 1
         return eps[self._rr % len(eps)]
 

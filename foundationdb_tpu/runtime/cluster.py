@@ -187,7 +187,15 @@ class ClusterController:
                     )
                     await self._publish_generation()
                     if self._deposed:
+                        # Unpublished generation: leave the OLD roles
+                        # alive — the rival's recovery still needs them
+                        # (retire_previous stays pending for the winner).
                         return
+                    # Only a PUBLISHED generation may retire its
+                    # predecessor's roles (Chaos-campaign split-brain fix).
+                    retire = getattr(self.recruiter, "retire_previous", None)
+                    if retire is not None:
+                        retire()
                     self.recoveries_completed += 1
                     return
                 except RecoveryFailed:

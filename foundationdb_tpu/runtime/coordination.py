@@ -156,10 +156,21 @@ class CoordinatedState:
 
     # -- leadership -----------------------------------------------------------
 
-    async def elect(self, my_id: str, controller_ep) -> dict:
+    async def elect(self, my_id: str, controller_ep,
+                    expect_leader: str | None = None) -> dict:
         """Claim leadership: write (reign+1, me). Raises Deposed if a rival
-        wins the race (the register names them at a higher ballot)."""
-        def claim(current: dict | None) -> dict:
+        wins the race (the register names them at a higher ballot).
+
+        expect_leader: the incumbent this candidate observed DEAD. If the
+        register already names someone else by claim time, a rival won
+        first — abort instead of superseding them (claiming over a live
+        freshly-elected leader mid-recovery orphans their half-recruited
+        generation; found by the Chaos campaign as a permanent stall)."""
+        def claim(current: dict | None) -> dict | None:
+            cur_leader = (current or {}).get("leader")
+            if (expect_leader is not None and cur_leader is not None
+                    and cur_leader != expect_leader and cur_leader != my_id):
+                return None  # a rival already took over: let them lead
             reign = (current or {}).get("reign", 0) + 1
             value = dict(current or {})
             value.update(reign=reign, leader=my_id, controller_ep=controller_ep)
@@ -212,7 +223,8 @@ class ControllerCandidate:
             if leader and await self._incumbent_alive(leader):
                 continue
             try:
-                state = await self.coord.elect(self.my_id, None)
+                state = await self.coord.elect(self.my_id, None,
+                                               expect_leader=leader)
             except FdbError:
                 continue  # lost the race or quorum flaked; re-monitor
             if state.get("leader") == self.my_id:

@@ -299,6 +299,25 @@ class SimCluster:
                 cand.run(), process=cand.my_id, name=f"{cand.my_id}.candidate"
             )
 
+    def retire_previous(self) -> None:
+        """Kill + unhost the superseded generation's roles (reference:
+        old-epoch roles die on seeing the new epoch). Called by the
+        controller once the new generation is PUBLISHED.
+
+        Names still in the CURRENT generation are skipped: a deposed
+        rival that recruited at the same epoch used the same process
+        names (sfx is epoch-derived), and killing by that shared name
+        would take down the winner's live roles — the rival's orphaned
+        actors are left to fail harmlessly against the locked old
+        tlogs."""
+        current = set(self._gen_processes)
+        for proc in set(getattr(self, "_pending_retirement", [])):
+            if proc in current:
+                continue
+            self.loop.kill_process(proc)
+            self.net.unhost_process(proc)
+        self._pending_retirement = []
+
     # -- recruiter interface (called by ClusterController / recovery) ---------
 
     def _derive_resolver_map(self) -> KeyShardMap:
@@ -448,12 +467,19 @@ class SimCluster:
         for s in self.storages:
             s.recover_to(recovery_version, self.tlog_eps[0], self.tlog_eps)
 
-        # Retire the previous generation: locked/stale roles must not keep
-        # serving (reference: old-epoch roles die on seeing the new epoch),
-        # and their objects must be unhosted or every recovery leaks them.
-        for proc in self._gen_processes:
-            self.loop.kill_process(proc)
-            self.net.unhost_process(proc)
+        # Retirement of the previous generation is DEFERRED: the
+        # controller calls retire_previous() only after the registry
+        # accepts the new generation. A deposed controller that already
+        # recruited must leave the old roles alive — its rival's recovery
+        # still needs to lock the old tlogs (killing them here was the
+        # Chaos-campaign stall: an unpublished generation orphaned the
+        # only locked log copies).
+        # ACCUMULATE (not overwrite): after a deposed rival's unpublished
+        # recruit, the winner's retire sweeps both the superseded
+        # generation AND the rival's orphaned roles.
+        self._pending_retirement = (
+            getattr(self, "_pending_retirement", []) + list(self._gen_processes)
+        )
         self._gen_processes = list(heartbeat_eps)
 
         return Generation(

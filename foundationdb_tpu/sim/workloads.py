@@ -971,9 +971,11 @@ class ChangeFeedWorkload(Workload):
 class IncrementWorkload(Workload):
     """Atomic-increment conservation (reference: Increment.actor.cpp):
     clients ADD 1 to random counters; quiesced, the counters must sum to
-    EXACTLY the committed-op count. Lost, torn, or double-applied atomic
-    ops all break the sum. (Run clean — an unknown-result retry of an
-    atomic op legitimately double-applies, as in the reference.)"""
+    the committed-op count — except that an applied-but-unknown commit
+    retried by the loop legitimately double-applies (as in the
+    reference, which tracks min/max expected): the sum must land in
+    [ops, ops + 2*retried_txns]. Clean runs have zero retries, making
+    the bound exact; lost or torn atomic ops still fail it from below."""
 
     name = "increment"
 
@@ -1023,10 +1025,16 @@ class IncrementWorkload(Workload):
                 total += struct.unpack("<q", v)[0] if v is not None else 0
             return total
 
+        # Snapshot BEFORE the read-only check txn runs: only run-phase
+        # ADD transactions can double-apply, so their retries alone set
+        # the tolerance (a retried check read must not widen it).
+        run_retries = self.metrics.txns_retried
         total = await self._run_txn(db, body)
-        if total != self.metrics.ops:
+        slack = 2 * run_retries  # 2 ADDs per txn attempt
+        if not self.metrics.ops <= total <= self.metrics.ops + slack:
             raise WorkloadFailed(
-                f"increment sum {total} != committed ops {self.metrics.ops}"
+                f"increment sum {total} outside [{self.metrics.ops}, "
+                f"{self.metrics.ops + slack}] (run retried {run_retries})"
             )
 
 
