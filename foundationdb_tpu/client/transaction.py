@@ -571,6 +571,17 @@ class Transaction:
     def is_read_only(self) -> bool:
         return not self.mutations and not self.write_ranges
 
+    def get_approximate_size(self) -> int:
+        """Commit-size estimate of the accumulated mutations + conflict
+        ranges (reference: Transaction::getApproximateSize; same number
+        the size_limit/transaction_too_large check uses)."""
+        return sum(
+            len(m.param1) + len(m.param2) + 24 for m in self.mutations
+        ) + sum(
+            len(r.begin) + len(r.end) + 16
+            for r in self.read_ranges + self.write_ranges
+        )
+
     async def commit(self) -> int:
         if self._committed is not None:
             raise UsedDuringCommit("commit() called twice")
@@ -579,10 +590,7 @@ class Transaction:
             self._committed = (version, 0)
             self._arm_watches()  # read-only txns still arm watches at commit
             return version
-        size = sum(len(m.param1) + len(m.param2) + 24 for m in self.mutations) + sum(
-            len(r.begin) + len(r.end) + 16
-            for r in self.read_ranges + self.write_ranges
-        )
+        size = self.get_approximate_size()
         cap = min(self.size_limit or MAX_TRANSACTION_SIZE, MAX_TRANSACTION_SIZE)
         if size > cap:
             raise TransactionTooLarge(f"{size} > {cap}")

@@ -344,6 +344,9 @@ class Transaction:
     def get_versionstamp(self) -> bytes:
         return self._tr.get_versionstamp()
 
+    def get_approximate_size(self) -> int:
+        return self._tr.get_approximate_size()
+
     # -- sugar ---------------------------------------------------------------
 
     def __getitem__(self, key):

@@ -161,3 +161,26 @@ class TestLocality:
             return "ok"
 
         assert c.loop.run(main(), timeout=60) == "ok"
+
+
+def test_get_approximate_size():
+    """Reference: Transaction.getApproximateSize — grows with mutations
+    and conflict ranges and matches the size-limit accounting."""
+    c, db = make_db(seed=8)
+
+    async def main():
+        tr = db.transaction()
+        assert tr.get_approximate_size() == 0
+        tr.set(b"k1", b"v" * 100)
+        s1 = tr.get_approximate_size()
+        assert s1 > 100
+        tr.set(b"k2", b"v" * 100)
+        assert tr.get_approximate_size() > s1
+        tr.set_option("size_limit", s1)  # now too small for both writes
+        import pytest as _pytest
+
+        with _pytest.raises(TransactionTooLarge):
+            await tr.commit()
+        return "ok"
+
+    assert c.loop.run(main(), timeout=60) == "ok"
