@@ -228,3 +228,64 @@ class TestDurableGapAcrossRecovery:
             return "ok"
 
         assert run(c2, check()) == "ok"
+
+
+class TestDurableChaos:
+    """Kills + partitions while writing to a DURABLE cluster, then a
+    whole-cluster crash-restart from disk: every acked commit must still
+    read back (the reference's sim restarts machines mid-run; our kills
+    are permanent per-run, so the crash-restart plays the reboot)."""
+
+    def _scenario(self, tmp_path, seed):
+        from foundationdb_tpu.sim.workloads import FaultInjector
+
+        d = os.path.join(str(tmp_path), f"s{seed}")
+        c1 = SimCluster(seed=seed, data_dir=d, n_tlogs=3, n_storages=2,
+                        n_replicas=2)
+        db1 = open_database(c1)
+        acked: list[int] = []
+
+        async def phase1():
+            faults = FaultInjector(
+                c1, kill_interval=0.8, partition_interval=1.0, max_kills=2)
+            ft = c1.loop.spawn(faults.run(), name="chaos.faults")
+            for i in range(24):
+                async def body(tr, i=i):
+                    tr.set(b"dc/%03d" % i, b"v%03d" % i)
+
+                await db1.run(body, max_retries=200)
+                acked.append(i)
+                await c1.loop.sleep(0.15)
+            faults.stop()
+            await ft
+            c1.net.heal_all()
+            # settle so known_committed covers the tail
+            async def settle(tr):
+                tr.set(b"zz/s", b"1")
+
+            await db1.run(settle)
+            await c1.loop.sleep(1.5)
+            return "ok"
+
+        assert run(c1, phase1()) == "ok"
+        assert len(acked) == 24
+
+        # Crash-restart from disk; all acked writes must be there.
+        c2 = SimCluster(seed=seed + 9000, data_dir=d, n_tlogs=3,
+                        n_storages=2, n_replicas=2)
+        db2 = open_database(c2)
+
+        async def check():
+            async def read(tr):
+                for i in acked:
+                    got = await tr.get(b"dc/%03d" % i)
+                    assert got == b"v%03d" % i, (i, got)
+
+            await db2.run(read)
+            return "ok"
+
+        assert run(c2, check()) == "ok"
+
+    def test_restart_after_faulted_run_seeds(self, tmp_path):
+        for seed in (401, 402, 403):
+            self._scenario(tmp_path, seed)
