@@ -42,7 +42,7 @@ from foundationdb_tpu.ops.lex import (
     searchsorted_words,
     sort_keys_with_payload,
 )
-from foundationdb_tpu.ops.rmq import block_table, range_max_blocked
+from foundationdb_tpu.ops.rmq import range_max, sparse_table
 
 NEG_VERSION = -(2**31) + 1
 
@@ -97,21 +97,19 @@ def init_state(capacity: int, width: int, min_key) -> ConflictState:
 def _history_conflicts(state: ConflictState, batch: BatchTensors) -> jax.Array:
     """bool [B]: some read range overlaps a historical write newer than rv."""
     b, r, w = batch.read_begin.shape
-    # Blocked two-level RMQ: the per-batch build is ~3 passes over [C]
-    # (in-block cummax x2 + a tiny table over block maxima) instead of
-    # the sparse table's log2(C) passes — measured 3.5x cheaper for the
-    # full build+query shape (scripts/tpu_diag.py A/B; parity pinned by
-    # the ConflictRange oracle tests).
-    bt = block_table(state.versions, NEG_VERSION)
+    # Sparse-table RMQ. The blocked two-level alternative (ops/rmq.py
+    # block_table) wins its ISOLATED build+query A/B 3.5x on CPU-XLA but
+    # regressed the FULL kernel 27% there (fusion effects) — production
+    # stays on the sparse table until scripts/tpu_diag.py's on-chip A/B
+    # ranks them on the real target.
+    st = sparse_table(state.versions)
     rb = batch.read_begin.reshape(b * r, w)
     re_ = batch.read_end.reshape(b * r, w)
     # Segments [lo, hi) intersect [rb, re): lo = segment containing rb,
     # hi = first segment starting at/after re.
     lo = searchsorted_words(state.keys, rb, side="right") - 1
     hi = searchsorted_words(state.keys, re_, side="left")
-    newest = range_max_blocked(
-        bt, jnp.maximum(lo, 0), hi, NEG_VERSION
-    ).reshape(b, r)
+    newest = range_max(st, jnp.maximum(lo, 0), hi, NEG_VERSION).reshape(b, r)
     nonempty = lex_lt(batch.read_begin, batch.read_end)
     live = batch.read_mask & nonempty
     conflict = live & (newest > batch.read_version[:, None])
