@@ -488,14 +488,18 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
                       wave_rounds: int = 4) -> dict:
     """Per-batch work estimate for resolve_batch at this mode's shapes.
 
-    Counts the five kernel phases (SURVEY §6): history searchsorted + RMQ,
-    endpoint rank sort, pairwise overlap, wave-acceptance matvecs (the MXU
-    part), and the merge/compact paint. Word width W is the packed-key
-    int32 width. These are estimates (sort passes modeled as bitonic
-    log²N), meant to bound which resource the kernel saturates and what
-    peak txns/s/chip the hardware admits — not to be exact."""
+    Models the CURRENT kernel (block-sequential acceptance, G=512
+    blocks): history sparse-table build + searchsorted + RMQ, endpoint
+    rank sort, and per-block fused overlap rows [G, B] (never a
+    materialized [B, B]) with cross-block [G, B]@[B] matvecs plus
+    within-block [G, G] waves, then the merge/compact paint. Word width
+    W is the packed-key int32 width; sorts modeled as bitonic log²N.
+    Bounds which resource saturates and what peak txns/s/chip the
+    hardware admits — not exact."""
     B, R, Q = mode.batch, mode.n_reads, mode.n_writes
     H = capacity
+    G = min(512, B)  # conflict_kernel._ACCEPT_BLOCK
+    nblk = max(1, B // G)
     W = (KEY_BYTES + 3) // 4 + 1  # +1 length/terminator word (keypack)
     lgH = max(1.0, np.log2(H))
     N = 2 * B * (R + Q)  # batch endpoints entering the rank sort
@@ -504,19 +508,25 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
     M = H + 2 * B * Q  # merged boundary set in paint/compact
 
     int_ops = (
-        2 * B * R * lgH * W * 2  # history searchsorted word compares
+        lgH * H  # sparse-table build passes
+        + 2 * B * R * lgH * W * 2  # history searchsorted word compares
         + 2 * B * R * 8  # sparse-table RMQ combine
         + sort_passes * N * W  # endpoint rank sort compares
         + 2 * N * lgN * W  # rank searchsorted
-        + B * B * R * Q * 3  # pairwise interval overlap
+        + B * B * R * Q * 3  # fused overlap rows, summed over blocks
         + M * np.log2(max(M, 2)) * W  # merge/compact
     )
-    mxu_flops = wave_rounds * 2.0 * B * B  # bf16 matvecs ride the MXU
+    mxu_flops = (
+        nblk * 2.0 * G * B  # cross-block demotion matvecs
+        + nblk * wave_rounds * 2.0 * 2 * G * G  # within-block wave rounds
+    )
     bytes_moved = (
-        2 * B * R * lgH * 4 * W  # searchsorted gathers (uncoalesced bound)
+        lgH * H * 4 * 2  # sparse-table build read+write
+        + 2 * B * R * lgH * 4 * W  # searchsorted gathers (uncoalesced bound)
         + 2 * B * R * 16
         + sort_passes * N * 4 * W * 2  # sort read+write per pass
-        + B * B * (1 + 2 * wave_rounds)  # overlap matrix + wave reads (bf16)
+        + B * B  # per-block [G, B] rows written+consumed once (bf16-ish)
+        + nblk * wave_rounds * 2 * G * G  # wave tile traffic
         + 6 * M * 4 * W  # compact passes
     )
     t_vpu = int_ops / V5E_VPU_INT_OPS_PER_S
