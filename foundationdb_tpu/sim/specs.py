@@ -39,6 +39,7 @@ from foundationdb_tpu.sim.workloads import (
     TPCCNewOrderWorkload,
     DDBalanceWorkload,
     FuzzApiWorkload,
+    IndexStressWorkload,
     TenantWorkload,
     VersionStampWorkload,
     WatchesWorkload,
@@ -118,6 +119,11 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "opsPerTransaction": "ops_per_txn",
+    }),
+    "IndexStress": (IndexStressWorkload, {
+        "itemCount": "n_items",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
     }),
     "Tenants": (TenantWorkload, {
         "tenantCount": "n_tenants",

@@ -1616,3 +1616,70 @@ class TenantWorkload(Workload):
                 raise WorkloadFailed(
                     f"tenant {name!r}: {len(rows)} rows != model {len(kv)}"
                 )
+
+
+class IndexStressWorkload(Workload):
+    """Transactional secondary index (reference: Storefront/IndexStress
+    shapes): every txn writes item `data/<k> = v` AND maintains the
+    index entry `idx/<v>/<k>` (clearing the previous index entry) in ONE
+    transaction. Quiesced, the index and the data must be exact mirrors:
+    a dangling or missing index entry means a torn multi-key txn."""
+
+    name = "index_stress"
+
+    def __init__(self, seed: int = 0, n_items: int = 10, n_txns: int = 30,
+                 n_clients: int = 3):
+        super().__init__(seed)
+        self.n_items = n_items
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"data/", b"data0")
+            tr.clear_range(b"idx/", b"idx0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                k = b"%03d" % rng.randrange(self.n_items)
+                v = b"v%05d" % rng.randrange(99999)
+
+                async def body(tr, k=k, v=v):
+                    old = await tr.get(b"data/" + k)
+                    if old is not None:
+                        tr.clear(b"idx/" + old + b"/" + k)
+                    tr.set(b"data/" + k, v)
+                    tr.set(b"idx/" + v + b"/" + k, b"")
+
+                await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"idx.client{i}")
+             for i in range(self.n_clients)]
+        )
+
+    async def check(self, db) -> None:
+        async def dump(tr):
+            data = await tr.get_range(b"data/", b"data0")
+            idx = await tr.get_range(b"idx/", b"idx0")
+            return data, idx
+
+        data, idx = await self._run_txn(db, dump)
+        want_idx = sorted(
+            b"idx/" + v + b"/" + k[len(b"data/"):] for k, v in data
+        )
+        got_idx = sorted(k for k, _ in idx)
+        if got_idx != want_idx:
+            dangling = set(got_idx) - set(want_idx)
+            missing = set(want_idx) - set(got_idx)
+            raise WorkloadFailed(
+                f"index diverged: {len(dangling)} dangling, "
+                f"{len(missing)} missing"
+            )
