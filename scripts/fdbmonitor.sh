@@ -1,0 +1,57 @@
+#!/bin/bash
+# fdbmonitor analogue: launch every role of a cluster spec and RESTART any
+# process that exits (reference: fdbmonitor supervises fdbserver processes
+# from foundationdb.conf; `fdbcli> kill` bounces a process through it).
+#
+#   scripts/fdbmonitor.sh CLUSTER_DIR
+#
+# CLUSTER_DIR must contain cluster.json (as written by start_cluster.sh).
+# If CLUSTER_DIR/data exists, every role gets a durable --data-dir under
+# it, so restarts reload tlog disk queues / storage sqlite state.
+# Stop everything with: touch CLUSTER_DIR/stop
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR="${1:?usage: fdbmonitor.sh CLUSTER_DIR}"
+SPEC="$DIR/cluster.json"
+[ -f "$SPEC" ] || { echo "no $SPEC" >&2; exit 1; }
+rm -f "$DIR/stop"
+
+supervise() { # role index
+  local role=$1 idx=$2
+  while [ ! -e "$DIR/stop" ]; do
+    local data_args=()
+    if [ -d "$DIR/data" ]; then
+      mkdir -p "$DIR/data/$role$idx"
+      data_args=(--data-dir "$DIR/data/$role$idx")
+    fi
+    JAX_PLATFORMS=cpu python -m foundationdb_tpu.server \
+      --cluster "$SPEC" --role "$role" --index "$idx" \
+      --trace-dir "$DIR/traces" "${data_args[@]}" \
+      >> "$DIR/$role$idx.log" 2>&1 || true
+    [ -e "$DIR/stop" ] && break
+    echo "$(date +%H:%M:%S) $role$idx exited — restarting in 1s" \
+      >> "$DIR/monitor.log"
+    sleep 1
+  done
+}
+
+ROLES=$(python - "$SPEC" <<'EOF'
+import json, sys
+spec = json.load(open(sys.argv[1]))
+for role, addrs in spec.items():
+    if isinstance(addrs, list):
+        for i in range(len(addrs)):
+            print(role, i)
+EOF
+)
+
+n=0
+while read -r role idx; do
+  [ -z "$role" ] && continue
+  supervise "$role" "$idx" &
+  n=$((n + 1))
+done <<< "$ROLES"
+
+echo "fdbmonitor supervising $n role processes; touch $DIR/stop to end"
+wait
