@@ -1,0 +1,157 @@
+"""TaskBucket: a transactional work queue in the keyspace.
+
+Reference: fdbclient/TaskBucket.actor.cpp — the queue the reference's
+backup/restore agents coordinate through: tasks are rows, execution
+leases are versionstamped claims, finished tasks are removed
+transactionally, and a crashed executor's lease simply expires so
+another claims the task. Same semantics here, pythonic surface:
+
+    tb = TaskBucket(Subspace(("tb",)))
+    await tb.add(db, {"type": "copy", "begin": "a"})
+    task = await tb.claim(db, lease=5.0)      # None if queue empty
+    ... do the work ...
+    await tb.finish(db, task)                 # or let the lease expire
+
+Keys:
+    <ss>/avail/<10-byte versionstamp>      = packed params (FIFO order)
+    <ss>/leased/<deadline_be>/<same stamp> = packed params
+
+Claim moves the FIRST available task into the leased set with a
+deadline; expired leases are recovered by the next claimer (the
+reference's timeout extension/requeue). All moves are single
+transactions — two executors can never hold the same task, and a
+crash between claim and finish loses nothing.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.core.types import strinc
+from foundationdb_tpu.layers.tuple_layer import Subspace, pack, unpack
+
+_AVAIL = b"avail/"
+_LEASED = b"leased/"
+
+
+class Task:
+    __slots__ = ("stamp", "params", "lease_key")
+
+    def __init__(self, stamp: bytes, params: dict, lease_key: bytes):
+        self.stamp = stamp
+        self.params = params
+        self.lease_key = lease_key
+
+    def __repr__(self) -> str:
+        return f"Task({self.stamp.hex()}, {self.params})"
+
+
+def _pack_params(params: dict) -> bytes:
+    return pack(tuple(x for kv in sorted(params.items()) for x in kv))
+
+
+def _unpack_params(blob: bytes) -> dict:
+    flat = unpack(blob)
+    return {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+
+
+class TaskBucket:
+    def __init__(self, subspace: Subspace):
+        self.ss = subspace
+
+    def _avail_prefix(self) -> bytes:
+        return self.ss.key() + _AVAIL
+
+    def _leased_prefix(self) -> bytes:
+        return self.ss.key() + _LEASED
+
+    async def add(self, db, params: dict) -> None:
+        """Enqueue (FIFO by commit order: the key is versionstamped)."""
+
+        async def body(tr):
+            tr.atomic_op(
+                MutationType.SET_VERSIONSTAMPED_KEY,
+                self._avail_prefix() + b"\x00" * 10
+                + struct.pack("<I", len(self._avail_prefix())),
+                _pack_params(params),
+            )
+
+        await db.run(body)
+
+    async def claim(self, db, lease: float = 5.0):
+        """Claim the oldest task (or a task whose lease expired): moves it
+        into the leased set under now+lease. Returns Task or None."""
+
+        async def body(tr):
+            # Clock INSIDE the attempt: a conflict-retried claim must not
+            # grant a lease computed from a pre-backoff timestamp (it
+            # could be born expired) nor miss leases that expired during
+            # the backoff.
+            now = db.loop.now
+            # 1. expired lease? (deadline sorts first)
+            lp = self._leased_prefix()
+            rows = await tr.get_range(lp, strinc(lp), limit=1)
+            if rows:
+                key, blob = rows[0]
+                deadline = struct.unpack(">d", key[len(lp):len(lp) + 8])[0]
+                if deadline <= now:
+                    stamp = key[len(lp) + 8:]
+                    tr.clear(key)
+                    new_key = (lp + struct.pack(">d", now + lease) + stamp)
+                    tr.set(new_key, blob)
+                    return Task(stamp, _unpack_params(blob), new_key)
+            # 2. oldest available
+            ap = self._avail_prefix()
+            rows = await tr.get_range(ap, strinc(ap), limit=1)
+            if not rows:
+                return None
+            key, blob = rows[0]
+            stamp = key[len(ap):]
+            tr.clear(key)
+            new_key = lp + struct.pack(">d", now + lease) + stamp
+            tr.set(new_key, blob)
+            return Task(stamp, _unpack_params(blob), new_key)
+
+        return await db.run(body)
+
+    async def extend(self, db, task, lease: float = 5.0):
+        """Push the task's deadline out (the reference's saveAndExtend):
+        returns the refreshed Task, or None if the lease was lost."""
+
+        async def body(tr):
+            now = db.loop.now  # per attempt (see claim)
+            blob = await tr.get(task.lease_key)
+            if blob is None:
+                return None  # lost: expired and reclaimed (or finished)
+            tr.clear(task.lease_key)
+            new_key = (self._leased_prefix()
+                       + struct.pack(">d", now + lease) + task.stamp)
+            tr.set(new_key, blob)
+            return Task(task.stamp, task.params, new_key)
+
+        return await db.run(body)
+
+    async def finish(self, db, task) -> bool:
+        """Remove a completed task. False if the lease had already been
+        lost (another executor may re-run it — tasks must be idempotent,
+        exactly the reference's contract)."""
+
+        async def body(tr):
+            if await tr.get(task.lease_key) is None:
+                return False
+            tr.clear(task.lease_key)
+            return True
+
+        return await db.run(body)
+
+    async def counts(self, db) -> tuple[int, int]:
+        """(available, leased) — monitoring."""
+
+        async def body(tr):
+            ap, lp = self._avail_prefix(), self._leased_prefix()
+            a = await tr.get_range(ap, strinc(ap))
+            le = await tr.get_range(lp, strinc(lp))
+            return len(a), len(le)
+
+        return await db.run(body)

@@ -53,5 +53,18 @@ while read -r role idx; do
   n=$((n + 1))
 done <<< "$ROLES"
 
-echo "fdbmonitor supervising $n role processes; touch $DIR/stop to end"
+echo $$ > "$DIR/monitor.pid"
+echo "fdbmonitor supervising $n role processes"
+echo "stop with: touch $DIR/stop && python -m foundationdb_tpu.cli --cluster $SPEC --exec 'kill ...' (or kill the pids in $DIR/pids)"
+
+# Track child server pids so stop actually terminates them: the stop file
+# gates RESTARTS; the running servers must be told to exit.
+( while [ ! -e "$DIR/stop" ]; do
+    pgrep -f "foundationdb_tpu.server --cluster $SPEC" > "$DIR/pids" 2>/dev/null || true
+    sleep 1
+  done
+  # stop requested: kill the current server processes; supervise loops
+  # see the stop file and do not relaunch.
+  pkill -f "foundationdb_tpu.server --cluster $SPEC" 2>/dev/null || true
+) &
 wait

@@ -1,0 +1,92 @@
+"""TaskBucket: transactional work queue (reference: TaskBucket.actor.cpp
+semantics — versionstamped FIFO, leases, expiry requeue, idempotent
+finish)."""
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.layers.taskbucket import TaskBucket
+from foundationdb_tpu.layers.tuple_layer import Subspace
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_db(seed=0, **kw):
+    kw.setdefault("n_storages", 2)
+    c = SimCluster(seed=seed, **kw)
+    return c, open_database(c)
+
+
+def test_fifo_claim_finish():
+    c, db = make_db(seed=1)
+    tb = TaskBucket(Subspace(("tb",)))
+
+    async def main():
+        for i in range(3):
+            await tb.add(db, {b"n": i})
+        assert await tb.counts(db) == (3, 0)
+        t1 = await tb.claim(db)
+        assert t1.params[b"n"] == 0  # FIFO by commit order
+        t2 = await tb.claim(db)
+        assert t2.params[b"n"] == 1
+        assert await tb.counts(db) == (1, 2)
+        assert await tb.finish(db, t1)
+        assert await tb.finish(db, t2)
+        t3 = await tb.claim(db)
+        assert t3.params[b"n"] == 2
+        assert await tb.claim(db) is None  # empty
+        assert await tb.finish(db, t3)
+        assert await tb.counts(db) == (0, 0)
+        return "ok"
+
+    assert c.loop.run(main(), timeout=120) == "ok"
+
+
+def test_lease_expiry_requeues_and_finish_races():
+    c, db = make_db(seed=2)
+    tb = TaskBucket(Subspace(("tb2",)))
+
+    async def main():
+        await tb.add(db, {b"job": b"x"})
+        t1 = await tb.claim(db, lease=1.0)  # executor A
+        # A stalls past its lease; B reclaims the SAME task.
+        await c.loop.sleep(1.5)
+        t2 = await tb.claim(db, lease=5.0)
+        assert t2 is not None and t2.stamp == t1.stamp
+        # A's stale handle can no longer finish or extend.
+        assert not await tb.finish(db, t1)
+        assert await tb.extend(db, t1) is None
+        # B extends, then finishes.
+        t2b = await tb.extend(db, t2, lease=5.0)
+        assert t2b is not None
+        assert await tb.finish(db, t2b)
+        assert await tb.counts(db) == (0, 0)
+        return "ok"
+
+    assert c.loop.run(main(), timeout=120) == "ok"
+
+
+def test_concurrent_claimers_never_share_a_task():
+    c, db = make_db(seed=3)
+    tb = TaskBucket(Subspace(("tb3",)))
+
+    async def main():
+        for i in range(8):
+            await tb.add(db, {b"n": i})
+        got: list[int] = []
+
+        async def worker(wid: int):
+            while True:
+                t = await tb.claim(db, lease=10.0)
+                if t is None:
+                    return
+                got.append(t.params[b"n"])
+                await c.loop.sleep(0.05)
+                assert await tb.finish(db, t)
+
+        from foundationdb_tpu.runtime.flow import all_of
+
+        await all_of([
+            c.loop.spawn(worker(w), name=f"tb.worker{w}") for w in range(3)
+        ])
+        assert sorted(got) == list(range(8))  # each task exactly once
+        return "ok"
+
+    assert c.loop.run(main(), timeout=120) == "ok"
