@@ -39,6 +39,7 @@ from foundationdb_tpu.core.types import (
 SPECIAL_KEY_PREFIX = b"\xff\xff"
 STATUS_JSON_KEY = b"\xff\xff/status/json"
 CONFLICTING_KEYS_PREFIX = b"\xff\xff/transaction/conflicting_keys/"
+WORKER_INTERFACES_PREFIX = b"\xff\xff/worker_interfaces/"
 from foundationdb_tpu.core.errors import (
     KeyOutsideLegalRange,
     KeyTooLarge,
@@ -404,7 +405,38 @@ class Transaction:
                 if k == key:
                     return v
             return None
+        if key.startswith(WORKER_INTERFACES_PREFIX):
+            for k, v in self._worker_interface_rows():
+                if k == key:
+                    return v
+            return None
         return None
+
+    def _worker_interface_rows(self) -> list[tuple[bytes, bytes]]:
+        """\xff\xff/worker_interfaces/<process> rows (reference: the
+        module fdbcli uses for process discovery/kill): one row per live
+        generation process plus persistent storages, valued with a small
+        JSON of role info."""
+        import json
+
+        cluster = self.db.cluster
+        if cluster is None:
+            return []
+        rows: list[tuple[bytes, bytes]] = []
+        dead = cluster.loop.dead_processes
+        gen = cluster.controller.generation
+        procs: dict[str, str] = {p: "generation" for p in gen.heartbeat_eps}
+        for i in range(len(cluster.storages)):
+            procs.setdefault(f"storage{i}", "storage")
+        for p in sorted(procs):
+            if p in dead:
+                continue
+            rows.append((
+                WORKER_INTERFACES_PREFIX + p.encode(),
+                json.dumps({"process": p, "class": procs[p],
+                            "epoch": gen.epoch}).encode(),
+            ))
+        return rows
 
     def _conflicting_rows(self) -> list[tuple[bytes, bytes]]:
         """\\xff\\xff/transaction/conflicting_keys/ rows from the last
@@ -436,10 +468,10 @@ class Transaction:
         when the limit truncates the scan (reference: getRange conflict-range
         trimming in NativeAPI)."""
         if begin.startswith(SPECIAL_KEY_PREFIX):
-            rows = [
-                (k, v) for k, v in self._conflicting_rows()
-                if begin <= k < end
-            ]
+            synthetic = self._conflicting_rows() + self._worker_interface_rows()
+            rows = sorted(
+                (k, v) for k, v in synthetic if begin <= k < end
+            )
             if reverse:
                 rows.reverse()
             return rows[:limit] if limit > 0 else rows

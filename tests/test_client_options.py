@@ -184,3 +184,28 @@ def test_get_approximate_size():
         return "ok"
 
     assert c.loop.run(main(), timeout=60) == "ok"
+
+
+def test_worker_interfaces_special_keys():
+    """\\xff\\xff/worker_interfaces/ lists live processes (reference: the
+    special-key module fdbcli's kill uses for discovery)."""
+    import json
+
+    c, db = make_db(seed=9)
+
+    async def main():
+        tr = db.transaction()
+        rows = await tr.get_range(b"\xff\xff/worker_interfaces/",
+                                  b"\xff\xff/worker_interfaces0")
+        procs = [k.split(b"/")[-1].decode() for k, _ in rows]
+        assert "master" in procs and "storage0" in procs, procs
+        info = json.loads(rows[0][1])
+        assert info["epoch"] == 1
+        # a killed process drops out
+        c.net.kill("storage1")
+        rows2 = await tr.get_range(b"\xff\xff/worker_interfaces/",
+                                   b"\xff\xff/worker_interfaces0")
+        assert b"\xff\xff/worker_interfaces/storage1" not in [k for k, _ in rows2]
+        return "ok"
+
+    assert c.loop.run(main(), timeout=60) == "ok"
