@@ -29,6 +29,7 @@ is ~5-7M versions, far inside int32; the host rebases periodically).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -42,9 +43,18 @@ from foundationdb_tpu.ops.lex import (
     searchsorted_words,
     sort_keys_with_payload,
 )
-from foundationdb_tpu.ops.rmq import range_max, sparse_table
+from foundationdb_tpu.ops.rmq import (
+    block_table,
+    range_max,
+    range_max_blocked,
+    sparse_table,
+)
 
 NEG_VERSION = -(2**31) + 1
+
+# History RMQ implementation: "sparse" (default) | "blocked". Read once at
+# import — flipping it mid-process would silently split jit caches.
+_RMQ_DESIGN = os.environ.get("FDB_TPU_RMQ", "sparse")
 
 # Verdict encoding (core.types.Verdict values, as device int8).
 V_COMMITTED = 0
@@ -97,19 +107,27 @@ def init_state(capacity: int, width: int, min_key) -> ConflictState:
 def _history_conflicts(state: ConflictState, batch: BatchTensors) -> jax.Array:
     """bool [B]: some read range overlaps a historical write newer than rv."""
     b, r, w = batch.read_begin.shape
-    # Sparse-table RMQ. The blocked two-level alternative (ops/rmq.py
-    # block_table) wins its ISOLATED build+query A/B 3.5x on CPU-XLA but
-    # regressed the FULL kernel 27% there (fusion effects) — production
-    # stays on the sparse table until scripts/tpu_diag.py's on-chip A/B
-    # ranks them on the real target.
-    st = sparse_table(state.versions)
     rb = batch.read_begin.reshape(b * r, w)
     re_ = batch.read_end.reshape(b * r, w)
     # Segments [lo, hi) intersect [rb, re): lo = segment containing rb,
     # hi = first segment starting at/after re.
     lo = searchsorted_words(state.keys, rb, side="right") - 1
     hi = searchsorted_words(state.keys, re_, side="left")
-    newest = range_max(st, jnp.maximum(lo, 0), hi, NEG_VERSION).reshape(b, r)
+    # RMQ design: sparse table by default. The blocked two-level
+    # alternative wins its ISOLATED build+query A/B 3.5x on CPU-XLA but
+    # regressed the FULL kernel 27% there (fusion effects) — production
+    # stays on the sparse table; FDB_TPU_RMQ=blocked flips it so the
+    # auto-bench can rank both at full-kernel level on the real chip.
+    if _RMQ_DESIGN == "blocked":
+        bt = block_table(state.versions, NEG_VERSION)
+        newest = range_max_blocked(
+            bt, jnp.maximum(lo, 0), hi, NEG_VERSION
+        ).reshape(b, r)
+    else:
+        st = sparse_table(state.versions)
+        newest = range_max(
+            st, jnp.maximum(lo, 0), hi, NEG_VERSION
+        ).reshape(b, r)
     nonempty = lex_lt(batch.read_begin, batch.read_end)
     live = batch.read_mask & nonempty
     conflict = live & (newest > batch.read_version[:, None])
