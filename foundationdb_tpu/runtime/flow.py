@@ -182,6 +182,10 @@ class Loop:
         # code. Per-site activation is decided once per run from the
         # seeded RNG, so a failing seed replays identically.
         self.buggify_enabled = False
+        # Aggressive mode (campaign --buggify-aggressive; TOML
+        # buggifyAggressive = true): every site is ACTIVE and fires at
+        # >= 50% — the maximum-perturbation schedule.
+        self.buggify_aggressive = False
         self._buggify_sites: dict[str, bool] = {}
 
     def buggify(self, site: str, activate_p: float = 0.25,
@@ -194,6 +198,8 @@ class Loop:
         deterministic under the run's seed."""
         if not self.buggify_enabled:
             return False
+        if self.buggify_aggressive:
+            return self.rng.random() < max(fire_p, 0.5)
         active = self._buggify_sites.get(site)
         if active is None:
             active = self._buggify_sites[site] = self.rng.random() < activate_p

@@ -27,7 +27,9 @@ from concurrent.futures import ProcessPoolExecutor, as_completed
 
 
 def run_one(spec_path: str, seed: int, buggify: bool,
-            clog: float | None) -> tuple[str, int, list[tuple[str, bool, str]]]:
+            clog: float | None,
+            aggressive: bool = False,
+            ) -> tuple[str, int, list[tuple[str, bool, str]]]:
     """Run every [[test]] of one spec file at one seed in THIS process.
     Returns (spec_path, seed, [(title, ok, detail), ...])."""
     from foundationdb_tpu.client.ryw import open_database
@@ -40,6 +42,9 @@ def run_one(spec_path: str, seed: int, buggify: bool,
     for spec in load_spec(spec_path):
         if buggify:
             spec.buggify = True
+        if aggressive:
+            spec.buggify = True
+            spec.buggify_aggressive = True
         if clog is not None and spec.clog_interval is None:
             spec.clog_interval = clog
         c = SimCluster(seed=seed, **cluster_kwargs(spec))
@@ -83,6 +88,9 @@ def main(argv: list[str] | None = None) -> int:
                          "--seeds 1 --seed-base SEED)")
     ap.add_argument("--buggify", action="store_true",
                     help="arm in-role BUGGIFY sites in every test")
+    ap.add_argument("--buggify-aggressive", action="store_true",
+                    help="every BUGGIFY site active, firing >= 50% "
+                         "(maximum perturbation; implies --buggify)")
     ap.add_argument("--clog", type=float, default=None, metavar="INTERVAL",
                     help="add slow-link clogging at this mean interval (s)")
     ap.add_argument("--jobs", type=int, default=min(8, os.cpu_count() or 1))
@@ -97,7 +105,8 @@ def main(argv: list[str] | None = None) -> int:
     done = 0
     with ProcessPoolExecutor(max_workers=args.jobs) as pool:
         futs = {
-            pool.submit(run_one, f, seed, args.buggify, args.clog): (f, seed)
+            pool.submit(run_one, f, seed, args.buggify, args.clog,
+                        args.buggify_aggressive): (f, seed)
             for f, seed in jobs
         }
         for fut in as_completed(futs):
@@ -120,6 +129,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"\n{len(failures)} FAILURES:", flush=True)
         for f, seed, title, detail in failures:
             flags = " --buggify" if args.buggify else ""
+            if args.buggify_aggressive:
+                flags += " --buggify-aggressive"
             if args.clog is not None:
                 flags += f" --clog {args.clog}"
             print(f"--- {f}:{title} seed={seed}\n{detail}\n"

@@ -158,6 +158,7 @@ class TestSpec:
     include_controller: bool = False
     clog_interval: float | None = None  # slow-but-alive link injection
     buggify: bool = False  # enable in-role BUGGIFY sites for this test
+    buggify_aggressive: bool = False  # every site active, fire >= 50%
     # [test.cluster] table: tests needing a non-default cluster (e.g. the
     # DataDistributor for DDBalance) declare it; the runner builds a fresh
     # SimCluster with these kwargs for that test only.
@@ -215,6 +216,7 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             include_controller=test.get("killController", False),
             clog_interval=test.get("clogInterval"),
             buggify=test.get("buggify", False),
+            buggify_aggressive=test.get("buggifyAggressive", False),
             cluster_opts=cluster_opts,
         ))
     return specs
@@ -224,8 +226,9 @@ async def run_spec_test(spec: TestSpec, cluster, db) -> SpecResult:
     """setup all → run all CONCURRENTLY (± faults) → quiesce → check all —
     the reference's multi-workload test execution order."""
     result = SpecResult(spec.title)
-    if spec.buggify:
+    if spec.buggify or spec.buggify_aggressive:
         cluster.loop.buggify_enabled = True
+        cluster.loop.buggify_aggressive = spec.buggify_aggressive
     for w in spec.workloads:
         await w.setup(db)
     faults = None
