@@ -78,6 +78,7 @@ clearrange BEGIN END    clear a range (requires `writemode on`)
 writemode on|off        allow/forbid mutations (fdbcli semantics)
 throttle tag NAME TPS   cap transactions carrying tag NAME at TPS
 unthrottle tag NAME     clear a tag quota
+watch KEY [T]           block until KEY changes (default 30s timeout)
 kill ROLEN              ask a server process to exit (fdbcli kill)
 status                  cluster role metrics (JSON)
 help                    this text
@@ -167,6 +168,24 @@ class Shell:
             tps = float(args[2]) if cmd == "throttle" else None
             self._await(ep.set_tag_quota(args[1], tps))
             return ("Throttled" if tps is not None else "Unthrottled")
+        if cmd == "watch":
+            # fdbcli `watch` analogue: block until the key's value changes
+            # (or a timeout passes), then report.
+            if not 1 <= len(args) <= 2:
+                return "usage: watch KEY [TIMEOUT_S]"
+            timeout_s = float(args[1]) if len(args) > 1 else 30.0
+
+            async def go():
+                tr = self.db.transaction()
+                fut = await tr.watch(unescape(args[0]))
+                await tr.commit()
+                return await fut
+
+            try:
+                self._await(go(), timeout=timeout_s)
+            except TimeoutError:
+                return f"watch: no change within {timeout_s:.0f}s"
+            return f"watch fired: `{args[0]}' changed"
         if cmd == "kill":
             # fdbcli `kill` analogue: ask a server process to exit (the
             # operator's supervisor — scripts/start_cluster.sh, systemd,
