@@ -212,6 +212,13 @@ class Database:
         else:
             self.clear(key)
 
+    def open_tenant(self, name: bytes) -> "TenantFacade":
+        """Reference: db.open_tenant — a handle whose transactions are
+        confined to the named tenant's keyspace."""
+        from foundationdb_tpu.client.tenant import Tenant as _Tenant
+
+        return TenantFacade(self, _Tenant(self._db, name))
+
     def close(self) -> None:
         t = getattr(self, "_transport", None)
         if t is not None:
@@ -397,6 +404,51 @@ class _SnapshotView:
         if isinstance(key, slice):
             return self.get_range(key.start or b"", key.stop or b"\xff")
         return self.get(key)
+
+
+class TenantFacade:
+    """Blocking tenant handle (reference: fdb.Tenant): create
+    transactions and run @transactional-style bodies inside the tenant."""
+
+    def __init__(self, dbf: Database, tenant):
+        self._dbf = dbf
+        self._tenant = tenant
+
+    def create_transaction(self) -> "Transaction":
+        self._dbf._block(self._tenant._resolve())
+        return Transaction(self._dbf, self._tenant.transaction())
+
+    def __getitem__(self, key):
+        tr = self.create_transaction()
+        v = tr[key]
+        return v
+
+    def __setitem__(self, key: bytes, value: bytes) -> None:
+        tr = self.create_transaction()
+        tr[key] = value
+        tr.commit()
+
+
+class tenant_management:
+    """Reference: fdb.tenant_management module surface."""
+
+    @staticmethod
+    def create_tenant(db: Database, name: bytes) -> None:
+        from foundationdb_tpu.client.tenant import create_tenant
+
+        db._block(create_tenant(db._db, name))
+
+    @staticmethod
+    def delete_tenant(db: Database, name: bytes) -> None:
+        from foundationdb_tpu.client.tenant import delete_tenant
+
+        db._block(delete_tenant(db._db, name))
+
+    @staticmethod
+    def list_tenants(db: Database) -> list:
+        from foundationdb_tpu.client.tenant import list_tenants
+
+        return db._block(list_tenants(db._db))
 
 
 class _TransactionOptions:

@@ -186,3 +186,17 @@ def test_snapshot_view_and_streaming_mode(fdb, db):
     db[fdb.tuple.pack(("tt", 1))] = b"a"
     assert len(db.create_transaction()[fdb.tuple.range(("tt",))]) == 1
     fdb.options.set_trace_enable("/tmp")
+
+
+def test_tenant_surface(fdb, db):
+    """db.open_tenant + fdb.tenant_management (reference binding shape)."""
+    fdb.tenant_management.create_tenant(db, b"shop")
+    t = db.open_tenant(b"shop")
+    t[b"sku/1"] = b"widget"
+    assert t[b"sku/1"] == b"widget"
+    assert db[b"sku/1"] is None  # invisible outside the tenant
+    tr = t.create_transaction()
+    tr[b"sku/2"] = b"gadget"
+    tr.commit()
+    assert t[b"sku/2"] == b"gadget"
+    assert fdb.tenant_management.list_tenants(db) == [b"shop"]
