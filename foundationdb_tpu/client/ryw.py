@@ -32,6 +32,20 @@ class RYWTransaction(Transaction):
         self._overlay: dict[bytes, tuple[str, object]] = {}
         self._clears: list[KeyRange] = []
 
+    def set_option(self, name: str, value=None) -> None:
+        # Reference option 51: reads see only the snapshot, never this
+        # transaction's own writes (apps use it to audit pre-txn state
+        # and to skip the overlay bookkeeping). Like the reference, it
+        # must be set before the transaction reads or writes.
+        if name == "read_your_writes_disable":
+            if self._overlay or self.mutations or self._read_version is not None:
+                raise FdbError(
+                    "read_your_writes_disable must be set before any "
+                    "read or write", code=2006)
+            self.ryw_disabled = True
+            return
+        super().set_option(name, value)
+
     # -- write path: maintain the overlay -------------------------------------
 
     def set(self, key: bytes, value: bytes) -> None:
@@ -76,6 +90,8 @@ class RYWTransaction(Transaction):
     # -- read path: overlay over snapshot --------------------------------------
 
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        if getattr(self, "ryw_disabled", False):
+            return await super().get(key, snapshot)
         kind, entry = self._overlay.get(key, (None, None))
         if kind == "value":
             return entry  # known locally: no storage read, no conflict range
@@ -131,6 +147,8 @@ class RYWTransaction(Transaction):
         reverse: bool = False,
         snapshot: bool = False,
     ) -> list[tuple[bytes, bytes]]:
+        if getattr(self, "ryw_disabled", False):
+            return await super().get_range(begin, end, limit, reverse, snapshot)
         if limit <= 0:
             base = dict(
                 await super().get_range(begin, end, 0, reverse, snapshot)

@@ -472,6 +472,9 @@ class _TransactionOptions:
     def set_report_conflicting_keys(self) -> None:
         self._tr.set_option("report_conflicting_keys")
 
+    def set_read_your_writes_disable(self) -> None:
+        self._tr.set_option("read_your_writes_disable")
+
     def set_tag(self, tag: str) -> None:
         self._tr.set_option("tag", tag)
 

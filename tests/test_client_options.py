@@ -209,3 +209,33 @@ def test_worker_interfaces_special_keys():
         return "ok"
 
     assert c.loop.run(main(), timeout=60) == "ok"
+
+
+def test_read_your_writes_disable():
+    """Reference option 51: reads see the snapshot only, never this
+    transaction's own writes; must be set before any read/write."""
+    c, db = make_db(seed=10)
+
+    async def main():
+        async def seed_data(tr):
+            tr.set(b"r/1", b"old")
+
+        await db.run(seed_data)
+        tr = db.transaction()
+        tr.set_option("read_your_writes_disable")
+        tr.set(b"r/1", b"new")
+        tr.set(b"r/2", b"added")
+        assert await tr.get(b"r/1") == b"old"  # snapshot, not own write
+        assert await tr.get(b"r/2") is None
+        rows = await tr.get_range(b"r/", b"r0")
+        assert rows == [(b"r/1", b"old")]
+        await tr.commit()
+        assert await db.transaction().get(b"r/1") == b"new"  # writes land
+        # Too late once the txn has state:
+        tr2 = db.transaction()
+        await tr2.get(b"r/1")
+        with pytest.raises(FdbError):
+            tr2.set_option("read_your_writes_disable")
+        return "ok"
+
+    assert c.loop.run(main(), timeout=60) == "ok"
