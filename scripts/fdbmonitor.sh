@@ -8,6 +8,15 @@
 # CLUSTER_DIR must contain cluster.json (as written by start_cluster.sh).
 # If CLUSTER_DIR/data exists, every role gets a durable --data-dir under
 # it, so restarts reload tlog disk queues / storage sqlite state.
+#
+# Scope (static wiring v1, see server.py): a restarted STORAGE rejoins
+# live (it re-pulls its tag from the tlogs). Chain roles (sequencer/
+# resolver/tlog/proxy) cannot rejoin a running chain without a recovery,
+# which the static deployment does not run — after bouncing one of
+# those, bounce the WHOLE cluster (touch stop; restart fdbmonitor) to
+# re-form the chain from durable state. Failure/recovery semantics are
+# exercised in the simulator, as in the reference's simulation-first
+# methodology.
 # Stop everything with: touch CLUSTER_DIR/stop
 set -euo pipefail
 cd "$(dirname "$0")/.."
