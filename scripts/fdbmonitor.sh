@@ -11,12 +11,12 @@
 #
 # Scope (static wiring v1, see server.py): a restarted STORAGE rejoins
 # live (it re-pulls its tag from the tlogs). Chain roles (sequencer/
-# resolver/tlog/proxy) cannot rejoin a running chain without a recovery,
-# which the static deployment does not run — after bouncing one of
-# those, bounce the WHOLE cluster (touch stop; restart fdbmonitor) to
-# re-form the chain from durable state. Failure/recovery semantics are
-# exercised in the simulator, as in the reference's simulation-first
-# methodology.
+# resolver/tlog/proxy) cannot rejoin a running chain — rejoining needs
+# the recovery machinery (epoch jump, lock, salvage), which lives in
+# the simulator (sim/cluster.py restarts durable clusters correctly)
+# and is not wired into the static deployment; a deployed bounce starts
+# a FRESH database. Use the sim for failure/recovery semantics and
+# backup_tool snapshot/restore to carry deployed data across bounces.
 # Stop everything with: touch CLUSTER_DIR/stop
 set -euo pipefail
 cd "$(dirname "$0")/.."
