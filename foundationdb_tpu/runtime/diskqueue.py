@@ -19,14 +19,17 @@ _HDR = struct.Struct("<II")  # payload length, crc32
 
 
 class DiskQueue:
-    def __init__(self, path: str):
+    def __init__(self, path: str, preserve: bool = False):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # Truncate on create: every queue belongs to exactly one brand-new
-        # tlog generation. A leftover same-named file (crash between queue
-        # creation and the cluster-meta swap, then a same-epoch re-recruit)
-        # must not get a second seed appended onto its stale contents.
-        self._f = open(path, "wb")
+        # Truncate on create by default: every queue belongs to exactly one
+        # brand-new tlog generation. A leftover same-named file (crash
+        # between queue creation and the cluster-meta swap, then a
+        # same-epoch re-recruit) must not get a second seed appended onto
+        # its stale contents. preserve=True (deployed restart resuming the
+        # SAME chain, TLog.from_disk) appends instead — truncating there
+        # would open a crash window that loses every acked commit.
+        self._f = open(path, "ab" if preserve else "wb")
 
     def append(self, record: object) -> None:
         payload = pickle.dumps(record)

@@ -29,6 +29,18 @@ class Resolver:
         self.txns_resolved = 0
 
     @rpc
+    async def begin_epoch(self, start_version: int) -> int:
+        """Deployed-restart handshake (see tlog.begin_epoch): adopt the
+        booting sequencer's chain start so the first batch's prev_version
+        matches. Monotone; parked batches wake to observe the jump."""
+        if start_version > self._version:
+            self._version = start_version
+            for p in list(self._waiters.values()):
+                p.send(None)
+            self._waiters.clear()
+        return self._version
+
+    @rpc
     async def resolve(
         self,
         prev_version: int,

@@ -45,6 +45,7 @@ class TLog:
         seed: list[tuple[int, dict[int, list[Mutation]]]] | None = None,
         retired_tags: set[int] | None = None,
         disk_path: str | None = None,
+        disk_preserved: bool = False,
     ):
         """`seed`: prior-generation entries salvaged by recovery (versions
         all < init_version); storage servers finish pulling them from this
@@ -59,8 +60,10 @@ class TLog:
         if disk_path is not None:
             from foundationdb_tpu.runtime.diskqueue import DiskQueue
 
-            self.disk = DiskQueue(disk_path)
-            if seed:  # salvaged entries must be durable in OUR file too
+            self.disk = DiskQueue(disk_path, preserve=disk_preserved)
+            if seed and not disk_preserved:
+                # salvaged entries must be durable in OUR file too (when
+                # preserved, the seed IS the file's recovered content)
                 for v, t in seed:
                     self.disk.append((v, t))
                 self.disk.fsync()
@@ -70,6 +73,11 @@ class TLog:
         # whole log there would be O(queue) exactly when the queue is huge).
         self._queue_bytes = sum(e.nbytes for e in self._log)
         self._version = init_version  # end of applied chain
+        # True end of the APPENDED chain: duplicates are judged against
+        # this, never against epoch jumps (begin_epoch raises _version
+        # without appending — a parked push woken by the jump must fail
+        # the gap check, not false-ack as an already-durable duplicate).
+        self._last_appended = (seed[-1][0] if seed else 0)
         self._waiters: dict[int, Promise] = {}
         self._popped: dict[int, int] = {}  # tag -> trimmed-below version
         self._retired: set[int] = set(retired_tags or ())
@@ -82,6 +90,67 @@ class TLog:
         # reads this off peek replies to bound its MVCC GC floor: anything
         # above it may be an unacked suffix recovery could roll back.
         self.known_committed = 0
+
+    @classmethod
+    def from_disk(cls, loop: Loop, disk_path: str,
+                  retired_tags: set[int] | None = None) -> "TLog":
+        """Deployed restart: recover the disk queue's chain and resume
+        as this log's content (the sim instead salvages into FRESH tlogs
+        during recovery). init_version = last recovered version + 1; the
+        booting sequencer's begin_epoch() then jumps the chain start
+        safely above everything recovered."""
+        import os
+
+        from foundationdb_tpu.runtime.diskqueue import DiskQueue
+
+        entries = (DiskQueue.recover(disk_path)
+                   if os.path.exists(disk_path) else [])
+        last = entries[-1][0] if entries else 0
+        return cls(
+            loop,
+            init_version=last + 1 if entries else 0,
+            seed=entries,
+            retired_tags=retired_tags,
+            disk_path=disk_path,
+            disk_preserved=True,  # resume the SAME chain file: no truncate
+        )
+
+    @rpc
+    async def truncate_to(self, version: int) -> int:
+        """Deployed-restart suffix discipline: drop entries ABOVE
+        `version` (present on this log but not fsync'd by every peer —
+        the ack required ALL tlogs, so anything above the minimum
+        recovered end is unacked and must not be served; serving it
+        would apply a transaction on some shards and not others). The
+        disk file is rewritten through the tmp+rename path."""
+        before = len(self._log)
+        kept = [e for e in self._log if e.version <= version]
+        if len(kept) != before:
+            self._queue_bytes -= sum(
+                e.nbytes for e in self._log if e.version > version
+            )
+            self._log = kept
+            self._last_appended = kept[-1].version if kept else 0
+            self._version = min(self._version, version + 1)
+            if self.disk is not None:
+                self.disk.rewrite([(e.version, e.tagged) for e in self._log])
+        return before - len(self._log)
+
+    @rpc
+    async def begin_epoch(self, start_version: int) -> int:
+        """Deployed-restart handshake (static wiring; the sim's recovery
+        recruits fresh tlogs instead): the booting sequencer announces
+        the new chain's start version so the first push's prev_version
+        matches. Monotone and idempotent; stale parked pushes are woken
+        to observe the jump and fail out."""
+        if self.locked:
+            raise TLogLocked("begin_epoch after lock")
+        if start_version > self._version:
+            self._version = start_version
+            for p in list(self._waiters.values()):
+                p.send(None)
+            self._waiters.clear()
+        return self._version
 
     @rpc
     async def push(
@@ -96,7 +165,7 @@ class TLog:
         Idempotent under retransmit: a push whose version is already in the
         chain (its ack was lost to a partition) re-acks without re-appending."""
         while self._version != prev_version and not self.locked:
-            if version <= self._version:
+            if version <= self._last_appended:
                 return version  # duplicate of an already-durable batch
             if prev_version < self._version:
                 raise ValueError(
@@ -119,6 +188,7 @@ class TLog:
         self._queue_bytes += entry.nbytes
         self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
+        self._last_appended = version
         self.known_committed = max(self.known_committed, known_committed)
         w = self._waiters.pop(version, None)
         if w is not None:

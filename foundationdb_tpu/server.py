@@ -165,7 +165,42 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
     if role == "sequencer":
         from foundationdb_tpu.runtime.sequencer import Sequencer
 
-        t.serve("sequencer", Sequencer(loop))
+        async def boot_sequencer():
+            # Deployed durable restart: the static-wiring slice of the
+            # sim's recovery. Chain start derives from the MINIMUM
+            # recovered tlog end — an ack required every tlog's fsync, so
+            # entries above the minimum are an unacked suffix present on
+            # only some logs; serving them would apply a transaction on
+            # some shards and not others. Those suffixes are truncated,
+            # then every chain consumer (tlogs, resolvers) adopts the
+            # jumped start.
+            ends = []
+            for ep in eps("tlog"):
+                while True:
+                    try:
+                        ends.append(await ep.get_version())
+                        break
+                    except Exception:
+                        await loop.sleep(0.3)  # tlog not up yet
+            minv = min(ends) if ends else 0
+            if minv > 0:
+                # get_version reports last_entry+1 for a recovered log;
+                # entries strictly above minv-1 are the unacked suffix.
+                for ep in eps("tlog"):
+                    await ep.truncate_to(minv - 1)
+                seq = Sequencer(loop, epoch=2, recovery_version=minv)
+                for ep in eps("tlog") + eps("resolver"):
+                    while True:
+                        try:
+                            await ep.begin_epoch(seq.last_handed_out)
+                            break
+                        except Exception:
+                            await loop.sleep(0.3)
+            else:
+                seq = Sequencer(loop)
+            t.serve("sequencer", seq)
+
+        return loop.spawn(boot_sequencer(), name="sequencer.boot")
     elif role == "resolver":
         from foundationdb_tpu.runtime.resolver import Resolver
 
@@ -174,9 +209,11 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
     elif role == "tlog":
         from foundationdb_tpu.runtime.tlog import TLog
 
-        disk = (os.path.join(data_dir, f"tlog{index}.q")
-                if data_dir else None)
-        t.serve("tlog", TLog(loop, disk_path=disk))
+        if data_dir:
+            disk = os.path.join(data_dir, f"tlog{index}.q")
+            t.serve("tlog", TLog.from_disk(loop, disk))
+        else:
+            t.serve("tlog", TLog(loop))
     elif role == "storage":
         from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
         from foundationdb_tpu.runtime.storage import StorageServer

@@ -521,3 +521,59 @@ class TestWireFuzz:
         finally:
             server.close()
             client.close()
+
+
+class TestTLogRestartSemantics:
+    def test_from_disk_preserves_file_and_duplicate_discipline(self, tmp_path):
+        """Deployed-restart tlog semantics: from_disk resumes the SAME
+        chain file without truncating it; begin_epoch jumps never cause
+        false duplicate acks; truncate_to drops the unacked suffix."""
+        import os
+
+        from foundationdb_tpu.runtime.flow import Loop
+        from foundationdb_tpu.runtime.tlog import TLog
+
+        loop = Loop(seed=1)
+        p = str(tmp_path / "t.q")
+        t1 = TLog(loop, disk_path=p)
+
+        async def fill():
+            await t1.push(0, 10, {0: []})
+            await t1.push(10, 20, {0: []})
+            await t1.push(20, 30, {0: []})
+
+        loop.run(fill())
+        size_before = os.path.getsize(p)
+
+        # Restart from disk: file survives byte-for-byte (no truncate
+        # window), chain end recovered.
+        t2 = TLog.from_disk(loop, p)
+        assert os.path.getsize(p) == size_before
+        assert t2._last_appended == 30
+
+        async def scenario():
+            # Unacked suffix discipline: drop entries above 20.
+            dropped = await t2.truncate_to(20)
+            assert dropped == 1 and t2._last_appended == 20
+            # Epoch jump, then the new chain pushes.
+            start = await t2.begin_epoch(1_000_000)
+            assert start == 1_000_000
+            # A STALE push from before the jump must fail the gap check,
+            # not ack as a duplicate (it was never appended).
+            try:
+                await t2.push(25, 40, {0: []})
+                raise AssertionError("stale push falsely acked")
+            except ValueError:
+                pass
+            # A true retransmit of an appended version still acks.
+            assert await t2.push(10, 20, {0: []}) == 20
+            # The new chain proceeds.
+            assert await t2.push(1_000_000, 1_000_050, {0: []}) == 1_000_050
+
+        loop.run(scenario())
+
+        # Third incarnation: truncation + new pushes are on disk.
+        t3 = TLog.from_disk(loop, p)
+        assert t3._last_appended == 1_000_050
+        versions = [e.version for e in t3._log]
+        assert 30 not in versions and 1_000_050 in versions
