@@ -11,12 +11,14 @@
 #
 # Scope (static wiring v1, see server.py): a restarted STORAGE rejoins
 # live (it re-pulls its tag from the tlogs). Chain roles (sequencer/
-# resolver/tlog/proxy) cannot rejoin a running chain — rejoining needs
-# the recovery machinery (epoch jump, lock, salvage), which lives in
-# the simulator (sim/cluster.py restarts durable clusters correctly)
-# and is not wired into the static deployment; a deployed bounce starts
-# a FRESH database. Use the sim for failure/recovery semantics and
-# backup_tool snapshot/restore to carry deployed data across bounces.
+# resolver/tlog/proxy) cannot rejoin a RUNNING chain — after bouncing
+# one of those, bounce the WHOLE cluster. With data dirs, a full bounce
+# restores every acked commit: tlogs resume their disk-queue chains,
+# the booting sequencer truncates unacked suffixes to the minimum
+# recovered end and jump-starts a new epoch (server.py boot_sequencer;
+# driven end-to-end by tests/test_server.py TestDurableDeployedRestart).
+# Live failure/recovery semantics (no full bounce) stay the simulator's
+# domain, as in the reference's simulation-first methodology.
 # Stop everything with: touch CLUSTER_DIR/stop
 set -euo pipefail
 cd "$(dirname "$0")/.."
