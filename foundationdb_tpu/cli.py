@@ -78,6 +78,7 @@ clearrange BEGIN END    clear a range (requires `writemode on`)
 writemode on|off        allow/forbid mutations (fdbcli semantics)
 throttle tag NAME TPS   cap transactions carrying tag NAME at TPS
 unthrottle tag NAME     clear a tag quota
+getversion              current read version (fdbcli getversion)
 watch KEY [T]           block until KEY changes (default 30s timeout)
 kill ROLEN              ask a server process to exit (fdbcli kill)
 status                  cluster role metrics (JSON)
@@ -168,6 +169,11 @@ class Shell:
             tps = float(args[2]) if cmd == "throttle" else None
             self._await(ep.set_tag_quota(args[1], tps))
             return ("Throttled" if tps is not None else "Unthrottled")
+        if cmd == "getversion":
+            # fdbcli getversion: the current read version.
+            async def go():
+                return await self.db.transaction().get_read_version()
+            return str(self._await(go()))
         if cmd == "watch":
             # fdbcli `watch` analogue: block until the key's value changes
             # (or a timeout passes), then report.
