@@ -165,6 +165,12 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
     if role == "sequencer":
         from foundationdb_tpu.runtime.sequencer import Sequencer
 
+        if data_dir is None:
+            # Memory-only cluster: fresh chain at version 0, serve now
+            # (the restart sync below exists to reconcile durable state).
+            t.serve("sequencer", Sequencer(loop))
+            return None
+
         async def boot_sequencer():
             # Deployed durable restart: the static-wiring slice of the
             # sim's recovery. Chain start derives from the MINIMUM
@@ -175,12 +181,16 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             # then every chain consumer (tlogs, resolvers) adopts the
             # jumped start.
             ends = []
+            deadline = loop.now + 120.0
             for ep in eps("tlog"):
                 while True:
                     try:
                         ends.append(await ep.get_version())
                         break
                     except Exception:
+                        if loop.now > deadline:
+                            raise TimeoutError(
+                                "tlogs unreachable during restart sync")
                         await loop.sleep(0.3)  # tlog not up yet
             minv = min(ends) if ends else 0
             if minv > 0:
@@ -312,7 +322,12 @@ def main(argv: list[str] | None = None) -> None:
     tracer = Tracer(loop, trace_dir=args.trace_dir,
                     process=f"{args.role}{args.index}")
     t = NetTransport(loop, host=host, port=port)
-    build_role(loop, t, spec, args.role, args.index, args.data_dir)
+    boot = build_role(loop, t, spec, args.role, args.index, args.data_dir)
+    if boot is not None:
+        # The role defers serving behind a boot task (sequencer restart
+        # sync): the readiness line must not print until it serves, or
+        # supervisors/tests proceed against a process that cannot answer.
+        loop.run_until(boot, timeout=300)
 
     from foundationdb_tpu.runtime.flow import Promise
 
