@@ -380,6 +380,7 @@ class Transaction:
     # -- reads ----------------------------------------------------------------
 
     async def get(self, key: bytes, snapshot: bool = False) -> bytes | None:
+        self._check_timeout()
         if key.startswith(SPECIAL_KEY_PREFIX):
             return await self._get_special(key)
         _check_key(key)
@@ -467,6 +468,7 @@ class Transaction:
         covers only what the result depends on: up to the last key returned
         when the limit truncates the scan (reference: getRange conflict-range
         trimming in NativeAPI)."""
+        self._check_timeout()
         if begin.startswith(SPECIAL_KEY_PREFIX):
             synthetic = self._conflicting_rows() + self._worker_interface_rows()
             rows = sorted(
@@ -507,6 +509,7 @@ class Transaction:
         otherwise every 10s system commit would spuriously conflict-abort
         transactions whose selectors ran off the end of user data
         (reference: getKey clamps non-system transactions to maxKey)."""
+        self._check_timeout()
         version = await self.get_read_version()
         anchor = sel.key
         space_end = self._keyspace_end()
@@ -617,6 +620,7 @@ class Transaction:
     async def commit(self) -> int:
         if self._committed is not None:
             raise UsedDuringCommit("commit() called twice")
+        self._check_timeout()
         version = await self.get_read_version()
         if self.is_read_only:
             self._committed = (version, 0)

@@ -419,14 +419,25 @@ class TenantFacade:
         return Transaction(self._dbf, self._tenant.transaction())
 
     def __getitem__(self, key):
-        tr = self.create_transaction()
-        v = tr[key]
-        return v
+        # One-shot sugar rides the shared retry loop (like Database's
+        # db[key]): transient retryables (recovery in flight, killed proxy,
+        # conflict) retry instead of surfacing.
+        if isinstance(key, slice):
+            async def body(tr):
+                return await tr.get_range(key.start or b"", key.stop or b"\xff")
+
+            return self._dbf._block(self._tenant.run(body))
+
+        async def body(tr):
+            return await tr.get(key)
+
+        return self._dbf._block(self._tenant.run(body))
 
     def __setitem__(self, key: bytes, value: bytes) -> None:
-        tr = self.create_transaction()
-        tr[key] = value
-        tr.commit()
+        async def body(tr):
+            tr.set(key, value)
+
+        self._dbf._block(self._tenant.run(body))
 
 
 class tenant_management:

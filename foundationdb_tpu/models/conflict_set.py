@@ -241,6 +241,40 @@ class TPUConflictSet:
     def overflowed(self) -> bool:
         return bool(np.asarray(self.state.overflow).any())
 
+    def headroom(self) -> int:
+        """Free boundary slots in the tightest shard (device sync).
+
+        The host-side back-pressure signal: a painted write range adds at
+        most 2 boundaries, so a batch of n txns can grow the history by at
+        most ``2 * n * max_write_ranges`` slots — if headroom is below that,
+        resolving the batch could overflow (truncate history → missed
+        conflicts). The runtime Resolver checks this before every batch and
+        fail-safes instead (see runtime/resolver.py). The reference's
+        SkipList never loses history inside the MVCC window; this check is
+        how the fixed-capacity engine earns the same guarantee.
+        """
+        used = int(np.asarray(self.state.n_used).max())
+        return self.capacity - used
+
+    def worst_case_growth(self, n_txns: int) -> int:
+        """Upper bound on boundary-slot growth from resolving n_txns."""
+        return 2 * n_txns * self.max_write_ranges
+
+    def clear_overflow(self) -> None:
+        """Reset the sticky device overflow flag (after the host has
+        reacted — see Resolver's unsafe-window handling)."""
+        self.state = self.state._replace(overflow=self.state.overflow & False)
+
+    def advance(self, commit_version: int, oldest_version: int | None = None) -> None:
+        """GC-only dispatch: move the version chain and MVCC floor forward
+        without painting any writes (an all-masked batch). Expired segments
+        compact out, so headroom recovers as the window slides — this is
+        what lets the Resolver's fail-safe mode drain and exit."""
+        self._begin_resolve(commit_version, oldest_version)
+        cv = np.int32(self._rel(commit_version))
+        oldest = np.int32(self._rel(self.oldest_version))
+        _, self.state = self._resolve_fn(self.state, self._empty_batch(), cv, oldest)
+
     # -- internals ----------------------------------------------------------
 
     def _rel(self, v: int) -> int:

@@ -14,6 +14,7 @@ from __future__ import annotations
 from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
+from foundationdb_tpu.runtime.trace import Severity, trace
 
 
 class Resolver:
@@ -27,6 +28,18 @@ class Resolver:
         self._replies: dict[int, list[Verdict]] = {}  # version -> verdicts
         self.batches_resolved = 0
         self.txns_resolved = 0
+        # History-capacity fail-safe (engines exposing headroom(), i.e. the
+        # fixed-capacity device kernels). The reference SkipList grows
+        # unboundedly within the MVCC window and can never lose history
+        # (fdbserver/SkipList.cpp); the TPU engine has fixed capacity, so
+        # the Resolver must guarantee that capacity pressure degrades to
+        # spurious CONFLICTs (always serializable), never to truncated
+        # history (missed conflicts = serializability violation).
+        self._headroom: int | None = None  # cached from last engine touch
+        self._fail_safe_on = False
+        self._unsafe_until: int | None = None  # version; set on true overflow
+        self.overflow_events = 0
+        self.txns_rejected_fail_safe = 0
 
     @rpc
     async def begin_epoch(self, start_version: int) -> int:
@@ -65,12 +78,30 @@ class Resolver:
             await p.future
         if oldest_version is None:
             oldest_version = max(0, version - MVCC_WINDOW_VERSIONS)
-        verdicts = self.cs.resolve(txns, version, oldest_version)
+        fail_safe = self._should_fail_safe(len(txns), version, oldest_version)
+        if fail_safe:
+            # Conflict-everything: rejected txns paint nothing, so history
+            # stops growing; advance() still slides the GC floor so expired
+            # segments compact out and headroom recovers. Spurious aborts,
+            # never missed conflicts.
+            self.cs.advance(version, oldest_version)
+            self._headroom = self.cs.headroom()
+            verdicts = [Verdict.CONFLICT] * len(txns)
+            self.txns_rejected_fail_safe += len(txns)
+        else:
+            verdicts = self.cs.resolve(txns, version, oldest_version)
+            if self._post_resolve_check(version):
+                # True overflow DURING this batch: chunked resolves paint
+                # earlier chunks before later ones resolve, so post-overflow
+                # chunks may have missed conflicts — reject the whole batch.
+                verdicts = [Verdict.CONFLICT] * len(txns)
+                self.txns_rejected_fail_safe += len(txns)
+                fail_safe = True
         # Conflicting read ranges for txns that asked (reference: the
         # reply's conflictingKRIndices). Engines that track exact ranges
         # (oracle) report them; others degrade to the conservative
         # superset of all the txn's read ranges.
-        exact = getattr(self.cs, "last_conflicting", None)
+        exact = None if fail_safe else getattr(self.cs, "last_conflicting", None)
         conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
         for i, (t, v) in enumerate(zip(txns, verdicts)):
             if v != Verdict.CONFLICT or not t.report_conflicting_keys:
@@ -91,6 +122,80 @@ class Resolver:
             w.send(None)
         return reply
 
+    # -- history-capacity fail-safe -----------------------------------------
+
+    def _should_fail_safe(
+        self, n_txns: int, version: int, oldest_version: int
+    ) -> bool:
+        """True → this batch must be rejected wholesale (all CONFLICT).
+
+        Two triggers:
+        - Proactive headroom check: resolving n_txns can add at most
+          ``cs.worst_case_growth(n_txns)`` boundary slots; if the cached
+          headroom (refreshed after every engine touch, so no extra device
+          sync here) can't absorb that, painting could truncate history.
+        - Unsafe window after a true overflow (belt and braces — should be
+          unreachable with the proactive check): history painted at
+          versions ≤ the overflow version may have been dropped, so every
+          batch is rejected until the MVCC floor passes that version and
+          the lost history would have expired anyway.
+        """
+        if not hasattr(self.cs, "headroom"):
+            return False  # unbounded engines (oracle, C++ skiplist)
+        if self._unsafe_until is not None:
+            if oldest_version > self._unsafe_until:
+                self._unsafe_until = None
+                trace(self.loop).event(
+                    "ResolverOverflowWindowExpired", version=version
+                )
+            else:
+                return True
+        if self._headroom is None:
+            self._headroom = self.cs.headroom()
+        needed = self.cs.worst_case_growth(n_txns)
+        engaged = self._headroom < needed
+        # Episode tracking with hysteresis: the per-batch decision above is
+        # the correctness gate (an empty batch is always safe to resolve),
+        # but engage/release trace events and the status flag follow the
+        # EPISODE — released only once headroom recovers past the largest
+        # demand seen — so interleaved idle batches don't flap WARN spam.
+        if engaged:
+            self._release_at = max(getattr(self, "_release_at", 0), needed)
+            if not self._fail_safe_on:
+                self._fail_safe_on = True
+                trace(self.loop).event(
+                    "ResolverFailSafeEngaged", Severity.WARN_ALWAYS,
+                    headroom=self._headroom, needed=needed, version=version,
+                )
+        elif self._fail_safe_on and self._headroom >= self._release_at:
+            self._fail_safe_on = False
+            self._release_at = 0
+            trace(self.loop).event(
+                "ResolverFailSafeReleased", headroom=self._headroom,
+                version=version,
+            )
+        return engaged
+
+    def _post_resolve_check(self, version: int) -> bool:
+        """Refresh cached headroom; detect true overflow (history truncated
+        on device). Returns True iff overflow fired during this batch — the
+        caller rejects the batch (chunked resolves mean later chunks saw
+        possibly-truncated history) and the unsafe window rejects everything
+        after it until the MVCC floor passes this version."""
+        if not hasattr(self.cs, "headroom"):
+            return False
+        self._headroom = self.cs.headroom()
+        if not self.cs.overflowed:
+            return False
+        self.overflow_events += 1
+        self._unsafe_until = version
+        self.cs.clear_overflow()
+        trace(self.loop).event(
+            "ResolverHistoryOverflow", Severity.ERROR,
+            version=version, headroom=self._headroom,
+        )
+        return True
+
     @property
     def version(self) -> int:
         return self._version
@@ -102,4 +207,9 @@ class Resolver:
             "batches_resolved": self.batches_resolved,
             "txns_resolved": self.txns_resolved,
             "version": self._version,
+            "fail_safe_active": self._fail_safe_on
+            or self._unsafe_until is not None,
+            "overflow_events": self.overflow_events,
+            "txns_rejected_fail_safe": self.txns_rejected_fail_safe,
+            "history_headroom": self._headroom,
         }

@@ -149,6 +149,24 @@ def _supervise(loop: RealLoop, name: str, make_coro):
     loop.spawn(runner(), name=f"supervise.{name}")
 
 
+def _bump_epoch(data_dir: str) -> int:
+    """Advance and persist the recovery generation (reference: the recovery
+    count in the coordinators' state). First durable restart → epoch 2."""
+    path = os.path.join(data_dir, "epoch")
+    try:
+        with open(path) as f:
+            epoch = int(f.read().strip()) + 1
+    except (OSError, ValueError):
+        epoch = 2
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(epoch))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return epoch
+
+
 def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                index: int, data_dir: str | None) -> None:
     """Construct and serve one role instance on transport `t`."""
@@ -193,12 +211,32 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                                 "tlogs unreachable during restart sync")
                         await loop.sleep(0.3)  # tlog not up yet
             minv = min(ends) if ends else 0
+            maxv = max(ends) if ends else 0
+            if minv == 0 and maxv > 0:
+                # Mixed state: some tlogs recovered data, at least one came
+                # up empty (lost/blank disk queue). Falling through to the
+                # fresh-cluster branch would restart the chain at version 0
+                # while recovered tlogs still hold higher versions — their
+                # duplicate check would false-ack new pushes without
+                # appending them (silent data loss). Refuse to start; the
+                # operator must either restore the missing queue file or
+                # wipe the data dir to accept the loss explicitly.
+                raise RuntimeError(
+                    f"mixed tlog recovery state (ends={ends}): some disk "
+                    "queues recovered data, some are empty — refusing to "
+                    "start. Restore the missing tlog queue or clear the "
+                    "data dir to accept data loss."
+                )
             if minv > 0:
                 # get_version reports last_entry+1 for a recovered log;
                 # entries strictly above minv-1 are the unacked suffix.
                 for ep in eps("tlog"):
                     await ep.truncate_to(minv - 1)
-                seq = Sequencer(loop, epoch=2, recovery_version=minv)
+                # Recovery generation persists across bounces (reference:
+                # the coordinated state's recovery count) — each durable
+                # restart with recovered data starts a new epoch.
+                epoch = _bump_epoch(data_dir)
+                seq = Sequencer(loop, epoch=epoch, recovery_version=minv)
                 for ep in eps("tlog") + eps("resolver"):
                     while True:
                         try:

@@ -380,3 +380,69 @@ class TestDurableDeployedRestart:
                 p.send_signal(signal.SIGKILL)
             for p in procs:
                 p.wait()
+
+    def test_mixed_tlog_state_refuses_boot(self, tmp_path_factory):
+        """One tlog's disk queue lost while others recovered data: the
+        sequencer must refuse to start (the fresh-chain fallback would
+        false-ack new pushes on the recovered tlogs — silent data loss)
+        rather than boot at version 0."""
+        tmp = tmp_path_factory.mktemp("mixed")
+        ports = iter(free_ports(7))
+        spec = {
+            "sequencer": [f"127.0.0.1:{next(ports)}"],
+            "resolver": [f"127.0.0.1:{next(ports)}"],
+            "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+            "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+            "proxy": [f"127.0.0.1:{next(ports)}"],
+            "engine": "cpu",
+        }
+        spec_path = tmp / "cluster.json"
+        spec_path.write_text(json.dumps(spec))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def launch(role, i):
+            d = tmp / "data" / f"{role}{i}"
+            d.mkdir(parents=True, exist_ok=True)
+            return subprocess.Popen(
+                [sys.executable, "-m", "foundationdb_tpu.server",
+                 "--cluster", str(spec_path), "--role", role,
+                 "--index", str(i), "--data-dir", str(d)],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+
+        procs = []
+        for role, addrs in spec.items():
+            if role == "engine":
+                continue
+            for i in range(len(addrs)):
+                procs.append(launch(role, i))
+        try:
+            for p in procs:
+                assert "ready" in p.stdout.readline()
+            r = run_cli(str(spec_path), "writemode on; set mx/a v1")
+            assert r.returncode == 0 and "ERROR" not in r.stdout, r.stdout
+            time.sleep(1)
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGKILL)
+            for p in procs:
+                p.wait()
+
+        # Blank one tlog's recovered state, reboot tlogs + the sequencer.
+        q = tmp / "data" / "tlog1" / "tlog1.q"
+        assert q.exists()
+        q.unlink()
+        tl0, tl1 = launch("tlog", 0), launch("tlog", 1)
+        seq = launch("sequencer", 0)
+        try:
+            assert "ready" in tl0.stdout.readline()
+            assert "ready" in tl1.stdout.readline()
+            out, _ = seq.communicate(timeout=120)
+            assert seq.returncode != 0, out
+            assert "mixed tlog recovery state" in out, out
+        finally:
+            for p in (tl0, tl1, seq):
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+                    p.wait()
