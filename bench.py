@@ -78,6 +78,74 @@ def log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def force_cpu_backend() -> None:
+    """Neutralize the tunneled axon backend and pin CPU — the one place
+    this dance lives (a wedged tunnel hangs ANY backend init, CPU
+    included, unless the axon PJRT factory is dropped first)."""
+    import os
+
+    import jax
+
+    os.environ["FDB_TPU_FORCE_CPU"] = "1"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as xb  # private; degrade gracefully
+
+        xb._backend_factories.pop("axon", None)
+    except (ImportError, AttributeError):
+        pass
+
+
+def probe_tpu_subprocess(timeout_s: float = 90.0) -> bool:
+    """Probe for a non-CPU backend in a THROWAWAY subprocess.
+
+    A wedged tunnel hangs jax.devices() forever and the stuck thread
+    poisons this process's backend-init lock; a subprocess probe can hang
+    and be killed without contaminating us, so it can be retried for as
+    long as the budget allows (VERDICT r3 item 2: wait for the TPU inside
+    the time budget rather than shipping a CPU number as the artifact)."""
+    import os
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("FDB_TPU_FORCE_CPU", "JAX_PLATFORMS")}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); import sys; "
+             "sys.exit(0 if d and d[0].platform != 'cpu' else 1)"],
+            timeout=timeout_s, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def wait_for_tpu(budget_left, reserve_s: float = 1200.0,
+                 poll_s: float = 120.0) -> float:
+    """Block until a TPU probe succeeds or the remaining budget drops to
+    `reserve_s` (kept for the diagnostic CPU fallback run). Returns seconds
+    spent waiting. No-op (0.0) if the first probe succeeds."""
+    waited_t0 = time.perf_counter()
+    attempt = 0
+    while True:
+        attempt += 1
+        if probe_tpu_subprocess():
+            if attempt > 1:
+                log(f"[wait] TPU reachable after {attempt} probes "
+                    f"({time.perf_counter() - waited_t0:.0f}s)")
+            return time.perf_counter() - waited_t0
+        left = budget_left()
+        if left <= reserve_s:
+            log(f"[wait] giving up on TPU: {left:.0f}s budget left "
+                f"(reserve {reserve_s:.0f}s)")
+            return time.perf_counter() - waited_t0
+        log(f"[wait] TPU probe {attempt} failed; retrying in {poll_s:.0f}s "
+            f"({left:.0f}s budget left)")
+        time.sleep(min(poll_s, max(1.0, left - reserve_s)))
+
+
 def init_backend(retries: int = 3, backoff_s: float = 10.0,
                  probe_timeout_s: float = 180.0) -> tuple[str, str | None]:
     """Returns (platform, error_or_None). Tries the configured backend
@@ -147,13 +215,7 @@ def init_backend(retries: int = 3, backoff_s: float = 10.0,
         if attempt + 1 < retries:
             time.sleep(backoff_s)
     log("[init] falling back to CPU backend")
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        import jax._src.xla_bridge as xb  # private; degrade gracefully
-
-        xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
+    force_cpu_backend()
     try:
         jax.devices()
         return jax.default_backend(), err
@@ -253,7 +315,8 @@ def run_tpu_wire(
     mode: ModeConfig = MODES["ycsb"], n_resolvers: int = 1,
     window: int = 32, pipeline_depth: int = 4,
     sample_keys: "list[bytes] | None" = None,
-) -> tuple[float, int, bool, list[float], list[int]]:
+    reshard_mid: bool = False,
+) -> tuple[float, int, bool, list[float], "list[int] | dict"]:
     """Drive the production path: TPUConflictSet.resolve_wire_window_async,
     `window` batches per device dispatch (one lax.scan program — amortizes
     per-dispatch latency the way the reference proxy batches commits per
@@ -273,12 +336,19 @@ def run_tpu_wire(
     with DENSITY splits: shard bounds at the quantiles of a key sample
     drawn from the stream itself, the way the runtime derives resolver
     ranges from DD density (uniform first-byte splits leave Zipf load
-    pathological — VERDICT r2 weak-4). `sample_keys` provides the sample."""
+    pathological — VERDICT r2 weak-4). `sample_keys` provides the sample.
+
+    reshard_mid demonstrates the runtime rebalance path (VERDICT r3 item
+    5): the engine STARTS on uniform splits, occupancy is sampled at the
+    midpoint, then reshard(density_splits(sample)) moves the bounds
+    between dispatch windows and occupancy is sampled again at the end —
+    the artifact shows the imbalance the density splits fix. Occupancy is
+    then returned as {"uniform": [...], "density": [...]}."""
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
-    occupancy: list = []
+    occupancy: "list | dict" = []
 
-    def make_cs():
+    def make_cs(force_uniform: bool = False):
         kw = dict(
             capacity=capacity,
             batch_size=mode.batch,
@@ -293,7 +363,7 @@ def run_tpu_wire(
             )
 
             splits = (density_splits(n_resolvers, sample_keys)
-                      if sample_keys else None)
+                      if sample_keys and not force_uniform else None)
             return ShardedConflictSet(
                 n_shards=n_resolvers, splits=splits, **kw
             )
@@ -309,16 +379,33 @@ def run_tpu_wire(
     off1 = int(txn_ends[window * B])
     cs.resolve_wire_window_async(blob[:off1], list(range(1, window + 1)), B)()
 
+    do_reshard = reshard_mid and n_resolvers > 1 and sample_keys
     best_dt, conflicts, overflowed = float("inf"), 0, False
     best_lat: list[float] = []
+    occ_uniform: list = []
     for rep in range(repeats):
-        cs = make_cs()
+        cs = make_cs(force_uniform=bool(do_reshard))
         collectors: list = [None] * n_windows
         verdicts: list = [None] * n_windows
         submit_t = [0.0] * n_windows
         lat_ms = [0.0] * n_windows
         t0 = time.perf_counter()
         for wi in range(n_windows):
+            if do_reshard and wi == max(1, n_windows // 2):
+                # Drain in-flight windows, sample the uniform-split load
+                # imbalance, then move the bounds — reshard() re-clips the
+                # device-resident histories between dispatches, no
+                # recompile (parallel/sharded_resolver.py).
+                from foundationdb_tpu.parallel.sharded_resolver import (
+                    density_splits,
+                )
+
+                for j in range(max(0, wi - depth), wi):
+                    if verdicts[j] is None:
+                        verdicts[j] = collectors[j]()
+                        lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
+                occ_uniform = cs.shard_occupancy()
+                cs.reshard(density_splits(n_resolvers, sample_keys))
             lo = int(txn_ends[wi * window * B])
             hi = int(txn_ends[(wi + 1) * window * B])
             cvs = list(range(wi * window + 1, (wi + 1) * window + 1))
@@ -326,11 +413,13 @@ def run_tpu_wire(
             collectors[wi] = cs.resolve_wire_window_async(blob[lo:hi], cvs, B)
             if wi >= depth:
                 j = wi - depth
-                verdicts[j] = collectors[j]()  # blocks until host-visible
-                lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
+                if verdicts[j] is None:
+                    verdicts[j] = collectors[j]()  # blocks until host-visible
+                    lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
         for j in range(max(0, n_windows - depth), n_windows):
-            verdicts[j] = collectors[j]()
-            lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
+            if verdicts[j] is None:
+                verdicts[j] = collectors[j]()
+                lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
         dt = time.perf_counter() - t0
         log(f"[tpu] rep {rep}: {dt:.3f}s "
             f"(window p50 {np.percentile(lat_ms, 50):.1f}ms "
@@ -344,10 +433,73 @@ def run_tpu_wire(
             conflicts = int(sum(int((v == 1).sum()) for v in verdicts))
         if n_resolvers > 1:
             occupancy = cs.shard_occupancy()
-    if occupancy:
+    if do_reshard and occupancy and occ_uniform:
+        mxu, mnu = max(occ_uniform), max(1, min(occ_uniform))
+        mxd, mnd = max(occupancy), max(1, min(occupancy))
+        log(f"[tpu] shard occupancy uniform {occ_uniform} "
+            f"({mxu / mnu:.2f}x) → density {occupancy} ({mxd / mnd:.2f}x)")
+        occupancy = {"uniform": occ_uniform, "density": occupancy}
+    elif occupancy:
         mx, mn = max(occupancy), max(1, min(occupancy))
         log(f"[tpu] shard occupancy {occupancy} (max/min {mx / mn:.2f}x)")
     return best_dt, conflicts, overflowed, best_lat, occupancy
+
+
+def run_tpu_batch_latency(
+    n_batches, capacity, blob, txn_ends,
+    mode: ModeConfig = MODES["ycsb"], depth: int = 2,
+    max_batches: int = 128,
+) -> tuple[list[float], float]:
+    """Honest per-batch commit latency at sustained load (VERDICT r3 item 7).
+
+    The windowed path (run_tpu_wire) amortizes dispatch overhead across 32
+    batches but each txn's verdict waits for the whole window — its p99 is
+    queueing, not resolver latency. This probe dispatches ONE batch at a
+    time, double-buffered (`depth` in flight, host packing overlapping
+    device execute, exactly how the runtime resolver would pipeline
+    consecutive proxy batches), and times each batch's submit→verdict. The
+    result is the resolver component of per-txn commit latency at
+    sustained single-batch dispatch, reported NEXT TO the windowed
+    throughput number rather than hidden inside it.
+
+    Returns (per_batch_latency_ms, elapsed_s) over min(n_batches,
+    max_batches) batches.
+    """
+    from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+    cs = TPUConflictSet(
+        capacity=capacity, batch_size=mode.batch,
+        max_read_ranges=mode.n_reads, max_write_ranges=mode.n_writes,
+        max_key_bytes=KEY_BYTES, window_versions=WINDOW,
+    )
+    B = mode.batch
+    n = min(n_batches, max_batches)
+    # Warm-up compile on batch 0's shape.
+    lo, hi = int(txn_ends[0]), int(txn_ends[B])
+    cs.resolve_wire_async(blob[lo:hi], 1, count=B, as_array=True)()
+    cs = TPUConflictSet(
+        capacity=capacity, batch_size=mode.batch,
+        max_read_ranges=mode.n_reads, max_write_ranges=mode.n_writes,
+        max_key_bytes=KEY_BYTES, window_versions=WINDOW,
+    )
+    collectors: list = [None] * n
+    submit_t = [0.0] * n
+    lat_ms = [0.0] * n
+    t0 = time.perf_counter()
+    for b in range(n):
+        lo, hi = int(txn_ends[b * B]), int(txn_ends[(b + 1) * B])
+        submit_t[b] = time.perf_counter()
+        collectors[b] = cs.resolve_wire_async(
+            blob[lo:hi], b + 1, count=B, as_array=True
+        )
+        if b >= depth:
+            j = b - depth
+            collectors[j]()
+            lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
+    for j in range(max(0, n - depth), n):
+        collectors[j]()
+        lat_ms[j] = (time.perf_counter() - submit_t[j]) * 1e3
+    return lat_ms, time.perf_counter() - t0
 
 
 # ---------------------------------------------------------------------------
@@ -550,6 +702,62 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
 # ---------------------------------------------------------------------------
 
 
+def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
+                         budget_s: float) -> dict:
+    """Run the sharded config on a virtual CPU mesh in a subprocess.
+
+    The child pins JAX_PLATFORMS=cpu with xla_force_host_platform_device
+    _count so the mesh exists without chips; its JSON result is embedded
+    with backend 'cpu-mesh' and valid:false — a load-balance/occupancy
+    signal, not a TPU perf claim."""
+    import os
+    import subprocess
+
+    if os.environ.get("FDB_TPU_NO_SUBBENCH") == "1":
+        return {"skipped": f"needs {nres} devices (subbench disabled)"}
+    if budget_s < 600:
+        return {"skipped": f"needs {nres} devices; no budget for cpu-mesh"}
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # FORCE_CPU drops the axon PJRT factory before any init — a wedged
+        # tunnel otherwise hangs even CPU-backend init for 180s in the child.
+        FDB_TPU_FORCE_CPU="1",
+        FDB_TPU_ALLOW_CPU="1",
+        FDB_TPU_NO_SUBBENCH="1",
+        FDB_TPU_BENCH_DEADLINE_S=str(max(300.0, budget_s - 120.0)),
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count=8").strip(),
+    )
+    child_txns = min(max(sweep_txns, 65_536), 131_072)
+    # ≥4 dispatch windows so the mid-run density reshard (run_tpu_wire
+    # reshard_mid) actually fires and the artifact records before/after.
+    child_window = max(1, (child_txns // MODES["ycsb"].batch) // 4)
+    cmd = [sys.executable, sys.argv[0] if sys.argv else "bench.py",
+           "--mode", "ycsb", "--resolvers", str(nres),
+           "--txns", str(child_txns),
+           "--keys", str(args.keys), "--capacity", str(args.capacity),
+           "--seed", str(args.seed + 1), "--window", str(child_window)]
+    log(f"[{cname}] launching cpu-mesh subprocess: {' '.join(cmd[1:])}")
+    try:
+        r = subprocess.run(
+            cmd, env=env, capture_output=True, text=True,
+            timeout=max(300.0, budget_s - 60.0),
+        )
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        child = json.loads(line)
+        keep = ("value", "vs_baseline", "txns", "conflict_rate",
+                "verdict_parity", "cpu_baseline_txns_per_sec", "p50_ms",
+                "p99_ms", "batches_per_dispatch", "shard_occupancy")
+        out = {k: child.get(k) for k in keep}
+        out.update(backend="cpu-mesh", resolvers=nres, valid=False,
+                   note="virtual 8-device CPU mesh: occupancy/balance "
+                        "signal, not TPU perf")
+        return out
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill sweep
+        return {"error": f"cpu-mesh run failed: {str(e)[:200]}"}
+
+
 def pct(lat_ms: list[float], q: float) -> float:
     return round(float(np.percentile(lat_ms, q)), 2) if lat_ms else 0.0
 
@@ -561,6 +769,11 @@ def run_config(
 ) -> dict:
     """Run one §5 benchmark configuration end-to-end (CPU baseline + TPU
     path on the same stream) and return its result dict."""
+    if n_resolvers > 1:
+        # The mid-run density reshard (reshard_mid) fires at window
+        # n_windows // 2 — force ≥4 dispatch windows or a sharded sweep
+        # would silently run whole on pathological uniform splits.
+        window = max(1, min(window, max(1, n_txns // mode.batch) // 4))
     window = max(1, min(window, max(1, n_txns // mode.batch)))
     n_batches = max(1, n_txns // mode.batch) // window * window
     n_txns = n_batches * mode.batch
@@ -598,11 +811,20 @@ def run_config(
     tpu_dt, tpu_conf, overflowed, tpu_lat, occupancy = run_tpu_wire(
         n_batches, capacity, blob, txn_ends, repeats=repeats,
         mode=mode, n_resolvers=n_resolvers, window=window,
-        sample_keys=sample_keys,
+        sample_keys=sample_keys, reshard_mid=n_resolvers > 1,
     )
     tpu_rate = n_txns / tpu_dt
     log(f"[tpu] {name}: {tpu_dt:.2f}s → {tpu_rate:,.0f} txns/s "
         f"({tpu_conf} conflicts, {tpu_conf / n_txns:.1%})")
+    batch_lat, batch_dt, batch_n = [], 0.0, 0
+    if n_resolvers == 1:
+        batch_lat, batch_dt = run_tpu_batch_latency(
+            n_batches, capacity, blob, txn_ends, mode=mode
+        )
+        batch_n = len(batch_lat)
+        log(f"[tpu] {name}: per-batch pipelined latency p50 "
+            f"{pct(batch_lat, 50)}ms p99 {pct(batch_lat, 99)}ms "
+            f"({batch_n * mode.batch / batch_dt:,.0f} txns/s at depth 2)")
     if profile:
         profile_phases(capacity, blob, txn_ends, mode=mode)
     if tpu_conf != cpu_conf:
@@ -621,6 +843,14 @@ def run_config(
         # per-batch resolve latency — the equal-p99 comparison of SURVEY §0.
         "p50_ms": pct(tpu_lat, 50),
         "p99_ms": pct(tpu_lat, 99),
+        # Honest per-batch commit latency: single-batch dispatch, double
+        # buffered (depth 2) — the number the north star's "equal p99"
+        # clause is judged on, vs the windowed queueing latency above.
+        "batch_p50_ms": pct(batch_lat, 50),
+        "batch_p99_ms": pct(batch_lat, 99),
+        "batch_pipeline_txns_per_sec": (
+            round(batch_n * mode.batch / batch_dt, 1) if batch_dt else None
+        ),
         "cpu_p50_ms": pct(cpu_lat, 50),
         "cpu_p99_ms": pct(cpu_lat, 99),
         "batches_per_dispatch": window,
@@ -638,15 +868,7 @@ def main() -> None:
     if os.environ.get("FDB_TPU_FORCE_CPU") == "1":
         # Set by the hang-recovery re-exec (init_backend): neutralize the
         # tunneled backend BEFORE anything can touch it.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            import jax._src.xla_bridge as xb
-
-            xb._backend_factories.pop("axon", None)
-        except (ImportError, AttributeError):
-            pass
+        force_cpu_backend()
         log("[init] FDB_TPU_FORCE_CPU=1: axon backend disabled, using CPU")
 
     ap = argparse.ArgumentParser()
@@ -663,6 +885,11 @@ def main() -> None:
     ap.add_argument("--window", type=int, default=32,
                     help="resolver batches per device dispatch")
     args = ap.parse_args()
+    if (os.environ.get("FDB_TPU_FORCE_CPU") == "1"
+            and os.environ.get("FDB_TPU_ALLOW_CPU") != "1"):
+        # Hang-recovery re-exec landed on CPU: diagnostic run only — keep
+        # it small; the artifact will be valid:false with rc=2 regardless.
+        args.txns = min(args.txns, 131_072)
     single = args.mode is not None or args.resolvers > 1
     headline_mode = MODES[args.mode or "ycsb"]
 
@@ -702,10 +929,26 @@ def main() -> None:
 
     threading.Thread(target=watchdog, daemon=True).start()
 
+    exit_rc = 0
     try:
-        # Backend FIRST: a hung tunnel re-execs immediately, before any
-        # baseline work is spent (init_backend never hangs and never dies —
-        # worst case it lands on CPU and the JSON says so).
+        # Backend FIRST. If no TPU is reachable, WAIT for one inside the
+        # budget (subprocess probes — safe to retry) instead of silently
+        # benchmarking the CPU: a CPU number must never ship as a normal
+        # artifact (VERDICT r3 item 2). Only once the wait budget is
+        # exhausted do we fall back to a reduced diagnostic CPU run, and
+        # then the process exits nonzero.
+        allow_cpu = os.environ.get("FDB_TPU_ALLOW_CPU") == "1"
+        waited = 0.0
+        if (os.environ.get("FDB_TPU_FORCE_CPU") != "1" and not allow_cpu
+                and "cpu" not in os.environ.get("JAX_PLATFORMS", "")):
+            waited = wait_for_tpu(lambda: deadline - (time.perf_counter() - _T0))
+            result["waited_for_tpu_s"] = round(waited, 1)
+            if not probe_tpu_subprocess(timeout_s=30.0):
+                # Still no TPU: neutralize the tunnel so in-process init
+                # can't hang, and remember this run is diagnostic-only.
+                force_cpu_backend()
+                args.txns = min(args.txns, 131_072)  # diagnostics, not artifact
+                log("[init] no TPU within budget — reduced CPU diagnostic run")
         platform, init_err = init_backend()
         result["backend"] = platform
         if init_err:
@@ -751,13 +994,15 @@ def main() -> None:
                     continue
                 if nres > len(jax.devices()):
                     # The sharded engine maps shards onto mesh devices; the
-                    # single-chip bench can't host it (the CPU-mesh parity
-                    # tests cover its correctness; MULTICHIP_r*.json its
-                    # compile/execute).
-                    configs[cname] = {
-                        "skipped": f"needs {nres} devices, "
-                                   f"have {len(jax.devices())}"
-                    }
+                    # single chip can't host it. Rather than leaving the
+                    # sharded config with zero perf evidence (VERDICT r3
+                    # item 5), run it in a SUBPROCESS on a virtual 8-device
+                    # CPU mesh — clearly labeled cpu-mesh, never valid as a
+                    # TPU number, but it records real shard_occupancy
+                    # before/after the density reshard under Zipf load.
+                    configs[cname] = run_cpu_mesh_sharded(
+                        cname, nres, sweep_txns, args, budget_left()
+                    )
                     continue
                 try:
                     configs[cname] = run_config(
@@ -775,14 +1020,21 @@ def main() -> None:
             result.setdefault(
                 "error", "ran on CPU fallback — no TPU backend available"
             )
+            if not allow_cpu:
+                # The artifact must tell the truth to tooling that only
+                # checks rc: a CPU-fallback run is NOT a benchmark result.
+                exit_rc = 2
     except Exception:
         tb = traceback.format_exc()
         log(tb)
         result["error"] = tb.splitlines()[-1][:500] if tb else "unknown"
+        exit_rc = 1
     finally:
         with emit_lock:  # exactly ONE JSON line prints, watchdog or us
             bench_done.set()
             print(json.dumps(result), flush=True)
+    if exit_rc:
+        sys.exit(exit_rc)
 
 
 if __name__ == "__main__":
