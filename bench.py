@@ -508,16 +508,20 @@ def run_tpu_batch_latency(
 
 
 def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
-                   mode: ModeConfig = MODES["ycsb"]) -> None:
+                   mode: ModeConfig = MODES["ycsb"]) -> dict:
+    """Per-phase device timings (ms). Returned as a dict so the round
+    artifact carries the attribution (VERDICT r3 item 1: commit the phase
+    breakdown, don't just log it)."""
     import jax
 
     from foundationdb_tpu.models import conflict_kernel as ck
     from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
     B = mode.batch
+    timings: dict = {}
     if (len(txn_ends) - 1) // B < 2:
         log("[profile] skipped: need >= 2 batches of txns to profile")
-        return
+        return timings
     warm_batches = max(0, min(warm_batches, (len(txn_ends) - 1) // B - 1))
     cs = TPUConflictSet(
         capacity=capacity, batch_size=B, max_read_ranges=mode.n_reads,
@@ -539,7 +543,9 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
         for _ in range(n):
             out = fn(*args)
         jax.block_until_ready(out)
-        log(f"[profile] {label}: {(time.perf_counter() - t0) / n * 1000:.3f} ms")
+        ms = (time.perf_counter() - t0) / n * 1000
+        timings[label] = round(ms, 3)
+        log(f"[profile] {label}: {ms:.3f} ms")
         return out
 
     hist = timeit("history_check", ck._phase_history_jit, state, batch)
@@ -550,6 +556,11 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
     timeit("paint_compact", ck._phase_paint_jit, state, batch, acc, cv, oldest)
     full = jax.jit(ck.resolve_batch)  # non-donating twin for repeat timing
     timeit("full_resolve", full, state, batch, cv, oldest)
+    phase_sum = sum(v for k, v in timings.items() if k != "full_resolve")
+    timings["phase_sum_vs_full"] = round(
+        phase_sum / timings["full_resolve"], 2
+    ) if timings.get("full_resolve") else None
+    return timings
 
 
 # ---------------------------------------------------------------------------
@@ -825,8 +836,9 @@ def run_config(
         log(f"[tpu] {name}: per-batch pipelined latency p50 "
             f"{pct(batch_lat, 50)}ms p99 {pct(batch_lat, 99)}ms "
             f"({batch_n * mode.batch / batch_dt:,.0f} txns/s at depth 2)")
+    phase_profile: dict = {}
     if profile:
-        profile_phases(capacity, blob, txn_ends, mode=mode)
+        phase_profile = profile_phases(capacity, blob, txn_ends, mode=mode)
     if tpu_conf != cpu_conf:
         log(f"[warn] {name}: verdict divergence: tpu={tpu_conf} "
             f"cpu={cpu_conf} ({abs(tpu_conf - cpu_conf) / n_txns:.2%})")
@@ -857,6 +869,7 @@ def run_config(
         "resolvers": n_resolvers,
         "shard_occupancy": occupancy or None,
         "overflowed": overflowed,
+        "phase_profile_ms": phase_profile or None,
         "roofline": roofline_estimate(mode, capacity),
         "valid": (not overflowed) and platform not in ("cpu", "none"),
     }

@@ -35,6 +35,8 @@ from foundationdb_tpu.layers import directory as _directory_impl
 from foundationdb_tpu.layers import tuple_layer as _tuple_layer
 from foundationdb_tpu.layers.tuple_layer import Subspace  # noqa: F401 (re-export)
 
+_std_tuple = tuple  # the builtin; `fdb.tuple` below shadows the name
+
 
 class _TupleNamespace:
     """fdb.tuple: the layer module plus the binding's range() (a SLICE,
@@ -53,8 +55,10 @@ tuple = _TupleNamespace()  # noqa: A001 (fdb.tuple)
 
 
 class StreamingMode:
-    """Reference streaming modes — accepted for signature parity; this
-    client always materializes the full (or limit-capped) result."""
+    """Reference streaming modes. `iterator` (the default for transaction
+    range reads) streams pages lazily with a ramped page size — a ported
+    app iterating a huge range holds one page, not the whole result;
+    `want_all`/`exact` fetch big pages up front (see RangeResult)."""
 
     want_all = -2
     iterator = -1
@@ -63,6 +67,94 @@ class StreamingMode:
     medium = 2
     large = 3
     serial = 4
+
+
+class KeyValue(_std_tuple):
+    """One row: unpacks like (key, value) AND reads like kv.key/kv.value
+    (the reference binding's KeyValue)."""
+
+    __slots__ = ()
+
+    def __new__(cls, key: bytes, value: bytes):
+        return _std_tuple.__new__(cls, (key, value))
+
+    @property
+    def key(self) -> bytes:
+        return self[0]
+
+    @property
+    def value(self) -> bytes:
+        return self[1]
+
+    def __repr__(self) -> str:
+        return f"KeyValue({self[0]!r}, {self[1]!r})"
+
+
+class RangeResult:
+    """Lazily-paged range result (reference: the binding's FDBRange over
+    streaming get_range).
+
+    Iterating fetches pages on demand — page size starts small and ramps
+    (StreamingMode.iterator), or starts at the cap for want_all/exact — so
+    memory during pure iteration is bounded by one page. `to_list()` (or
+    any second iteration) materializes and caches. Each page is its own
+    fetch inside the SAME transaction, so conflict-range accounting stays
+    exact: only scanned extents are recorded.
+    """
+
+    _PAGE_START = 256
+    _PAGE_MAX = 4096
+
+    def __init__(self, fetch, begin: bytes, end: bytes, limit: int,
+                 reverse: bool, mode: int):
+        self._fetch = fetch  # (begin, end, limit, reverse) -> [(k, v)]
+        self._begin, self._end = begin, end
+        self._limit, self._reverse, self._mode = limit, reverse, mode
+        self._cache: "list[KeyValue] | None" = None
+
+    def __iter__(self):
+        if self._cache is not None:
+            yield from self._cache
+            return
+        acc: list[KeyValue] = []
+        begin, end = self._begin, self._end
+        remaining = self._limit if self._limit else None
+        page = (self._PAGE_MAX
+                if self._mode in (StreamingMode.want_all, StreamingMode.exact)
+                else self._PAGE_START)
+        while True:
+            n = page if remaining is None else min(page, remaining)
+            rows = self._fetch(begin, end, n, self._reverse)
+            for k, v in rows:
+                kv = KeyValue(k, v)
+                acc.append(kv)
+                yield kv
+            if remaining is not None:
+                remaining -= len(rows)
+                if remaining <= 0:
+                    break
+            if len(rows) < n:
+                break
+            if self._reverse:
+                end = rows[-1][0]
+            else:
+                begin = rows[-1][0] + b"\x00"
+            page = min(page * 2, self._PAGE_MAX)
+        self._cache = acc
+
+    def to_list(self) -> "list[KeyValue]":
+        if self._cache is None:
+            # Drive the generator directly — list(self) would probe
+            # __len__ for presizing and recurse through to_list.
+            for _ in self.__iter__():
+                pass
+        return list(self._cache)
+
+    def __len__(self) -> int:  # materializes (prior shim returned a list)
+        return len(self.to_list())
+
+    def __getitem__(self, i):
+        return self.to_list()[i]
 
 
 class _NetworkOptions:
@@ -124,11 +216,32 @@ def transactional(func):
         db: Database = db_or_tr
 
         async def body(tr):
-            return func(Transaction(db, tr), *args, **kwargs)
+            out = func(Transaction(db, tr), *args, **kwargs)
+            # A lazy range escaping the retry loop would page from a
+            # committed/reset transaction — materialize before commit,
+            # including ranges nested in returned containers. (Anything
+            # that still escapes hits RangeResult's used_during_commit
+            # guard, the reference binding's behavior.)
+            _materialize_ranges(out)
+            return out
 
         return db._block(db._db.run(body))
 
     return wrapper
+
+
+def _materialize_ranges(out, depth: int = 3) -> None:
+    if isinstance(out, RangeResult):
+        out.to_list()
+        return
+    if depth <= 0:
+        return
+    if isinstance(out, dict):
+        for v in out.values():
+            _materialize_ranges(v, depth - 1)
+    elif isinstance(out, (list, _std_tuple, set)):
+        for v in out:
+            _materialize_ranges(v, depth - 1)
 
 
 class Database:
@@ -250,9 +363,19 @@ class Transaction:
             begin = self.get_key(begin)
         if isinstance(end, KeySelector):
             end = self.get_key(end)
-        return self._dbf._block(
-            self._tr.get_range(begin, end, limit=limit, reverse=reverse)
-        )
+        mode = (StreamingMode.iterator if streaming_mode is None
+                else streaming_mode)
+
+        def fetch(b, e, n, rev):
+            if self._tr._committed is not None:
+                # Reference: used_during_commit — a lazy range must not
+                # silently page at a stale read version post-commit.
+                raise FdbError(
+                    "range result paged after commit", code=2017)
+            return self._dbf._block(
+                self._tr.get_range(b, e, limit=n, reverse=rev))
+
+        return RangeResult(fetch, begin, end, limit, reverse, mode)
 
     def get_range_startswith(self, prefix: bytes, **kw):
         return self.get_range(prefix, _strinc(prefix), **kw)
@@ -388,9 +511,12 @@ class _SnapshotView:
             begin = t._dbf._block(t._tr.get_key(begin, snapshot=True))
         if isinstance(end, KeySelector):
             end = t._dbf._block(t._tr.get_key(end, snapshot=True))
-        return t._dbf._block(
-            t._tr.get_range(begin, end, limit=limit, reverse=reverse,
-                            snapshot=True)
+        mode = (StreamingMode.iterator if streaming_mode is None
+                else streaming_mode)
+        return RangeResult(
+            lambda b, e, n, rev: t._dbf._block(
+                t._tr.get_range(b, e, limit=n, reverse=rev, snapshot=True)),
+            begin, end, limit, reverse, mode,
         )
 
     def get_range_startswith(self, prefix: bytes, **kw):

@@ -49,7 +49,8 @@ from foundationdb_tpu.runtime.flow import ActorCancelled, rpc
 from foundationdb_tpu.runtime.net import NetTransport, RealLoop
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 
-ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy", "ratekeeper")
+ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy", "ratekeeper",
+         "controller")
 
 
 def load_spec(path: str) -> dict:
@@ -133,6 +134,194 @@ class ReadRouter:
 def _supervise(loop: RealLoop, name: str, make_coro):
     """Run a role actor forever, restarting on failure (a peer that is not
     up yet surfaces as BrokenPromise; deployment boots in any order)."""
+    loop.spawn(_supervised(loop, name, make_coro), name=f"supervise.{name}")
+
+
+class Worker:
+    """Per-process recruitment surface for managed clusters (reference: the
+    fdbserver worker the ClusterController recruits roles onto —
+    fdbserver/worker.actor.cpp). When the spec names a `controller`, chain
+    roles (sequencer/resolver/tlog/proxy) do NOT self-wire at boot: each
+    process serves only this Worker, and the controller forms generations
+    by RPC — which is what lets a deployed cluster heal a killed tlog or
+    sequencer with a generation change instead of a full bounce
+    (VERDICT r3 item 6)."""
+
+    def __init__(self, loop: RealLoop, t: NetTransport, spec: dict,
+                 role: str, index: int, data_dir: str | None):
+        self.loop = loop
+        self.t = t
+        self.spec = spec
+        self.role = role
+        self.index = index
+        self.data_dir = data_dir
+        self.epoch = 0
+        self._run_tasks: list = []  # current generation's actor tasks
+        self.storage = None  # storage role: the long-lived StorageServer
+
+    @rpc
+    async def ping(self) -> str:
+        return "pong"
+
+    @rpc
+    async def describe(self) -> dict:
+        return {"role": self.role, "index": self.index, "epoch": self.epoch}
+
+    # -- role recruitment (controller-only callers) -----------------------
+
+    def _cancel_runs(self) -> None:
+        for task in self._run_tasks:
+            task.cancel()
+        self._run_tasks = []
+
+    def _spawn(self, name: str, make_coro) -> None:
+        self._run_tasks.append(
+            self.loop.spawn(_supervised(self.loop, name, make_coro),
+                            name=f"supervise.{name}")
+        )
+
+    @rpc
+    async def tlog_resume(self) -> int:
+        """Durable bootstrap: recover this process's newest disk queue and
+        serve it. Returns the recovered end version (get_version semantics:
+        last entry + 1, or 0 for a fresh/blank queue). The controller
+        compares ends across tlogs, truncates the unacked suffix, and jumps
+        the chain (the controller-driven form of the static boot_sequencer
+        restart sync)."""
+        from foundationdb_tpu.runtime.tlog import TLog
+
+        if self.data_dir is None:
+            tlog = TLog(self.loop)
+        else:
+            tlog = TLog.from_disk(self.loop, self._newest_queue())
+        self._tlog = tlog
+        self.t.serve("tlog", tlog)
+        return await tlog.get_version()
+
+    @rpc
+    async def tlog_adopt(self, epoch: int, start_version: int) -> int:
+        """Finish a resumed tlog's handoff: adopt the generation's chain
+        start (a no-op for a fresh epoch-1 chain) and the epoch stamp the
+        controller's sweep checks."""
+        await self._tlog.begin_epoch(start_version)
+        self.epoch = epoch
+        return start_version
+
+    def _newest_queue(self) -> str:
+        """The highest-epoch queue file for this tlog index (recoveries
+        write tlog{i}.e{N}.q; the static path wrote tlog{i}.q)."""
+        import re
+
+        best, best_epoch = os.path.join(
+            self.data_dir, f"tlog{self.index}.q"), 1
+        for name in os.listdir(self.data_dir):
+            m = re.fullmatch(rf"tlog{self.index}\.e(\d+)\.q", name)
+            if m and int(m.group(1)) >= best_epoch:
+                best, best_epoch = os.path.join(self.data_dir, name), int(m.group(1))
+        return best
+
+    @rpc
+    async def recruit_tlog(self, epoch: int, start_version: int,
+                           seed_entries: list) -> int:
+        """Next-generation tlog: fresh chain at start_version, seeded with
+        the prior generation's salvaged un-popped suffix."""
+        from foundationdb_tpu.runtime.tlog import TLog
+
+        disk = (os.path.join(self.data_dir, f"tlog{self.index}.e{epoch}.q")
+                if self.data_dir else None)
+        tlog = TLog(self.loop, init_version=start_version,
+                    seed=[(v, t) for v, t in seed_entries], disk_path=disk)
+        self._tlog = tlog
+        self.t.serve("tlog", tlog)
+        self.epoch = epoch
+        return start_version
+
+    @rpc
+    async def recruit_sequencer(self, epoch: int, recovery_version: int) -> int:
+        from foundationdb_tpu.runtime.sequencer import Sequencer
+
+        seq = Sequencer(self.loop, epoch=epoch,
+                        recovery_version=recovery_version)
+        self.t.serve("sequencer", seq)
+        self.epoch = epoch
+        return seq.last_handed_out
+
+    @rpc
+    async def recruit_resolver(self, epoch: int, start_version: int) -> int:
+        from foundationdb_tpu.runtime.resolver import Resolver
+
+        engine = self.spec.get("engine", "cpu")
+        self.t.serve(
+            "resolver",
+            Resolver(self.loop, make_conflict_set(engine),
+                     init_version=start_version),
+        )
+        self.epoch = epoch
+        return start_version
+
+    @rpc
+    async def recruit_proxy(self, epoch: int, tlog_addrs: list,
+                            resolver_addrs: list) -> int:
+        """Rebuild this process's CommitProxy + GrvProxy against the new
+        generation's LIVE tlog/resolver sets. Old actor loops are
+        cancelled; the service names are re-pointed at the new objects, so
+        clients keep their endpoints (in-flight calls to the old objects
+        resolve against the new generation's chain guards)."""
+        from foundationdb_tpu.core.errors import ProcessKilled
+        from foundationdb_tpu.runtime.commit_proxy import CommitProxy
+        from foundationdb_tpu.runtime.grv_proxy import GrvProxy
+
+        self._cancel_runs()
+        old = getattr(self, "_commit_proxy", None)
+        if old is not None:
+            # Queued commits of the retired generation would hang forever
+            # (their batch loop is cancelled) — fail them retryably; the
+            # client's on_error loop resubmits against the new generation.
+            for _req, p in old._queue:
+                p.fail(ProcessKilled("proxy retired by recovery"))
+            old._queue = []
+        seq_ep = self.t.endpoint(parse_addr(self.spec["sequencer"][0]),
+                                 "sequencer")
+        rk = self.spec.get("ratekeeper") or []
+        rk_ep = (self.t.endpoint(parse_addr(rk[0]), "ratekeeper")
+                 if rk else None)
+        tlog_eps = [self.t.endpoint(tuple(a), "tlog") for a in tlog_addrs]
+        resolver_eps = [self.t.endpoint(tuple(a), "resolver")
+                        for a in resolver_addrs]
+        controller_ep = self.t.endpoint(
+            parse_addr(self.spec["controller"][0]), "controller")
+        proxy = CommitProxy(
+            self.loop, seq_ep, resolver_eps,
+            KeyShardMap.uniform(len(resolver_eps)), tlog_eps,
+            KeyShardMap.uniform(len(self.spec["storage"])),
+            controller_ep=controller_ep, epoch=epoch,
+        )
+        self._commit_proxy = proxy
+        grv = GrvProxy(self.loop, seq_ep, rk_ep)
+        self.t.serve("commit_proxy", proxy)
+        self.t.serve("grv_proxy", grv)
+        self._spawn(f"proxy{self.index}.run", proxy.run)
+        self._spawn(f"grv{self.index}.run", grv.run)
+        self.epoch = epoch
+        return epoch
+
+    @rpc
+    async def recruit_storage(self, epoch: int, recovery_version: int,
+                              tlog_addrs: list) -> int:
+        """Re-point the long-lived StorageServer at the new generation:
+        roll back above the recovery version, pull from the new tlogs."""
+        tlog_eps = [self.t.endpoint(tuple(a), "tlog") for a in tlog_addrs]
+        tag = self.storage.tag
+        self.storage.recover_to(
+            recovery_version, tlog_eps[tag % len(tlog_eps)], tlog_eps
+        )
+        self.epoch = epoch
+        return epoch
+
+
+def _supervised(loop: RealLoop, name: str, make_coro):
+    """The _supervise coroutine, returned (not spawned) so callers can hold
+    and cancel the task — generation changes retire old actor loops."""
 
     async def runner():
         while True:
@@ -146,7 +335,315 @@ def _supervise(loop: RealLoop, name: str, make_coro):
                       "restarting in 0.5s", file=sys.stderr, flush=True)
                 await loop.sleep(0.5)
 
-    loop.spawn(runner(), name=f"supervise.{name}")
+    return runner()
+
+
+class DeployedController:
+    """Failure detection + generation formation over real TCP.
+
+    The deployed counterpart of the sim's ClusterController + recovery
+    state machine (runtime/cluster.py, runtime/recovery.py; reference:
+    fdbserver/ClusterController.actor.cpp + masterserver recovery): sweep
+    worker heartbeats, and on a chain-role failure lock the surviving
+    tlogs, salvage the un-popped suffix, and recruit the next generation
+    on every process that answers. Processes come from the static spec
+    (there is no spare-worker pool to place roles on — recruitment
+    re-forms the generation on the surviving/restarted spec processes,
+    which fdbmonitor keeps restarting). Singleton by deployment (one
+    `controller` entry in the spec); the coordinator-quorum election the
+    sim exercises is not wired over TCP.
+    """
+
+    HEARTBEAT_INTERVAL = 1.0
+    RETRY_DELAY = 0.5
+    BOOT_DEADLINE = 120.0
+
+    def __init__(self, loop: RealLoop, t: NetTransport, spec: dict,
+                 data_dir: str | None):
+        self.loop = loop
+        self.t = t
+        self.spec = spec
+        self.data_dir = data_dir
+        self.epoch = 0
+        self.recovery_version = 0
+        # role -> list of live spec indices in the current generation.
+        self.live: dict[str, list[int]] = {}
+        self.recoveries_completed = 0
+        self._recovering = False
+
+    # -- endpoints ---------------------------------------------------------
+
+    def _worker(self, role: str, i: int):
+        return self.t.endpoint(parse_addr(self.spec[role][i]), "worker")
+
+    def _tlog(self, i: int):
+        return self.t.endpoint(parse_addr(self.spec["tlog"][i]), "tlog")
+
+    def _addrs(self, role: str, live: list[int]) -> list:
+        return [list(parse_addr(self.spec[role][i])) for i in live]
+
+    async def _retry(self, make_call, deadline: float):
+        while True:
+            try:
+                return await make_call()
+            except Exception:
+                if self.loop.now > deadline:
+                    raise
+                await self.loop.sleep(self.RETRY_DELAY)
+
+    # -- status (cli/status surface) ---------------------------------------
+
+    @rpc
+    async def get_status(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "recovery_version": self.recovery_version,
+            "recoveries_completed": self.recoveries_completed,
+            "recovering": self._recovering,
+            "generation": {r: list(v) for r, v in self.live.items()},
+        }
+
+    @rpc
+    async def request_recovery(self, epoch: int, reason: str) -> None:
+        """A proxy observed the pipeline wedged (lost tlog pushes) —
+        heartbeats can't always see it first (reference: proxies force
+        recovery on tlog failure)."""
+        if self._recovering or epoch != self.epoch:
+            return
+        self.loop.spawn(self._recover(f"requested: {reason}"),
+                        name="controller.requested_recovery")
+
+    # -- bootstrap ---------------------------------------------------------
+
+    async def bootstrap(self) -> None:
+        """First generation of this controller lifetime.
+
+        Three cases, distinguished by what the tlog workers report:
+        - some worker holds a RECRUITED tlog (epoch > 0): only the
+          controller restarted — the old generation is still live and
+          committing. Resuming disk files here would truncate commits
+          acked after the end-snapshot (they keep landing while we read);
+          instead run the lock-based recovery against the LIVE tlogs,
+          exactly like a failure-triggered generation change.
+        - all workers fresh, disk queues hold data: durable full-bounce
+          restart — resume chains, truncate the unacked suffix, new epoch.
+        - all fresh and blank: new cluster at epoch 1.
+        """
+        deadline = self.loop.now + self.BOOT_DEADLINE
+        n_tlogs = len(self.spec["tlog"])
+        live_tlogs = []
+        for i in range(n_tlogs):
+            try:
+                d = await self._worker("tlog", i).describe()
+                if d.get("epoch", 0) > 0:
+                    live_tlogs.append(i)
+            except Exception:
+                continue
+        if live_tlogs:
+            self.epoch = 0  # superseded by the recovery's bumped epoch
+            self.live = {"tlog": live_tlogs}
+            await self._recover("controller restart over a live generation")
+            return
+        ends = []
+        for i in range(n_tlogs):
+            ep = self._worker("tlog", i)
+            ends.append(await self._retry(ep.tlog_resume, deadline))
+        minv, maxv = min(ends), max(ends)
+        if minv == 0 and maxv > 0:
+            raise RuntimeError(
+                f"mixed tlog recovery state (ends={ends}): some disk "
+                "queues recovered data, some are empty — refusing to "
+                "start. Restore the missing tlog queue or clear the "
+                "data dir to accept data loss."
+            )
+        if minv > 0:
+            epoch = _bump_epoch(self.data_dir) if self.data_dir else 2
+            for i in range(n_tlogs):
+                await self._retry(
+                    lambda i=i: self._tlog(i).truncate_to(minv - 1), deadline)
+            await self._form_generation(
+                epoch, minv, live=self._all_live(), seed_entries=[],
+                resume=True,
+            )
+        else:
+            await self._form_generation(
+                1, 0, live=self._all_live(), seed_entries=[], resume=True,
+            )
+
+    def _all_live(self) -> dict:
+        return {r: list(range(len(self.spec[r])))
+                for r in ("tlog", "resolver", "proxy", "storage")}
+
+    # -- generation formation ----------------------------------------------
+
+    async def _form_generation(self, epoch: int, recovery_version: int,
+                               live: dict, seed_entries: list,
+                               resume: bool) -> None:
+        from foundationdb_tpu.runtime.sequencer import EPOCH_VERSION_JUMP
+
+        deadline = self.loop.now + self.BOOT_DEADLINE
+        start = 0 if epoch == 1 else recovery_version + EPOCH_VERSION_JUMP
+        tlog_addrs = self._addrs("tlog", live["tlog"])
+        resolver_addrs = self._addrs("resolver", live["resolver"])
+
+        for i in live["resolver"]:
+            await self._retry(
+                lambda i=i: self._worker("resolver", i)
+                .recruit_resolver(epoch, start), deadline)
+        if not resume:
+            for i in live["tlog"]:
+                await self._retry(
+                    lambda i=i: self._worker("tlog", i)
+                    .recruit_tlog(epoch, start, seed_entries), deadline)
+        seq_start = await self._retry(
+            lambda: self._worker("sequencer", 0)
+            .recruit_sequencer(epoch, recovery_version), deadline)
+        assert seq_start == start
+        if resume:
+            # Resumed tlogs keep their recovered chain; adopt the jumped
+            # start (the unacked suffix was truncated in bootstrap; a
+            # fresh epoch-1 chain adopts start 0, a no-op) + epoch stamp.
+            for i in live["tlog"]:
+                await self._retry(
+                    lambda i=i: self._worker("tlog", i)
+                    .tlog_adopt(epoch, start), deadline)
+        for i in live["proxy"]:
+            await self._retry(
+                lambda i=i: self._worker("proxy", i)
+                .recruit_proxy(epoch, tlog_addrs, resolver_addrs), deadline)
+        for i in live["storage"]:
+            await self._retry(
+                lambda i=i: self._worker("storage", i)
+                .recruit_storage(epoch, recovery_version, tlog_addrs),
+                deadline)
+        self.epoch = epoch
+        self.recovery_version = recovery_version
+        self.live = live
+
+    # -- failure detection + recovery ---------------------------------------
+
+    async def run(self) -> None:
+        while True:
+            await self.loop.sleep(self.HEARTBEAT_INTERVAL)
+            if self._recovering:
+                continue
+            reason = await self._sweep()
+            if reason:
+                await self._recover(reason)
+
+    async def _sweep(self) -> str | None:
+        """Ping every generation process; also notice spec processes that
+        are BACK (restarted by fdbmonitor) but not in the generation — a
+        rejoin is folded in with a generation change, restoring full tlog
+        replication."""
+        checks = [("sequencer", 0)]
+        for role in ("tlog", "resolver", "proxy", "storage"):
+            checks.extend((role, i) for i in self.live.get(role, []))
+        # All probes in flight at once: one sweep costs ONE RPC timeout
+        # even with several dead/black-holed endpoints (mirrors the sim
+        # controller's parallel _sweep).
+        tasks = [
+            (role, i, self.loop.spawn(self._worker(role, i).describe(),
+                                      name=f"sweep.{role}{i}"))
+            for role, i in checks
+        ]
+        verdict = None
+        for role, i, t in tasks:
+            try:
+                d = await t
+            except Exception:
+                verdict = verdict or f"{role}{i} failed heartbeat"
+                continue
+            if d.get("epoch") != self.epoch:
+                # fdbmonitor restarted the process between sweeps: it
+                # answers pings but serves no recruited role — fold it
+                # back in with a generation change (catches restarts
+                # faster than a wedged proxy batch would).
+                verdict = verdict or f"{role}{i} restarted (epoch {d.get('epoch')})"
+        if verdict:
+            return verdict
+        missing = [
+            (role, i)
+            for role in ("tlog", "resolver", "proxy", "storage")
+            for i in set(range(len(self.spec[role]))) - set(
+                self.live.get(role, []))
+        ]
+        tasks = [
+            (role, i, self.loop.spawn(self._worker(role, i).ping(),
+                                      name=f"sweep.rejoin.{role}{i}"))
+            for role, i in missing
+        ]
+        for role, i, t in tasks:
+            try:
+                await t
+            except Exception:
+                continue
+            verdict = verdict or f"{role}{i} rejoined"
+        return verdict
+
+    async def _recover(self, reason: str) -> None:
+        """Lock → salvage → recruit (runtime/recovery.py's state machine,
+        driven over TCP against worker RPCs)."""
+        if self._recovering:
+            return
+        self._recovering = True
+        print(f"[controller] recovery: {reason}", file=sys.stderr, flush=True)
+        try:
+            while True:
+                try:
+                    locked = []
+                    for i in self.live.get("tlog", []):
+                        try:
+                            locked.append((await self._tlog(i).lock(), i))
+                        except Exception:
+                            continue
+                    if not locked:
+                        await self.loop.sleep(self.RETRY_DELAY)
+                        continue
+                    recovery_version, src = max(locked)
+                    seed = await self._tlog(src).recover_entries()
+                    live = await self._probe_live()
+                    if (not live["sequencer"] or not live["tlog"]
+                            or not live["resolver"] or not live["proxy"]):
+                        await self.loop.sleep(self.RETRY_DELAY)
+                        continue
+                    epoch = (_bump_epoch(self.data_dir)
+                             if self.data_dir else self.epoch + 1)
+                    await self._form_generation(
+                        epoch, recovery_version, live, seed, resume=False)
+                    self.recoveries_completed += 1
+                    print(f"[controller] recovered to epoch {epoch} "
+                          f"v{recovery_version} live={live}",
+                          file=sys.stderr, flush=True)
+                    return
+                except Exception as e:  # noqa: BLE001 — keep retrying
+                    print(f"[controller] recovery attempt failed: "
+                          f"{type(e).__name__}: {e}", file=sys.stderr,
+                          flush=True)
+                    await self.loop.sleep(self.RETRY_DELAY)
+        finally:
+            self._recovering = False
+
+    async def _probe_live(self) -> dict:
+        """Which spec processes answer right now (the recruitable set),
+        probed concurrently. Includes `sequencer`: [0] or [] — recovery
+        cannot complete without the one sequencer process and waits for
+        fdbmonitor to bring it back."""
+        roles = ("sequencer", "tlog", "resolver", "proxy", "storage")
+        tasks = [
+            (role, i, self.loop.spawn(self._worker(role, i).ping(),
+                                      name=f"probe.{role}{i}"))
+            for role in roles
+            for i in range(len(self.spec[role]))
+        ]
+        live: dict[str, list[int]] = {r: [] for r in roles}
+        for role, i, t in tasks:
+            try:
+                await t
+                live[role].append(i)
+            except Exception:
+                continue
+        return live
 
 
 def _bump_epoch(data_dir: str) -> int:
@@ -169,7 +666,18 @@ def _bump_epoch(data_dir: str) -> int:
 
 def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                index: int, data_dir: str | None) -> None:
-    """Construct and serve one role instance on transport `t`."""
+    """Construct and serve one role instance on transport `t`.
+
+    Two wiring modes:
+    - static (no `controller` in the spec): every role self-wires from the
+      spec at boot; restart recovery is the full-bounce boot_sequencer
+      sync below. Chain-role failure needs a full bounce.
+    - managed (`controller` names a process): chain roles serve only a
+      Worker; the DeployedController forms generations over RPC and heals
+      chain-role failures with a generation change (reference: fdbserver
+      workers + ClusterController recruitment).
+    """
+    managed = bool(spec.get("controller"))
     seq_addr = parse_addr(spec["sequencer"][0])
     n_storages = len(spec["storage"])
     n_tlogs = len(spec["tlog"])
@@ -180,6 +688,24 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         service = service or role_name
         return [t.endpoint(parse_addr(a), service) for a in spec[role_name]]
 
+    if role == "controller":
+        cc = DeployedController(loop, t, spec, data_dir)
+        t.serve("controller", cc)
+
+        async def boot_controller():
+            await cc.bootstrap()
+            loop.spawn(cc.run(), name="controller.run")
+
+        return loop.spawn(boot_controller(), name="controller.boot")
+    if managed and role in ("sequencer", "resolver", "tlog"):
+        t.serve("worker", Worker(loop, t, spec, role, index, data_dir))
+        return None
+    if managed and role == "proxy":
+        t.serve("worker", Worker(loop, t, spec, role, index, data_dir))
+        router = ReadRouter(storage_map, eps("storage"))
+        t.serve("read_router", router)
+        t.serve("storage0", router)  # C client default service name
+        return None
     if role == "sequencer":
         from foundationdb_tpu.runtime.sequencer import Sequencer
 
@@ -276,6 +802,12 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         )
         t.serve("storage", ss)
         _supervise(loop, f"storage{index}.run", ss.run)
+        if managed:
+            # Long-lived data role: serves reads from boot; the controller
+            # re-points its pull loop at each new generation's tlogs.
+            w = Worker(loop, t, spec, role, index, data_dir)
+            w.storage = ss
+            t.serve("worker", w)
     elif role == "proxy":
         from foundationdb_tpu.runtime.commit_proxy import CommitProxy
         from foundationdb_tpu.runtime.grv_proxy import GrvProxy

@@ -200,3 +200,65 @@ def test_tenant_surface(fdb, db):
     tr.commit()
     assert t[b"sku/2"] == b"gadget"
     assert fdb.tenant_management.list_tenants(db) == [b"shop"]
+
+
+def test_streaming_get_range_pages_lazily(fdb, db):
+    """StreamingMode.iterator (the default): iterating a range larger than
+    one page fetches pages on demand — partial iteration costs one page,
+    full iteration pages through with limit/reverse parity vs the
+    materialized result (VERDICT r3 item 8's done-criterion)."""
+    n = 700  # > RangeResult._PAGE_START
+    @fdb.transactional
+    def seed(tr):
+        for i in range(n):
+            tr[b"st%04d" % i] = b"v%d" % i
+
+    seed(db)
+    tr = db.create_transaction()
+
+    rr = tr.get_range(b"st", b"su")
+    assert isinstance(rr, fdb.RangeResult)
+    pages = []
+    real_fetch = rr._fetch
+    rr._fetch = lambda b, e, lim, rev: (
+        pages.append(lim) or real_fetch(b, e, lim, rev))
+
+    it = iter(rr)
+    first = [next(it) for _ in range(10)]
+    assert [kv.key for kv in first] == [b"st%04d" % i for i in range(10)]
+    assert first[0] == (b"st0000", b"v0")  # KeyValue unpacks like a tuple
+    assert len(pages) == 1 and pages[0] == rr._PAGE_START  # lazy: one page
+
+    rows = list(tr.get_range(b"st", b"su"))
+    assert len(rows) == n and len(pages) == 1
+    assert [kv.key for kv in rows] == [b"st%04d" % i for i in range(n)]
+
+    # limit + reverse parity with the eager Database facade.
+    fwd = list(tr.get_range(b"st", b"su", limit=300))
+    assert [kv.key for kv in fwd] == [b"st%04d" % i for i in range(300)]
+    rev = list(tr.get_range(b"st", b"su", limit=300, reverse=True))
+    assert [kv.key for kv in rev] == [b"st%04d" % i
+                                      for i in range(n - 1, n - 301, -1)]
+    # want_all starts at the page cap (big fetches up front).
+    pages.clear()
+    rr2 = tr.get_range(b"st", b"su",
+                       streaming_mode=fdb.StreamingMode.want_all)
+    real2 = rr2._fetch
+    rr2._fetch = lambda b, e, lim, rev: (
+        pages.append(lim) or real2(b, e, lim, rev))
+    assert len(list(rr2)) == n
+    assert pages[0] == rr2._PAGE_MAX
+    tr.commit()
+
+
+def test_transactional_returns_range_materialized(fdb, db):
+    """A @transactional body returning a lazy range must not page from a
+    committed transaction — the wrapper materializes it pre-commit."""
+    @fdb.transactional
+    def seed_and_scan(tr):
+        for i in range(300):
+            tr[b"mz%03d" % i] = b"x"
+        return tr.get_range(b"mz", b"m{")
+
+    rows = list(seed_and_scan(db))
+    assert len(rows) == 300

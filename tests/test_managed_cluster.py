@@ -1,0 +1,188 @@
+"""Managed deployed cluster: controller-driven recruitment over real TCP.
+
+VERDICT r3 item 6's done-criterion: boot a cluster whose spec names a
+controller, kill -9 a chain role (tlog, then sequencer), and observe the
+cluster heal with a generation change — acked data intact, commits
+resuming — without a full bounce. The restarted process is folded back in
+(full tlog replication restored), which is what fdbmonitor's restart-on-exit
+produces in production (reference: fdbserver workers re-recruited by
+ClusterController.actor.cpp after reboot).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.create_server(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_cli(spec_path: str, cmds: str):
+    return subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.cli",
+         "--cluster", spec_path, "--exec", cmds],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+@pytest.fixture
+def managed(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("managed")
+    ports = iter(free_ports(10))
+    spec = {
+        "controller": [f"127.0.0.1:{next(ports)}"],
+        "sequencer": [f"127.0.0.1:{next(ports)}"],
+        "resolver": [f"127.0.0.1:{next(ports)}"],
+        "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "engine": "cpu",
+    }
+    spec_path = tmp / "cluster.json"
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs: dict[tuple, subprocess.Popen] = {}
+
+    def launch(role, i):
+        d = tmp / "data" / f"{role}{i}"
+        d.mkdir(parents=True, exist_ok=True)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.server",
+             "--cluster", str(spec_path), "--role", role,
+             "--index", str(i), "--data-dir", str(d)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        procs[(role, i)] = p
+        return p
+
+    # Workers first, controller last (any order works — the controller's
+    # bootstrap retries — but this keeps boot fast).
+    for role in ("sequencer", "resolver", "tlog", "storage", "proxy"):
+        for i in range(len(spec[role])):
+            launch(role, i)
+    launch("controller", 0)
+
+    try:
+        for p in procs.values():
+            line = p.stdout.readline()
+            assert "ready" in line, line
+        yield spec, str(spec_path), procs, launch
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs.values():
+            p.wait()
+
+
+def controller_status(spec: dict) -> dict:
+    from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+    from foundationdb_tpu.server import parse_addr
+
+    loop = RealLoop()
+    t = NetTransport(loop)
+    try:
+        ep = t.endpoint(parse_addr(spec["controller"][0]), "controller")
+        return loop.run_until(ep.get_status(), timeout=10)
+    finally:
+        t._listener.close()
+
+
+def cli_ok(spec_path: str, cmds: str, tries: int = 45):
+    last = None
+    for _ in range(tries):
+        last = run_cli(spec_path, cmds)
+        if last.returncode == 0 and "ERROR" not in last.stdout:
+            return last
+        time.sleep(1)
+    raise AssertionError(
+        f"cli never succeeded: {last.stdout!r} {last.stderr!r}")
+
+
+class TestManagedHealing:
+    def test_tlog_kill_heals_without_bounce(self, managed):
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set mg/a v1; set mg/b v2")
+
+        # kill -9 one tlog: the controller must form a new generation on
+        # the survivors; commits resume; acked data still reads.
+        procs[("tlog", 1)].send_signal(signal.SIGKILL)
+        procs[("tlog", 1)].wait()
+        out = cli_ok(spec_path, "writemode on; set mg/c v3; getrange mg/ mg0")
+        assert "v1" in out.stdout and "v2" in out.stdout and "v3" in out.stdout
+
+        # Restart the killed tlog (what fdbmonitor does): the controller
+        # folds it back in with another generation change; writes continue.
+        launch("tlog", 1)
+        assert "ready" in procs[("tlog", 1)].stdout.readline()
+        deadline = time.monotonic() + 90
+        rejoined = False
+        while time.monotonic() < deadline and not rejoined:
+            try:
+                st = controller_status(spec)
+                rejoined = st["generation"].get("tlog") == [0, 1] \
+                    and not st["recovering"]
+            except Exception:
+                pass
+            if not rejoined:
+                time.sleep(1)
+        assert rejoined, "tlog1 never folded back into the generation"
+        out = cli_ok(spec_path, "writemode on; set mg/d v4; getrange mg/ mg0")
+        assert all(v in out.stdout for v in ("v1", "v2", "v3", "v4"))
+
+    def test_full_bounce_durable_restart(self, managed):
+        """Managed durable restart: kill EVERY process, reboot the same
+        spec + data dirs — the controller's bootstrap resumes the tlog
+        chains from disk (truncating the unacked suffix) and acked data
+        reads back in a new epoch."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set fb/a v1; set fb/b v2")
+        time.sleep(2)  # let pulls/flushes settle a beat
+        for p in procs.values():
+            p.send_signal(signal.SIGKILL)
+        for p in procs.values():
+            p.wait()
+        for role in ("sequencer", "resolver", "tlog", "storage", "proxy"):
+            for i in range(len(spec[role])):
+                launch(role, i)
+        launch("controller", 0)
+        for key, p in procs.items():
+            assert "ready" in p.stdout.readline(), key
+        out = cli_ok(spec_path, "getrange fb/ fb0")
+        assert "v1" in out.stdout and "v2" in out.stdout
+        cli_ok(spec_path, "writemode on; set fb/c v3; get fb/c")
+        st = controller_status(spec)
+        assert st["epoch"] >= 2  # durable restart started a new generation
+
+    def test_sequencer_kill_heals_after_restart(self, managed):
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set sq/a v1")
+
+        procs[("sequencer", 0)].send_signal(signal.SIGKILL)
+        procs[("sequencer", 0)].wait()
+        time.sleep(2)  # let the failure be observed
+        # There is exactly one sequencer process in the spec; recovery
+        # waits for its restart (fdbmonitor's job — emulated here).
+        launch("sequencer", 0)
+        assert "ready" in procs[("sequencer", 0)].stdout.readline()
+
+        out = cli_ok(spec_path, "writemode on; set sq/b v2; getrange sq/ sq0")
+        assert "v1" in out.stdout and "v2" in out.stdout
