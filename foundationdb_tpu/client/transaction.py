@@ -290,6 +290,7 @@ class Transaction:
         self.retry_limit: int | None = None  # option 501
         self.size_limit: int | None = None  # option 503
         self.access_system_keys = False  # option 301
+        self.lock_aware = False  # option 306: commit despite database lock
         self._retries = 0  # attempts consumed by on_error (for retry_limit)
         self._reset()
 
@@ -320,6 +321,8 @@ class Transaction:
             self.size_limit = limit
         elif name == "access_system_keys":
             self.access_system_keys = True
+        elif name == "lock_aware":
+            self.lock_aware = True
         else:
             raise FdbError(f"unknown transaction option {name!r}", code=2006)
 
@@ -636,6 +639,7 @@ class Transaction:
             read_ranges=list(self.read_ranges),
             write_ranges=list(self.write_ranges),
             report_conflicting_keys=self.report_conflicting_keys,
+            lock_aware=self.lock_aware,
         )
         try:
             res = await self.db._pick(self.db.commit_proxies).commit(req)

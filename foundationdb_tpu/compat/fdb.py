@@ -513,11 +513,15 @@ class _SnapshotView:
             end = t._dbf._block(t._tr.get_key(end, snapshot=True))
         mode = (StreamingMode.iterator if streaming_mode is None
                 else streaming_mode)
-        return RangeResult(
-            lambda b, e, n, rev: t._dbf._block(
-                t._tr.get_range(b, e, limit=n, reverse=rev, snapshot=True)),
-            begin, end, limit, reverse, mode,
-        )
+
+        def fetch(b, e, n, rev):
+            if t._tr._committed is not None:
+                raise FdbError(
+                    "range result paged after commit", code=2017)
+            return t._dbf._block(
+                t._tr.get_range(b, e, limit=n, reverse=rev, snapshot=True))
+
+        return RangeResult(fetch, begin, end, limit, reverse, mode)
 
     def get_range_startswith(self, prefix: bytes, **kw):
         return self.get_range(prefix, _strinc(prefix), **kw)
@@ -611,6 +615,9 @@ class _TransactionOptions:
 
     def set_read_your_writes_disable(self) -> None:
         self._tr.set_option("read_your_writes_disable")
+
+    def set_lock_aware(self) -> None:
+        self._tr.set_option("lock_aware")
 
     def set_tag(self, tag: str) -> None:
         self._tr.set_option("tag", tag)

@@ -124,6 +124,14 @@ class TransactionTimedOut(FdbError):
     code = 1031
 
 
+class DatabaseLocked(FdbError):
+    """Database is locked (reference error 1038): commits rejected unless
+    the transaction set the lock_aware option. Not retryable — retrying
+    cannot succeed until an operator (or DR switchover) unlocks."""
+
+    code = 1038
+
+
 class ProcessKilled(FdbError):
     """Simulation-only: the role's process was killed mid-operation."""
 

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 from foundationdb_tpu.core.errors import (
     CommitUnknownResult,
+    DatabaseLocked,
     NotCommitted,
     TransactionTooOld,
 )
@@ -40,6 +41,9 @@ class CommitRequest:
     read_ranges: list[KeyRange] = field(default_factory=list)
     write_ranges: list[KeyRange] = field(default_factory=list)
     report_conflicting_keys: bool = False
+    # Bypass the database lock (reference: LOCK_AWARE option; DR agents
+    # and operator tooling write to a locked database with this set).
+    lock_aware: bool = False
 
 
 @dataclass(frozen=True)
@@ -87,6 +91,9 @@ class CommitProxy:
         # the commit stream off the tlogs (reference: proxies write backup
         # mutations when backup/DR is active; runtime/backup.py).
         self.backup_enabled = False
+        # Database lock (reference: error 1038): set by DR switchover /
+        # operator tooling; the recruiter re-applies it across recoveries.
+        self.locked = False
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self.txns_committed = 0
         self.txns_conflicted = 0
@@ -106,6 +113,10 @@ class CommitProxy:
     @rpc
     async def set_backup_enabled(self, enabled: bool) -> None:
         self.backup_enabled = enabled
+
+    @rpc
+    async def set_locked(self, locked: bool) -> None:
+        self.locked = locked
 
     @rpc
     async def get_metrics(self) -> dict:
@@ -134,6 +145,17 @@ class CommitProxy:
                     else self.MAX_BATCH
                 batch, self._queue = \
                     self._queue[:max_batch], self._queue[max_batch:]
+            if self.locked and batch:
+                # Database locked (reference error 1038, checked at the
+                # proxy): reject non-lock-aware commits; DR/operator txns
+                # with LOCK_AWARE pass through.
+                passed = []
+                for req, p in batch:
+                    if req.lock_aware:
+                        passed.append((req, p))
+                    else:
+                        p.fail(DatabaseLocked("database is locked"))
+                batch = passed
             last_batch = self.loop.now
             # One version per batch; fetched in the batcher (not the spawned
             # worker) so batches acquire chain positions in queue order.

@@ -1,0 +1,245 @@
+"""DR: continuous asynchronous replication to a second cluster.
+
+Reference: fdbclient/DatabaseBackupAgent.actor.cpp + the `fdbdr` tool —
+"backup to a database": the same dual-tagged commit stream a file backup
+uses, but applied continuously to a DESTINATION CLUSTER through ordinary
+transactions, giving a warm secondary that can take over (fdbdr switch).
+
+Design here (the reference recipe on this runtime's machinery):
+
+- start(): enable the primary's backup dual-tagging (runtime/backup.py —
+  the BackupWorker pulls the commit stream off the tlogs into a
+  container), take the rolling range snapshot, and bootstrap the
+  secondary with an ordinary restore. From then on a continuous apply
+  loop drains container.log into the secondary in version batches.
+- Exactly-once across agent restarts: every apply batch transactionally
+  sets the progress key ``\\xff/dr/applied`` on the SECONDARY; a new
+  agent resumes from it (the reference stores DR state in the destination
+  the same way).
+- lag(): primary's committed version minus the secondary's applied
+  version — the "how far behind is DR" operator signal.
+- switchover(): LOCK the primary (commit proxies reject non-lock-aware
+  commits, reference error 1038), drain the stream through the lock
+  version, stop replication. The secondary now holds every acked commit
+  and is consistent; the operator points clients at it (fdbdr switch
+  locks the source and unlocks the destination the same way).
+- abort(): stop replication, leave the primary unlocked (fdbdr abort).
+
+The secondary stays UNLOCKED for reads; DR apply transactions set
+lock_aware so an operator may keep the secondary locked against stray
+writers while DR runs (reference DR destinations are locked) — see
+``lock_secondary``.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.core.errors import FdbError
+from foundationdb_tpu.core.mutations import ATOMIC_OPS, MutationType
+from foundationdb_tpu.runtime.backup import BackupAgent
+
+DR_APPLIED_KEY = b"\xff/dr/applied"
+APPLY_BATCH_VERSIONS = 64  # log entries folded into one dst transaction
+
+
+class DRError(FdbError):
+    code = 2316  # reference: backup_error family
+
+
+class DRAgent:
+    """Drives DR from a primary (cluster, db) to a secondary db."""
+
+    APPLY_INTERVAL = 0.005
+
+    def __init__(self, src_cluster, src_db, dst_db,
+                 lock_secondary: bool = False):
+        self.src_cluster = src_cluster
+        self.src_db = src_db
+        self.dst_db = dst_db
+        self.lock_secondary = lock_secondary
+        self.backup = BackupAgent(src_cluster, src_db)
+        self.applied = 0  # secondary consistent through this src version
+        self._task = None
+        self._stop = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, resume: bool = True) -> int:
+        """Begin DR: log first (so it covers every snapshot chunk), then
+        snapshot, then bootstrap the secondary via restore. Returns the
+        version the secondary is consistent through at bootstrap.
+
+        resume: a crashed agent's successor skips the bootstrap when the
+        secondary's progress key exists AND the primary's dual-tagging
+        stayed enabled — stream continuity holds (the proxies kept
+        tagging; un-popped entries waited on the tlogs, their trim floor
+        pinned by the tag) so applying from the progress key is exact.
+        If tagging lapsed (backup_active False), versions may be missing
+        from the stream and the full snapshot+restore bootstrap re-runs.
+        """
+        from foundationdb_tpu.runtime.backup import restore
+
+        base = 0
+        if resume:
+            base = await self.read_progress(self.dst_db)
+        if base > 0 and self.src_cluster.backup_active:
+            await self.backup.start()
+            self.applied = base
+            self._task = self.src_cluster.loop.spawn(
+                self._apply_loop(), name="dr.apply"
+            )
+            return base
+        await self.backup.start()
+        await self.backup.snapshot()
+        if self.lock_secondary:
+            await set_database_lock(self.dst_db, True)
+        base = await restore(self._dst_run_facade(), self.backup.container)
+        self.applied = base
+        await self._record_progress(base)
+        self._task = self.src_cluster.loop.spawn(
+            self._apply_loop(), name="dr.apply"
+        )
+        return base
+
+    async def abort(self) -> None:
+        """Stop replication; the primary keeps running unlocked."""
+        self._stop = True
+        await self.backup.stop()
+
+    async def switchover(self) -> int:
+        """Lock the primary, drain DR through everything acked, stop.
+
+        After this returns, the secondary contains every commit the
+        primary ever acknowledged, at the returned version; the primary
+        stays locked (clients must move — reference fdbdr switch)."""
+        await set_database_lock_cluster(self.src_cluster, True, strict=True)
+        # Everything committed before the lock is on the tlogs; stop()
+        # drains the worker through the live committed version.
+        await self.backup.stop()
+        target = self.backup.container.log_end_version
+        while self.applied < target:
+            await self.src_cluster.loop.sleep(0.01)
+        self._stop = True
+        if self.lock_secondary:
+            await set_database_lock(self.dst_db, False)
+        return self.applied
+
+    def lag(self) -> int:
+        """Versions the secondary trails the primary's pulled stream."""
+        return max(0, self.backup.container.log_end_version - self.applied)
+
+    # -- internals ---------------------------------------------------------
+
+    def _dst_run_facade(self):
+        """restore() drives db.run(body); wrap so every bootstrap txn is
+        lock-aware (the secondary may be locked against stray writers)."""
+        agent = self
+
+        class _Facade:
+            async def run(self, body, *a, **kw):
+                async def lock_aware_body(tr):
+                    tr.set_option("lock_aware")
+                    return await body(tr)
+
+                return await agent.dst_db.run(lock_aware_body, *a, **kw)
+
+        return _Facade()
+
+    async def _record_progress(self, version: int) -> None:
+        async def body(tr):
+            tr.set_option("lock_aware")
+            tr.set_option("access_system_keys")
+            tr.set(DR_APPLIED_KEY, str(version).encode())
+
+        await self.dst_db.run(body)
+
+    @classmethod
+    async def read_progress(cls, dst_db) -> int:
+        async def body(tr):
+            tr.set_option("access_system_keys")
+            return await tr.get(DR_APPLIED_KEY)
+
+        v = await dst_db.run(body)
+        return int(v) if v else 0
+
+    async def _apply_loop(self) -> None:
+        loop = self.src_cluster.loop
+        log = self.backup.container.log
+        while not self._stop:
+            pending = [(v, ms) for v, ms in log if v > self.applied]
+            if not pending:
+                await loop.sleep(self.APPLY_INTERVAL)
+                continue
+            batch = pending[:APPLY_BATCH_VERSIONS]
+            end_version = batch[-1][0]
+
+            async def body(tr, batch=batch, end_version=end_version):
+                tr.set_option("lock_aware")
+                tr.set_option("access_system_keys")
+                for _v, muts in batch:
+                    for m in muts:
+                        if m.type == MutationType.SET_VALUE:
+                            tr.set(m.param1, m.param2)
+                        elif m.type == MutationType.CLEAR_RANGE:
+                            tr.clear_range(m.param1, m.param2)
+                        elif m.type in ATOMIC_OPS:
+                            tr.atomic_op(m.type, m.param1, m.param2)
+                        else:
+                            raise DRError(f"unreplayable mutation {m.type!r}")
+                # Progress rides the SAME transaction: apply+record are
+                # atomic, so an agent crash can never double-apply a
+                # non-idempotent atomic op on resume.
+                tr.set(DR_APPLIED_KEY, str(end_version).encode())
+
+            await self.dst_db.run(body)
+            self.applied = end_version
+            # Trim the applied prefix so a long-running DR doesn't hold
+            # the whole history in memory (the file backup keeps it; DR
+            # has no restore-to-the-past contract). Scan for the cut —
+            # the log may still hold pre-bootstrap entries the restore
+            # consumed, and the worker appends concurrently at the tail.
+            cut = 0
+            while cut < len(log) and log[cut][0] <= self.applied:
+                cut += 1
+            del log[:cut]
+
+
+async def set_database_lock(db, locked: bool, strict: bool = False) -> None:
+    """Operator lock via the client's cluster handle (sim clusters)."""
+    await set_database_lock_cluster(db.cluster, locked, strict=strict)
+
+
+async def set_database_lock_cluster(cluster, locked: bool,
+                                    strict: bool = False,
+                                    retries: int = 20) -> None:
+    """Reference `lock`/`unlock`: flips every commit proxy's lock flag and
+    records it on the cluster FIRST so recoveries re-apply it (a proxy
+    replaced mid-call inherits the flag from the recruiter).
+
+    strict (switchover's mode): every CURRENT proxy must acknowledge — a
+    live proxy that silently kept committing unlocked would break the
+    "secondary holds every acked commit" contract. Each proxy is retried;
+    a proxy that stays unreachable past the retry budget is re-checked
+    against the cluster's current endpoint list (if it was replaced by a
+    recovery, its successor inherited cluster.db_locked and it no longer
+    accepts client commits), otherwise DRError surfaces to the operator."""
+    cluster.db_locked = locked
+    pending = list(cluster.commit_proxy_eps)
+    for attempt in range(retries):
+        failed = []
+        for ep in pending:
+            try:
+                await ep.set_locked(locked)
+            except Exception:
+                failed.append(ep)
+        if not failed or not strict:
+            return
+        # Drop proxies that a concurrent recovery already replaced: their
+        # successors inherited cluster.db_locked at recruit.
+        current = set(cluster.commit_proxy_eps)
+        pending = [ep for ep in failed if ep in current]
+        if not pending:
+            return
+        await cluster.loop.sleep(0.1)
+    raise DRError(
+        f"database lock not acknowledged by {len(pending)} live proxies"
+    )

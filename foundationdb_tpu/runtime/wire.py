@@ -238,11 +238,13 @@ def _register_runtime_types() -> None:
         5, CommitRequest,
         lambda r: (
             r.read_version, list(r.mutations), list(r.read_ranges),
-            list(r.write_ranges), r.report_conflicting_keys,
+            list(r.write_ranges), r.report_conflicting_keys, r.lock_aware,
         ),
         lambda f: CommitRequest(
             read_version=f[0], mutations=f[1], read_ranges=f[2],
             write_ranges=f[3], report_conflicting_keys=f[4],
+            # 5-element form: peers predating the lock_aware field.
+            lock_aware=f[5] if len(f) > 5 else False,
         ),
     )
     register_struct(

@@ -429,21 +429,32 @@ class DeployedController:
           restart — resume chains, truncate the unacked suffix, new epoch.
         - all fresh and blank: new cluster at epoch 1.
         """
-        deadline = self.loop.now + self.BOOT_DEADLINE
         n_tlogs = len(self.spec["tlog"])
-        live_tlogs = []
+        live_tlogs, max_epoch = [], 0
         for i in range(n_tlogs):
             try:
                 d = await self._worker("tlog", i).describe()
                 if d.get("epoch", 0) > 0:
                     live_tlogs.append(i)
+                    max_epoch = max(max_epoch, d["epoch"])
             except Exception:
                 continue
         if live_tlogs:
-            self.epoch = 0  # superseded by the recovery's bumped epoch
+            # The recovery's next epoch derives from the OBSERVED live
+            # generation — without a data dir it must still exceed it, or
+            # the new generation would restart the version chain.
+            self.epoch = max_epoch
             self.live = {"tlog": live_tlogs}
             await self._recover("controller restart over a live generation")
             return
+        await self._bootstrap_resume()
+
+    async def _bootstrap_resume(self) -> None:
+        """Resume tlog chains from disk (or start blank). Only safe when no
+        recruited tlog is live — callers check first (appends racing the
+        end-version snapshot would be truncated as 'unacked')."""
+        deadline = self.loop.now + self.BOOT_DEADLINE
+        n_tlogs = len(self.spec["tlog"])
         ends = []
         for i in range(n_tlogs):
             ep = self._worker("tlog", i)
@@ -457,7 +468,8 @@ class DeployedController:
                 "data dir to accept data loss."
             )
         if minv > 0:
-            epoch = _bump_epoch(self.data_dir) if self.data_dir else 2
+            epoch = (_bump_epoch(self.data_dir) if self.data_dir
+                     else self.epoch + 1 if self.epoch else 2)
             for i in range(n_tlogs):
                 await self._retry(
                     lambda i=i: self._tlog(i).truncate_to(minv - 1), deadline)
@@ -588,6 +600,7 @@ class DeployedController:
             return
         self._recovering = True
         print(f"[controller] recovery: {reason}", file=sys.stderr, flush=True)
+        lock_failures = 0
         try:
             while True:
                 try:
@@ -598,6 +611,19 @@ class DeployedController:
                         except Exception:
                             continue
                     if not locked:
+                        # No generation tlog reachable. If EVERY spec tlog
+                        # worker answers but fresh (epoch 0 — fdbmonitor
+                        # restarted them all, e.g. rack power loss), no
+                        # live chain exists to lock: fall back to the
+                        # durable disk-resume path instead of spinning.
+                        lock_failures += 1
+                        if lock_failures >= 5 and await self._all_tlogs_fresh():
+                            print("[controller] all tlogs restarted fresh — "
+                                  "disk-resume recovery", file=sys.stderr,
+                                  flush=True)
+                            await self._bootstrap_resume()
+                            self.recoveries_completed += 1
+                            return
                         await self.loop.sleep(self.RETRY_DELAY)
                         continue
                     recovery_version, src = max(locked)
@@ -607,7 +633,7 @@ class DeployedController:
                             or not live["resolver"] or not live["proxy"]):
                         await self.loop.sleep(self.RETRY_DELAY)
                         continue
-                    epoch = (_bump_epoch(self.data_dir)
+                    epoch = (_bump_epoch(self.data_dir, floor=self.epoch)
                              if self.data_dir else self.epoch + 1)
                     await self._form_generation(
                         epoch, recovery_version, live, seed, resume=False)
@@ -623,6 +649,17 @@ class DeployedController:
                     await self.loop.sleep(self.RETRY_DELAY)
         finally:
             self._recovering = False
+
+    async def _all_tlogs_fresh(self) -> bool:
+        """Every spec tlog worker answers AND serves no recruited tlog."""
+        for i in range(len(self.spec["tlog"])):
+            try:
+                d = await self._worker("tlog", i).describe()
+            except Exception:
+                return False
+            if d.get("epoch", 0) != 0:
+                return False
+        return True
 
     async def _probe_live(self) -> dict:
         """Which spec processes answer right now (the recruitable set),
@@ -646,15 +683,18 @@ class DeployedController:
         return live
 
 
-def _bump_epoch(data_dir: str) -> int:
+def _bump_epoch(data_dir: str, floor: int = 0) -> int:
     """Advance and persist the recovery generation (reference: the recovery
-    count in the coordinators' state). First durable restart → epoch 2."""
+    count in the coordinators' state). First durable restart → epoch 2.
+    `floor`: a live generation epoch observed elsewhere — the bump must
+    exceed it even if this data dir's counter lags (e.g. it was wiped)."""
     path = os.path.join(data_dir, "epoch")
     try:
         with open(path) as f:
             epoch = int(f.read().strip()) + 1
     except (OSError, ValueError):
         epoch = 2
+    epoch = max(epoch, floor + 1)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(str(epoch))

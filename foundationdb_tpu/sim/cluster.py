@@ -71,6 +71,7 @@ class SimCluster:
         n_cc_candidates: int = 3,
         data_dir: str | None = None,
         timekeeper: bool = True,
+        process_prefix: str = "",
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
@@ -88,7 +89,12 @@ class SimCluster:
 
         if not hasattr(self.loop, "tracer"):
             Tracer(self.loop)
-        self.net = SimNetwork(self.loop)
+        # Namespace for loop-global process names: two clusters on one
+        # Loop (a DR pair) must not both own a "tlog0" (kills would
+        # cross clusters). Applied by SimNetwork at host()/kill() and
+        # here at every loop.spawn(process=...).
+        self.process_prefix = process_prefix
+        self.net = SimNetwork(self.loop, process_prefix=process_prefix)
         self.engine = engine
         self.n_proxies = n_proxies
         self.n_resolvers = n_resolvers
@@ -107,6 +113,7 @@ class SimCluster:
         self._gen_processes: list[str] = []  # previous generation, for retirement
         self.backup_active = False  # BackupAgent sets; survives recoveries
         self.backup_worker = None  # live BackupWorker (its cursor bounds salvage)
+        self.db_locked = False  # DR switchover / operator lock; survives recoveries
         self.retired_tags: set[int] = set()  # stopped-backup tags, per tlog
 
         # Storage servers persist across generations (they ARE the data);
@@ -149,11 +156,12 @@ class SimCluster:
             )
             self.controller.bootstrap(**self._bootstrap_args())
             self.loop.spawn(
-                self.controller.run(), process="cluster_controller", name="cc.run"
+                self.controller.run(), process=process_prefix + "cluster_controller", name="cc.run"
             )
 
         for i, s in enumerate(self.storages):
-            self.loop.spawn(s.run(), process=f"storage{i}", name=f"storage{i}.run")
+            self.loop.spawn(s.run(), process=process_prefix + f"storage{i}",
+                            name=f"storage{i}.run")
 
         self.data_distributor = None
         self.data_distributor_ep = None
@@ -168,7 +176,7 @@ class SimCluster:
             )
             self.loop.spawn(
                 self.data_distributor.run(),
-                process="data_distributor",
+                process=process_prefix + "data_distributor",
                 name="dd.run",
             )
 
@@ -182,7 +190,7 @@ class SimCluster:
 
             self.timekeeper = TimeKeeper(self.loop, open_database(self))
             self.loop.spawn(
-                self.timekeeper.run(), process="timekeeper",
+                self.timekeeper.run(), process=process_prefix + "timekeeper",
                 name="timekeeper.run",
             )
 
@@ -288,7 +296,7 @@ class SimCluster:
             c.accepted_ballot = (1, 0)
             c.promised = (1, 0)
             c.accepted_value = dict(seed)
-        self.loop.spawn(cc0.run(), process="cc0", name="cc0.run")
+        self.loop.spawn(cc0.run(), process=self.process_prefix + "cc0", name="cc0.run")
 
         self.cc_candidates = [
             ControllerCandidate(self.loop, self, i, self.coordinator_eps)
@@ -296,7 +304,8 @@ class SimCluster:
         ]
         for cand in self.cc_candidates:
             self.loop.spawn(
-                cand.run(), process=cand.my_id, name=f"{cand.my_id}.candidate"
+                cand.run(), process=self.process_prefix + cand.my_id,
+                name=f"{cand.my_id}.candidate"
             )
 
     def retire_previous(self) -> None:
@@ -314,7 +323,7 @@ class SimCluster:
         for proc in set(getattr(self, "_pending_retirement", [])):
             if proc in current:
                 continue
-            self.loop.kill_process(proc)
+            self.loop.kill_process(self.process_prefix + proc)
             self.net.unhost_process(proc)
         self._pending_retirement = []
 
@@ -377,7 +386,9 @@ class SimCluster:
             ep = self.net.host(process, name, obj)
             heartbeat_eps[process] = self.net.host(process, "heartbeat", Heartbeat())
             if run:
-                self.loop.spawn(obj.run(), process=process, name=f"{name}.run")
+                self.loop.spawn(obj.run(),
+                                process=self.process_prefix + process,
+                                name=f"{name}.run")
             return ep
 
         if epoch > 1:
@@ -452,6 +463,7 @@ class SimCluster:
         ]
         for c in self.commit_proxies:
             c.backup_enabled = self.backup_active  # backup spans recoveries
+            c.locked = self.db_locked  # the lock spans recoveries too
         self.commit_proxy_eps = [
             host(f"commit_proxy{i}{sfx}", f"commit_proxy{i}", c, run=True)
             for i, c in enumerate(self.commit_proxies)

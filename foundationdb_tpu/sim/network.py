@@ -49,10 +49,16 @@ class SimNetwork:
         loop: Loop,
         min_latency: float = 0.0002,
         max_latency: float = 0.002,
+        process_prefix: str = "",
     ):
         self.loop = loop
         self.min_latency = min_latency
         self.max_latency = max_latency
+        # Process-name namespace: kills/partitions act on loop-global
+        # process names, so two clusters sharing one Loop (DR pairs) must
+        # not both own a "tlog0". The prefix is applied at host()/kill()
+        # so per-cluster call sites keep using bare names.
+        self.process_prefix = process_prefix
         self._objects: dict[str, Any] = {}  # endpoint name -> role object
         self._partitions: set[frozenset] = set()
         # Clogs: slow-but-alive links (reference: sim2's clogging — the
@@ -65,27 +71,31 @@ class SimNetwork:
 
     def host(self, process: str, name: str, obj: Any) -> Endpoint:
         """Register a role object as `name` on `process`; returns its endpoint."""
+        process = self.process_prefix + process
         self._objects[(process, name)] = obj
         return Endpoint(self, process, name)
 
     def kill(self, process: str) -> None:
-        self.loop.kill_process(process)
+        self.loop.kill_process(self.process_prefix + process)
 
     def unhost_process(self, process: str) -> None:
         """Drop every role object hosted on `process` (generation retirement
         — without this, each recovery would leak the full old generation,
         including never-trimmed replica tlogs holding an epoch's history)."""
+        process = self.process_prefix + process
         self._objects = {k: v for k, v in self._objects.items() if k[0] != process}
 
     def reboot(self, process: str) -> None:
         """Clears the dead flag; the harness re-hosts/restarts role actors."""
-        self.loop.revive_process(process)
+        self.loop.revive_process(self.process_prefix + process)
 
     def partition(self, a: str, b: str) -> None:
-        self._partitions.add(frozenset((a, b)))
+        self._partitions.add(frozenset(
+            (self.process_prefix + a, self.process_prefix + b)))
 
     def heal(self, a: str, b: str) -> None:
-        self._partitions.discard(frozenset((a, b)))
+        self._partitions.discard(frozenset(
+            (self.process_prefix + a, self.process_prefix + b)))
 
     def heal_all(self) -> None:
         self._partitions.clear()
@@ -96,10 +106,13 @@ class SimNetwork:
         """Inflate latency on the a↔b link by `factor` for `duration`
         virtual seconds. The link stays ALIVE: RPCs arrive late rather
         than failing, so no failure detector trips — the hard case."""
-        self._clogs[frozenset((a, b))] = (factor, self.loop.now + duration)
+        self._clogs[frozenset(
+            (self.process_prefix + a, self.process_prefix + b)
+        )] = (factor, self.loop.now + duration)
 
     def unclog(self, a: str, b: str) -> None:
-        self._clogs.pop(frozenset((a, b)), None)
+        self._clogs.pop(frozenset(
+            (self.process_prefix + a, self.process_prefix + b)), None)
 
     def _unreachable(self, src: str, dst: str) -> bool:
         return (

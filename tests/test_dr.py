@@ -1,0 +1,245 @@
+"""DR (fdbdr analogue): continuous replication to a second cluster,
+database lock, and switchover.
+
+Reference: fdbclient/DatabaseBackupAgent.actor.cpp + fdbdr. Two
+SimClusters share one deterministic Loop; the DRAgent streams the
+primary's commit log into the secondary and switchover proves the
+fdbdr contract: lock the source, drain, the destination holds every
+acknowledged commit.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.core.errors import DatabaseLocked
+from foundationdb_tpu.runtime.dr import (
+    DRAgent,
+    set_database_lock,
+)
+from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_pair(seed=3):
+    loop = Loop(seed=seed)
+    src = SimCluster(loop=loop, seed=seed, n_storages=2)
+    # Second cluster on the SAME loop: its process names ride a prefix so
+    # kills/partitions in either cluster can't cross the pair.
+    dst = SimCluster(loop=loop, seed=seed + 100, n_storages=2,
+                     process_prefix="dst.")
+    return loop, src, open_database(src), open_database(dst), dst
+
+
+async def put(db, kvs):
+    async def body(tr):
+        for k, v in kvs:
+            tr.set(k, v)
+
+    await db.run(body)
+
+
+async def scan(db, begin=b"", end=b"\xff"):
+    async def body(tr):
+        return await tr.get_range(begin, end)
+
+    return await db.run(body)
+
+
+def test_dr_bootstrap_and_continuous_replication():
+    loop, src, src_db, dst_db, _dst = make_pair()
+
+    async def main():
+        # Pre-existing data: covered by the bootstrap snapshot+restore.
+        await put(src_db, [(b"dr/a", b"1"), (b"dr/b", b"2")])
+        agent = DRAgent(src, src_db, dst_db)
+        await agent.start()
+        assert await scan(dst_db, b"dr/", b"dr0") == [
+            (b"dr/a", b"1"), (b"dr/b", b"2")]
+
+        # Live writes stream across, including atomics and clears.
+        async def mutate(tr):
+            tr.set(b"dr/c", b"3")
+            tr.clear(b"dr/a")
+            from foundationdb_tpu.core.mutations import MutationType
+            tr.atomic_op(MutationType.ADD, b"dr/ctr", (5).to_bytes(8, "little"))
+
+        await src_db.run(mutate)
+        deadline = loop.now + 30
+        while loop.now < deadline:
+            rows = await scan(dst_db, b"dr/", b"dr0")
+            if (b"dr/c", b"3") in rows and all(k != b"dr/a" for k, _ in rows):
+                break
+            await loop.sleep(0.05)
+        rows = dict(await scan(dst_db, b"dr/", b"dr0"))
+        assert rows[b"dr/c"] == b"3" and b"dr/a" not in rows
+        assert int.from_bytes(rows[b"dr/ctr"], "little") == 5
+        assert agent.lag() >= 0
+        await agent.abort()
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_database_lock_rejects_commits_unless_lock_aware():
+    loop, src, src_db, _dst_db, _ = make_pair(seed=5)
+
+    async def main():
+        await put(src_db, [(b"lk/a", b"1")])
+        await set_database_lock(src_db, True)
+        with pytest.raises(DatabaseLocked):
+            async def body(tr):
+                tr.set(b"lk/b", b"2")
+
+            await src_db.run(body)
+
+        async def aware(tr):
+            tr.set_option("lock_aware")
+            tr.set(b"lk/c", b"3")
+
+        await src_db.run(aware)
+        # Reads are unaffected by the lock.
+        assert dict(await scan(src_db, b"lk/", b"lk0"))[b"lk/c"] == b"3"
+        await set_database_lock(src_db, False)
+        await put(src_db, [(b"lk/d", b"4")])
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_database_lock_survives_recovery():
+    loop, src, src_db, _dst_db, _ = make_pair(seed=6)
+
+    async def main():
+        await set_database_lock(src_db, True)
+        # Force a generation change; the new proxies must inherit the lock.
+        from foundationdb_tpu.runtime.recovery import recover
+
+        gen = src.recruit_generation  # recruiter interface on the cluster
+        assert gen is not None
+        old_epoch_proxies = list(src.commit_proxy_eps)
+        src.controller_gen = None
+        # The sim exposes recovery via the controller in richer tests;
+        # here drive recruit_generation directly like cluster.py does.
+        new = src.recruit_generation(
+            epoch=2, recovery_version=await src.sequencer_ep
+            .get_live_committed_version(), seed_entries=[])
+        assert new.epoch == 2
+        with pytest.raises(DatabaseLocked):
+            async def body(tr):
+                tr.set(b"lk2/a", b"1")
+
+            await src_db.run(body)
+        assert old_epoch_proxies  # silence lints
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_dr_agent_crash_resumes_from_progress_key():
+    """A crashed agent's successor resumes from the transactional progress
+    key instead of re-bootstrapping (the secondary is not re-restored —
+    stream continuity holds because the proxies kept dual-tagging)."""
+    loop, src, src_db, dst_db, _dst = make_pair(seed=13)
+
+    async def main():
+        agent = DRAgent(src, src_db, dst_db)
+        await agent.start()
+        await put(src_db, [(b"rs/a", b"1")])
+        deadline = loop.now + 30
+        while loop.now < deadline:
+            if dict(await scan(dst_db, b"rs/", b"rs0")).get(b"rs/a") == b"1":
+                break
+            await loop.sleep(0.05)
+        # Simulate an agent crash: kill its tasks WITHOUT backup.stop()
+        # (dual-tagging stays on, un-popped entries wait on the tlogs).
+        agent._task.cancel()
+        agent.backup._worker.stop()
+        progress_before = await DRAgent.read_progress(dst_db)
+        assert progress_before > 0
+
+        # A sentinel the bootstrap restore would wipe (clear+reapply): its
+        # survival proves the successor resumed rather than re-restored.
+        await put(dst_db, [(b"sentinel/x", b"keep")])
+        await put(src_db, [(b"rs/b", b"2")])
+
+        agent2 = DRAgent(src, src_db, dst_db)
+        base = await agent2.start()
+        assert base == progress_before  # resumed, not re-bootstrapped
+        deadline = loop.now + 30
+        while loop.now < deadline:
+            if dict(await scan(dst_db, b"rs/", b"rs0")).get(b"rs/b") == b"2":
+                break
+            await loop.sleep(0.05)
+        rows = dict(await scan(dst_db, b"rs/", b"rs0"))
+        assert rows == {b"rs/a": b"1", b"rs/b": b"2"}
+        assert (await scan(dst_db, b"sentinel/", b"sentinel0")) == [
+            (b"sentinel/x", b"keep")]
+        await agent2.abort()
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_dr_rides_primary_recovery():
+    """DR must survive a primary generation change mid-stream (the backup
+    worker re-reads the cluster's current tlogs; proxies re-enable
+    dual-tagging on recruit) and still satisfy the switchover contract."""
+    loop = Loop(seed=11)
+    src = SimCluster(loop=loop, seed=11, n_storages=2, n_tlogs=2)
+    dst = SimCluster(loop=loop, seed=111, n_storages=2,
+                     process_prefix="dst.")
+    src_db, dst_db = open_database(src), open_database(dst)
+
+    async def main():
+        agent = DRAgent(src, src_db, dst_db)
+        await agent.start()
+        await put(src_db, [(b"rc/%02d" % i, b"a") for i in range(20)])
+        # Kill a chain role: the controller recovers to epoch 2 mid-stream.
+        src.net.kill("tlog0")
+        while src.controller.generation.epoch < 2:
+            await loop.sleep(0.25)
+        for i in range(20, 40):
+            await put(src_db, [(b"rc/%02d" % i, b"b")])
+        switch_v = await agent.switchover()
+        assert switch_v > 0
+        rows = dict(await scan(dst_db, b"rc/", b"rc0"))
+        assert len(rows) == 40, sorted(rows)
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_dr_switchover_contract():
+    """fdbdr switch: lock the primary, drain, secondary holds EVERY acked
+    commit; non-lock-aware writes to the old primary now fail."""
+    loop, src, src_db, dst_db, _dst = make_pair(seed=7)
+
+    async def main():
+        await put(src_db, [(b"sw/%03d" % i, b"v%d" % i) for i in range(50)])
+        agent = DRAgent(src, src_db, dst_db)
+        await agent.start()
+        # Keep writing while DR streams.
+        for i in range(50, 80):
+            await put(src_db, [(b"sw/%03d" % i, b"v%d" % i)])
+        switch_v = await agent.switchover()
+        assert switch_v > 0
+
+        # Old primary is locked.
+        with pytest.raises(DatabaseLocked):
+            async def body(tr):
+                tr.set(b"sw/after", b"x")
+
+            await src_db.run(body)
+
+        # Secondary has everything the primary ever acked.
+        rows = dict(await scan(dst_db, b"sw/", b"sw0"))
+        assert len(rows) == 80
+        for i in range(80):
+            assert rows[b"sw/%03d" % i] == b"v%d" % i
+
+        # And the secondary takes new writes (it is the primary now).
+        await put(dst_db, [(b"sw/new", b"y")])
+        assert (await scan(dst_db, b"sw/new", b"sw/new\x00"))[0][1] == b"y"
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"

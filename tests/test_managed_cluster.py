@@ -63,13 +63,19 @@ def managed(tmp_path_factory):
     def launch(role, i):
         d = tmp / "data" / f"{role}{i}"
         d.mkdir(parents=True, exist_ok=True)
+        # stderr to a FILE, not the pipe: supervise/controller chatter over
+        # a long heal window would fill an unread 64KB pipe and block the
+        # server's event loop mid-test. stdout stays piped for the single
+        # "ready" line.
+        errlog = open(tmp / f"{role}{i}.err.log", "ab")
         p = subprocess.Popen(
             [sys.executable, "-m", "foundationdb_tpu.server",
              "--cluster", str(spec_path), "--role", role,
              "--index", str(i), "--data-dir", str(d)],
             cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True,
+            stderr=errlog, text=True,
         )
+        errlog.close()  # child holds its own fd
         procs[(role, i)] = p
         return p
 
@@ -147,6 +153,23 @@ class TestManagedHealing:
         assert rejoined, "tlog1 never folded back into the generation"
         out = cli_ok(spec_path, "writemode on; set mg/d v4; getrange mg/ mg0")
         assert all(v in out.stdout for v in ("v1", "v2", "v3", "v4"))
+
+    def test_all_tlogs_killed_recovers_from_disk(self, managed):
+        """Both tlogs die at once (rack loss): no live chain to lock, so
+        the controller must fall back to the durable disk-resume path once
+        the restarted workers all report fresh — not spin forever."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set rk/a v1; set rk/b v2")
+        time.sleep(1)
+        for i in (0, 1):
+            procs[("tlog", i)].send_signal(signal.SIGKILL)
+            procs[("tlog", i)].wait()
+        for i in (0, 1):
+            launch("tlog", i)
+            assert "ready" in procs[("tlog", i)].stdout.readline()
+        out = cli_ok(spec_path, "getrange rk/ rk0", tries=90)
+        assert "v1" in out.stdout and "v2" in out.stdout, out.stdout
+        cli_ok(spec_path, "writemode on; set rk/c v3; get rk/c")
 
     def test_full_bounce_durable_restart(self, managed):
         """Managed durable restart: kill EVERY process, reboot the same
