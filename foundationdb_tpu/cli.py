@@ -30,9 +30,11 @@ from foundationdb_tpu.server import load_spec, parse_addr
 
 def open_cluster(spec_path: str):
     """Connect to a deployed cluster: returns (loop, transport, db)."""
+    from foundationdb_tpu.server import tls_config
+
     spec = load_spec(spec_path)
     loop = RealLoop()
-    t = NetTransport(loop)
+    t = NetTransport(loop, tls=tls_config(spec, spec_path))
 
     def eps(role: str, service: str | None = None):
         return [t.endpoint(parse_addr(a), service or role)

@@ -136,11 +136,18 @@ class BackupWorker:
     PULL_INTERVAL = 0.002
     RETRY = 0.05
 
-    def __init__(self, cluster, container: BackupContainer):
+    def __init__(self, cluster, container: BackupContainer, pop_floor=None):
         self.cluster = cluster
         self.container = container
         self._version = 0  # log pulled through this version
         self._stop = False
+        # How far the tlogs may trim our tag. Default: everything pulled
+        # (the in-memory container holds it). A DR agent instead passes
+        # its APPLIED version: pulled-but-unapplied entries live only in
+        # this process's memory, and popping them would make an agent
+        # crash unrecoverable (the resume path re-peeks them from the
+        # tlogs — silent divergence otherwise, found by review).
+        self._pop_floor = pop_floor
 
     def stop(self) -> None:
         self._stop = True
@@ -165,9 +172,12 @@ class BackupWorker:
                 # Pop on EVERY replica: proxies dual-tag all tlogs, so a
                 # replica that never sees our pop pins its trim floor at 0
                 # and grows without bound within the epoch.
+                pop_v = self._version
+                if self._pop_floor is not None:
+                    pop_v = min(pop_v, self._pop_floor())
                 for ep in self.cluster.tlog_eps:
                     try:
-                        await ep.pop(BACKUP_TAG, self._version)
+                        await ep.pop(BACKUP_TAG, pop_v)
                     except Exception:
                         pass  # dead replica: recovery will retire it
             except Exception:
@@ -183,12 +193,13 @@ class BackupAgent:
 
     CHUNK_LIMIT = 1000  # keys per range chunk
 
-    def __init__(self, cluster, db):
+    def __init__(self, cluster, db, pop_floor=None):
         self.cluster = cluster
         self.db = db
         self.container = BackupContainer()
         self._worker: BackupWorker | None = None
         self._worker_task = None
+        self._pop_floor = pop_floor  # see BackupWorker (DR passes applied)
 
     async def start(self) -> None:
         """Begin continuous backup: log first, then snapshot (the log must
@@ -201,7 +212,8 @@ class BackupAgent:
             except Exception:
                 pass
         await self._set_proxies(True)
-        self._worker = BackupWorker(self.cluster, self.container)
+        self._worker = BackupWorker(self.cluster, self.container,
+                                    pop_floor=self._pop_floor)
         self.cluster.backup_worker = self._worker  # recovery bounds salvage by it
         self._worker_task = self.cluster.loop.spawn(
             self._worker.run(), name="backup.worker"

@@ -95,6 +95,7 @@ class CommitProxy:
         # operator tooling; the recruiter re-applies it across recoveries.
         self.locked = False
         self._queue: list[tuple[CommitRequest, Promise]] = []
+        self._inflight: set[int] = set()  # batch versions being processed
         self.txns_committed = 0
         self.txns_conflicted = 0
         # Highest batch version this proxy has seen durable on ALL tlogs;
@@ -188,10 +189,22 @@ class CommitProxy:
         watchdog = self.loop.spawn(
             self._wedge_watchdog(version), name=f"wedge_watchdog@{version}"
         )
+        self._inflight.add(version)
         try:
             await self._process_inner(batch, prev_version, version)
         finally:
+            self._inflight.discard(version)
             watchdog.cancel()
+
+    @rpc
+    async def quiesce(self) -> None:
+        """Resolve once every batch admitted before this call has fully
+        completed (queued + in-flight drained). DR switchover uses this
+        after locking: a batch that passed the lock check pre-lock is
+        still entitled to its backup tagging, so dual-tagging must stay
+        on until nothing admitted remains in flight."""
+        while self._queue or self._inflight:
+            await self.loop.sleep(self.BATCH_INTERVAL)
 
     async def _wedge_watchdog(self, version: int) -> None:
         await self.loop.sleep(self.WEDGE_TIMEOUT)

@@ -56,7 +56,11 @@ class DRAgent:
         self.src_db = src_db
         self.dst_db = dst_db
         self.lock_secondary = lock_secondary
-        self.backup = BackupAgent(src_cluster, src_db)
+        # pop_floor=applied: the tlogs may only trim what the SECONDARY
+        # has durably applied — pulled-but-unapplied entries must survive
+        # an agent crash so the resume path can re-peek them.
+        self.backup = BackupAgent(src_cluster, src_db,
+                                  pop_floor=lambda: self.applied)
         self.applied = 0  # secondary consistent through this src version
         self._task = None
         self._stop = False
@@ -103,22 +107,58 @@ class DRAgent:
     async def abort(self) -> None:
         """Stop replication; the primary keeps running unlocked."""
         self._stop = True
+        if self._task is not None:
+            self._task.cancel()
         await self.backup.stop()
+
+    def _check_apply_alive(self) -> None:
+        """A dead apply loop must surface, not hang the caller's drain
+        (especially switchover, which has already locked the primary)."""
+        t = self._task
+        if t is not None and t.done() and not self._stop:
+            try:
+                t.result()
+            except Exception as e:
+                raise DRError(f"DR apply loop died: {e!r}") from e
+            raise DRError("DR apply loop exited unexpectedly")
 
     async def switchover(self) -> int:
         """Lock the primary, drain DR through everything acked, stop.
 
-        After this returns, the secondary contains every commit the
-        primary ever acknowledged, at the returned version; the primary
-        stays locked (clients must move — reference fdbdr switch)."""
+        Sequence matters (review-found race): lock first, then QUIESCE
+        every proxy — a batch that passed the lock check pre-lock is
+        still in flight and entitled to its backup tagging, so dual-
+        tagging must stay enabled until nothing admitted remains — then
+        read the drain target and only then stop the backup (which
+        disables tagging). After this returns, the secondary contains
+        every commit the primary ever acknowledged, at the returned
+        version; the primary stays locked (clients must move — reference
+        fdbdr switch)."""
+        loop = self.src_cluster.loop
         await set_database_lock_cluster(self.src_cluster, True, strict=True)
-        # Everything committed before the lock is on the tlogs; stop()
-        # drains the worker through the live committed version.
-        await self.backup.stop()
-        target = self.backup.container.log_end_version
-        while self.applied < target:
-            await self.src_cluster.loop.sleep(0.01)
+        for ep in list(self.src_cluster.commit_proxy_eps):
+            try:
+                await ep.quiesce()
+            except Exception:
+                continue  # replaced/dead proxy: its batches failed out
+        target = await self.src_cluster.sequencer_ep.get_live_committed_version()
+        await self.backup.stop()  # drains the worker ≥ target, then untags
+        # Drained when no entry remains unapplied AND the worker's
+        # coverage reached the target: versions in (applied, target] with
+        # no entry were idle/empty batches — nothing to apply (comparing
+        # `applied < target` alone would hang on a trailing idle gap).
+        container = self.backup.container
+        while True:
+            self._check_apply_alive()
+            if (container.log_covered >= target
+                    and not any(v > self.applied for v, _ in container.log)):
+                break
+            await loop.sleep(0.01)
+        self.applied = max(self.applied, target)
+        await self._record_progress(self.applied)
         self._stop = True
+        if self._task is not None:
+            self._task.cancel()
         if self.lock_secondary:
             await set_database_lock(self.dst_db, False)
         return self.applied

@@ -103,9 +103,18 @@ class RealLoop(Loop):
 
 
 class _Conn:
-    """One TCP connection (either side): frame reassembly + buffered writes."""
+    """One TCP connection (either side): frame reassembly + buffered writes.
 
-    def __init__(self, transport: "NetTransport", sock: socket.socket):
+    With a TLS-configured transport (reference: flow/TLSConfig.actor.cpp —
+    mutual TLS between every pair of processes), the framing rides an
+    ``ssl.SSLObject`` over memory BIOs: raw socket bytes feed the incoming
+    BIO, decrypted application bytes feed the frame reassembly, and
+    outgoing handshake/application bytes drain from the outgoing BIO into
+    the ordinary nonblocking write buffer. Frames queued before the
+    handshake completes are buffered and sent on completion."""
+
+    def __init__(self, transport: "NetTransport", sock: socket.socket,
+                 server_side: bool = True):
         self.t = transport
         self.sock = sock
         sock.setblocking(False)
@@ -114,7 +123,24 @@ class _Conn:
         self.wbuf = bytearray()
         self.pending: dict[int, Promise] = {}  # requests sent on this conn
         self.closed = False
-        self.t.loop.register(sock, selectors.EVENT_READ, self._on_ready)
+        self.tls = None
+        ctx = transport.tls_context(server_side)
+        if ctx is not None:
+            import ssl as _ssl
+
+            self._in_bio = _ssl.MemoryBIO()
+            self._out_bio = _ssl.MemoryBIO()
+            self.tls = ctx.wrap_bio(
+                self._in_bio, self._out_bio, server_side=server_side
+            )
+            self._hs_done = False
+            self._pre_hs: list[bytes] = []  # frames queued pre-handshake
+            self._step_tls()
+        # _events(), not EVENT_READ: _step_tls may already have queued the
+        # ClientHello in wbuf (send hit EAGAIN on an in-flight connect) —
+        # registering read-only here would drop write interest and the
+        # handshake would deadlock.
+        self.t.loop.register(sock, self._events(), self._on_ready)
 
     # -- IO -------------------------------------------------------------
 
@@ -138,9 +164,55 @@ class _Conn:
             if not data:
                 self.close()
                 return
-            self.rbuf += data
+            if self.tls is not None:
+                self._in_bio.write(bytes(data))
+                if not self._step_tls():
+                    return  # closed on TLS failure
+            else:
+                self.rbuf += data
             self._drain_frames()
         if self.wbuf:
+            self._flush()
+
+    # -- TLS pump --------------------------------------------------------
+
+    def _step_tls(self) -> bool:
+        """Advance handshake + decrypt available bytes. False → closed."""
+        import ssl as _ssl
+
+        if not self._hs_done:
+            try:
+                self.tls.do_handshake()
+                self._hs_done = True
+                for payload in self._pre_hs:
+                    self.tls.write(payload)
+                self._pre_hs = []
+            except _ssl.SSLWantReadError:
+                pass
+            except _ssl.SSLError:
+                self._drain_out_bio()
+                self.close()  # alert bytes (if any) flushed best-effort
+                return False
+        if self._hs_done:
+            while True:
+                try:
+                    chunk = self.tls.read(1 << 16)
+                except _ssl.SSLWantReadError:
+                    break
+                except _ssl.SSLError:
+                    self.close()
+                    return False
+                if not chunk:
+                    self.close()  # clean TLS EOF
+                    return False
+                self.rbuf += chunk
+        self._drain_out_bio()
+        return True
+
+    def _drain_out_bio(self) -> None:
+        pending = self._out_bio.read()
+        if pending:
+            self.wbuf += pending
             self._flush()
 
     def send_frame(self, payload: bytes) -> None:
@@ -153,7 +225,15 @@ class _Conn:
             raise TransactionTooLarge(
                 f"frame of {len(payload)} bytes exceeds {MAX_FRAME}"
             )
-        self.wbuf += _LEN.pack(len(payload)) + payload
+        framed = _LEN.pack(len(payload)) + payload
+        if self.tls is not None:
+            if not self._hs_done:
+                self._pre_hs.append(framed)
+                return
+            self.tls.write(framed)
+            self._drain_out_bio()
+            return
+        self.wbuf += framed
         self._flush()
 
     def _flush(self) -> None:
@@ -226,18 +306,47 @@ class RemoteEndpoint:
 
 
 class NetTransport:
-    """Serve local role objects + call remote ones over TCP."""
+    """Serve local role objects + call remote ones over TCP.
 
-    def __init__(self, loop: RealLoop, host: str = "127.0.0.1", port: int = 0):
+    `tls`: optional dict ``{"cert": path, "key": path, "ca": path}`` —
+    enables MUTUAL TLS on every connection, both directions (reference:
+    flow/TLSConfig.actor.cpp; FDB processes verify each other's chains).
+    Peers without the right client certificate cannot complete a
+    handshake, so the @rpc surface is unreachable to them. Note: the C
+    netclient (native/netclient.cpp) speaks plaintext — point it at a
+    non-TLS cluster (the reference's fdb_c grows TLS via network options;
+    ours does not yet)."""
+
+    def __init__(self, loop: RealLoop, host: str = "127.0.0.1", port: int = 0,
+                 tls: dict | None = None):
         self.loop = loop
         self._services: dict[str, tuple[object, frozenset[str]]] = {}
         self._conns: dict[tuple, _Conn] = {}  # outbound, by remote addr
         self._all_conns: set[_Conn] = set()
         self._next_id = 0
+        self._tls_server_ctx = self._tls_client_ctx = None
+        if tls:
+            import ssl as _ssl
+
+            srv = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            srv.load_cert_chain(tls["cert"], tls["key"])
+            srv.load_verify_locations(tls["ca"])
+            srv.verify_mode = _ssl.CERT_REQUIRED  # mutual TLS
+            cli = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            cli.load_cert_chain(tls["cert"], tls["key"])
+            cli.load_verify_locations(tls["ca"])
+            # Peers are verified by CA chain, not hostname (processes move
+            # between addresses; the reference verifies subject criteria).
+            cli.check_hostname = False
+            cli.verify_mode = _ssl.CERT_REQUIRED
+            self._tls_server_ctx, self._tls_client_ctx = srv, cli
         self._listener = socket.create_server((host, port))
         self._listener.setblocking(False)
         self.addr = self._listener.getsockname()
         loop.register(self._listener, selectors.EVENT_READ, self._accept)
+
+    def tls_context(self, server_side: bool):
+        return self._tls_server_ctx if server_side else self._tls_client_ctx
 
     # -- server side ------------------------------------------------------
 
@@ -262,7 +371,7 @@ class NetTransport:
             sock, _peer = self._listener.accept()
         except (BlockingIOError, OSError):
             return
-        self._all_conns.add(_Conn(self, sock))
+        self._all_conns.add(_Conn(self, sock, server_side=True))
 
     # -- client side ------------------------------------------------------
 
@@ -282,7 +391,7 @@ class NetTransport:
         except OSError:
             sock.close()  # synchronous failure: don't leak the fd
             raise
-        conn = _Conn(self, sock)
+        conn = _Conn(self, sock, server_side=False)
         self._conns[addr] = conn
         self._all_conns.add(conn)
         return conn

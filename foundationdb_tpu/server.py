@@ -67,6 +67,18 @@ def parse_addr(s: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def tls_config(spec: dict, spec_path: str) -> dict | None:
+    """The spec's optional `tls` section (cert/key/ca paths, resolved
+    relative to the cluster file — reference: TLSConfig from the cluster
+    file's tls: suffix + command-line knobs)."""
+    tls = spec.get("tls")
+    if not tls:
+        return None
+    base = os.path.dirname(os.path.abspath(spec_path))
+    return {k: os.path.join(base, v) if not os.path.isabs(v) else v
+            for k, v in tls.items()}
+
+
 def make_conflict_set(engine: str):
     """Resolver engine: 'tpu' is the production kernel; 'cpu' (C++ skiplist)
     keeps a cluster deployable on hosts with no accelerator."""
@@ -468,7 +480,8 @@ class DeployedController:
                 "data dir to accept data loss."
             )
         if minv > 0:
-            epoch = (_bump_epoch(self.data_dir) if self.data_dir
+            epoch = (_bump_epoch(self.data_dir, floor=self.epoch)
+                     if self.data_dir
                      else self.epoch + 1 if self.epoch else 2)
             for i in range(n_tlogs):
                 await self._retry(
@@ -931,7 +944,8 @@ def main(argv: list[str] | None = None) -> None:
 
     tracer = Tracer(loop, trace_dir=args.trace_dir,
                     process=f"{args.role}{args.index}")
-    t = NetTransport(loop, host=host, port=port)
+    t = NetTransport(loop, host=host, port=port,
+                     tls=tls_config(spec, args.cluster))
     boot = build_role(loop, t, spec, args.role, args.index, args.data_dir)
     if boot is not None:
         # The role defers serving behind a boot task (sequencer restart

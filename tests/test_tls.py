@@ -1,0 +1,169 @@
+"""Mutual TLS on the deployed transport (reference: flow/TLSConfig).
+
+A CA + one leaf cert are generated per test dir; every process and the
+CLI load them through the cluster file's `tls` section. Positive path: a
+full cluster speaks TLS end-to-end through the CLI. Negative paths: a
+plaintext client cannot complete a handshake, and a client presenting a
+certificate from a DIFFERENT CA is rejected (mutual verification).
+"""
+
+import datetime
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.create_server(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_ca_and_leaf(dirpath, prefix: str):
+    """Write {prefix}-ca.pem, {prefix}-cert.pem, {prefix}-key.pem."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name(f"{prefix}-ca")).issuer_name(name(f"{prefix}-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    leaf_key = ec.generate_private_key(ec.SECP256R1())
+    leaf_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name(f"{prefix}-proc")).issuer_name(name(f"{prefix}-ca"))
+        .public_key(leaf_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .sign(ca_key, hashes.SHA256())
+    )
+    paths = {}
+    for nm, data in (
+        ("ca", ca_cert.public_bytes(serialization.Encoding.PEM)),
+        ("cert", leaf_cert.public_bytes(serialization.Encoding.PEM)),
+        ("key", leaf_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption())),
+    ):
+        p = os.path.join(dirpath, f"{prefix}-{nm}.pem")
+        with open(p, "wb") as f:
+            f.write(data)
+        paths[nm] = p
+    return paths
+
+
+@pytest.fixture
+def tls_cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tls")
+    certs = make_ca_and_leaf(str(tmp), "main")
+    ports = iter(free_ports(6))
+    spec = {
+        "sequencer": [f"127.0.0.1:{next(ports)}"],
+        "resolver": [f"127.0.0.1:{next(ports)}"],
+        "tlog": [f"127.0.0.1:{next(ports)}"],
+        "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "proxy": [f"127.0.0.1:{next(ports)}"],
+        "engine": "cpu",
+        "tls": {"cert": certs["cert"], "key": certs["key"],
+                "ca": certs["ca"]},
+    }
+    spec_path = tmp / "cluster.json"
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    for role, addrs in spec.items():
+        if role in ("engine", "tls"):
+            continue
+        for i in range(len(addrs)):
+            errlog = open(tmp / f"{role}{i}.err.log", "ab")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "foundationdb_tpu.server",
+                 "--cluster", str(spec_path), "--role", role,
+                 "--index", str(i)],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=errlog, text=True,
+            ))
+            errlog.close()
+    try:
+        for p in procs:
+            assert "ready" in p.stdout.readline()
+        yield spec, str(spec_path), str(tmp)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait()
+
+
+def run_cli(spec_path: str, cmds: str):
+    return subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.cli",
+         "--cluster", spec_path, "--exec", cmds],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+class TestTLS:
+    def test_tls_cluster_end_to_end(self, tls_cluster):
+        _spec, spec_path, _tmp = tls_cluster
+        last = None
+        for _ in range(30):
+            last = run_cli(spec_path, "writemode on; set tls/a v1; get tls/a")
+            if last.returncode == 0 and "v1" in last.stdout:
+                return
+            time.sleep(1)
+        raise AssertionError(f"TLS cli failed: {last.stdout} {last.stderr}")
+
+    def test_plaintext_client_rejected(self, tls_cluster):
+        spec, spec_path, tmp = tls_cluster
+        # A spec WITHOUT the tls section = plaintext transport.
+        plain = {k: v for k, v in spec.items() if k != "tls"}
+        plain_path = os.path.join(tmp, "plain.json")
+        with open(plain_path, "w") as f:
+            json.dump(plain, f)
+        r = run_cli(plain_path, "getversion")
+        assert r.returncode != 0 or "ERROR" in r.stdout, r.stdout
+
+    def test_wrong_ca_client_rejected(self, tls_cluster):
+        spec, spec_path, tmp = tls_cluster
+        rogue = make_ca_and_leaf(tmp, "rogue")
+        bad = dict(spec)
+        bad["tls"] = {"cert": rogue["cert"], "key": rogue["key"],
+                      "ca": rogue["ca"]}
+        bad_path = os.path.join(tmp, "rogue.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad, f)
+        r = run_cli(bad_path, "getversion")
+        assert r.returncode != 0 or "ERROR" in r.stdout, r.stdout
