@@ -28,13 +28,17 @@ from foundationdb_tpu.runtime.shardmap import KeyShardMap
 from foundationdb_tpu.server import load_spec, parse_addr
 
 
-def open_cluster(spec_path: str):
-    """Connect to a deployed cluster: returns (loop, transport, db)."""
+def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
+                 t: "NetTransport | None" = None):
+    """Connect to a deployed cluster: returns (loop, transport, db).
+
+    Pass an existing (loop, t) to put several clusters on ONE event loop
+    (the deployed DR agent drives source and destination together)."""
     from foundationdb_tpu.server import tls_config
 
     spec = load_spec(spec_path)
-    loop = RealLoop()
-    t = NetTransport(loop, tls=tls_config(spec, spec_path))
+    loop = loop or RealLoop()
+    t = t or NetTransport(loop, tls=tls_config(spec, spec_path))
 
     def eps(role: str, service: str | None = None):
         return [t.endpoint(parse_addr(a), service or role)

@@ -291,6 +291,7 @@ class Transaction:
         self.size_limit: int | None = None  # option 503
         self.access_system_keys = False  # option 301
         self.lock_aware = False  # option 306: commit despite database lock
+        self.authorization_token: str | None = None  # option 2000
         self._retries = 0  # attempts consumed by on_error (for retry_limit)
         self._reset()
 
@@ -323,6 +324,12 @@ class Transaction:
             self.access_system_keys = True
         elif name == "lock_aware":
             self.lock_aware = True
+        elif name == "authorization_token":
+            if not value:
+                raise FdbError("authorization_token requires a value",
+                               code=2006)
+            self.authorization_token = (
+                value.decode() if isinstance(value, bytes) else str(value))
         else:
             raise FdbError(f"unknown transaction option {name!r}", code=2006)
 
@@ -640,6 +647,7 @@ class Transaction:
             write_ranges=list(self.write_ranges),
             report_conflicting_keys=self.report_conflicting_keys,
             lock_aware=self.lock_aware,
+            token=self.authorization_token,
         )
         try:
             res = await self.db._pick(self.db.commit_proxies).commit(req)

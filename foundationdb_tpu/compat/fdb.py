@@ -619,6 +619,9 @@ class _TransactionOptions:
     def set_lock_aware(self) -> None:
         self._tr.set_option("lock_aware")
 
+    def set_authorization_token(self, token) -> None:
+        self._tr.set_option("authorization_token", token)
+
     def set_tag(self, tag: str) -> None:
         self._tr.set_option("tag", tag)
 

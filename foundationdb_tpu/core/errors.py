@@ -124,6 +124,16 @@ class TransactionTimedOut(FdbError):
     code = 1031
 
 
+class PermissionDenied(FdbError):
+    """Reference error 6000: permission_denied (tenant authorization
+    rejection — runtime/authz.py). Not retryable: retrying cannot mint a
+    better token. Defined here (not in authz.py) so make_error can
+    reconstruct it in client processes that never import the authz
+    module."""
+
+    code = 6000
+
+
 class DatabaseLocked(FdbError):
     """Database is locked (reference error 1038): commits rejected unless
     the transaction set the lock_aware option. Not retryable — retrying

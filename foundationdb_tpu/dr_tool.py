@@ -1,0 +1,174 @@
+"""fdbdr analogue for DEPLOYED clusters: drive runtime/dr.DRAgent between
+two TCP clusters (reference: fdbdr start/status/switch/abort over
+DatabaseBackupAgent.actor.cpp).
+
+    python -m foundationdb_tpu.dr_tool replicate --src src.json --dst dst.json
+    python -m foundationdb_tpu.dr_tool status    --src src.json --dst dst.json
+    python -m foundationdb_tpu.dr_tool switch    --src src.json --dst dst.json
+    python -m foundationdb_tpu.dr_tool abort     --src src.json --dst dst.json
+
+- replicate: bootstrap (or resume from the destination's progress key)
+  and stream continuously until SIGINT/SIGTERM or --duration elapses.
+  Dual-tagging stays enabled on exit, so a later `switch` resumes and
+  drains without a re-bootstrap.
+- status: standalone lag readout — source live committed version minus
+  the destination's applied progress key. No agent required.
+- switch: resume, drain, lock the source, leave the destination
+  consistent through every acked commit (fdbdr switch).
+- abort: stop replication and unlock the source (fdbdr abort).
+
+The agent addresses cluster ROLES directly (tlog peek/pop, proxy
+set_backup_enabled/set_locked/quiesce, sequencer live version) through a
+`DeployedClusterHandle` presenting SimCluster's surface over RPC
+endpoints. Static generation wiring: if the source recovers to a new
+generation mid-replication, restart the tool (it resumes); the sim
+DRAgent rides recoveries live, the deployed handle does not re-resolve
+endpoints yet.
+
+An authz-enabled destination needs --dst-token (an admin token minted
+with prefix b"" — see runtime/authz.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from foundationdb_tpu.cli import open_cluster
+from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+from foundationdb_tpu.server import load_spec, parse_addr, tls_config
+
+
+class DeployedClusterHandle:
+    """SimCluster's agent-facing surface over a deployed cluster's RPC
+    endpoints (the attributes Backup/DR agents touch, nothing more)."""
+
+    def __init__(self, loop: RealLoop, t: NetTransport, spec: dict):
+        self.loop = loop
+        self.tlog_eps = [t.endpoint(parse_addr(a), "tlog")
+                         for a in spec["tlog"]]
+        self.commit_proxy_eps = [t.endpoint(parse_addr(a), "commit_proxy")
+                                 for a in spec["proxy"]]
+        self.sequencer_ep = t.endpoint(parse_addr(spec["sequencer"][0]),
+                                       "sequencer")
+        self.retired_tags: set[int] = set()
+        self.backup_active = False
+        self.backup_worker = None
+        self.db_locked = False
+
+    async def probe_backup_active(self) -> bool:
+        """Stream-continuity probe (DRAgent resume gate): ANY live proxy
+        still dual-tagging means the tlog stream stayed unbroken."""
+        for ep in self.commit_proxy_eps:
+            try:
+                if await ep.get_backup_enabled():
+                    return True
+            except Exception:
+                continue
+        return False
+
+
+def connect_pair(src_spec_path: str, dst_spec_path: str):
+    """One loop, but a transport PER CLUSTER: each side's TLS config
+    (or lack of one) comes from its own spec — a plaintext source and a
+    TLS destination, or different CAs, must both work."""
+    loop = RealLoop()
+    src_spec, dst_spec = load_spec(src_spec_path), load_spec(dst_spec_path)
+    t_src = NetTransport(loop, tls=tls_config(src_spec, src_spec_path))
+    t_dst = NetTransport(loop, tls=tls_config(dst_spec, dst_spec_path))
+    _, _, src_db = open_cluster(src_spec_path, loop=loop, t=t_src)
+    _, _, dst_db = open_cluster(dst_spec_path, loop=loop, t=t_dst)
+    src = DeployedClusterHandle(loop, t_src, src_spec)
+    dst = DeployedClusterHandle(loop, t_dst, dst_spec)
+    src_db.cluster = src
+    dst_db.cluster = dst
+    return loop, src, src_db, dst_db
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command",
+                    choices=("replicate", "status", "switch", "abort"))
+    ap.add_argument("--src", required=True, help="source cluster spec")
+    ap.add_argument("--dst", required=True, help="destination cluster spec")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="replicate: stop after this many seconds")
+    ap.add_argument("--dst-token", default=None,
+                    help="authz admin token for the destination")
+    args = ap.parse_args(argv)
+
+    from foundationdb_tpu.runtime.dr import (
+        DRAgent,
+        set_database_lock_cluster,
+    )
+
+    loop, src, src_db, dst_db = connect_pair(args.src, args.dst)
+
+    if args.command == "status":
+        async def status():
+            applied = await DRAgent.read_progress(dst_db)
+            live = await src.sequencer_ep.get_live_committed_version()
+            print(f"applied={applied} src_committed={live} "
+                  f"lag_versions={max(0, live - applied)}", flush=True)
+
+        loop.run(status(), timeout=60)
+        return 0
+
+    agent = DRAgent(src, src_db, dst_db, dst_token=args.dst_token)
+
+    if args.command == "abort":
+        async def abort():
+            # Full backup stop (no live worker in this process, so the
+            # drain is skipped): disables tagging AND retires BACKUP_TAG —
+            # otherwise the tag pins every source tlog's trim floor
+            # forever and the logs grow unbounded.
+            await agent.backup.stop()
+            await set_database_lock_cluster(src, False)
+            print("dr aborted: tagging off, tag retired, source unlocked",
+                  flush=True)
+
+        loop.run(abort(), timeout=120)
+        return 0
+
+    if args.command == "switch":
+        async def switch():
+            base = await agent.start()  # resumes from the progress key
+            v = await agent.switchover()
+            print(f"switched at version {v} (resumed from {base}); "
+                  "source locked", flush=True)
+
+        loop.run(switch(), timeout=3600)
+        return 0
+
+    # replicate
+    import signal as _signal
+
+    stop = {"flag": False}
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, lambda *_: stop.update(flag=True))
+
+    async def replicate():
+        base = await agent.start()
+        print(f"replicating (consistent through {base})", flush=True)
+        t0 = loop.now
+        while not stop["flag"]:
+            if args.duration is not None and loop.now - t0 > args.duration:
+                break
+            agent._check_apply_alive()
+            await loop.sleep(0.25)
+        # Leave dual-tagging ON so `switch` can resume and drain later;
+        # stop only this process's worker/apply.
+        agent._stop = True
+        if agent._task is not None:
+            agent._task.cancel()
+        if agent.backup._worker is not None:
+            agent.backup._worker.stop()
+        print(f"replication paused at applied={agent.applied} "
+              "(tagging stays on; run `switch` or `abort`)", flush=True)
+
+    loop.run(replicate(), timeout=float("inf"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Tenant authorization tokens (reference: FDB 7.x authorization —
+fdbrpc/TokenSign.cpp, TenantAuthorizer): an operator holding the cluster's
+private key mints expiring tokens that scope a client to specific tenant
+key prefixes; cluster processes hold only the PUBLIC key and verify every
+tokened commit.
+
+Differences from the reference, by design of this runtime:
+- Tokens authorize PREFIXES (the tenant prefix bytes), not tenant IDs:
+  our commit proxies are stateless and never read the tenant map, so the
+  issuer (who reads ``\\xff/tenant/map`` with operator credentials)
+  resolves names to prefixes at mint time.
+- Enforcement is at the COMMIT boundary: with authz enabled, every
+  mutation and write-conflict range of a tokened request must lie inside
+  an authorized prefix, and untokened user-keyspace writes are denied
+  outright (the reference's tenant-required mode). Reads ride the mutual
+  TLS process mesh (runtime/net.py); per-read storage-side token checks
+  are not implemented.
+
+Token wire form: ``base64url(json payload) + "." + base64url(signature)``
+with an Ed25519 signature over the payload bytes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+
+from foundationdb_tpu.core.errors import PermissionDenied  # noqa: F401 (re-export)
+from foundationdb_tpu.core.mutations import VERSIONSTAMP_SIZE, MutationType
+from foundationdb_tpu.core.types import strinc
+
+
+def _b64e(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _b64d(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def generate_keypair() -> tuple[bytes, bytes]:
+    """(private_pem, public_pem) — Ed25519, the reference's default."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    priv = ed25519.Ed25519PrivateKey.generate()
+    return (
+        priv.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+        priv.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        ),
+    )
+
+
+def mint_token(private_pem: bytes, prefixes: list[bytes],
+               expires_at: float) -> str:
+    """Operator-side: sign a token authorizing writes under `prefixes`
+    until `expires_at` (seconds, the cluster loop's clock domain)."""
+    from cryptography.hazmat.primitives import serialization
+
+    priv = serialization.load_pem_private_key(private_pem, password=None)
+    payload = json.dumps({
+        "prefixes": [p.hex() for p in prefixes],
+        "exp": expires_at,
+    }, sort_keys=True).encode()
+    return _b64e(payload) + "." + _b64e(priv.sign(payload))
+
+
+class TokenAuthority:
+    """Proxy-side verifier: holds the public key, caches verified tokens
+    (signature checks are not free; the reference caches too)."""
+
+    CACHE_MAX = 1024
+
+    def __init__(self, public_pem: bytes):
+        from cryptography.hazmat.primitives import serialization
+
+        self._pub = serialization.load_pem_public_key(public_pem)
+        self._cache: dict[str, tuple[list[bytes], float]] = {}
+
+    def verify(self, token: str, now: float) -> list[bytes]:
+        """→ authorized prefixes; raises PermissionDenied on any flaw."""
+        hit = self._cache.get(token)
+        if hit is None:
+            try:
+                payload_s, sig_s = token.split(".", 1)
+                payload = _b64d(payload_s)
+                self._pub.verify(_b64d(sig_s), payload)
+                doc = json.loads(payload)
+                hit = ([bytes.fromhex(p) for p in doc["prefixes"]],
+                       float(doc["exp"]))
+            except PermissionDenied:
+                raise
+            except Exception as e:  # malformed/forged
+                raise PermissionDenied(f"invalid token: {type(e).__name__}")
+            if len(self._cache) >= self.CACHE_MAX:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[token] = hit
+        prefixes, exp = hit
+        if now > exp:
+            raise PermissionDenied("token expired")
+        return prefixes
+
+    def check_commit(self, req, now: float) -> None:
+        """Enforce the write boundary over the USER keyspace: every user
+        mutation endpoint and write range must lie inside an authorized
+        prefix (the reference's tenant-required mode for untrusted
+        clients). System-keyspace writes (``\\xff...``) are outside token
+        scope — they stay governed by the access_system_keys option and
+        the mutual-TLS process mesh, which is how in-process system
+        actors (TimeKeeper, tenant management) keep working. A DR/backup
+        apply agent on an authz-enabled destination needs an ADMIN token
+        (minted with the explicit prefix b"" = the whole user keyspace).
+        """
+        prefixes: list[bytes] | None = None
+        token = getattr(req, "token", None)
+        if token:
+            prefixes = self.verify(token, now)
+
+        def prefix_of(begin: bytes, end: bytes):
+            """The authorized prefix containing [begin, end), or None."""
+            if begin >= b"\xff":
+                return b""  # system keyspace: not token-governed
+            if prefixes is None:
+                return None  # untokened user write under authz
+            for p in prefixes:
+                if p == b"":
+                    # Explicit admin grant: the whole user keyspace.
+                    if end <= b"\xff":
+                        return p
+                    continue
+                try:
+                    bound = strinc(p)
+                except ValueError:
+                    continue  # all-0xff prefix: no user key has it
+                if begin.startswith(p) and end <= bound:
+                    return p
+            return None
+
+        def covered(begin: bytes, end: bytes) -> bool:
+            return prefix_of(begin, end) is not None
+
+        def stamped_key_ok(param: bytes) -> bool:
+            """SET_VERSIONSTAMPED_KEY writes body[:off]+stamp+body[off+10:]
+            — the check must hold for the POST-substitution key, whose
+            stamp bytes are arbitrary. Safe iff the covering prefix lies
+            entirely BEFORE the stamp splice (off >= len(prefix)); a
+            malformed operand is denied here and would fail at assembly
+            anyway."""
+            if len(param) < 4:
+                return False
+            (off,) = struct.unpack("<I", param[-4:])
+            body = param[:-4]
+            if off + VERSIONSTAMP_SIZE > len(body):
+                return False
+            p = prefix_of(body, body + b"\x00")
+            return p is not None and off >= len(p)
+
+        for m in req.mutations:
+            if m.type == MutationType.CLEAR_RANGE:
+                if not covered(m.param1, m.param2):
+                    raise PermissionDenied(
+                        "clear range outside authorized tenants")
+            elif m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+                if not stamped_key_ok(m.param1):
+                    raise PermissionDenied(
+                        "versionstamped key escapes authorized tenants")
+            else:
+                if not covered(m.param1, m.param1 + b"\x00"):
+                    raise PermissionDenied("write outside authorized tenants")
+        for r in req.write_ranges:
+            if not covered(r.begin, r.end):
+                raise PermissionDenied(
+                    "write conflict range outside authorized tenants")

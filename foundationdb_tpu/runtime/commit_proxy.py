@@ -44,6 +44,9 @@ class CommitRequest:
     # Bypass the database lock (reference: LOCK_AWARE option; DR agents
     # and operator tooling write to a locked database with this set).
     lock_aware: bool = False
+    # Tenant authorization token (reference: AUTHORIZATION_TOKEN option):
+    # verified by the proxy when the cluster enables authz (runtime/authz).
+    token: str | None = None
 
 
 @dataclass(frozen=True)
@@ -76,6 +79,7 @@ class CommitProxy:
         storage_map: KeyShardMap,
         controller_ep=None,
         epoch: int = 1,
+        authz=None,
     ):
         assert resolver_map.n_shards == len(resolver_eps)
         self.loop = loop
@@ -94,8 +98,15 @@ class CommitProxy:
         # Database lock (reference: error 1038): set by DR switchover /
         # operator tooling; the recruiter re-applies it across recoveries.
         self.locked = False
+        # Tenant authz (runtime/authz.TokenAuthority) — None = authz off,
+        # every commit trusted (the pre-7.x reference default).
+        self.authz = authz
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self._inflight: set[int] = set()  # batch versions being processed
+        # Batches popped from _queue but not yet in _inflight (awaiting
+        # their commit version): quiesce() must see them or a batch could
+        # vanish from both sets mid-await and slip past a DR switchover.
+        self._admitting = 0
         self.txns_committed = 0
         self.txns_conflicted = 0
         # Highest batch version this proxy has seen durable on ALL tlogs;
@@ -114,6 +125,14 @@ class CommitProxy:
     @rpc
     async def set_backup_enabled(self, enabled: bool) -> None:
         self.backup_enabled = enabled
+
+    @rpc
+    async def get_backup_enabled(self) -> bool:
+        """Stream-continuity probe: a reconnecting DR/backup agent asks
+        whether dual-tagging stayed on since its predecessor (the resume
+        gate — a lapse means versions are missing from the tlog stream
+        and a full re-bootstrap is required)."""
+        return self.backup_enabled
 
     @rpc
     async def set_locked(self, locked: bool) -> None:
@@ -157,15 +176,33 @@ class CommitProxy:
                     else:
                         p.fail(DatabaseLocked("database is locked"))
                 batch = passed
+            if self.authz is not None and batch:
+                # Tenant authorization (reference: TenantAuthorizer at the
+                # commit boundary): every write must lie inside a prefix
+                # the request's token authorizes.
+                passed = []
+                for req, p in batch:
+                    try:
+                        self.authz.check_commit(req, self.loop.wall_now)
+                        passed.append((req, p))
+                    except Exception as e:  # PermissionDenied
+                        p.fail(e)
+                batch = passed
             last_batch = self.loop.now
             # One version per batch; fetched in the batcher (not the spawned
             # worker) so batches acquire chain positions in queue order.
+            self._admitting += 1
             try:
                 prev_version, version = await self.sequencer.get_commit_version()
             except Exception:
                 for _req, p in batch:
                     p.fail(CommitUnknownResult("sequencer unreachable"))
                 continue
+            finally:
+                self._admitting -= 1
+            # Into _inflight HERE (not in the spawned task, which may not
+            # have run yet when quiesce() samples).
+            self._inflight.add(version)
             self.loop.spawn(
                 self._process(batch, prev_version, version),
                 name=f"commit_batch@{version}",
@@ -203,7 +240,7 @@ class CommitProxy:
         after locking: a batch that passed the lock check pre-lock is
         still entitled to its backup tagging, so dual-tagging must stay
         on until nothing admitted remains in flight."""
-        while self._queue or self._inflight:
+        while self._queue or self._inflight or self._admitting:
             await self.loop.sleep(self.BATCH_INTERVAL)
 
     async def _wedge_watchdog(self, version: int) -> None:

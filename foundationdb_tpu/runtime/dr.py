@@ -51,11 +51,16 @@ class DRAgent:
     APPLY_INTERVAL = 0.005
 
     def __init__(self, src_cluster, src_db, dst_db,
-                 lock_secondary: bool = False):
+                 lock_secondary: bool = False,
+                 dst_token: str | None = None):
         self.src_cluster = src_cluster
         self.src_db = src_db
         self.dst_db = dst_db
         self.lock_secondary = lock_secondary
+        # Admin token for the DESTINATION (authz-enabled secondaries deny
+        # untokened user-keyspace writes): mint with the explicit prefix
+        # b"" — the whole user keyspace (runtime/authz.py).
+        self.dst_token = dst_token
         # pop_floor=applied: the tlogs may only trim what the SECONDARY
         # has durably applied — pulled-but-unapplied entries must survive
         # an agent crash so the resume path can re-peek them.
@@ -85,7 +90,13 @@ class DRAgent:
         base = 0
         if resume:
             base = await self.read_progress(self.dst_db)
-        if base > 0 and self.src_cluster.backup_active:
+        active = self.src_cluster.backup_active
+        probe = getattr(self.src_cluster, "probe_backup_active", None)
+        if probe is not None:
+            # Deployed handle: the local flag resets per process — ask the
+            # proxies whether tagging actually stayed on.
+            active = await probe()
+        if base > 0 and active:
             await self.backup.start()
             self.applied = base
             self._task = self.src_cluster.loop.spawn(
@@ -178,6 +189,8 @@ class DRAgent:
             async def run(self, body, *a, **kw):
                 async def lock_aware_body(tr):
                     tr.set_option("lock_aware")
+                    if agent.dst_token:
+                        tr.set_option("authorization_token", agent.dst_token)
                     return await body(tr)
 
                 return await agent.dst_db.run(lock_aware_body, *a, **kw)
@@ -188,6 +201,8 @@ class DRAgent:
         async def body(tr):
             tr.set_option("lock_aware")
             tr.set_option("access_system_keys")
+            if self.dst_token:
+                tr.set_option("authorization_token", self.dst_token)
             tr.set(DR_APPLIED_KEY, str(version).encode())
 
         await self.dst_db.run(body)
@@ -215,6 +230,8 @@ class DRAgent:
             async def body(tr, batch=batch, end_version=end_version):
                 tr.set_option("lock_aware")
                 tr.set_option("access_system_keys")
+                if self.dst_token:
+                    tr.set_option("authorization_token", self.dst_token)
                 for _v, muts in batch:
                     for m in muts:
                         if m.type == MutationType.SET_VALUE:

@@ -210,6 +210,14 @@ class Loop:
     def now(self) -> float:
         return self._now
 
+    @property
+    def wall_now(self) -> float:
+        """Epoch-seconds clock for EXTERNALLY-MEANINGFUL timestamps (token
+        expiry, trace WallTime): virtual time in sim (deterministic);
+        RealLoop overrides with time.time(). `now` stays monotonic-domain
+        and must never be compared with operator wall-clock values."""
+        return self._now
+
     def sleep(self, dt: float) -> Future:
         """Timer future; awaiting it parks the actor for `dt` virtual seconds."""
         p = Promise()

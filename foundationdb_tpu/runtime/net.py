@@ -67,6 +67,14 @@ class RealLoop(Loop):
         super().__init__(seed=seed, start_time=time.monotonic())
         self.selector = selectors.DefaultSelector()
 
+    @property
+    def wall_now(self) -> float:
+        """Epoch seconds: operator-minted expiries (authz tokens) compare
+        against THIS, never against the monotonic `now` (whose epoch is
+        host boot — a token minted with time.time() would otherwise stay
+        valid for decades)."""
+        return time.time()
+
     def register(self, sock: socket.socket, events: int, callback) -> None:
         try:
             self.selector.register(sock, events, callback)

@@ -239,12 +239,14 @@ def _register_runtime_types() -> None:
         lambda r: (
             r.read_version, list(r.mutations), list(r.read_ranges),
             list(r.write_ranges), r.report_conflicting_keys, r.lock_aware,
+            r.token,
         ),
         lambda f: CommitRequest(
             read_version=f[0], mutations=f[1], read_ranges=f[2],
             write_ranges=f[3], report_conflicting_keys=f[4],
-            # 5-element form: peers predating the lock_aware field.
+            # Shorter forms: peers predating lock_aware/token fields.
             lock_aware=f[5] if len(f) > 5 else False,
+            token=f[6] if len(f) > 6 else None,
         ),
     )
     register_struct(

@@ -59,12 +59,34 @@ def load_spec(path: str) -> dict:
     for role in ("sequencer", "resolver", "tlog", "storage", "proxy"):
         if not spec.get(role):
             raise ValueError(f"cluster spec missing role {role!r}")
+    # Resolve key-material paths against the cluster file's directory at
+    # LOAD time (the one choke point every entry point — server, cli,
+    # dr_tool, tests — goes through), so consumers never depend on cwd.
+    if spec.get("authz_public_key"):
+        base = os.path.dirname(os.path.abspath(path))
+        p = spec["authz_public_key"]
+        spec["authz_public_key"] = (
+            p if os.path.isabs(p) else os.path.join(base, p))
     return spec
 
 
 def parse_addr(s: str) -> tuple[str, int]:
     host, port = s.rsplit(":", 1)
     return host, int(port)
+
+
+def _make_authz(spec: dict):
+    """Tenant authz verifier from the spec's `authz_public_key` (a PEM
+    path — main() resolves it against the cluster file's directory before
+    build_role sees the spec, same convention as tls paths). None = authz
+    disabled."""
+    path = spec.get("authz_public_key")
+    if not path:
+        return None
+    from foundationdb_tpu.runtime.authz import TokenAuthority
+
+    with open(path, "rb") as f:
+        return TokenAuthority(f.read())
 
 
 def tls_config(spec: dict, spec_path: str) -> dict | None:
@@ -307,6 +329,7 @@ class Worker:
             KeyShardMap.uniform(len(resolver_eps)), tlog_eps,
             KeyShardMap.uniform(len(self.spec["storage"])),
             controller_ep=controller_ep, epoch=epoch,
+            authz=_make_authz(self.spec),
         )
         self._commit_proxy = proxy
         grv = GrvProxy(self.loop, seq_ep, rk_ep)
@@ -871,6 +894,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         proxy = CommitProxy(
             loop, seq_ep, eps("resolver"), resolver_map,
             eps("tlog"), storage_map,
+            authz=_make_authz(spec),
         )
         grv = GrvProxy(loop, seq_ep, rk_ep)
         router = ReadRouter(storage_map, eps("storage"))
@@ -928,7 +952,7 @@ def main(argv: list[str] | None = None) -> None:
                          "(reference: fdbserver --logdir)")
     args = ap.parse_args(argv)
 
-    spec = load_spec(args.cluster)
+    spec = load_spec(args.cluster)  # resolves authz_public_key to absolute
     addrs = spec.get(args.role) or []
     if not 0 <= args.index < len(addrs):
         raise SystemExit(

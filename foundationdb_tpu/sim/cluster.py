@@ -72,6 +72,7 @@ class SimCluster:
         data_dir: str | None = None,
         timekeeper: bool = True,
         process_prefix: str = "",
+        authz_public_key: bytes | None = None,
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
@@ -114,6 +115,13 @@ class SimCluster:
         self.backup_active = False  # BackupAgent sets; survives recoveries
         self.backup_worker = None  # live BackupWorker (its cursor bounds salvage)
         self.db_locked = False  # DR switchover / operator lock; survives recoveries
+        # Tenant authorization (runtime/authz): proxies of every generation
+        # verify commit tokens against this public key when set.
+        self.authz = None
+        if authz_public_key is not None:
+            from foundationdb_tpu.runtime.authz import TokenAuthority
+
+            self.authz = TokenAuthority(authz_public_key)
         self.retired_tags: set[int] = set()  # stopped-backup tags, per tlog
 
         # Storage servers persist across generations (they ARE the data);
@@ -458,6 +466,7 @@ class SimCluster:
                 self.storage_map,
                 controller_ep=getattr(self, "controller_ep", None),
                 epoch=epoch,
+                authz=self.authz,
             )
             for _ in range(self.n_proxies)
         ]
