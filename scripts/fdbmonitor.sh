@@ -9,16 +9,15 @@
 # If CLUSTER_DIR/data exists, every role gets a durable --data-dir under
 # it, so restarts reload tlog disk queues / storage sqlite state.
 #
-# Scope (static wiring v1, see server.py): a restarted STORAGE rejoins
-# live (it re-pulls its tag from the tlogs). Chain roles (sequencer/
-# resolver/tlog/proxy) cannot rejoin a RUNNING chain — after bouncing
-# one of those, bounce the WHOLE cluster. With data dirs, a full bounce
-# restores every acked commit: tlogs resume their disk-queue chains,
-# the booting sequencer truncates unacked suffixes to the minimum
-# recovered end and jump-starts a new epoch (server.py boot_sequencer;
-# driven end-to-end by tests/test_server.py TestDurableDeployedRestart).
-# Live failure/recovery semantics (no full bounce) stay the simulator's
-# domain, as in the reference's simulation-first methodology.
+# Scope depends on the spec's wiring mode (see server.py):
+# - STATIC (no "controller" in the spec): a restarted STORAGE rejoins
+#   live; chain roles (sequencer/resolver/tlog/proxy) need a WHOLE-
+#   cluster bounce, which with data dirs restores every acked commit
+#   (boot_sequencer truncates unacked suffixes, new epoch).
+# - MANAGED (spec names a "controller" process — supervised here like
+#   any other role): the controller heals chain-role failures live with
+#   a generation change and folds this script's restarts back in; no
+#   full bounce needed (tests/test_managed_cluster.py).
 # Stop everything with: touch CLUSTER_DIR/stop
 set -euo pipefail
 cd "$(dirname "$0")/.."

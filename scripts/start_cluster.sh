@@ -13,12 +13,16 @@ cd "$(dirname "$0")/.."
 
 DIR="${1:-/tmp/fdb_tpu_cluster}"
 BASE_PORT="${FDB_TPU_BASE_PORT:-4500}"
+# FDB_TPU_MANAGED=1: include a controller process — the cluster then
+# heals chain-role failures live with generation changes (managed mode;
+# see server.py DeployedController) instead of needing a full bounce.
+MANAGED="${FDB_TPU_MANAGED:-0}"
 mkdir -p "$DIR"
 SPEC="$DIR/cluster.json"
 
-python - "$SPEC" "$BASE_PORT" <<'EOF'
+python - "$SPEC" "$BASE_PORT" "$MANAGED" <<'EOF'
 import json, sys
-spec_path, base = sys.argv[1], int(sys.argv[2])
+spec_path, base, managed = sys.argv[1], int(sys.argv[2]), sys.argv[3] == "1"
 ports = iter(range(base, base + 32))
 spec = {
     "sequencer": [f"127.0.0.1:{next(ports)}"],
@@ -29,6 +33,8 @@ spec = {
     "ratekeeper": [f"127.0.0.1:{next(ports)}"],
     "engine": "cpu",
 }
+if managed:
+    spec["controller"] = [f"127.0.0.1:{next(ports)}"]
 json.dump(spec, open(spec_path, "w"), indent=1)
 print(spec_path)
 EOF
@@ -50,6 +56,9 @@ launch storage 1
 launch proxy 0
 launch proxy 1
 launch ratekeeper 0
+if [ "$MANAGED" = "1" ]; then
+  launch controller 0
+fi
 
 # Wait until a client transaction commits end to end.
 for i in $(seq 1 30); do
