@@ -548,15 +548,36 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
         log(f"[profile] {label}: {ms:.3f} ms")
         return out
 
-    hist = timeit("history_check", ck._phase_history_jit, state, batch)
-    ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
-    floor, too_old = ck.too_old_mask(state, batch, oldest)
-    base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
-    acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
-    timeit("paint_compact", ck._phase_paint_jit, state, batch, acc, cv, oldest)
-    full = jax.jit(ck.resolve_batch)  # non-donating twin for repeat timing
-    timeit("full_resolve", full, state, batch, cv, oldest)
-    phase_sum = sum(v for k, v in timings.items() if k != "full_resolve")
+    if isinstance(state, ck.HistState):
+        # Window-history engine: base RMQ rides a prebuilt table; the
+        # per-batch history cost is the delta table + queries, paint
+        # touches only the delta, and the amortized merge is timed
+        # separately (it runs once per ~Cd/(2BQ_live) batches).
+        timings["history_design"] = "window"
+        hist = timeit("history_check", ck._phase_history_hist_jit, state, batch)
+        ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
+        floor, too_old = ck.too_old_mask(state.delta, batch, oldest)
+        base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
+        acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
+        timeit("paint_compact", ck._phase_paint_hist_jit, state, batch, acc,
+               cv, oldest)
+        timeit("merge_amortized", ck._phase_merge_hist_jit, state, oldest)
+        full = jax.jit(ck.resolve_batch_hist)  # non-donating twin
+        timeit("full_resolve", full, state, batch, cv, oldest)
+        phase_sum = sum(
+            v for k, v in timings.items()
+            if k not in ("full_resolve", "merge_amortized", "history_design")
+        )
+    else:
+        hist = timeit("history_check", ck._phase_history_jit, state, batch)
+        ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
+        floor, too_old = ck.too_old_mask(state, batch, oldest)
+        base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
+        acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
+        timeit("paint_compact", ck._phase_paint_jit, state, batch, acc, cv, oldest)
+        full = jax.jit(ck.resolve_batch)  # non-donating twin for repeat timing
+        timeit("full_resolve", full, state, batch, cv, oldest)
+        phase_sum = sum(v for k, v in timings.items() if k != "full_resolve")
     timings["phase_sum_vs_full"] = round(
         phase_sum / timings["full_resolve"], 2
     ) if timings.get("full_resolve") else None
@@ -843,29 +864,46 @@ def run_config(
         log(f"[warn] {name}: verdict divergence: tpu={tpu_conf} "
             f"cpu={cpu_conf} ({abs(tpu_conf - cpu_conf) / n_txns:.2%})")
 
+    # HEADLINE (VERDICT r4 item 3): the PIPELINED per-batch path — one
+    # batch per dispatch, depth-2 double buffering, exactly how a live
+    # resolver serves proxies — because the north star is judged "at equal
+    # p99" and the windowed mode structurally hides queueing latency. The
+    # windowed number is kept as a secondary line (the throughput ceiling
+    # when latency doesn't matter, e.g. bulk restore verification).
+    pipeline_rate = (
+        round(batch_n * mode.batch / batch_dt, 1) if batch_dt else None
+    )
+    headline_rate = pipeline_rate if pipeline_rate else round(tpu_rate, 1)
+    head_p50 = pct(batch_lat, 50) if batch_lat else pct(tpu_lat, 50)
+    head_p99 = pct(batch_lat, 99) if batch_lat else pct(tpu_lat, 99)
+    cpu_p99 = pct(cpu_lat, 99)
     return {
-        "value": round(tpu_rate, 1),
-        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+        "value": headline_rate,
+        "vs_baseline": round(headline_rate / cpu_rate, 3),
+        "headline_mode": "pipelined_depth2" if pipeline_rate else "windowed",
         "txns": n_txns,
         "conflict_rate": round(tpu_conf / n_txns, 4),
         "verdict_parity": tpu_conf == cpu_conf,
         "cpu_baseline_txns_per_sec": round(cpu_rate, 1),
-        # Dispatch→verdict latency of one `window`-batch device dispatch
-        # (the resolver component of commit latency) vs the CPU baseline's
-        # per-batch resolve latency — the equal-p99 comparison of SURVEY §0.
-        "p50_ms": pct(tpu_lat, 50),
-        "p99_ms": pct(tpu_lat, 99),
-        # Honest per-batch commit latency: single-batch dispatch, double
-        # buffered (depth 2) — the number the north star's "equal p99"
-        # clause is judged on, vs the windowed queueing latency above.
-        "batch_p50_ms": pct(batch_lat, 50),
-        "batch_p99_ms": pct(batch_lat, 99),
-        "batch_pipeline_txns_per_sec": (
-            round(batch_n * mode.batch / batch_dt, 1) if batch_dt else None
+        # Headline latency: submit→verdict of a single pipelined batch —
+        # the resolver component of per-txn commit latency — vs the CPU
+        # baseline's per-batch latency (the equal-p99 clause of SURVEY §0).
+        "p50_ms": head_p50,
+        "p99_ms": head_p99,
+        "p99_vs_cpu": (
+            round(head_p99 / cpu_p99, 2) if cpu_p99 else None
         ),
         "cpu_p50_ms": pct(cpu_lat, 50),
-        "cpu_p99_ms": pct(cpu_lat, 99),
-        "batches_per_dispatch": window,
+        "cpu_p99_ms": cpu_p99,
+        # Secondary: the windowed (32-batch scan) dispatch mode — higher
+        # throughput, but each verdict waits for the whole window.
+        "windowed": {
+            "value": round(tpu_rate, 1),
+            "vs_baseline": round(tpu_rate / cpu_rate, 3),
+            "p50_ms": pct(tpu_lat, 50),
+            "p99_ms": pct(tpu_lat, 99),
+            "batches_per_dispatch": window,
+        },
         "resolvers": n_resolvers,
         "shard_occupancy": occupancy or None,
         "overflowed": overflowed,

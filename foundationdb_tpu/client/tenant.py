@@ -66,13 +66,17 @@ def _check_name(name: bytes) -> None:
         raise TenantError(f"illegal tenant name {name!r}", code=2134)
 
 
-async def create_tenant(db, name: bytes) -> bytes:
+async def create_tenant(db, name: bytes, token: str | None = None) -> bytes:
     """Create `name`; returns its data prefix (reference:
-    TenantAPI::createTenant)."""
+    TenantAPI::createTenant). On an authz-enabled cluster `token` must
+    carry the system grant (runtime/authz mint_token system=True) — the
+    tenant map lives in \\xff and system writes are token-gated there."""
     _check_name(name)
 
     async def body(tr):
         tr.set_option("access_system_keys")
+        if token:
+            tr.set_option("authorization_token", token)
         if await tr.get(TENANT_MAP_PREFIX + name) is not None:
             raise TenantExists(name)
         raw = await tr.get(TENANT_ID_COUNTER)
@@ -85,12 +89,14 @@ async def create_tenant(db, name: bytes) -> bytes:
     return await db.run(body)
 
 
-async def delete_tenant(db, name: bytes) -> None:
+async def delete_tenant(db, name: bytes, token: str | None = None) -> None:
     """Delete `name`; fails unless its keyspace is empty (reference
-    semantics — data must be cleared first)."""
+    semantics — data must be cleared first). `token` as create_tenant."""
 
     async def body(tr):
         tr.set_option("access_system_keys")
+        if token:
+            tr.set_option("authorization_token", token)
         prefix = await tr.get(TENANT_MAP_PREFIX + name)
         if prefix is None:
             raise TenantNotFound(name)

@@ -106,10 +106,29 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "status":
         async def status():
+            import time as _time
+
             applied = await DRAgent.read_progress(dst_db)
             live = await src.sequencer_ep.get_live_committed_version()
+            hb = await DRAgent.read_heartbeat(dst_db)
+            tagging = await src.probe_backup_active()
+            lag = max(0, live - applied)
+            hb_age = None if hb is None else max(0.0, _time.time() - hb)
+            # Distinguish "idle" from "dead agent": lag is measured
+            # against the PRIMARY's live version (a wedged puller can't
+            # hide it), and the heartbeat says whether an agent is even
+            # running to close it.
+            if hb is None:
+                state = "no agent has run"
+            elif hb_age > 10.0:
+                state = f"AGENT STALLED (heartbeat {hb_age:.1f}s old)"
+            else:
+                state = "agent live"
             print(f"applied={applied} src_committed={live} "
-                  f"lag_versions={max(0, live - applied)}", flush=True)
+                  f"lag_versions={lag} tagging={'on' if tagging else 'OFF'} "
+                  f"heartbeat_age_s="
+                  f"{'-' if hb_age is None else round(hb_age, 1)} "
+                  f"[{state}]", flush=True)
 
         loop.run(status(), timeout=60)
         return 0

@@ -39,6 +39,7 @@ class TPUConflictSet:
         max_write_ranges: int = 8,
         max_key_bytes: int = 32,
         window_versions: int = DEFAULT_WINDOW_VERSIONS,
+        delta_capacity: int | None = None,
     ):
         self.codec = KeyCodec(max_key_bytes)
         self.capacity = capacity
@@ -46,6 +47,12 @@ class TPUConflictSet:
         self.max_read_ranges = max_read_ranges
         self.max_write_ranges = max_write_ranges
         self.window_versions = window_versions
+        # Window-history delta sizing: must absorb one batch's worst-case
+        # paint (the in-jit merge empties it just-in-time before a batch
+        # that wouldn't fit).
+        self.delta_capacity = delta_capacity or min(
+            capacity, 2 * batch_size * max_write_ranges + 2
+        )
         self.base_version: int | None = None
         self.oldest_version: int = 0  # absolute; advances monotonically
         self._last_commit: int = 0
@@ -54,10 +61,21 @@ class TPUConflictSet:
     def _init_engine(self) -> None:
         """Build device state + entry points. Subclasses (the mesh-sharded
         engine) override this; all host-side logic is shared."""
-        self.state = ck.init_state(self.capacity, self.codec.width, self.codec.min_key)
-        self._resolve_fn = ck._resolve_jit
-        self._resolve_many_fn = ck._resolve_many_jit
-        self._rebase_fn = ck._rebase_jit
+        if ck._HIST_DESIGN == "window":
+            self.state = ck.init_hist(
+                self.capacity, self.codec.width, self.codec.min_key,
+                self.delta_capacity,
+            )
+            self._resolve_fn = ck._resolve_hist_jit
+            self._resolve_many_fn = ck._resolve_many_hist_jit
+            self._rebase_fn = ck._rebase_hist_jit
+        else:
+            self.state = ck.init_state(
+                self.capacity, self.codec.width, self.codec.min_key
+            )
+            self._resolve_fn = ck._resolve_jit
+            self._resolve_many_fn = ck._resolve_many_jit
+            self._rebase_fn = ck._rebase_jit
 
     # -- public API ---------------------------------------------------------
 
@@ -238,7 +256,16 @@ class TPUConflictSet:
         self._last_commit = commit_version
 
     @property
+    def _is_hist(self) -> bool:
+        return isinstance(self.state, ck.HistState)
+
+    @property
     def overflowed(self) -> bool:
+        if self._is_hist:
+            return bool(
+                np.asarray(self.state.base.overflow).any()
+                or np.asarray(self.state.delta.overflow).any()
+            )
         return bool(np.asarray(self.state.overflow).any())
 
     def headroom(self) -> int:
@@ -252,7 +279,17 @@ class TPUConflictSet:
         fail-safes instead (see runtime/resolver.py). The reference's
         SkipList never loses history inside the MVCC window; this check is
         how the fixed-capacity engine earns the same guarantee.
+
+        Window-history engine: a merge keeps at most base+delta live
+        boundaries, and the just-in-time merge empties the delta before a
+        batch that wouldn't fit — so admission needs room in the merged
+        base AND a delta that can absorb one whole batch.
         """
+        if self._is_hist:
+            used = int(np.asarray(self.state.base.n_used).max()) + int(
+                np.asarray(self.state.delta.n_used).max()
+            )
+            return min(self.capacity - used, self.delta_capacity)
         used = int(np.asarray(self.state.n_used).max())
         return self.capacity - used
 
@@ -263,16 +300,29 @@ class TPUConflictSet:
     def clear_overflow(self) -> None:
         """Reset the sticky device overflow flag (after the host has
         reacted — see Resolver's unsafe-window handling)."""
+        if self._is_hist:
+            base, st, delta = self.state
+            self.state = ck.HistState(
+                base._replace(overflow=base.overflow & False),
+                st,
+                delta._replace(overflow=delta.overflow & False),
+            )
+            return
         self.state = self.state._replace(overflow=self.state.overflow & False)
 
     def advance(self, commit_version: int, oldest_version: int | None = None) -> None:
         """GC-only dispatch: move the version chain and MVCC floor forward
-        without painting any writes (an all-masked batch). Expired segments
-        compact out, so headroom recovers as the window slides — this is
-        what lets the Resolver's fail-safe mode drain and exit."""
+        without painting any writes. Expired segments compact out, so
+        headroom recovers as the window slides — this is what lets the
+        Resolver's fail-safe mode drain and exit. The window-history
+        engine forces a merge here (the lazy base would otherwise hold
+        expired segments until the next organic merge)."""
         self._begin_resolve(commit_version, oldest_version)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
+        if self._is_hist:
+            _, self.state = ck._advance_hist_jit(self.state, cv, oldest)
+            return
         _, self.state = self._resolve_fn(self.state, self._empty_batch(), cv, oldest)
 
     # -- internals ----------------------------------------------------------

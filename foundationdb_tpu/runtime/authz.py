@@ -59,16 +59,25 @@ def generate_keypair() -> tuple[bytes, bytes]:
 
 
 def mint_token(private_pem: bytes, prefixes: list[bytes],
-               expires_at: float) -> str:
+               expires_at: float, system: bool = False) -> str:
     """Operator-side: sign a token authorizing writes under `prefixes`
-    until `expires_at` (seconds, the cluster loop's clock domain)."""
+    until `expires_at` (seconds, the cluster loop's clock domain).
+
+    ``system=True`` additionally grants the SYSTEM keyspace (``\\xff...``)
+    — the operator/admin credential (reference: trusted-peer status /
+    tenant-management privileges). Required for tenant management, the
+    TimeKeeper on an authz cluster, and DR apply agents (whose progress
+    key lives in ``\\xff``)."""
     from cryptography.hazmat.primitives import serialization
 
     priv = serialization.load_pem_private_key(private_pem, password=None)
-    payload = json.dumps({
+    doc = {
         "prefixes": [p.hex() for p in prefixes],
         "exp": expires_at,
-    }, sort_keys=True).encode()
+    }
+    if system:
+        doc["system"] = True
+    payload = json.dumps(doc, sort_keys=True).encode()
     return _b64e(payload) + "." + _b64e(priv.sign(payload))
 
 
@@ -82,10 +91,11 @@ class TokenAuthority:
         from cryptography.hazmat.primitives import serialization
 
         self._pub = serialization.load_pem_public_key(public_pem)
-        self._cache: dict[str, tuple[list[bytes], float]] = {}
+        self._cache: dict[str, tuple[list[bytes], float, bool]] = {}
 
-    def verify(self, token: str, now: float) -> list[bytes]:
-        """→ authorized prefixes; raises PermissionDenied on any flaw."""
+    def verify(self, token: str, now: float) -> tuple[list[bytes], bool]:
+        """→ (authorized prefixes, system grant); raises PermissionDenied
+        on any flaw."""
         hit = self._cache.get(token)
         if hit is None:
             try:
@@ -94,7 +104,8 @@ class TokenAuthority:
                 self._pub.verify(_b64d(sig_s), payload)
                 doc = json.loads(payload)
                 hit = ([bytes.fromhex(p) for p in doc["prefixes"]],
-                       float(doc["exp"]))
+                       float(doc["exp"]),
+                       bool(doc.get("system", False)))
             except PermissionDenied:
                 raise
             except Exception as e:  # malformed/forged
@@ -102,31 +113,38 @@ class TokenAuthority:
             if len(self._cache) >= self.CACHE_MAX:
                 self._cache.pop(next(iter(self._cache)))
             self._cache[token] = hit
-        prefixes, exp = hit
+        prefixes, exp, system = hit
         if now > exp:
             raise PermissionDenied("token expired")
-        return prefixes
+        return prefixes, system
 
     def check_commit(self, req, now: float) -> None:
-        """Enforce the write boundary over the USER keyspace: every user
-        mutation endpoint and write range must lie inside an authorized
-        prefix (the reference's tenant-required mode for untrusted
-        clients). System-keyspace writes (``\\xff...``) are outside token
-        scope — they stay governed by the access_system_keys option and
-        the mutual-TLS process mesh, which is how in-process system
-        actors (TimeKeeper, tenant management) keep working. A DR/backup
-        apply agent on an authz-enabled destination needs an ADMIN token
-        (minted with the explicit prefix b"" = the whole user keyspace).
+        """Enforce the write boundary: every user mutation endpoint and
+        write range must lie inside an authorized prefix (the reference's
+        tenant-required mode for untrusted clients), and SYSTEM-keyspace
+        writes (``\\xff...``) require a token with the explicit ``system``
+        grant — the client-side access_system_keys option is advisory and
+        never trusted here (an advisor-found bypass: the old carve-out
+        let any client rewrite ``\\xff/tenant/map`` and defeat isolation).
+        In-process system actors (TimeKeeper, tenant management, DR
+        apply) on an authz cluster carry an operator-minted system token
+        (SimCluster ``authz_system_token`` / spec ``authz_system_token``).
+        A DR/backup apply agent on an authz-enabled destination needs an
+        ADMIN token: prefixes=[b""] (whole user keyspace) + system=True
+        (its progress key rides in ``\\xff``).
         """
         prefixes: list[bytes] | None = None
+        system_ok = False
         token = getattr(req, "token", None)
         if token:
-            prefixes = self.verify(token, now)
+            prefixes, system_ok = self.verify(token, now)
 
         def prefix_of(begin: bytes, end: bytes):
             """The authorized prefix containing [begin, end), or None."""
             if begin >= b"\xff":
-                return b""  # system keyspace: not token-governed
+                # System keyspace: only an explicit system grant covers
+                # it (any end — the grant spans all of \xff...).
+                return b"\xff" if system_ok else None
             if prefixes is None:
                 return None  # untokened user write under authz
             for p in prefixes:

@@ -139,6 +139,11 @@ class CommitProxy:
         self.locked = locked
 
     @rpc
+    async def get_locked(self) -> bool:
+        """Operator/DR probe: is the database lock in force here?"""
+        return self.locked
+
+    @rpc
     async def get_metrics(self) -> dict:
         """Status inputs (reference: commit proxy stats in status json)."""
         return {

@@ -35,10 +35,15 @@ def _key(seconds: float) -> bytes:
 class TimeKeeper:
     """Actor: periodically record (now → committed version)."""
 
-    def __init__(self, loop, db, interval: float = DEFAULT_INTERVAL):
+    def __init__(self, loop, db, interval: float = DEFAULT_INTERVAL,
+                 token: str | None = None):
         self.loop = loop
         self.db = db
         self.interval = interval
+        # System-scope authz token (runtime/authz mint_token system=True):
+        # required on an authz-enabled cluster, where \xff writes demand
+        # an explicit system grant. None on authz-off clusters.
+        self.token = token
         self._stopped = False
 
     def stop(self) -> None:
@@ -68,6 +73,8 @@ class TimeKeeper:
             # version_for_time over-includes writes.
             now = self._clock()
             tr.set_option("access_system_keys")
+            if self.token:
+                tr.set_option("authorization_token", self.token)
             version = await tr.get_read_version()
             tr.set(_key(now), struct.pack("<q", version))
             # Trim the rolling window.

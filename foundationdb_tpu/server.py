@@ -62,12 +62,24 @@ def load_spec(path: str) -> dict:
     # Resolve key-material paths against the cluster file's directory at
     # LOAD time (the one choke point every entry point — server, cli,
     # dr_tool, tests — goes through), so consumers never depend on cwd.
-    if spec.get("authz_public_key"):
-        base = os.path.dirname(os.path.abspath(path))
-        p = spec["authz_public_key"]
-        spec["authz_public_key"] = (
-            p if os.path.isabs(p) else os.path.join(base, p))
+    base = os.path.dirname(os.path.abspath(path))
+    for k in ("authz_public_key", "authz_system_token"):
+        if spec.get(k):
+            p = spec[k]
+            spec[k] = p if os.path.isabs(p) else os.path.join(base, p)
     return spec
+
+
+def _system_token(spec: dict) -> str | None:
+    """Operator-minted system-scope authz token for in-process system
+    actors (TimeKeeper) — spec key `authz_system_token`, a path to the
+    token file (resolved by load_spec). With authz enabled, system
+    (``\\xff``) writes require it."""
+    path = spec.get("authz_system_token")
+    if not path:
+        return None
+    with open(path) as f:
+        return f.read().strip()
 
 
 def parse_addr(s: str) -> tuple[str, int]:
@@ -199,7 +211,17 @@ class Worker:
 
     @rpc
     async def describe(self) -> dict:
-        return {"role": self.role, "index": self.index, "epoch": self.epoch}
+        d = {"role": self.role, "index": self.index, "epoch": self.epoch}
+        # Proxy processes report their database flags so the controller's
+        # sweep keeps a live cache — a heal must re-apply backup tagging
+        # and the database lock to the next generation (advisor finding:
+        # recruiting with defaults silently dropped both: a DR stream gap,
+        # and a post-switchover unlock letting stale clients commit).
+        cp = getattr(self, "_commit_proxy", None)
+        if cp is not None:
+            d["backup_enabled"] = cp.backup_enabled
+            d["locked"] = cp.locked
+        return d
 
     # -- role recruitment (controller-only callers) -----------------------
 
@@ -295,12 +317,17 @@ class Worker:
 
     @rpc
     async def recruit_proxy(self, epoch: int, tlog_addrs: list,
-                            resolver_addrs: list) -> int:
+                            resolver_addrs: list,
+                            backup_enabled: bool = False,
+                            locked: bool = False) -> int:
         """Rebuild this process's CommitProxy + GrvProxy against the new
         generation's LIVE tlog/resolver sets. Old actor loops are
         cancelled; the service names are re-pointed at the new objects, so
         clients keep their endpoints (in-flight calls to the old objects
-        resolve against the new generation's chain guards)."""
+        resolve against the new generation's chain guards).
+        `backup_enabled`/`locked` carry the database flags across the
+        generation change (the sim recruiter propagates the same pair —
+        sim/cluster.py)."""
         from foundationdb_tpu.core.errors import ProcessKilled
         from foundationdb_tpu.runtime.commit_proxy import CommitProxy
         from foundationdb_tpu.runtime.grv_proxy import GrvProxy
@@ -331,6 +358,8 @@ class Worker:
             controller_ep=controller_ep, epoch=epoch,
             authz=_make_authz(self.spec),
         )
+        proxy.backup_enabled = backup_enabled
+        proxy.locked = locked
         self._commit_proxy = proxy
         grv = GrvProxy(self.loop, seq_ep, rk_ep)
         self.t.serve("commit_proxy", proxy)
@@ -405,6 +434,11 @@ class DeployedController:
         self.live: dict[str, list[int]] = {}
         self.recoveries_completed = 0
         self._recovering = False
+        # Database flags cached from proxy describes (sweep + pre-recovery
+        # probe) and re-applied at recruit_proxy — the deployed analogue
+        # of the sim recruiter reading cluster.backup_active/db_locked.
+        self.backup_active = False
+        self.db_locked = False
 
     # -- endpoints ---------------------------------------------------------
 
@@ -436,6 +470,8 @@ class DeployedController:
             "recoveries_completed": self.recoveries_completed,
             "recovering": self._recovering,
             "generation": {r: list(v) for r, v in self.live.items()},
+            "backup_active": self.backup_active,
+            "db_locked": self.db_locked,
         }
 
     @rpc
@@ -558,7 +594,9 @@ class DeployedController:
         for i in live["proxy"]:
             await self._retry(
                 lambda i=i: self._worker("proxy", i)
-                .recruit_proxy(epoch, tlog_addrs, resolver_addrs), deadline)
+                .recruit_proxy(epoch, tlog_addrs, resolver_addrs,
+                               self.backup_active, self.db_locked),
+                deadline)
         for i in live["storage"]:
             await self._retry(
                 lambda i=i: self._worker("storage", i)
@@ -596,18 +634,28 @@ class DeployedController:
             for role, i in checks
         ]
         verdict = None
+        flag_answers = []
         for role, i, t in tasks:
             try:
                 d = await t
             except Exception:
                 verdict = verdict or f"{role}{i} failed heartbeat"
                 continue
+            if role == "proxy" and "backup_enabled" in d:
+                flag_answers.append(d)
             if d.get("epoch") != self.epoch:
                 # fdbmonitor restarted the process between sweeps: it
                 # answers pings but serves no recruited role — fold it
                 # back in with a generation change (catches restarts
                 # faster than a wedged proxy batch would).
                 verdict = verdict or f"{role}{i} restarted (epoch {d.get('epoch')})"
+        if flag_answers:
+            # Any-answered OR: the flags are set on every proxy together
+            # (backup._set_proxies / set_database_lock loop over all), so
+            # one fresh answer is authoritative; OR guards the window
+            # where a setter died mid-loop.
+            self.backup_active = any(d["backup_enabled"] for d in flag_answers)
+            self.db_locked = any(d.get("locked") for d in flag_answers)
         if verdict:
             return verdict
         missing = [
@@ -636,6 +684,7 @@ class DeployedController:
             return
         self._recovering = True
         print(f"[controller] recovery: {reason}", file=sys.stderr, flush=True)
+        await self._learn_db_flags()
         lock_failures = 0
         try:
             while True:
@@ -685,6 +734,23 @@ class DeployedController:
                     await self.loop.sleep(self.RETRY_DELAY)
         finally:
             self._recovering = False
+
+    async def _learn_db_flags(self) -> None:
+        """Probe every spec proxy for its database flags before recruiting
+        the next generation — covers the controller-restart path where no
+        sweep has cached them yet. Keeps the cache when nothing answers
+        (all proxies dead: the last swept values are the best evidence)."""
+        answers = []
+        for i in range(len(self.spec["proxy"])):
+            try:
+                d = await self._worker("proxy", i).describe()
+            except Exception:
+                continue
+            if d.get("epoch", 0) > 0 and "backup_enabled" in d:
+                answers.append(d)
+        if answers:
+            self.backup_active = any(d["backup_enabled"] for d in answers)
+            self.db_locked = any(d.get("locked") for d in answers)
 
     async def _all_tlogs_fresh(self) -> bool:
         """Every spec tlog worker answers AND serves no recruited tlog."""
@@ -929,7 +995,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             eps("storage"),
         )
         tk_db.transaction_class = RYWTransaction
-        tk = TimeKeeper(loop, tk_db)
+        tk = TimeKeeper(loop, tk_db, token=_system_token(spec))
         _supervise(loop, "timekeeper.run", tk.run)
     else:
         raise ValueError(f"unknown role {role!r}")

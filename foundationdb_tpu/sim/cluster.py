@@ -73,6 +73,7 @@ class SimCluster:
         timekeeper: bool = True,
         process_prefix: str = "",
         authz_public_key: bytes | None = None,
+        authz_system_token: str | None = None,
     ):
         assert 1 <= n_replicas <= n_storages
         self.loop = loop or Loop(seed=seed)
@@ -122,6 +123,9 @@ class SimCluster:
             from foundationdb_tpu.runtime.authz import TokenAuthority
 
             self.authz = TokenAuthority(authz_public_key)
+        # Operator-minted system-scope token for in-process system actors
+        # (TimeKeeper): with authz on, \xff writes require it.
+        self.authz_system_token = authz_system_token
         self.retired_tags: set[int] = set()  # stopped-backup tags, per tlog
 
         # Storage servers persist across generations (they ARE the data);
@@ -196,7 +200,8 @@ class SimCluster:
             from foundationdb_tpu.client.ryw import open_database
             from foundationdb_tpu.runtime.timekeeper import TimeKeeper
 
-            self.timekeeper = TimeKeeper(self.loop, open_database(self))
+            self.timekeeper = TimeKeeper(self.loop, open_database(self),
+                                         token=authz_system_token)
             self.loop.spawn(
                 self.timekeeper.run(), process=process_prefix + "timekeeper",
                 name="timekeeper.run",

@@ -61,6 +61,14 @@ class SimNetwork:
         self.process_prefix = process_prefix
         self._objects: dict[str, Any] = {}  # endpoint name -> role object
         self._partitions: set[frozenset] = set()
+        # Dead REGIONS (reference: multi-region FDB models datacenter
+        # loss, fdbserver/DataDistribution.actor.cpp region teams). A
+        # region here is a process-name prefix ("pri/", "sat/", "rem/");
+        # failing one kills every process under it AND isolates the
+        # prefix: later-hosted processes there are unreachable too, so a
+        # recovery that recruited into a dead region simply stalls and
+        # retries elsewhere.
+        self._dead_regions: set[str] = set()
         # Clogs: slow-but-alive links (reference: sim2's clogging — the
         # failure mode BETWEEN healthy and partitioned that shakes out
         # timeout/ordering assumptions). pair -> (latency multiplier,
@@ -88,6 +96,27 @@ class SimNetwork:
     def reboot(self, process: str) -> None:
         """Clears the dead flag; the harness re-hosts/restarts role actors."""
         self.loop.revive_process(self.process_prefix + process)
+
+    def fail_region(self, prefix: str) -> None:
+        """Datacenter loss: kill every live process under `prefix` and
+        black-hole the prefix for anything hosted there later."""
+        p = self.process_prefix + prefix
+        self._dead_regions.add(p)
+        for proc in {k[0] for k in self._objects}:
+            if proc.startswith(p):
+                self.loop.kill_process(proc)
+
+    def heal_region(self, prefix: str) -> None:
+        self._dead_regions.discard(self.process_prefix + prefix)
+        for proc in {k[0] for k in self._objects}:
+            if proc.startswith(self.process_prefix + prefix):
+                self.loop.revive_process(proc)
+
+    def region_dead(self, prefix: str) -> bool:
+        return (self.process_prefix + prefix) in self._dead_regions
+
+    def _in_dead_region(self, process: str) -> bool:
+        return any(process.startswith(r) for r in self._dead_regions)
 
     def partition(self, a: str, b: str) -> None:
         self._partitions.add(frozenset(
@@ -118,6 +147,8 @@ class SimNetwork:
         return (
             dst in self.loop.dead_processes
             or (src != dst and frozenset((src, dst)) in self._partitions)
+            or (self._dead_regions
+                and (self._in_dead_region(dst) or self._in_dead_region(src)))
         )
 
     def _latency(self, src: str | None = None, dst: str | None = None) -> float:

@@ -42,7 +42,7 @@ def main():
     cs = TPUConflictSet(capacity=C, batch_size=B, max_read_ranges=R,
                         max_write_ranges=Q, max_key_bytes=12,
                         window_versions=64)
-    W = cs.state.keys.shape[1]
+    W = cs.codec.width
 
     def rand_keys(n):
         k = np.zeros((n, W), np.int32)

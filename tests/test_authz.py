@@ -4,9 +4,11 @@ A cluster constructed with an authz public key verifies every commit at
 the proxy: user-keyspace writes must lie inside a prefix the request's
 Ed25519-signed token authorizes; untokened user writes, out-of-scope
 writes, forged and expired tokens are all denied with permission_denied
-(6000). System actors (TimeKeeper, tenant management) keep working —
-system-keyspace writes are governed by access_system_keys + the TLS
-process mesh, not tokens.
+(6000). SYSTEM-keyspace writes require an explicit system grant in the
+token (mint_token system=True) — the client-side access_system_keys
+option is never trusted, so a tenant client cannot rewrite
+\xff/tenant/map and defeat isolation. System actors (TimeKeeper, tenant
+management) carry an operator-minted system token.
 """
 
 import pytest
@@ -24,7 +26,9 @@ from foundationdb_tpu.sim.cluster import SimCluster
 @pytest.fixture
 def authz_db():
     priv, pub = generate_keypair()
-    c = SimCluster(seed=21, n_storages=2, authz_public_key=pub)
+    c = SimCluster(seed=21, n_storages=2, authz_public_key=pub,
+                   authz_system_token=mint_token(
+                       priv, [], expires_at=1e12, system=True))
     return priv, c, open_database(c)
 
 
@@ -88,13 +92,14 @@ def test_clear_range_must_stay_inside_prefix(authz_db):
 
 
 def test_system_actors_unaffected_and_tenant_flow_works(authz_db):
-    """Tenant create (system keys) works untokened via operator client;
+    """Tenant create (system keys) works with an operator system token;
     a token minted for the allocated prefix then authorizes tenant data
     writes through the TenantTransaction surface."""
     priv, c, db = authz_db
     from foundationdb_tpu.client.tenant import Tenant, create_tenant
 
-    c.loop.run(create_tenant(db, b"acme"))
+    admin = mint_token(priv, [], expires_at=c.loop.now + 3600, system=True)
+    c.loop.run(create_tenant(db, b"acme", token=admin))
     t = Tenant(db, b"acme")
     prefix = c.loop.run(t._resolve())
     token = mint_token(priv, [prefix], expires_at=c.loop.now + 3600)
@@ -162,7 +167,7 @@ def test_dr_to_authz_secondary_with_admin_token():
     dst = SimCluster(loop=loop, seed=131, n_storages=2,
                      process_prefix="dst.", authz_public_key=pub)
     src_db, dst_db = open_database(src), open_database(dst)
-    admin = mint_token(priv, [b""], expires_at=loop.now + 3600)
+    admin = mint_token(priv, [b""], expires_at=loop.now + 3600, system=True)
 
     async def main():
         async def w(tr):
@@ -187,7 +192,57 @@ def test_verify_cache_and_authority_unit():
     priv, pub = generate_keypair()
     auth = TokenAuthority(pub)
     tok = mint_token(priv, [b"p/"], expires_at=100.0)
-    assert auth.verify(tok, now=50.0) == [b"p/"]
-    assert auth.verify(tok, now=50.0) == [b"p/"]  # cached path
+    assert auth.verify(tok, now=50.0) == ([b"p/"], False)
+    assert auth.verify(tok, now=50.0) == ([b"p/"], False)  # cached path
     with pytest.raises(PermissionDenied):
         auth.verify(tok, now=200.0)  # expiry checked past the cache
+    sys_tok = mint_token(priv, [], expires_at=100.0, system=True)
+    assert auth.verify(sys_tok, now=50.0) == ([], True)
+
+
+def test_system_keyspace_requires_system_grant(authz_db):
+    """The advisor-found bypass: with authz on, NO client — tokened or
+    untokened, access_system_keys or not — may write \xff keys without an
+    explicit system grant. A tenant token must not be able to re-point
+    \xff/tenant/map entries."""
+    priv, c, db = authz_db
+    from foundationdb_tpu.client.tenant import Tenant, create_tenant
+
+    admin = mint_token(priv, [], expires_at=c.loop.now + 3600, system=True)
+    prefix = c.loop.run(create_tenant(db, b"victim", token=admin))
+
+    tenant_tok = mint_token(priv, [b"tenantA/"],
+                            expires_at=c.loop.now + 3600)
+
+    async def repoint(tr):
+        # Attack: re-point the victim tenant's prefix into tenantA's
+        # authorized space, then read victim data through the tenant API.
+        tr.set_option("access_system_keys")
+        tr.set_option("authorization_token", tenant_tok)
+        tr.set(b"\xff/tenant/map/victim", b"tenantA/")
+
+    with pytest.raises(PermissionDenied):
+        c.loop.run(db.run(repoint))
+
+    async def untokened(tr):
+        tr.set_option("access_system_keys")
+        tr.set(b"\xff/rogue", b"1")
+
+    with pytest.raises(PermissionDenied):
+        c.loop.run(db.run(untokened))
+
+    async def clear_sys(tr):
+        tr.set_option("access_system_keys")
+        tr.set_option("authorization_token", tenant_tok)
+        tr.clear_range(b"\xff/tenant/map/", b"\xff/tenant/map/\xff")
+
+    with pytest.raises(PermissionDenied):
+        c.loop.run(db.run(clear_sys))
+
+    # The system grant itself works — and the tenant map is intact.
+    async def sys_write(tr):
+        tr.set_option("access_system_keys")
+        tr.set_option("authorization_token", admin)
+        return await tr.get(b"\xff/tenant/map/victim")
+
+    assert c.loop.run(db.run(sys_write)) == prefix

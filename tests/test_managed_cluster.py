@@ -209,3 +209,52 @@ class TestManagedHealing:
 
         out = cli_ok(spec_path, "writemode on; set sq/b v2; getrange sq/ sq0")
         assert "v1" in out.stdout and "v2" in out.stdout
+
+    def test_db_flags_survive_heal(self, managed):
+        """Advisor finding: a heal during DR must keep dual-tagging on,
+        and a locked database must stay locked through recruitment —
+        recruit_proxy with defaults silently dropped both (stream gap /
+        stale-client commits after switchover)."""
+        spec, spec_path, procs, launch = managed
+
+        def proxy_rpc(method, *args):
+            from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+            from foundationdb_tpu.server import parse_addr
+
+            loop = RealLoop()
+            t = NetTransport(loop)
+            try:
+                return [
+                    loop.run_until(
+                        getattr(t.endpoint(parse_addr(a), "commit_proxy"),
+                                method)(*args), timeout=10)
+                    for a in spec["proxy"]
+                ]
+            finally:
+                t._listener.close()
+
+        cli_ok(spec_path, "writemode on; set fl/a v1")
+        proxy_rpc("set_backup_enabled", True)
+        proxy_rpc("set_locked", True)
+        time.sleep(3)  # > one heartbeat: the controller sweep caches flags
+
+        epoch0 = controller_status(spec)["epoch"]
+        procs[("tlog", 1)].send_signal(signal.SIGKILL)
+        procs[("tlog", 1)].wait()
+        deadline = time.monotonic() + 90
+        healed = False
+        while time.monotonic() < deadline and not healed:
+            try:
+                st = controller_status(spec)
+                healed = st["epoch"] > epoch0 and not st["recovering"]
+            except Exception:
+                pass
+            if not healed:
+                time.sleep(1)
+        assert healed, "cluster never healed after tlog kill"
+
+        # The NEW generation's proxies carry both flags.
+        assert all(proxy_rpc("get_backup_enabled"))
+        assert all(proxy_rpc("get_locked"))
+        st = controller_status(spec)
+        assert st["backup_active"] and st["db_locked"]
