@@ -765,26 +765,52 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
     # ≥4 dispatch windows so the mid-run density reshard (run_tpu_wire
     # reshard_mid) actually fires and the artifact records before/after.
     child_window = max(1, (child_txns // MODES["ycsb"].batch) // 4)
-    cmd = [sys.executable, sys.argv[0] if sys.argv else "bench.py",
-           "--mode", "ycsb", "--resolvers", str(nres),
-           "--txns", str(child_txns),
-           "--keys", str(args.keys), "--capacity", str(args.capacity),
-           "--seed", str(args.seed + 1), "--window", str(child_window)]
-    log(f"[{cname}] launching cpu-mesh subprocess: {' '.join(cmd[1:])}")
-    try:
+
+    def child_run(n: int, timeout_s: float) -> dict:
+        cmd = [sys.executable, sys.argv[0] if sys.argv else "bench.py",
+               "--mode", "ycsb", "--resolvers", str(n),
+               "--txns", str(child_txns),
+               "--keys", str(args.keys), "--capacity", str(args.capacity),
+               "--seed", str(args.seed + 1), "--window", str(child_window)]
+        log(f"[{cname}] launching cpu-mesh subprocess: {' '.join(cmd[1:])}")
         r = subprocess.run(
-            cmd, env=env, capture_output=True, text=True,
-            timeout=max(300.0, budget_s - 60.0),
+            cmd, env=env, capture_output=True, text=True, timeout=timeout_s,
         )
         line = (r.stdout.strip().splitlines() or [""])[-1]
-        child = json.loads(line)
+        return json.loads(line)
+
+    try:
+        budget = max(300.0, budget_s - 60.0)
+        child = child_run(nres, budget)
         keep = ("value", "vs_baseline", "txns", "conflict_rate",
                 "verdict_parity", "cpu_baseline_txns_per_sec", "p50_ms",
-                "p99_ms", "batches_per_dispatch", "shard_occupancy")
+                "p99_ms", "windowed", "shard_occupancy")
         out = {k: child.get(k) for k in keep}
         out.update(backend="cpu-mesh", resolvers=nres, valid=False,
                    note="virtual 8-device CPU mesh: occupancy/balance "
                         "signal, not TPU perf")
+        # Throughput SCALING curve (VERDICT r4 item 10): the same stream
+        # on the same cpu-mesh backend with ONE resolver; ratio of the
+        # windowed rates says what n-way sharding actually buys — a
+        # load-balance claim becomes a throughput measurement (still
+        # labeled cpu-mesh, never a TPU number).
+        if budget_s > 900:
+            try:
+                one = child_run(1, budget / 2)
+                n_rate = (child.get("windowed") or {}).get("value") or child.get("value")
+                one_rate = ((one.get("windowed") or {}).get("value")
+                            or one.get("value"))
+                out["scaling"] = {
+                    "one_resolver_txns_per_sec": one_rate,
+                    "n_resolver_txns_per_sec": n_rate,
+                    "ratio": (round(n_rate / one_rate, 2)
+                              if n_rate and one_rate else None),
+                    "ideal": nres,
+                }
+            except Exception as e:  # noqa: BLE001
+                out["scaling"] = {"error": str(e)[:200]}
+        else:
+            out["scaling"] = {"skipped": "deadline budget"}
         return out
     except Exception as e:  # noqa: BLE001 — diagnostics must not kill sweep
         return {"error": f"cpu-mesh run failed: {str(e)[:200]}"}

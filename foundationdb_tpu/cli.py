@@ -87,6 +87,14 @@ unthrottle tag NAME     clear a tag quota
 getversion              current read version (fdbcli getversion)
 watch KEY [T]           block until KEY changes (default 30s timeout)
 kill ROLEN              ask a server process to exit (fdbcli kill)
+lock / unlock           set/clear the database lock (error 1038) on every
+                        commit proxy (fdbcli lock/unlock)
+exclude ROLEN           drop a chain process (tlog/resolver/proxy) from the
+                        generation — managed clusters only (fdbcli exclude)
+include ROLEN           return an excluded process to service
+configure ROLE=N ...    chain-role counts for the next generation, e.g.
+                        `configure proxies=1 tlogs=2` (fdbcli configure)
+coordinators            show the coordination/controller endpoints
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -215,6 +223,64 @@ class Shell:
                 return f"ERROR: no process {args[0]} in the cluster spec"
             ep = self.t.endpoint(parse_addr(addrs[idx]), "admin")
             return self._await(ep.shutdown())
+        if cmd in ("lock", "unlock"):
+            # fdbcli lock/unlock: the database lock at every commit proxy
+            # (runtime/dr.py's deployed analogue; error 1038 for
+            # non-lock-aware commits while locked).
+            locked = cmd == "lock"
+            n = 0
+            for addr in self.spec.get("proxy") or []:
+                ep = self.t.endpoint(parse_addr(addr), "commit_proxy")
+                self._await(ep.set_locked(locked))
+                n += 1
+            return f"{'Locked' if locked else 'Unlocked'} ({n} proxies)"
+        if cmd in ("exclude", "include"):
+            if len(args) != 1 or not re.fullmatch(r"[a-z]+\d+", args[0]):
+                return f"usage: {cmd} ROLEN  (e.g. {cmd} tlog1)"
+            ctrl = self.spec.get("controller") or []
+            if not ctrl:
+                return ("ERROR: exclude/include need a managed cluster "
+                        "(spec `controller`) — generation membership is "
+                        "the controller's")
+            role = args[0].rstrip("0123456789")
+            idx = int(args[0][len(role):])
+            ep = self.t.endpoint(parse_addr(ctrl[0]), "controller")
+            # Server-side ValueError crosses the wire wrapped as
+            # FdbError(1500) — run_cmd's generic handler prints it.
+            out = self._await(ep.set_excluded(role, idx, cmd == "exclude"))
+            return f"excluded: {out['excluded'] or '(none)'}"
+        if cmd == "configure":
+            ctrl = self.spec.get("controller") or []
+            if not ctrl:
+                return ("ERROR: configure needs a managed cluster "
+                        "(spec `controller`)")
+            counts: dict = {}
+            alias = {"proxies": "proxy", "tlogs": "tlog",
+                     "resolvers": "resolver", "proxy": "proxy",
+                     "tlog": "tlog", "resolver": "resolver"}
+            for a in args:
+                if "=" not in a:
+                    return "usage: configure ROLE=N [ROLE=N ...]"
+                k, v = a.split("=", 1)
+                if k not in alias or not v.isdigit():
+                    return f"ERROR: cannot configure {a!r}"
+                counts[alias[k]] = int(v)
+            if not counts:
+                return "usage: configure ROLE=N [ROLE=N ...]"
+            ep = self.t.endpoint(parse_addr(ctrl[0]), "controller")
+            out = self._await(ep.configure(counts))
+            return f"configured: {out['configured']}"
+        if cmd == "coordinators":
+            # fdbcli coordinators: where cluster coordination lives. The
+            # deployed runtime coordinates through the controller
+            # singleton (static mode has none).
+            ctrl = self.spec.get("controller") or []
+            coords = self.spec.get("coordinators") or []
+            if coords:
+                return "coordinators: " + " ".join(coords)
+            if ctrl:
+                return f"controller (singleton coordination): {ctrl[0]}"
+            return "static wiring: no coordination processes"
         if cmd == "status":
             return json.dumps(self._status(), indent=1, sort_keys=True)
         return f"ERROR: unknown command `{cmd}' (try help)"

@@ -27,6 +27,11 @@ def _lib():
         lib = load_library("netclient")
         lib.fnet_connect.restype = ctypes.c_void_p
         lib.fnet_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.fnet_connect_tls.restype = ctypes.c_void_p
+        lib.fnet_connect_tls.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
         lib.fnet_close.argtypes = [ctypes.c_void_p]
         lib.fnet_get_read_version.restype = ctypes.c_int64
         lib.fnet_get_read_version.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -79,10 +84,23 @@ class NetClient:
     def __init__(self, host: str, port: int,
                  grv_service: bytes = b"grv_proxy",
                  proxy_service: bytes = b"commit_proxy",
-                 storage_service: bytes = b"storage0"):
-        self._h = _lib().fnet_connect(host.encode(), port)
+                 storage_service: bytes = b"storage0",
+                 tls: dict | None = None):
+        """`tls`: {"cert": path, "key": path, "ca": path} — mutual TLS
+        against a TLS-enabled cluster (the spec's `tls` section; the C
+        side dlopens the system OpenSSL 3 runtime)."""
+        if tls:
+            self._h = _lib().fnet_connect_tls(
+                host.encode(), port,
+                str(tls["cert"]).encode(), str(tls["key"]).encode(),
+                str(tls["ca"]).encode(),
+            )
+        else:
+            self._h = _lib().fnet_connect(host.encode(), port)
         if not self._h:
-            raise ConnectionError(f"cannot connect to {host}:{port}")
+            raise ConnectionError(
+                f"cannot connect to {host}:{port}"
+                + (" (TLS handshake failed)" if tls else ""))
         self.grv_service = grv_service
         self.proxy_service = proxy_service
         self.storage_service = storage_service

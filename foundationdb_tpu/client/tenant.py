@@ -29,7 +29,8 @@ from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.core.mutations import MutationType
 from foundationdb_tpu.core.types import strinc
 
-TENANT_MAP_PREFIX = b"\xff/tenant/map/"
+from foundationdb_tpu.core.types import TENANT_MAP_PREFIX  # canonical home
+
 TENANT_ID_COUNTER = b"\xff/tenant/idCounter"
 # Tenant data lives under this byte BY CONVENTION, like the reference's
 # optional tenant mode: plain-database clients are not fenced off from it
@@ -124,10 +125,14 @@ class Tenant:
     The prefix is resolved lazily on first use and cached (reference
     clients cache the tenant map entry the same way)."""
 
-    def __init__(self, db, name: bytes):
+    def __init__(self, db, name: bytes, token: str | None = None):
+        """`token`: the tenant's authz token — on a read-authz cluster the
+        lazy prefix resolution reads the tenant map at storage, which
+        admits any VALID token (runtime/authz.TENANT_MAP_RANGE)."""
         _check_name(name)
         self.db = db
         self.name = name
+        self.token = token
         self._prefix: bytes | None = None
 
     async def _resolve(self) -> bytes:
@@ -137,6 +142,8 @@ class Tenant:
             # tenant failures — found by the buggify campaign.
             async def body(tr):
                 tr.set_option("access_system_keys")
+                if self.token:
+                    tr.set_option("authorization_token", self.token)
                 return await tr.get(TENANT_MAP_PREFIX + self.name)
 
             prefix = await self.db.run(body)

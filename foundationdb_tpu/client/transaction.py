@@ -185,7 +185,8 @@ class Database:
 
         return sorted(team, key=bad)
 
-    async def read_key(self, key: bytes, version: int):
+    async def read_key(self, key: bytes, version: int,
+                       token: str | None = None):
         """Point read with replica failover + shard-map refresh: try every
         team member (dead replicas skipped), refresh the map and re-route on
         wrong_shard_server (data distribution moved the shard)."""
@@ -194,7 +195,8 @@ class Database:
             wrong_shard = False
             for tag in self._order_team(team):
                 try:
-                    return await self.storage_eps[tag].get(key, version)
+                    return await self.storage_eps[tag].get(
+                        key, version, token=token)
                 except BrokenPromise:
                     self._ep_failed_at[tag] = self.loop.now
                     continue  # dead/partitioned replica: try the next
@@ -210,7 +212,7 @@ class Database:
 
     async def read_range(
         self, begin: bytes, end: bytes, version: int,
-        limit: int, reverse: bool,
+        limit: int, reverse: bool, token: str | None = None,
     ) -> list[tuple[bytes, bytes]]:
         """Range read across shards with the same failover/refresh loop."""
         out: list[tuple[bytes, bytes]] = []
@@ -225,7 +227,8 @@ class Database:
                 for r, team in parts:
                     if len(out) >= limit:
                         return out
-                    got = await self._read_part(r, team, version, limit - len(out), reverse)
+                    got = await self._read_part(
+                        r, team, version, limit - len(out), reverse, token)
                     out.extend(got)
                     # Progress cursor so a later wrong-shard retry does not
                     # re-read (and double-count) finished parts.
@@ -239,13 +242,15 @@ class Database:
         raise ProcessKilled("shard map kept changing under range read")
 
     async def _read_part(
-        self, r: KeyRange, team, version: int, limit: int, reverse: bool
+        self, r: KeyRange, team, version: int, limit: int, reverse: bool,
+        token: str | None = None,
     ) -> list[tuple[bytes, bytes]]:
         last_wrong: Exception | None = None
         for tag in self._order_team(team):
             try:
                 return await self.storage_eps[tag].get_range(
-                    r.begin, r.end, version, limit=limit, reverse=reverse
+                    r.begin, r.end, version, limit=limit, reverse=reverse,
+                    token=token,
                 )
             except BrokenPromise:
                 self._ep_failed_at[tag] = self.loop.now
@@ -395,7 +400,8 @@ class Transaction:
             return await self._get_special(key)
         _check_key(key)
         version = await self.get_read_version()
-        value = await self.db.read_key(key, version)
+        value = await self.db.read_key(key, version,
+                                        token=self.authorization_token)
         if not snapshot:
             self.read_ranges.append(single_key_range(key))
         return value
@@ -489,7 +495,8 @@ class Transaction:
             return rows[:limit] if limit > 0 else rows
         version = await self.get_read_version()
         cap = limit if limit > 0 else 1 << 30
-        rows = await self.db.read_range(begin, end, version, cap, reverse)
+        rows = await self.db.read_range(begin, end, version, cap, reverse,
+                                        token=self.authorization_token)
         rows = rows[:cap]
         if not snapshot:
             if limit > 0 and len(rows) == cap and rows:
@@ -544,7 +551,8 @@ class Transaction:
     async def _scan_keys(
         self, begin: bytes, end: bytes, limit: int, reverse: bool, version: int
     ) -> list[bytes]:
-        rows = await self.db.read_range(begin, end, version, limit, reverse)
+        rows = await self.db.read_range(begin, end, version, limit, reverse,
+                                        token=self.authorization_token)
         return [k for k, _v in rows[:limit]]
 
     async def watch(self, key: bytes) -> "object":
@@ -669,7 +677,7 @@ class Transaction:
     def _arm_watches(self) -> None:
         for (key, value), slot in zip(self._pending_watches, self._watch_futures):
             ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
-            fut = ep.watch(key, value)
+            fut = ep.watch(key, value, token=self.authorization_token)
             fut.add_done_callback(
                 lambda f, s=slot: s._finish(f._state, f._value)
             )

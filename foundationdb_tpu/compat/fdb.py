@@ -325,12 +325,17 @@ class Database:
         else:
             self.clear(key)
 
-    def open_tenant(self, name: bytes) -> "TenantFacade":
+    def open_tenant(self, name: bytes,
+                    token: str | None = None) -> "TenantFacade":
         """Reference: db.open_tenant — a handle whose transactions are
-        confined to the named tenant's keyspace."""
+        confined to the named tenant's keyspace. On a read-authz cluster
+        pass the tenant's authorization token: the lazy prefix
+        resolution reads the tenant map at storage, which requires a
+        valid token there (and transactions still set their own
+        authorization_token option for data access)."""
         from foundationdb_tpu.client.tenant import Tenant as _Tenant
 
-        return TenantFacade(self, _Tenant(self._db, name))
+        return TenantFacade(self, _Tenant(self._db, name, token=token))
 
     def close(self) -> None:
         t = getattr(self, "_transport", None)

@@ -18,6 +18,12 @@ MAX_KEY_SIZE = 10_000
 MAX_VALUE_SIZE = 100_000
 MAX_TRANSACTION_SIZE = 10_000_000
 
+# THE canonical tenant-map location (reference: SystemData's tenant map
+# prefix). One definition — client/tenant.py (management + resolution),
+# runtime/authz.py (the read carve-out, a security boundary) and the
+# commit proxies' live-map refresh all import it from here.
+TENANT_MAP_PREFIX = b"\xff/tenant/map/"
+
 
 class Verdict(enum.IntEnum):
     """Resolver verdict for one transaction in a batch.

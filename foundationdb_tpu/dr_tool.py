@@ -108,9 +108,9 @@ def main(argv: list[str] | None = None) -> int:
         async def status():
             import time as _time
 
-            applied = await DRAgent.read_progress(dst_db)
+            applied = await DRAgent.read_progress(dst_db, args.dst_token)
             live = await src.sequencer_ep.get_live_committed_version()
-            hb = await DRAgent.read_heartbeat(dst_db)
+            hb = await DRAgent.read_heartbeat(dst_db, args.dst_token)
             tagging = await src.probe_backup_active()
             lag = max(0, live - applied)
             hb_age = None if hb is None else max(0.0, _time.time() - hb)

@@ -21,12 +21,90 @@
 #include <vector>
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 namespace {
+
+// -- optional mutual TLS (matching runtime/net.py's transport) ---------------
+//
+// A TLS-enabled cluster (spec `tls` section) requires every peer —
+// including this C client — to complete a mutual handshake (reference:
+// the fdb_c client speaks the same TLS as the server via network
+// options, flow/TLSConfig.actor.cpp). OpenSSL 3 ships in the image as a
+// RUNTIME library only (no headers), so the handful of stable C-ABI
+// entry points a blocking client needs is declared here and resolved
+// with dlopen on first use.
+
+constexpr int SSL_FILETYPE_PEM_ = 1;
+constexpr int SSL_VERIFY_PEER_ = 1;
+
+struct TlsApi {
+  void* (*TLS_client_method)() = nullptr;
+  void* (*SSL_CTX_new)(void*) = nullptr;
+  void (*SSL_CTX_free)(void*) = nullptr;
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*) = nullptr;
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(void*, const char*,
+                                       const char*) = nullptr;
+  void (*SSL_CTX_set_verify)(void*, int, void*) = nullptr;
+  void* (*SSL_new)(void*) = nullptr;
+  int (*SSL_set_fd)(void*, int) = nullptr;
+  int (*SSL_connect)(void*) = nullptr;
+  int (*SSL_read)(void*, void*, int) = nullptr;
+  int (*SSL_write)(void*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(void*) = nullptr;
+  void (*SSL_free)(void*) = nullptr;
+  bool ok = false;
+};
+
+TlsApi* tls_api() {
+  static TlsApi api;
+  static bool tried = false;
+  if (!tried) {
+    tried = true;
+    void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (h) {
+      auto sym = [h](const char* n) { return dlsym(h, n); };
+      api.TLS_client_method =
+          reinterpret_cast<void* (*)()>(sym("TLS_client_method"));
+      api.SSL_CTX_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_CTX_new"));
+      api.SSL_CTX_free = reinterpret_cast<void (*)(void*)>(sym("SSL_CTX_free"));
+      api.SSL_CTX_use_certificate_chain_file =
+          reinterpret_cast<int (*)(void*, const char*)>(
+              sym("SSL_CTX_use_certificate_chain_file"));
+      api.SSL_CTX_use_PrivateKey_file =
+          reinterpret_cast<int (*)(void*, const char*, int)>(
+              sym("SSL_CTX_use_PrivateKey_file"));
+      api.SSL_CTX_load_verify_locations =
+          reinterpret_cast<int (*)(void*, const char*, const char*)>(
+              sym("SSL_CTX_load_verify_locations"));
+      api.SSL_CTX_set_verify = reinterpret_cast<void (*)(void*, int, void*)>(
+          sym("SSL_CTX_set_verify"));
+      api.SSL_new = reinterpret_cast<void* (*)(void*)>(sym("SSL_new"));
+      api.SSL_set_fd = reinterpret_cast<int (*)(void*, int)>(sym("SSL_set_fd"));
+      api.SSL_connect = reinterpret_cast<int (*)(void*)>(sym("SSL_connect"));
+      api.SSL_read =
+          reinterpret_cast<int (*)(void*, void*, int)>(sym("SSL_read"));
+      api.SSL_write =
+          reinterpret_cast<int (*)(void*, const void*, int)>(sym("SSL_write"));
+      api.SSL_shutdown = reinterpret_cast<int (*)(void*)>(sym("SSL_shutdown"));
+      api.SSL_free = reinterpret_cast<void (*)(void*)>(sym("SSL_free"));
+      api.ok = api.TLS_client_method && api.SSL_CTX_new && api.SSL_CTX_free &&
+               api.SSL_CTX_use_certificate_chain_file &&
+               api.SSL_CTX_use_PrivateKey_file &&
+               api.SSL_CTX_load_verify_locations && api.SSL_CTX_set_verify &&
+               api.SSL_new && api.SSL_set_fd && api.SSL_connect &&
+               api.SSL_read && api.SSL_write && api.SSL_shutdown &&
+               api.SSL_free;
+    }
+  }
+  return &api;
+}
 
 // wire.py tags
 constexpr uint8_t T_NONE = 0x00, T_TRUE = 0x01, T_FALSE = 0x02, T_INT = 0x03,
@@ -41,6 +119,8 @@ constexpr int64_t ERR_BROKEN = 1100;     // broken_promise (connection lost)
 
 struct Conn {
   int fd = -1;
+  void* ssl = nullptr;      // OpenSSL SSL* when the cluster runs TLS
+  void* ssl_ctx = nullptr;  // its SSL_CTX*
   uint64_t next_id = 1;
   // Replies that arrived while waiting for a different request id —
   // the pipelining stash (multiple requests in flight on one conn).
@@ -134,9 +214,19 @@ bool skip_value(Cur& c) {
 
 // -- socket IO ---------------------------------------------------------------
 
-bool write_all(int fd, const uint8_t* p, size_t n) {
+bool conn_write(Conn* c, const uint8_t* p, size_t n) {
+  if (c->ssl) {
+    TlsApi* t = tls_api();
+    while (n) {
+      int k = t->SSL_write(c->ssl, p, static_cast<int>(n));
+      if (k <= 0) return false;
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+    return true;
+  }
   while (n) {
-    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    ssize_t k = ::send(c->fd, p, n, MSG_NOSIGNAL);
     if (k <= 0) return false;
     p += k;
     n -= static_cast<size_t>(k);
@@ -144,9 +234,19 @@ bool write_all(int fd, const uint8_t* p, size_t n) {
   return true;
 }
 
-bool read_all(int fd, uint8_t* p, size_t n) {
+bool conn_read(Conn* c, uint8_t* p, size_t n) {
+  if (c->ssl) {
+    TlsApi* t = tls_api();
+    while (n) {
+      int k = t->SSL_read(c->ssl, p, static_cast<int>(n));
+      if (k <= 0) return false;
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+    return true;
+  }
   while (n) {
-    ssize_t k = ::recv(fd, p, n, 0);
+    ssize_t k = ::recv(c->fd, p, n, 0);
     if (k <= 0) return false;
     p += k;
     n -= static_cast<size_t>(k);
@@ -160,7 +260,7 @@ bool send_frame(Conn* c, const Buf& req) {
   uint32_t len = static_cast<uint32_t>(req.d.size());
   uint8_t hdr[4];
   memcpy(hdr, &len, 4);
-  return write_all(c->fd, hdr, 4) && write_all(c->fd, req.d.data(), len);
+  return conn_write(c, hdr, 4) && conn_write(c, req.d.data(), len);
 }
 
 // Parse a reply frame (RSP=1, msg_id, ok, value). Fills msg_id; on ok
@@ -203,7 +303,7 @@ int64_t recv_reply_for(Conn* c, uint64_t want, std::vector<uint8_t>& out,
   while (true) {
     if (c->fd < 0) return -ERR_BROKEN;
     uint8_t hdr[4];
-    if (!read_all(c->fd, hdr, 4)) return -ERR_BROKEN;
+    if (!conn_read(c, hdr, 4)) return -ERR_BROKEN;
     uint32_t rlen;
     memcpy(&rlen, hdr, 4);
     if (rlen > (64u << 20)) {
@@ -214,7 +314,7 @@ int64_t recv_reply_for(Conn* c, uint64_t want, std::vector<uint8_t>& out,
       return -ERR_BROKEN;
     }
     std::vector<uint8_t> frame(rlen);
-    if (!read_all(c->fd, frame.data(), rlen)) return -ERR_BROKEN;
+    if (!conn_read(c, frame.data(), rlen)) return -ERR_BROKEN;
     // Peek the msg_id without consuming the frame.
     Cur cur{frame.data(), frame.size()};
     if (cur.u8() != T_TUPLE || cur.u32() != 4) return -ERR_INTERNAL;
@@ -263,6 +363,8 @@ void pack_range(Buf& b, const uint8_t* begin, int64_t blen,
 
 extern "C" {
 
+void fnet_close(void* h);  // fwd: fnet_connect_tls unwinds through it
+
 void* fnet_connect(const char* host, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
@@ -281,9 +383,51 @@ void* fnet_connect(const char* host, int port) {
   return c;
 }
 
+// TLS variant: mutual TLS with the cluster's CA + this client's cert/key
+// (PEM paths — the same material the spec's `tls` section names). The
+// server requires a client certificate (CERT_REQUIRED both ways in
+// runtime/net.py); we verify the server against `ca` (chain, not
+// hostname — processes move, matching the Python transport). Returns
+// nullptr on any failure (no OpenSSL runtime, bad key material, refused
+// handshake).
+void* fnet_connect_tls(const char* host, int port, const char* cert,
+                       const char* key, const char* ca) {
+  TlsApi* t = tls_api();
+  if (!t->ok) return nullptr;
+  void* raw = fnet_connect(host, port);
+  if (!raw) return nullptr;
+  Conn* c = static_cast<Conn*>(raw);
+  void* ctx = t->SSL_CTX_new(t->TLS_client_method());
+  if (!ctx ||
+      t->SSL_CTX_use_certificate_chain_file(ctx, cert) != 1 ||
+      t->SSL_CTX_use_PrivateKey_file(ctx, key, SSL_FILETYPE_PEM_) != 1 ||
+      t->SSL_CTX_load_verify_locations(ctx, ca, nullptr) != 1) {
+    if (ctx) t->SSL_CTX_free(ctx);
+    fnet_close(raw);
+    return nullptr;
+  }
+  t->SSL_CTX_set_verify(ctx, SSL_VERIFY_PEER_, nullptr);
+  void* ssl = t->SSL_new(ctx);
+  if (!ssl || t->SSL_set_fd(ssl, c->fd) != 1 || t->SSL_connect(ssl) != 1) {
+    if (ssl) t->SSL_free(ssl);
+    t->SSL_CTX_free(ctx);
+    fnet_close(raw);
+    return nullptr;
+  }
+  c->ssl = ssl;
+  c->ssl_ctx = ctx;
+  return c;
+}
+
 void fnet_close(void* h) {
   Conn* c = static_cast<Conn*>(h);
   if (!c) return;
+  if (c->ssl) {
+    TlsApi* t = tls_api();
+    t->SSL_shutdown(c->ssl);
+    t->SSL_free(c->ssl);
+    t->SSL_CTX_free(c->ssl_ctx);
+  }
   if (c->fd >= 0) ::close(c->fd);
   delete c;
 }

@@ -80,6 +80,7 @@ class CommitProxy:
         controller_ep=None,
         epoch: int = 1,
         authz=None,
+        tenant_mirror=None,
     ):
         assert resolver_map.n_shards == len(resolver_eps)
         self.loop = loop
@@ -101,6 +102,13 @@ class CommitProxy:
         # Tenant authz (runtime/authz.TokenAuthority) — None = authz off,
         # every commit trusted (the pre-7.x reference default).
         self.authz = authz
+        # Live tenant-map view for TENANT-BOUND tokens (authz.check_commit
+        # live_tenants; reference: proxies track the tenant map and check
+        # token tenant ids against it). An authz.TenantMapMirror shared
+        # with (or mirroring the one on) the storage servers; its view is
+        # None until the first refresh — tenant-bound tokens fail CLOSED
+        # in that window.
+        self.tenant_mirror = tenant_mirror
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self._inflight: set[int] = set()  # batch versions being processed
         # Batches popped from _queue but not yet in _inflight (awaiting
@@ -154,6 +162,10 @@ class CommitProxy:
 
     # -- batch engine ---------------------------------------------------------
 
+    @property
+    def live_tenants(self):
+        return self.tenant_mirror.view if self.tenant_mirror else None
+
     async def run(self) -> None:
         last_batch = self.loop.now
         while True:
@@ -184,11 +196,13 @@ class CommitProxy:
             if self.authz is not None and batch:
                 # Tenant authorization (reference: TenantAuthorizer at the
                 # commit boundary): every write must lie inside a prefix
-                # the request's token authorizes.
+                # the request's token authorizes; tenant-bound tokens are
+                # additionally checked against the live tenant map.
                 passed = []
                 for req, p in batch:
                     try:
-                        self.authz.check_commit(req, self.loop.wall_now)
+                        self.authz.check_commit(req, self.loop.wall_now,
+                                                live_tenants=self.live_tenants)
                         passed.append((req, p))
                     except Exception as e:  # PermissionDenied
                         p.fail(e)

@@ -99,7 +99,7 @@ class DRAgent:
 
         base = 0
         if resume:
-            base = await self.read_progress(self.dst_db)
+            base = await self.read_progress(self.dst_db, self.dst_token)
         active = self.src_cluster.backup_active
         probe = getattr(self.src_cluster, "probe_backup_active", None)
         if probe is not None:
@@ -242,20 +242,25 @@ class DRAgent:
         await self.dst_db.run(body)
 
     @classmethod
-    async def read_progress(cls, dst_db) -> int:
+    async def read_progress(cls, dst_db, token: str | None = None) -> int:
         async def body(tr):
             tr.set_option("access_system_keys")
+            if token:
+                tr.set_option("authorization_token", token)
             return await tr.get(DR_APPLIED_KEY)
 
         v = await dst_db.run(body)
         return int(v) if v else 0
 
     @classmethod
-    async def read_heartbeat(cls, dst_db) -> float | None:
+    async def read_heartbeat(cls, dst_db,
+                             token: str | None = None) -> float | None:
         """Wall-clock epoch seconds of the agent's last liveness beacon
         (None: no agent has ever run against this destination)."""
         async def body(tr):
             tr.set_option("access_system_keys")
+            if token:
+                tr.set_option("authorization_token", token)
             return await tr.get(DR_HEARTBEAT_KEY)
 
         v = await dst_db.run(body)

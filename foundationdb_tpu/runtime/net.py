@@ -332,6 +332,12 @@ class NetTransport:
         self._conns: dict[tuple, _Conn] = {}  # outbound, by remote addr
         self._all_conns: set[_Conn] = set()
         self._next_id = 0
+        # Operator-triggered fault rules for deployed chaos testing
+        # (the TCP analogue of sim/network.py's partition/clog): peer
+        # addr -> {"mode": "drop"|"delay", "delay_s", "until"}. Applied
+        # to OUTBOUND calls from this process; installed via the admin
+        # service's inject_fault RPC (server.py).
+        self._fault_rules: dict[tuple, dict] = {}
         self._tls_server_ctx = self._tls_client_ctx = None
         if tls:
             import ssl as _ssl
@@ -404,9 +410,59 @@ class NetTransport:
         self._all_conns.add(conn)
         return conn
 
+    FAULT_DETECT_DELAY = 1.0  # dropped call → BrokenPromise after this
+
+    def set_fault(self, addr: tuple, mode: str, delay_s: float = 0.05,
+                  duration_s: float = 5.0) -> None:
+        """Install a fault rule against `addr`: "drop" black-holes calls
+        (they fail BrokenPromise after FAULT_DETECT_DELAY — the same
+        observable as a network partition) and "delay" defers each send
+        by `delay_s` (a clogged-but-alive link). Auto-expires after
+        `duration_s` — a wedged test cannot leave a cluster permanently
+        partitioned."""
+        if mode not in ("drop", "delay"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self._fault_rules[tuple(addr)] = {
+            "mode": mode, "delay_s": float(delay_s),
+            "until": self.loop.now + float(duration_s),
+        }
+
+    def clear_faults(self) -> None:
+        self._fault_rules.clear()
+
     def _call(self, addr: tuple, service: str, method: str, args: tuple,
               kwargs: dict | None = None) -> Future:
+        addr = tuple(addr)
+        rule = self._fault_rules.get(addr)
+        if rule is not None:
+            if self.loop.now >= rule["until"]:
+                self._fault_rules.pop(addr, None)
+            elif rule["mode"] == "drop":
+                p = Promise()
+
+                async def blackhole():
+                    await self.loop.sleep(self.FAULT_DETECT_DELAY)
+                    p.fail(BrokenPromise(
+                        f"{service}.{method} to {addr} dropped (fault rule)"))
+
+                self.loop.spawn(blackhole(), name="fault.drop")
+                return p.future
+            else:  # delay
+                p = Promise()
+                delay = rule["delay_s"]
+
+                async def deferred():
+                    await self.loop.sleep(delay)
+                    self._send_call(p, addr, service, method, args, kwargs)
+
+                self.loop.spawn(deferred(), name="fault.delay")
+                return p.future
         p = Promise()
+        self._send_call(p, addr, service, method, args, kwargs)
+        return p.future
+
+    def _send_call(self, p: Promise, addr: tuple, service: str, method: str,
+                   args: tuple, kwargs: dict | None = None) -> None:
         try:
             self._next_id += 1
             msg_id = self._next_id
@@ -429,7 +485,6 @@ class NetTransport:
             p.fail(FdbError(f"unserializable RPC argument: {e}", code=1500))
         except FdbError as e:  # incl. BrokenPromise, oversized-frame
             p.fail(e)
-        return p.future
 
     # -- dispatch ---------------------------------------------------------
 

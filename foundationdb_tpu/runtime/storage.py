@@ -177,10 +177,21 @@ class StorageServer:
     MAX_WATCHES = 10_000  # reference knob MAX_WATCHES → too_many_watches
 
     def __init__(self, loop: Loop, tag: int, tlog_ep, init_version: int = 0,
-                 tlog_replicas=None, kvstore=None):
+                 tlog_replicas=None, kvstore=None, authz=None):
         self.loop = loop
         self.tag = tag
         self.tlog = tlog_ep
+        # Per-read tenant authorization (runtime/authz.TokenAuthority;
+        # reference: storageserver.actor.cpp read authz) — None = authz
+        # off, every read trusted. Enforced on the CLIENT read surface
+        # (get/get_range/watch); storage↔storage transfer RPCs
+        # (fetch_keys/snapshot_range) ride the mutual-TLS process mesh.
+        self.authz = authz
+        # Live tenant-map view (authz.TenantMapMirror) so tenant-BOUND
+        # tokens stop reading when their tenant dies, matching the
+        # commit-side liveness check. Attached by the cluster harness /
+        # server bootstrap when authz is on.
+        self.tenant_mirror = None
         # Persistent engine behind the MVCC window (runtime/kvstore.py;
         # reference: KeyValueStoreSQLite). On restart the durable snapshot
         # reloads and the pull loop resumes from its version. The flush
@@ -683,8 +694,19 @@ class StorageServer:
                     self._version_waiters.remove(entry)
                 raise FutureVersion(f"read at {version} > applied {self._version}")
 
+    def _check_read_authz(self, begin: bytes, end: bytes,
+                          token: str | None) -> None:
+        if self.authz is not None:
+            self.authz.check_read(
+                begin, end, token, self.loop.wall_now,
+                live_tenants=(self.tenant_mirror.view
+                              if self.tenant_mirror else None),
+            )
+
     @rpc
-    async def get(self, key: bytes, version: int) -> bytes | None:
+    async def get(self, key: bytes, version: int,
+                  token: str | None = None) -> bytes | None:
+        self._check_read_authz(key, key + b"\x00", token)
         await self._check_version(version)
         self._check_serving(key, key + b"\x00", version)
         return self.map.at(key, version)
@@ -697,8 +719,18 @@ class StorageServer:
         version: int,
         limit: int = 10_000,
         reverse: bool = False,
+        token: str | None = None,
     ) -> list[tuple[bytes, bytes]]:
-        await self._check_version(version)
+        self._check_read_authz(begin, end, token)
+        if version < 0:
+            # Latest-applied read (no wait): infrastructure consumers —
+            # the tenant-map mirror — want "whatever this replica has
+            # NOW", not a snapshot pinned at some caller's version (a
+            # pinned read goes stale/empty on idle or freshly recruited
+            # callers — review finding).
+            version = self._version
+        else:
+            await self._check_version(version)
         self._check_serving(begin, end, version)
         keys = self.map.range_keys(begin, end)
         if reverse:
@@ -722,7 +754,8 @@ class StorageServer:
         await p.future
 
     @rpc
-    async def watch(self, key: bytes, value: bytes | None) -> int:
+    async def watch(self, key: bytes, value: bytes | None,
+                    token: str | None = None) -> int:
         """Resolves (with the triggering version) once the key's value is
         observed ≠ `value` (reference: storage watch at the latest version).
 
@@ -730,6 +763,7 @@ class StorageServer:
         the shard would hang forever — after a move, proxies stop tagging
         us, so the triggering write never arrives. Reject instead; the
         client sees a retryable error and re-arms on the new owner."""
+        self._check_read_authz(key, key + b"\x00", token)
         self._check_serving(key, key + b"\x00", self._version)
         current = self.map.latest(key)
         if current != value:

@@ -70,6 +70,23 @@ def load_spec(path: str) -> dict:
     return spec
 
 
+def _make_tenant_mirror(loop, t, spec: dict, storage_map, spawn):
+    """TenantMapMirror for a deployed process when authz is on: storage
+    endpoints from the spec, refreshed with the spec's system token.
+    `spawn(name, make_coro)` is the caller's task-spawning convention
+    (Worker._spawn ties the mirror's life to the generation;
+    _supervise for boot-time roles)."""
+    if not spec.get("authz_public_key"):
+        return None
+    from foundationdb_tpu.runtime.authz import TenantMapMirror
+
+    eps = [t.endpoint(parse_addr(a), "storage") for a in spec["storage"]]
+    mirror = TenantMapMirror(loop, eps, storage_map,
+                             token=_system_token(spec))
+    spawn("tenant_mirror.run", mirror.run)
+    return mirror
+
+
 def _system_token(spec: dict) -> str | None:
     """Operator-minted system-scope authz token for in-process system
     actors (TimeKeeper) — spec key `authz_system_token`, a path to the
@@ -351,12 +368,15 @@ class Worker:
                         for a in resolver_addrs]
         controller_ep = self.t.endpoint(
             parse_addr(self.spec["controller"][0]), "controller")
+        storage_map = KeyShardMap.uniform(len(self.spec["storage"]))
         proxy = CommitProxy(
             self.loop, seq_ep, resolver_eps,
             KeyShardMap.uniform(len(resolver_eps)), tlog_eps,
-            KeyShardMap.uniform(len(self.spec["storage"])),
+            storage_map,
             controller_ep=controller_ep, epoch=epoch,
             authz=_make_authz(self.spec),
+            tenant_mirror=_make_tenant_mirror(
+                self.loop, self.t, self.spec, storage_map, self._spawn),
         )
         proxy.backup_enabled = backup_enabled
         proxy.locked = locked
@@ -439,6 +459,53 @@ class DeployedController:
         # of the sim recruiter reading cluster.backup_active/db_locked.
         self.backup_active = False
         self.db_locked = False
+        # Operator maintenance config (fdbcli exclude / configure):
+        # excluded chain processes are left out of the next generation
+        # (upstream's exclude semantics for stateless/log classes — the
+        # process stays up, the cluster stops depending on it); desired
+        # counts clamp how many of each chain role the generation uses.
+        # Storage is data-bearing and not excludable here (that is data
+        # distribution's drain job — sim-only for now). PERSISTED in the
+        # controller's data dir (reference keeps exclusions in
+        # \xff/conf/excluded for the same reason): a controller restart
+        # must not silently recruit a drained-for-decommission process
+        # back into the generation (review finding).
+        self.excluded: set[tuple[str, int]] = set()
+        self.desired_counts: dict[str, int] = {}
+        self._load_maintenance()
+
+    def _maintenance_path(self) -> str | None:
+        if not self.data_dir:
+            return None
+        return os.path.join(self.data_dir, "maintenance.json")
+
+    def _load_maintenance(self) -> None:
+        path = self._maintenance_path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            self.excluded = {(r, int(i)) for r, i in doc.get("excluded", [])}
+            self.desired_counts = {
+                r: int(n) for r, n in doc.get("configured", {}).items()
+            }
+        except (OSError, ValueError):
+            pass  # unreadable config: start clean rather than refuse boot
+
+    def _save_maintenance(self) -> None:
+        path = self._maintenance_path()
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "excluded": sorted([r, i] for r, i in self.excluded),
+                "configured": dict(self.desired_counts),
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     # -- endpoints ---------------------------------------------------------
 
@@ -472,7 +539,51 @@ class DeployedController:
             "generation": {r: list(v) for r, v in self.live.items()},
             "backup_active": self.backup_active,
             "db_locked": self.db_locked,
+            "excluded": sorted(f"{r}{i}" for r, i in self.excluded),
+            "configured": dict(self.desired_counts),
         }
+
+    @rpc
+    async def set_excluded(self, role: str, index: int,
+                           excluded: bool) -> dict:
+        """fdbcli exclude/include for CHAIN roles: drop the process from
+        (or return it to) generation membership with a generation change.
+        Storage is refused — draining a data-bearing role is data
+        distribution's job (sim-only DataDistributor.exclude)."""
+        if role not in ("tlog", "resolver", "proxy"):
+            raise ValueError(
+                f"role {role!r} is not excludable here: chain roles only "
+                "(storage drain requires data distribution)")
+        if not 0 <= index < len(self.spec[role]):
+            raise ValueError(f"no {role}{index} in the cluster spec")
+        if excluded:
+            self.excluded.add((role, index))
+        else:
+            self.excluded.discard((role, index))
+        self._save_maintenance()
+        self.loop.spawn(
+            self._recover(
+                f"operator {'exclude' if excluded else 'include'} "
+                f"{role}{index}"),
+            name="controller.exclude_recovery")
+        return {"excluded": sorted(f"{r}{i}" for r, i in self.excluded)}
+
+    @rpc
+    async def configure(self, counts: dict) -> dict:
+        """fdbcli configure analogue for chain-role counts: the next
+        generation uses the first N spec processes of each role."""
+        for role, n in counts.items():
+            if role not in ("tlog", "resolver", "proxy"):
+                raise ValueError(f"cannot configure count for {role!r}")
+            n = int(n)
+            if not 1 <= n <= len(self.spec[role]):
+                raise ValueError(
+                    f"{role} count must be in [1, {len(self.spec[role])}]")
+            self.desired_counts[role] = n
+        self._save_maintenance()
+        self.loop.spawn(self._recover(f"operator configure {counts}"),
+                        name="controller.configure_recovery")
+        return {"configured": dict(self.desired_counts)}
 
     @rpc
     async def request_recovery(self, epoch: int, reason: str) -> None:
@@ -554,8 +665,30 @@ class DeployedController:
                 1, 0, live=self._all_live(), seed_entries=[], resume=True,
             )
 
+    def _admitted(self, role: str, candidates: list[int]) -> list[int]:
+        """Maintenance filter for chain roles: drop excluded processes,
+        then take the first `desired_counts[role]` of what REMAINS — so
+        `exclude tlog0; configure tlogs=1` yields [1], not the excluded
+        tlog0 (review finding: counting by raw spec index made exclusion
+        and configure impossible to compose). Safety valve: a config
+        that would leave a chain role EMPTY (everything excluded) is
+        ignored rather than wedging recovery forever."""
+        if role == "storage":
+            return candidates  # data-bearing: not excludable without DD
+        out = [i for i in candidates if (role, i) not in self.excluded]
+        n = self.desired_counts.get(role)
+        if n is not None:
+            out = out[:n]
+        return out or candidates
+
+    def _admit(self, role: str, i: int) -> bool:
+        """Is process (role, i) part of the admitted set right now? Used
+        by the sweep's rejoin scan — consistent with _admitted by
+        construction."""
+        return i in self._admitted(role, list(range(len(self.spec[role]))))
+
     def _all_live(self) -> dict:
-        return {r: list(range(len(self.spec[r])))
+        return {r: self._admitted(r, list(range(len(self.spec[r]))))
                 for r in ("tlog", "resolver", "proxy", "storage")}
 
     # -- generation formation ----------------------------------------------
@@ -663,6 +796,7 @@ class DeployedController:
             for role in ("tlog", "resolver", "proxy", "storage")
             for i in set(range(len(self.spec[role]))) - set(
                 self.live.get(role, []))
+            if self._admit(role, i)  # excluded processes must not rejoin
         ]
         tasks = [
             (role, i, self.loop.spawn(self._worker(role, i).ping(),
@@ -782,6 +916,8 @@ class DeployedController:
                 live[role].append(i)
             except Exception:
                 continue
+        for role in ("tlog", "resolver", "proxy"):
+            live[role] = self._admitted(role, live[role])
         return live
 
 
@@ -940,8 +1076,11 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
               if data_dir else None)
         ss = StorageServer(
             loop, tag=index, tlog_ep=tlog_eps[index % n_tlogs],
-            tlog_replicas=tlog_eps, kvstore=kv,
+            tlog_replicas=tlog_eps, kvstore=kv, authz=_make_authz(spec),
         )
+        ss.tenant_mirror = _make_tenant_mirror(
+            loop, t, spec, KeyShardMap.uniform(len(spec["storage"])),
+            lambda name, mk: _supervise(loop, name, mk))
         t.serve("storage", ss)
         _supervise(loop, f"storage{index}.run", ss.run)
         if managed:
@@ -961,6 +1100,9 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             loop, seq_ep, eps("resolver"), resolver_map,
             eps("tlog"), storage_map,
             authz=_make_authz(spec),
+            tenant_mirror=_make_tenant_mirror(
+                loop, t, spec, storage_map,
+                lambda name, mk: _supervise(loop, name, mk)),
         )
         grv = GrvProxy(loop, seq_ep, rk_ep)
         router = ReadRouter(storage_map, eps("storage"))
@@ -1061,6 +1203,26 @@ def main(argv: list[str] | None = None) -> None:
             # on the loop, so the exit can't race the reply flush.
             loop.spawn(self._finish(), name="admin.shutdown")
             return "shutting down"
+
+        @rpc
+        async def inject_fault(self, host: str, port: int, mode: str,
+                               delay_s: float = 0.05,
+                               duration_s: float = 5.0) -> str:
+            """Operator-triggered network fault from THIS process toward
+            (host, port): "drop" black-holes its outbound calls (a
+            one-sided partition), "delay" defers them (clog). The chaos
+            harness for deployed clusters — the TCP analogue of the sim
+            campaign's partition/clog injection. Auto-expires."""
+            tracer.event("FaultInjected", Role=args.role, Index=args.index,
+                         Peer=f"{host}:{port}", Mode=mode,
+                         Duration=duration_s)
+            t.set_fault((host, int(port)), mode, delay_s, duration_s)
+            return f"fault {mode} -> {host}:{port} for {duration_s}s"
+
+        @rpc
+        async def clear_faults(self) -> str:
+            t.clear_faults()
+            return "faults cleared"
 
         async def _finish(self):
             await loop.sleep(0)

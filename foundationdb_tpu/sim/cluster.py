@@ -74,8 +74,40 @@ class SimCluster:
         process_prefix: str = "",
         authz_public_key: bytes | None = None,
         authz_system_token: str | None = None,
+        multi_region: dict | None = None,
     ):
+        """``multi_region`` (reference: DatabaseConfiguration regions —
+        fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
+        teams): a three-region topology
+        ``{"satellite_tlogs": k}`` with
+
+        - **pri/**: the active region — the whole transaction subsystem
+          (sequencer, resolvers, tlogs, proxies) plus one storage replica
+          per shard;
+        - **sat/**: satellite TLogs — IN the synchronous commit path
+          (every proxy push awaits them, the reference's satellite
+          redundancy), holding the full mutation stream but no storage;
+        - **rem/**: the standby region — the other storage replica of
+          every shard (pulling asynchronously, the reference's remote
+          region), plus capacity to host the next transaction subsystem.
+
+        Automatic inter-region failover: when recovery runs while the
+        active region is dead (``net.fail_region("pri/")``), recruitment
+        flips the active region to the standby and re-forms the chain
+        there, salvaging from the surviving satellite tlogs — which hold
+        every ACKED commit by construction, so failover loses nothing.
+        """
         assert 1 <= n_replicas <= n_storages
+        self.multi_region = multi_region or None
+        if self.multi_region:
+            assert n_replicas == 1 and not data_distribution, (
+                "multi_region replicates across regions (one replica per "
+                "region); in-region replication/DD on top is not modeled"
+            )
+            self.active_region = "pri"
+            self.standby_region = "rem"
+            self.n_satellite_tlogs = int(self.multi_region.get(
+                "satellite_tlogs", 1))
         self.loop = loop or Loop(seed=seed)
         # Real durability (reference: tlog DiskQueue + KeyValueStoreSQLite):
         # tlogs fsync pushes to append-only queues, storages flush a
@@ -106,11 +138,16 @@ class SimCluster:
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
         # k-way teams: shard i is owned by storages {i, i+1, ..., i+k-1}
         # (reference: DDTeamCollection builds overlapping teams so load
-        # spreads without k*n servers).
-        teams = [
-            tuple((i + j) % n_storages for j in range(n_replicas))
-            for i in range(n_storages)
-        ]
+        # spreads without k*n servers). Multi-region: REGION teams — each
+        # shard's replicas are (primary storage i, remote storage n+i),
+        # the reference's cross-region team pairing.
+        if self.multi_region:
+            teams = [(i, n_storages + i) for i in range(n_storages)]
+        else:
+            teams = [
+                tuple((i + j) % n_storages for j in range(n_replicas))
+                for i in range(n_storages)
+            ]
         self.storage_map = KeyShardMap.uniform(n_storages, teams=teams)
         self._gen_processes: list[str] = []  # previous generation, for retirement
         self.backup_active = False  # BackupAgent sets; survives recoveries
@@ -137,17 +174,40 @@ class SimCluster:
 
             return KeyValueStoreSQLite(os.path.join(data_dir, f"storage{i}.db"))
 
+        n_storage_total = n_storages * (2 if self.multi_region else 1)
         self.storages = [
-            StorageServer(self.loop, tag=i, tlog_ep=None, kvstore=make_kvstore(i))
-            for i in range(n_storages)
+            StorageServer(self.loop, tag=i, tlog_ep=None,
+                          kvstore=make_kvstore(i), authz=self.authz)
+            for i in range(n_storage_total)
         ]
         self.storage_eps = [
-            self.net.host(f"storage{i}", f"storage{i}", s)
+            self.net.host(self._region_proc(self._storage_region(i),
+                                            f"storage{i}"),
+                          f"storage{i}", s)
             for i, s in enumerate(self.storages)
         ]
+        # ONE tenant-map mirror per cluster (authz.TenantMapMirror):
+        # proxies check tenant-bound tokens at commit, storages at read;
+        # all against the same live view refreshed from the owning
+        # storage team at its latest applied version.
+        self.tenant_mirror = None
+        if self.authz is not None:
+            from foundationdb_tpu.runtime.authz import TenantMapMirror
+
+            self.tenant_mirror = TenantMapMirror(
+                self.loop, self.storage_eps, self.storage_map,
+                token=self.authz_system_token,
+            )
+            self.loop.spawn(
+                self.tenant_mirror.run(),
+                process=process_prefix + "tenant_mirror",
+                name="tenant_mirror.run",
+            )
+            for s in self.storages:
+                s.tenant_mirror = self.tenant_mirror
         # Serve-set guards are active whenever shards can move or replicate
         # (single-replica static clusters skip them entirely).
-        if data_distribution or n_replicas > 1:
+        if data_distribution or n_replicas > 1 or self.multi_region:
             for i, s in enumerate(self.storages):
                 s.init_served([
                     (sh.range.begin, sh.range.end)
@@ -172,8 +232,11 @@ class SimCluster:
             )
 
         for i, s in enumerate(self.storages):
-            self.loop.spawn(s.run(), process=process_prefix + f"storage{i}",
-                            name=f"storage{i}.run")
+            self.loop.spawn(
+                s.run(),
+                process=process_prefix + self._region_proc(
+                    self._storage_region(i), f"storage{i}"),
+                name=f"storage{i}.run")
 
         self.data_distributor = None
         self.data_distributor_ep = None
@@ -340,6 +403,58 @@ class SimCluster:
             self.net.unhost_process(proc)
         self._pending_retirement = []
 
+    # -- region placement -----------------------------------------------------
+
+    def _storage_region(self, i: int) -> str | None:
+        if not self.multi_region:
+            return None
+        return "pri" if i < len(self.storage_map.shards) else "rem"
+
+    def _region_proc(self, region: str | None, name: str) -> str:
+        """Region-prefixed process name ("pri/storage0"); plain name in
+        single-region clusters (zero behavior change there)."""
+        return f"{region}/{name}" if region else name
+
+    def _pick_active_region(self) -> str | None:
+        """Recruitment-time region choice (the automatic failover seam):
+        if the active region is dead and the standby is not, flip — the
+        new transaction subsystem forms in the standby region, salvaging
+        from the satellite tlogs. Reference: ClusterController's
+        datacenter preference + region failover
+        (fdbserver/ClusterController.actor.cpp bestDC logic)."""
+        if not self.multi_region:
+            return None
+        if (self.net.region_dead(self.active_region + "/")
+                and not self.net.region_dead(self.standby_region + "/")):
+            from foundationdb_tpu.runtime.trace import Severity, trace
+
+            trace(self.loop).event(
+                "RegionFailover", Severity.WARN_ALWAYS,
+                failed=self.active_region, to=self.standby_region,
+            )
+            self.active_region, self.standby_region = (
+                self.standby_region, self.active_region)
+        return self.active_region
+
+    def heal_region(self, region: str) -> None:
+        """Harness-side region heal: clear the network fault and restart
+        the region's storage pull loops (sim kills cancel actor tasks;
+        the storage OBJECTS survive with their data — a rebooted machine
+        reattaching its disk). Chain roles of the dead region are NOT
+        restarted: they belong to a retired generation; the region serves
+        as standby until a failover recruits into it again. Catch-up is
+        guaranteed by the pop-floor machinery: these storages never
+        popped their tags from the new generation's tlogs, so the suffix
+        they missed is still held for them."""
+        self.net.heal_region(region + "/")
+        for i, s in enumerate(self.storages):
+            if self._storage_region(i) == region:
+                self.loop.spawn(
+                    s.run(),
+                    process=self.process_prefix + self._region_proc(
+                        region, f"storage{i}"),
+                    name=f"storage{i}.run")
+
     # -- recruiter interface (called by ClusterController / recovery) ---------
 
     def _derive_resolver_map(self) -> KeyShardMap:
@@ -394,8 +509,11 @@ class SimCluster:
             floor = min(floor, self.backup_worker._version)
         seed_entries = [(v, t) for v, t in seed_entries if v > floor]
         heartbeat_eps: dict = {}
+        region = self._pick_active_region()
 
-        def host(process: str, name: str, obj, run: bool = False):
+        def host(process: str, name: str, obj, run: bool = False,
+                 region_name: str | None = region):
+            process = self._region_proc(region_name, process)
             ep = self.net.host(process, name, obj)
             heartbeat_eps[process] = self.net.host(process, "heartbeat", Heartbeat())
             if run:
@@ -435,6 +553,26 @@ class SimCluster:
         self.tlog_eps = [
             host(f"tlog{i}{sfx}", f"tlog{i}", t) for i, t in enumerate(self.tlogs)
         ]
+        # Region tlog set: chain tlogs serve storage pulls; satellite
+        # tlogs (hosted in the satellite region, full replicas of the
+        # mutation stream) are in the proxies' synchronous push set AND
+        # recovery's lock/salvage set — that is what makes region
+        # failover lossless (reference: satellite TLogs,
+        # TLogServer.actor.cpp + DatabaseConfiguration satellite policy).
+        chain_tlog_eps = list(self.tlog_eps)
+        if self.multi_region and self.n_satellite_tlogs:
+            self.satellite_tlogs = [
+                TLog(self.loop, init_version=start_version,
+                     seed=list(seed_entries),
+                     retired_tags=set(self.retired_tags))
+                for _ in range(self.n_satellite_tlogs)
+            ]
+            sat_eps = [
+                host(f"tlog_s{i}{sfx}", f"tlog_s{i}", t, region_name="sat")
+                for i, t in enumerate(self.satellite_tlogs)
+            ]
+            self.tlogs = self.tlogs + self.satellite_tlogs
+            self.tlog_eps = chain_tlog_eps + sat_eps
         if self.data_dir is not None:
             self._persist_cluster_meta(
                 epoch, recovery_version,
@@ -472,6 +610,7 @@ class SimCluster:
                 controller_ep=getattr(self, "controller_ep", None),
                 epoch=epoch,
                 authz=self.authz,
+                tenant_mirror=self.tenant_mirror,
             )
             for _ in range(self.n_proxies)
         ]
@@ -489,9 +628,10 @@ class SimCluster:
 
         # Hand storage servers to the new generation: roll back anything
         # applied above the recovery version (their old tlog's lost suffix)
-        # and re-point their pull loops at the new tlog.
+        # and re-point their pull loops at the new CHAIN tlogs (satellite
+        # tlogs hold the same stream but serve recovery, not pulls).
         for s in self.storages:
-            s.recover_to(recovery_version, self.tlog_eps[0], self.tlog_eps)
+            s.recover_to(recovery_version, chain_tlog_eps[0], chain_tlog_eps)
 
         # Retirement of the previous generation is DEFERRED: the
         # controller calls retire_previous() only after the registry

@@ -40,6 +40,7 @@ from foundationdb_tpu.sim.workloads import (
     DDBalanceWorkload,
     FuzzApiWorkload,
     IndexStressWorkload,
+    RegionFailoverWorkload,
     TenantWorkload,
     VersionStampWorkload,
     WatchesWorkload,
@@ -135,6 +136,12 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "transactionCount": "n_txns",
         "moveCount": "n_moves",
     }),
+    "RegionFailover": (RegionFailoverWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "failAfter": "fail_after",
+        "heal": "heal",
+    }),
 }
 
 
@@ -207,6 +214,13 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             cluster_map[k]: v for k, v in cluster_tbl.items()
             if k in cluster_map
         }
+        # Region config (reference: DatabaseConfiguration regions):
+        # `satelliteTlogs = k` in [test.cluster] turns on the pri/sat/rem
+        # multi-region topology with k satellite tlogs.
+        if "satelliteTlogs" in cluster_tbl:
+            cluster_opts["multi_region"] = {
+                "satellite_tlogs": cluster_tbl["satelliteTlogs"]
+            }
         specs.append(TestSpec(
             title=test.get("testTitle", "untitled"),
             workloads=workloads,

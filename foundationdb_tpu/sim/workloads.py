@@ -1683,3 +1683,83 @@ class IndexStressWorkload(Workload):
                 f"index diverged: {len(dangling)} dangling, "
                 f"{len(missing)} missing"
             )
+
+
+class RegionFailoverWorkload(Workload):
+    """Multi-region failover under live writes (reference: the
+    multi-region correctness the reference covers with region-config
+    simulation tests + ClusterController dc failover): clients write a
+    monotone journal; mid-run the ENTIRE primary region is failed; the
+    chain must re-form in the remote region from the satellite tlogs.
+    Check: every ACKED write reads back (zero acked-commit loss), the
+    active region flipped, and writes continued post-failover. Requires
+    a cluster built with multi_region."""
+
+    name = "region_failover"
+
+    def __init__(self, seed: int = 0, n_txns: int = 40, n_clients: int = 2,
+                 fail_after: int = 10, heal: bool = False):
+        super().__init__(seed)
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.fail_after = fail_after  # acked txns before the region dies
+        self.heal = heal  # heal the failed region mid-run (failback test)
+        self._acked: list[bytes] = []
+        self._failed_region = None
+
+    def _key(self, cid: int, i: int) -> bytes:
+        return b"rf/%02d/%04d" % (cid, i)
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"rf/", b"rf0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        assert cluster.multi_region, "RegionFailover needs multi_region"
+        counts = self._split(self.n_txns, self.n_clients)
+        total_acked = [0]
+
+        async def client(cid: int):
+            for i in range(counts[cid]):
+                key = self._key(cid, i)
+
+                async def body(tr, key=key):
+                    tr.set(key, b"v")
+
+                await self._run_txn(db, body)
+                self._acked.append(key)
+                self.metrics.ops += 1
+                total_acked[0] += 1
+
+        async def regicide():
+            while total_acked[0] < self.fail_after:
+                await cluster.loop.sleep(0.05)
+            self._failed_region = cluster.active_region
+            cluster.net.fail_region(self._failed_region + "/")
+            if self.heal:
+                await cluster.loop.sleep(5.0)
+                cluster.heal_region(self._failed_region)
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"rf.client{i}")
+             for i in range(self.n_clients)]
+            + [cluster.loop.spawn(regicide(), name="rf.regicide")]
+        )
+        self._cluster = cluster
+
+    async def check(self, db) -> None:
+        c = self._cluster
+        assert self._failed_region is not None, "region never failed"
+        assert c.active_region != self._failed_region or self.heal, (
+            "active region never flipped")
+
+        async def body(tr):
+            return await tr.get_range(b"rf/", b"rf0")
+
+        rows = dict(await self._run_txn(db, body))
+        missing = [k for k in self._acked if k not in rows]
+        assert not missing, (
+            f"{len(missing)} ACKED writes lost in region failover: "
+            f"{missing[:5]}")

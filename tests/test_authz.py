@@ -48,6 +48,7 @@ def test_token_scopes_writes_to_prefixes(authz_db):
     put(c, db, b"tenantA/k", b"v", token=token)
 
     async def rd(tr):
+        tr.set_option("authorization_token", token)
         return await tr.get(b"tenantA/k")
 
     assert c.loop.run(db.run(rd)) == b"v"
@@ -100,9 +101,10 @@ def test_system_actors_unaffected_and_tenant_flow_works(authz_db):
 
     admin = mint_token(priv, [], expires_at=c.loop.now + 3600, system=True)
     c.loop.run(create_tenant(db, b"acme", token=admin))
-    t = Tenant(db, b"acme")
+    t = Tenant(db, b"acme", token=admin)
     prefix = c.loop.run(t._resolve())
     token = mint_token(priv, [prefix], expires_at=c.loop.now + 3600)
+    t = Tenant(db, b"acme", token=token)  # the tenant's own token resolves too
 
     async def w(tr):
         tr.set_option("authorization_token", token)
@@ -111,6 +113,7 @@ def test_system_actors_unaffected_and_tenant_flow_works(authz_db):
     c.loop.run(t.run(w))
 
     async def r(tr):
+        tr.set_option("authorization_token", token)
         return await tr.get(b"doc")
 
     assert c.loop.run(t.run(r)) == b"1"
@@ -180,6 +183,7 @@ def test_dr_to_authz_secondary_with_admin_token():
         assert v > 0
 
         async def rd(tr):
+            tr.set_option("authorization_token", admin)
             return await tr.get(b"ad/x")
 
         assert await dst_db.run(rd) == b"1"
@@ -192,12 +196,12 @@ def test_verify_cache_and_authority_unit():
     priv, pub = generate_keypair()
     auth = TokenAuthority(pub)
     tok = mint_token(priv, [b"p/"], expires_at=100.0)
-    assert auth.verify(tok, now=50.0) == ([b"p/"], False)
-    assert auth.verify(tok, now=50.0) == ([b"p/"], False)  # cached path
+    assert auth.verify(tok, now=50.0) == ([b"p/"], False, None)
+    assert auth.verify(tok, now=50.0) == ([b"p/"], False, None)  # cached path
     with pytest.raises(PermissionDenied):
         auth.verify(tok, now=200.0)  # expiry checked past the cache
     sys_tok = mint_token(priv, [], expires_at=100.0, system=True)
-    assert auth.verify(sys_tok, now=50.0) == ([], True)
+    assert auth.verify(sys_tok, now=50.0) == ([], True, None)
 
 
 def test_system_keyspace_requires_system_grant(authz_db):
@@ -246,3 +250,148 @@ def test_system_keyspace_requires_system_grant(authz_db):
         return await tr.get(b"\xff/tenant/map/victim")
 
     assert c.loop.run(db.run(sys_write)) == prefix
+
+
+def test_reads_scoped_to_tenant_prefixes(authz_db):
+    """Per-read enforcement at the storage server (reference:
+    storageserver.actor.cpp authorization): a tenant-A token reads ONLY
+    tenant A; untokened and out-of-scope reads are denied; system reads
+    need the system grant; the tenant map stays readable by any valid
+    token (prefix resolution)."""
+    priv, c, db = authz_db
+    writer = mint_token(priv, [b""], expires_at=c.loop.now + 3600)
+    a_tok = mint_token(priv, [b"tenantA/"], expires_at=c.loop.now + 3600)
+    admin = mint_token(priv, [], expires_at=c.loop.now + 3600, system=True)
+    put(c, db, b"tenantA/k", b"va", token=writer)
+    put(c, db, b"tenantB/k", b"vb", token=writer)
+
+    def rd(key, token=None):
+        async def body(tr):
+            if token:
+                tr.set_option("authorization_token", token)
+            return await tr.get(key)
+
+        return c.loop.run(db.run(body))
+
+    def rd_range(begin, end, token=None):
+        async def body(tr):
+            if token:
+                tr.set_option("authorization_token", token)
+            return await tr.get_range(begin, end)
+
+        return c.loop.run(db.run(body))
+
+    # In-scope works; everything else is denied AT STORAGE.
+    assert rd(b"tenantA/k", token=a_tok) == b"va"
+    assert rd_range(b"tenantA/", b"tenantA0", token=a_tok) == [
+        (b"tenantA/k", b"va")]
+    with pytest.raises(PermissionDenied):
+        rd(b"tenantB/k", token=a_tok)
+    with pytest.raises(PermissionDenied):
+        rd(b"tenantA/k")  # untokened
+    with pytest.raises(PermissionDenied):
+        rd_range(b"tenantA/", b"tenantB0", token=a_tok)  # crosses out
+
+    # System keyspace: denied without the system grant even with
+    # access_system_keys; allowed with it.
+    def rd_sys(key, token=None):
+        async def body(tr):
+            tr.set_option("access_system_keys")
+            if token:
+                tr.set_option("authorization_token", token)
+            return await tr.get(key)
+
+        return c.loop.run(db.run(body))
+
+    with pytest.raises(PermissionDenied):
+        rd_sys(b"\xff/dr/applied", token=a_tok)
+    rd_sys(b"\xff/dr/applied", token=admin)  # no raise
+
+    # Tenant map: readable with ANY valid token (prefix resolution), not
+    # untokened.
+    rd_sys(b"\xff/tenant/map/acme", token=a_tok)  # no raise
+    with pytest.raises(PermissionDenied):
+        rd_sys(b"\xff/tenant/map/acme")
+
+
+def test_watch_requires_read_scope(authz_db):
+    """Watches reveal change timing — they carry the same read boundary."""
+    priv, c, db = authz_db
+    writer = mint_token(priv, [b""], expires_at=c.loop.now + 3600)
+    a_tok = mint_token(priv, [b"tenantA/"], expires_at=c.loop.now + 3600)
+    put(c, db, b"tenantB/w", b"0", token=writer)
+
+    async def arm(tr):
+        tr.set_option("authorization_token", a_tok)
+        return tr.watch(b"tenantB/w")
+
+    fut = c.loop.run(db.run(arm))
+    with pytest.raises(PermissionDenied):
+        c.loop.run(fut)
+
+
+def test_tenant_bound_token_dies_with_its_tenant(authz_db):
+    """Tokens minted with tenant= are checked against the proxies' live
+    tenant-map view: delete the tenant (and recreate it — the allocator
+    hands out a FRESH prefix, never reusing the old one) and the old
+    token is denied immediately, instead of writing into dead prefix
+    space until expiry (reference: TokenSign tokens carry tenant ids)."""
+    priv, c, db = authz_db
+    from foundationdb_tpu.client.tenant import (
+        create_tenant,
+        delete_tenant,
+    )
+
+    # Full admin: system grant (tenant map) + whole-user-keyspace grant
+    # (delete_tenant's is-empty probe reads the tenant's data range).
+    admin = mint_token(priv, [b""], expires_at=c.loop.now + 3600, system=True)
+    p1 = c.loop.run(create_tenant(db, b"corp", token=admin))
+    bound = mint_token(priv, [p1], expires_at=c.loop.now + 3600,
+                       tenant=b"corp")
+
+    # Unknown-tenant binding fails closed even while the map is fresh.
+    ghost = mint_token(priv, [b"tenantX/"], expires_at=c.loop.now + 3600,
+                       tenant=b"ghost")
+    c.loop.run(c.loop.sleep(1.5))  # > TENANT_REFRESH_INTERVAL
+    with pytest.raises(PermissionDenied):
+        put(c, db, b"tenantX/k", b"v", token=ghost)
+
+    put(c, db, p1 + b"doc", b"1", token=bound)
+
+    # Clear the tenant's data (the bound token may), delete, recreate.
+    async def clr(tr):
+        tr.set_option("authorization_token", bound)
+        tr.clear_range(p1, p1 + b"\xff")
+
+    c.loop.run(db.run(clr))
+    c.loop.run(delete_tenant(db, b"corp", token=admin))
+    p2 = c.loop.run(create_tenant(db, b"corp", token=admin))
+    assert p2 != p1  # monotone allocator: prefixes never reused
+    c.loop.run(c.loop.sleep(1.5))  # let proxies observe the new map
+
+    with pytest.raises(PermissionDenied):
+        put(c, db, p1 + b"doc2", b"x", token=bound)  # dead prefix space
+    with pytest.raises(PermissionDenied):
+        put(c, db, p2 + b"doc", b"x", token=bound)  # successor's space
+
+    # READS die with the tenant too (the storage checks the same live
+    # view — review finding: write-only invalidation contradicted the
+    # 'immediately' claim).
+    async def dead_read(tr):
+        tr.set_option("authorization_token", bound)
+        return await tr.get(p1 + b"doc")
+
+    with pytest.raises(PermissionDenied):
+        c.loop.run(db.run(dead_read))
+
+    # A fresh binding against the recreated tenant works — writes AND
+    # reads.
+    bound2 = mint_token(priv, [p2], expires_at=c.loop.now + 3600,
+                        tenant=b"corp")
+    put(c, db, p2 + b"doc", b"1", token=bound2)
+
+    async def live_read(tr):
+        tr.set_option("authorization_token", bound2)
+        return await tr.get(p2 + b"doc")
+
+    assert c.loop.run(db.run(live_read)) == b"1"

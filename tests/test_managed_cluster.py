@@ -258,3 +258,158 @@ class TestManagedHealing:
         assert all(proxy_rpc("get_locked"))
         st = controller_status(spec)
         assert st["backup_active"] and st["db_locked"]
+
+    def test_operator_cli_commands(self, managed):
+        """fdbcli-analogue operator surface over a managed cluster:
+        lock/unlock (1038 at the proxies), exclude/include of a chain
+        process (generation membership via the controller), configure
+        (chain-role counts), coordinators."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set op/a v1")
+
+        # lock: non-lock-aware writes fail; unlock: they work again.
+        out = run_cli(spec_path, "lock")
+        assert "Locked" in out.stdout, out.stdout
+        out = run_cli(spec_path, "writemode on; set op/b v2")
+        assert "1038" in out.stdout or "locked" in out.stdout.lower()
+        out = run_cli(spec_path, "unlock")
+        assert "Unlocked" in out.stdout
+        cli_ok(spec_path, "writemode on; set op/b v2; get op/b")
+
+        # exclude tlog1: the generation re-forms without it.
+        out = cli_ok(spec_path, "exclude tlog1")
+        assert "tlog1" in out.stdout
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                st = controller_status(spec)
+                ok = (st["generation"].get("tlog") == [0]
+                      and not st["recovering"]
+                      and "tlog1" in st["excluded"])
+            except Exception:
+                pass
+            if not ok:
+                time.sleep(1)
+        assert ok, "tlog1 never left the generation"
+        cli_ok(spec_path, "writemode on; set op/c v3; get op/c")
+
+        # include: it folds back in.
+        cli_ok(spec_path, "include tlog1")
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                st = controller_status(spec)
+                ok = (st["generation"].get("tlog") == [0, 1]
+                      and not st["recovering"])
+            except Exception:
+                pass
+            if not ok:
+                time.sleep(1)
+        assert ok, "tlog1 never rejoined after include"
+
+        # configure proxies=1: next generation uses one commit proxy.
+        out = cli_ok(spec_path, "configure proxies=1")
+        assert "proxy" in out.stdout
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                st = controller_status(spec)
+                ok = (st["generation"].get("proxy") == [0]
+                      and not st["recovering"])
+            except Exception:
+                pass
+            if not ok:
+                time.sleep(1)
+        assert ok, "proxy count never reconfigured"
+        cli_ok(spec_path, "writemode on; set op/d v4; get op/d")
+
+        # storage exclusion is refused (needs DD drain).
+        out = run_cli(spec_path, "exclude storage0")
+        assert "ERROR" in out.stdout
+
+        out = run_cli(spec_path, "coordinators")
+        assert spec["controller"][0] in out.stdout
+
+
+def admin_rpc(spec: dict, role: str, i: int, method: str, *rpc_args):
+    from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+    from foundationdb_tpu.server import parse_addr
+
+    loop = RealLoop()
+    t = NetTransport(loop)
+    try:
+        ep = t.endpoint(parse_addr(spec[role][i]), "admin")
+        return loop.run_until(getattr(ep, method)(*rpc_args), timeout=10)
+    finally:
+        t._listener.close()
+
+
+class TestDeployedChaos:
+    """Network-level fault injection over REAL TCP (VERDICT r4 item 8):
+    the sim campaign partitions and clogs freely; the deployed path
+    customers run must survive the same abuse. Faults are installed via
+    the admin service's inject_fault RPC (runtime/net.py set_fault)."""
+
+    def test_partition_controller_tlog_during_heal(self, managed):
+        """Kill one tlog AND black-hole the controller's link to the
+        surviving tlog: recovery cannot lock the chain until the fault
+        expires — it must stall (not corrupt), then complete, with a
+        client writing throughout and no acked write lost."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set ch/a v1")
+
+        host, port = spec["tlog"][0].rsplit(":", 1)
+        out = admin_rpc(spec, "controller", 0, "inject_fault",
+                        host, int(port), "drop", 0.05, 8.0)
+        assert "drop" in out
+        procs[("tlog", 1)].send_signal(signal.SIGKILL)
+        procs[("tlog", 1)].wait()
+
+        # Writes keep retrying through the stalled heal and land once the
+        # fault expires and recovery completes.
+        out = cli_ok(spec_path,
+                     "writemode on; set ch/b v2; getrange ch/ ch0",
+                     tries=90)
+        assert "v1" in out.stdout and "v2" in out.stdout
+        st = controller_status(spec)
+        assert st["recoveries_completed"] >= 1
+
+    def test_kill_sequencer_mid_recruitment(self, managed):
+        """Kill a tlog to start a heal, then kill the sequencer WHILE the
+        controller is recruiting: recovery must retry until fdbmonitor
+        (the test) brings the sequencer back, and every acked write
+        survives the double failure."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set sk/a v1; set sk/b v2")
+
+        procs[("tlog", 1)].send_signal(signal.SIGKILL)
+        procs[("tlog", 1)].wait()
+        time.sleep(1.5)  # sweep notices; recovery begins
+        procs[("sequencer", 0)].send_signal(signal.SIGKILL)
+        procs[("sequencer", 0)].wait()
+        time.sleep(2)
+        launch("sequencer", 0)
+        assert "ready" in procs[("sequencer", 0)].stdout.readline()
+
+        out = cli_ok(spec_path,
+                     "writemode on; set sk/c v3; getrange sk/ sk0",
+                     tries=90)
+        assert all(v in out.stdout for v in ("v1", "v2", "v3"))
+
+    def test_clogged_link_commits_still_flow(self, managed):
+        """Delay-mode fault: a slow-but-alive proxy→tlog link (the hard
+        case — no failure detector trips). Commits must still complete,
+        just slower."""
+        spec, spec_path, procs, launch = managed
+        cli_ok(spec_path, "writemode on; set cl/a v1")
+        host, port = spec["tlog"][0].rsplit(":", 1)
+        for p in range(len(spec["proxy"])):
+            admin_rpc(spec, "proxy", p, "inject_fault",
+                      host, int(port), "delay", 0.2, 6.0)
+        out = cli_ok(spec_path,
+                     "writemode on; set cl/b v2; getrange cl/ cl0",
+                     tries=60)
+        assert "v1" in out.stdout and "v2" in out.stdout

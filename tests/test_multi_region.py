@@ -1,0 +1,162 @@
+"""Multi-region configuration: satellite TLogs + automatic region failover.
+
+Reference: FDB multi-region mode — DatabaseConfiguration regions
+(fdbclient/DatabaseConfiguration.cpp), satellite TLog redundancy in the
+synchronous commit path, DataDistribution region teams, and the
+ClusterController's automatic datacenter failover. The sim topology is
+pri/ (active chain + one storage replica per shard), sat/ (satellite
+tlogs, synchronously pushed), rem/ (standby storage replicas + capacity
+for the next chain). The contract under test: kill the ENTIRE primary
+region and every acknowledged commit survives into the remote region,
+which takes over committing.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.ryw import open_database
+from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.sim.cluster import SimCluster
+
+
+def make_mr(seed=77, **kw):
+    loop = Loop(seed=seed)
+    c = SimCluster(loop=loop, seed=seed, n_storages=2, n_tlogs=1,
+                   multi_region={"satellite_tlogs": 1}, **kw)
+    return loop, c, open_database(c)
+
+
+async def put(db, kvs, loop=None):
+    async def body(tr):
+        for k, v in kvs:
+            tr.set(k, v)
+
+    await db.run(body)
+
+
+async def scan(db, begin=b"", end=b"\xff"):
+    async def body(tr):
+        return await tr.get_range(begin, end)
+
+    return await db.run(body)
+
+
+def test_multi_region_topology_and_replication():
+    """Writes commit through the satellite push path and replicate to the
+    REMOTE storage replica (region teams): reads served by the remote
+    copy alone must see every acked write."""
+    loop, c, db = make_mr(seed=78)
+
+    async def main():
+        await put(db, [(b"mr/%02d" % i, b"v%d" % i) for i in range(20)])
+        # The chain lives in pri/, satellites in sat/, replicas in rem/.
+        assert c.active_region == "pri"
+        assert any(p.startswith("pri/") for p in c._gen_processes)
+        assert any(p.startswith("sat/tlog_s") for p in c._gen_processes)
+        # Remote replica catches up (async pull): wait until the remote
+        # storage's applied version covers the writes, then read with the
+        # primary storages partitioned away (forces team failover).
+        deadline = loop.now + 30
+        n = len(c.storage_map.shards)
+        while loop.now < deadline:
+            if all(s._version > 0 for s in c.storages[n:]):
+                rows = {
+                    k: v
+                    for s in c.storages[n:]
+                    for k, v in s.debug_snapshot().items()
+                } if hasattr(c.storages[n], "debug_snapshot") else None
+                break
+            await loop.sleep(0.1)
+        # Directly assert through the client with primary storages dead.
+        for i in range(n):
+            c.net.kill(f"pri/storage{i}")
+        rows = dict(await scan(db, b"mr/", b"mr0"))
+        assert len(rows) == 20
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_region_failover_zero_acked_loss():
+    """The headline contract: the primary region dies wholesale; the
+    controller recovers by locking the surviving satellite tlogs and
+    recruiting the chain in the remote region. Every ACKED commit reads
+    back; new commits flow; the active region flipped."""
+    loop, c, db = make_mr(seed=77)
+
+    async def main():
+        await put(db, [(b"fo/%03d" % i, b"v%d" % i) for i in range(50)])
+        epoch0 = c.controller.generation.epoch
+
+        c.net.fail_region("pri/")
+
+        deadline = loop.now + 120
+        while loop.now < deadline:
+            if (c.controller.generation.epoch > epoch0
+                    and c.active_region == "rem"):
+                break
+            await loop.sleep(0.25)
+        assert c.active_region == "rem", "failover never happened"
+
+        # Every acked commit survived into the remote region.
+        rows = dict(await scan(db, b"fo/", b"fo0"))
+        assert len(rows) == 50, len(rows)
+        for i in range(50):
+            assert rows[b"fo/%03d" % i] == b"v%d" % i
+
+        # And the database still takes writes (chain now in rem/).
+        await put(db, [(b"fo/new", b"post-failover")])
+        got = dict(await scan(db, b"fo/new", b"fo/new\x00"))
+        assert got[b"fo/new"] == b"post-failover"
+        assert any(p.startswith("rem/") for p in c._gen_processes)
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_region_failback_after_heal():
+    """After the failed region heals, the NEXT recovery keeps the chain in
+    the (now-active) remote region — and a later failure of rem/ fails
+    back to pri/: the flip is symmetric."""
+    loop, c, db = make_mr(seed=79)
+
+    async def main():
+        await put(db, [(b"fb/a", b"1")])
+        epoch0 = c.controller.generation.epoch
+        c.net.fail_region("pri/")
+        deadline = loop.now + 120
+        while loop.now < deadline and c.active_region != "rem":
+            await loop.sleep(0.25)
+        assert c.active_region == "rem"
+        await put(db, [(b"fb/b", b"2")])
+
+        # Heal pri/, then kill rem/: the chain must fail back.
+        c.heal_region("pri")
+        epoch1 = c.controller.generation.epoch
+        c.net.fail_region("rem/")
+        deadline = loop.now + 120
+        while loop.now < deadline and c.active_region != "pri":
+            await loop.sleep(0.25)
+        assert c.active_region == "pri"
+        assert c.controller.generation.epoch > epoch1 > epoch0
+
+        rows = dict(await scan(db, b"fb/", b"fb0"))
+        assert rows == {b"fb/a": b"1", b"fb/b": b"2"}
+        await put(db, [(b"fb/c", b"3")])
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
+def test_single_region_unaffected():
+    """multi_region=None keeps every process name and behavior unchanged
+    (no region prefixes anywhere)."""
+    loop = Loop(seed=80)
+    c = SimCluster(loop=loop, seed=80, n_storages=2)
+    db = open_database(c)
+
+    async def main():
+        await put(db, [(b"sr/a", b"1")])
+        assert all("/" not in p for p in c._gen_processes)
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
