@@ -187,11 +187,19 @@ class RYWTransaction(Transaction):
 
         # User-keyspace confinement in BOTH directions without system
         # access (see Transaction.get_key): system keys are neither
-        # returned nor read.
+        # returned nor read. A prefix-scoped authz token further clamps
+        # the scan to its covering span (Transaction._token_span) — the
+        # keyspace-edge scan would be denied at storage.
         space_end = self._keyspace_end()
+        space_begin = b""
+        span = self._token_span()
+        if span is not None:
+            space_begin = max(space_begin, span[0])
+            space_end = min(space_end, span[1])
         if sel.offset >= 1:
             begin = min(sel.key + b"\x00" if sel.or_equal else sel.key,
                         space_end)
+            begin = max(begin, space_begin)
             rows = await self.get_range(
                 begin, space_end, limit=sel.offset, snapshot=snapshot
             )
@@ -199,7 +207,9 @@ class RYWTransaction(Transaction):
                     if len(rows) >= sel.offset else MAX_KEY)
         back = 1 - sel.offset
         end = min(sel.key + b"\x00" if sel.or_equal else sel.key, space_end)
-        rows = await self.get_range(b"", end, limit=back, reverse=True, snapshot=snapshot)
+        end = max(end, space_begin)
+        rows = await self.get_range(space_begin, end, limit=back,
+                                    reverse=True, snapshot=snapshot)
         return rows[back - 1][0] if len(rows) >= back else b""
 
 

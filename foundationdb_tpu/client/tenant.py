@@ -109,8 +109,13 @@ async def delete_tenant(db, name: bytes, token: str | None = None) -> None:
     await db.run(body)
 
 
-async def list_tenants(db) -> list[bytes]:
+async def list_tenants(db, token: str | None = None) -> list[bytes]:
+    """`token`: any valid token on a read-authz cluster (the tenant map
+    admits every tokened reader — runtime/authz.TENANT_MAP_RANGE)."""
     async def body(tr):
+        tr.set_option("access_system_keys")
+        if token:
+            tr.set_option("authorization_token", token)
         rows = await tr.get_range(
             TENANT_MAP_PREFIX, TENANT_MAP_PREFIX + b"\xff"
         )

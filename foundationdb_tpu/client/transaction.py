@@ -515,6 +515,33 @@ class Transaction:
         selectors in: the user keyspace unless access_system_keys."""
         return MAX_KEY if self.access_system_keys else b"\xff"
 
+    def _token_span(self) -> tuple[bytes, bytes] | None:
+        """Covering span [lo, hi) of the transaction token's prefixes —
+        selector scans clamp to it, or a prefix-scoped token could never
+        resolve selectors (the scan-to-the-keyspace-edge read is denied
+        at storage; review finding). The token payload is readable
+        without the key (signatures protect integrity, not secrecy).
+        Multi-prefix tokens get their covering span; scans crossing the
+        GAPS between prefixes are still denied server-side — use one
+        token per tenant (TenantTransaction clamps exactly)."""
+        if not self.authorization_token:
+            return None
+        try:
+            import base64 as _b64
+            import json as _json
+
+            payload = self.authorization_token.split(".", 1)[0]
+            doc = _json.loads(_b64.urlsafe_b64decode(
+                payload + "=" * (-len(payload) % 4)))
+            prefixes = [bytes.fromhex(p) for p in doc["prefixes"]]
+        except Exception:
+            return None  # malformed: let the server be the judge
+        if not prefixes or b"" in prefixes:
+            return None  # whole-user-keyspace grant: no clamp needed
+        from foundationdb_tpu.core.types import strinc
+
+        return min(prefixes), max(strinc(p) for p in prefixes)
+
     async def get_key(self, sel: KeySelector, snapshot: bool = False) -> bytes:
         """Resolve a key selector (reference: Transaction::getKey). Returns
         b"" when the selector runs off the front, MAX_KEY off the back.
@@ -525,21 +552,31 @@ class Transaction:
         be returned nor be included in the recorded read-conflict range —
         otherwise every 10s system commit would spuriously conflict-abort
         transactions whose selectors ran off the end of user data
-        (reference: getKey clamps non-system transactions to maxKey)."""
+        (reference: getKey clamps non-system transactions to maxKey).
+        With a prefix-scoped authz token, resolution is further confined
+        to the token's covering span (scans outside it are denied at
+        storage anyway)."""
         self._check_timeout()
         version = await self.get_read_version()
         anchor = sel.key
         space_end = self._keyspace_end()
+        space_begin = b""
+        span = self._token_span()
+        if span is not None:
+            space_begin = max(space_begin, span[0])
+            space_end = min(space_end, span[1])
         # Position 0 is "last key ≤/< anchor"; walk |offset| from there.
         if sel.offset >= 1:
             # forward: the offset-th key in order from (anchor, or_equal ? > : ≥)
             begin = min(anchor + b"\x00" if sel.or_equal else anchor, space_end)
+            begin = max(begin, space_begin)
             rows = await self._scan_keys(begin, space_end, sel.offset, False, version)
             result = rows[sel.offset - 1] if len(rows) >= sel.offset else MAX_KEY
         else:
             back = 1 - sel.offset  # how many keys back from the anchor
             end = min(anchor + b"\x00" if sel.or_equal else anchor, space_end)
-            rows = await self._scan_keys(b"", end, back, True, version)
+            end = max(end, space_begin)
+            rows = await self._scan_keys(space_begin, end, back, True, version)
             result = rows[back - 1] if len(rows) >= back else b""
         if not snapshot:
             # Result depends on the span between anchor and resolved key,

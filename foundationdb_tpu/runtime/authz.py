@@ -141,14 +141,35 @@ class TenantMapMirror:
                     continue
                 try:
                     rows = await self._eps[tag].get_range(
-                        TENANT_MAP_PREFIX, end, -1, token=self._token
+                        # limit far above any tenant count: the default
+                        # 10k would silently truncate the live view and
+                        # strand later tenants' tokens (review finding).
+                        TENANT_MAP_PREFIX, end, -1, limit=1 << 30,
+                        token=self._token,
                     )
                     self.view = {
                         k[len(TENANT_MAP_PREFIX):]: v for k, v in rows
                     }
+                    self._failures = 0
                     break
                 except Exception:
-                    continue  # dead replica / mid-move: try next, retry
+                    # Dead replica / mid-move: try the next, retry next
+                    # round. A PERSISTENT failure (e.g. authz on without
+                    # a system token — the mirror's own reads denied) is
+                    # surfaced instead of being eaten forever.
+                    self._failures = getattr(self, "_failures", 0) + 1
+                    if self._failures == 20:
+                        import sys as _sys
+
+                        print(
+                            "[tenant_mirror] WARNING: 20 consecutive "
+                            "refresh failures — tenant-bound tokens are "
+                            "failing closed. If authz is enabled the "
+                            "mirror needs the cluster system token "
+                            "(spec authz_system_token / SimCluster "
+                            "authz_system_token).",
+                            file=_sys.stderr, flush=True)
+                    continue
             await self.loop.sleep(self.INTERVAL)
 
 

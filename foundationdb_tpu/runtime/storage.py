@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from foundationdb_tpu.core.errors import (
     ChangeFeedCancelled,
     ChangeFeedPopped,
+    FdbError,
     FutureVersion,
     TooManyWatches,
     TransactionTooOld,
@@ -192,6 +193,9 @@ class StorageServer:
         # commit-side liveness check. Attached by the cluster harness /
         # server bootstrap when authz is on.
         self.tenant_mirror = None
+        # System-grant token this storage presents to PEER storages
+        # (snapshot_range during shard moves) on an authz cluster.
+        self.system_token: str | None = None
         # Persistent engine behind the MVCC window (runtime/kvstore.py;
         # reference: KeyValueStoreSQLite). On restart the durable snapshot
         # reloads and the pull loop resumes from its version. The flush
@@ -486,7 +490,8 @@ class StorageServer:
 
     @rpc
     async def snapshot_range(
-        self, begin: bytes, end: bytes, min_version: int | None = None
+        self, begin: bytes, end: bytes, min_version: int | None = None,
+        token: str | None = None,
     ) -> tuple[int, list[tuple[bytes, bytes]]]:
         """Source side of fetchKeys: the range at our applied version.
 
@@ -496,7 +501,14 @@ class StorageServer:
         source could snapshot a state OLDER than mutations already
         committed for this range whose tags the destination does not
         carry — e.g. a clear committed before the move began would be
-        silently resurrected."""
+        silently resurrected.
+
+        Authz: this RPC shares the client-facing service, so with authz
+        on it is token-gated like every read (review-found bypass: an
+        untokened snapshot_range(b'', b'\\xff') dumped every tenant).
+        Peer storages doing shard moves carry the cluster's system token
+        (StorageServer.system_token)."""
+        self._check_read_authz(begin, end, token)
         if min_version is not None:
             await self.wait_for_version(min_version)
         v = self._version
@@ -509,14 +521,19 @@ class StorageServer:
 
     @rpc
     async def fetch_keys(self, begin: bytes, end: bytes, src_ep,
-                         min_version: int | None = None) -> int:
+                         min_version: int | None = None,
+                         token: str | None = None) -> int:
         """Destination side of a shard move: copy [begin, end) from `src_ep`.
 
         The caller (DataDistributor) must already have dual-tagged the range
         so our tag stream carries every mutation concurrent with the
         snapshot; those buffer while the copy is in flight and replay on
         top (atomic ops must never fold into a missing base value).
-        Returns the snapshot version — the shard has no history below it."""
+        Returns the snapshot version — the shard has no history below it.
+
+        Authz: token-gated like snapshot_range (it writes fetched rows
+        into this replica and could be aimed at any source)."""
+        self._check_read_authz(begin, end, token)
         f = FetchState(begin, end)
         self._fetching.append(f)
         trace(self.loop).event("FetchKeysBegin", begin=begin, end=end)
@@ -531,7 +548,7 @@ class StorageServer:
             # moves).
             snap_floor = max(min_version or 0, self._version)
             snap_version, rows = await src_ep.snapshot_range(
-                begin, end, snap_floor
+                begin, end, snap_floor, token=self.system_token
             )
             # Reconcile existing history with the snapshot instead of
             # purging: when a shard is RE-acquired within the read window,
@@ -727,7 +744,16 @@ class StorageServer:
             # the tenant-map mirror — want "whatever this replica has
             # NOW", not a snapshot pinned at some caller's version (a
             # pinned read goes stale/empty on idle or freshly recruited
-            # callers — review finding).
+            # callers — review finding). SYSTEM keyspace only: for user
+            # data this would be a dirty read of the applied-but-unacked
+            # suffix that recovery may roll back (review finding) — the
+            # MVCC/GRV contract stands for everything clients own.
+            # (System metadata seen early converges: the mirror re-reads
+            # every interval and rollback removes the entry again.)
+            if begin < b"\xff":
+                raise FdbError(
+                    "latest-applied reads (version -1) are system-"
+                    "keyspace-only", code=2108)  # invalid_option_value
             version = self._version
         else:
             await self._check_version(version)

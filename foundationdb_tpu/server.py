@@ -80,9 +80,18 @@ def _make_tenant_mirror(loop, t, spec: dict, storage_map, spawn):
         return None
     from foundationdb_tpu.runtime.authz import TenantMapMirror
 
+    tok = _system_token(spec)
+    if tok is None:
+        # Fail LOUD at boot, not silently at every refresh: without the
+        # system token the mirror's own reads are denied at storage,
+        # its view never forms, and every tenant-bound token fails
+        # closed with zero diagnostics (review finding).
+        print("WARNING: authz_public_key set without authz_system_token "
+              "— the tenant-map mirror cannot read the map; tenant-bound "
+              "tokens will be denied until the spec adds one.",
+              file=sys.stderr, flush=True)
     eps = [t.endpoint(parse_addr(a), "storage") for a in spec["storage"]]
-    mirror = TenantMapMirror(loop, eps, storage_map,
-                             token=_system_token(spec))
+    mirror = TenantMapMirror(loop, eps, storage_map, token=tok)
     spawn("tenant_mirror.run", mirror.run)
     return mirror
 
@@ -490,6 +499,18 @@ class DeployedController:
             self.desired_counts = {
                 r: int(n) for r, n in doc.get("configured", {}).items()
             }
+            # Sanitize a persisted config that (e.g. after a spec edit)
+            # would empty a chain role: drop its exclusions, loudly.
+            for role in ("tlog", "resolver", "proxy"):
+                all_idx = list(range(len(self.spec[role])))
+                if not [i for i in self._admitted(role, all_idx)
+                        if (role, i) not in self.excluded]:
+                    dropped = {(r, i) for r, i in self.excluded if r == role}
+                    if dropped:
+                        self.excluded -= dropped
+                        print(f"[controller] WARNING: persisted exclusions "
+                              f"{sorted(dropped)} would leave no {role}; "
+                              "dropped", file=sys.stderr, flush=True)
         except (OSError, ValueError):
             pass  # unreadable config: start clean rather than refuse boot
 
@@ -557,6 +578,19 @@ class DeployedController:
         if not 0 <= index < len(self.spec[role]):
             raise ValueError(f"no {role}{index} in the cluster spec")
         if excluded:
+            # Refuse (don't record-and-ignore) an exclusion that would
+            # leave the role with nothing to recruit — otherwise status
+            # reports the process excluded while the generation quietly
+            # keeps depending on it (review finding).
+            remaining = [
+                i for i in range(len(self.spec[role]))
+                if i != index and (role, i) not in self.excluded
+            ]
+            n = self.desired_counts.get(role)
+            if not (remaining[:n] if n is not None else remaining):
+                raise ValueError(
+                    f"cannot exclude {role}{index}: no {role} would "
+                    "remain recruitable")
             self.excluded.add((role, index))
         else:
             self.excluded.discard((role, index))
@@ -1081,6 +1115,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         ss.tenant_mirror = _make_tenant_mirror(
             loop, t, spec, KeyShardMap.uniform(len(spec["storage"])),
             lambda name, mk: _supervise(loop, name, mk))
+        ss.system_token = _system_token(spec)
         t.serve("storage", ss)
         _supervise(loop, f"storage{index}.run", ss.run)
         if managed:

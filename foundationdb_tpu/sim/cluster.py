@@ -74,6 +74,7 @@ class SimCluster:
         process_prefix: str = "",
         authz_public_key: bytes | None = None,
         authz_system_token: str | None = None,
+        authz_private_pem: bytes | None = None,
         multi_region: dict | None = None,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
@@ -163,6 +164,11 @@ class SimCluster:
         # Operator-minted system-scope token for in-process system actors
         # (TimeKeeper): with authz on, \xff writes require it.
         self.authz_system_token = authz_system_token
+        # HARNESS-side operator private key (cluster processes never hold
+        # it — they verify with the public key only): lets spec-driven
+        # workloads mint tokens mid-run, playing the operator (the
+        # reference's simulation signs tokens the same way).
+        self.authz_private_pem = authz_private_pem
         self.retired_tags: set[int] = set()  # stopped-backup tags, per tlog
 
         # Storage servers persist across generations (they ARE the data);
@@ -205,6 +211,10 @@ class SimCluster:
             )
             for s in self.storages:
                 s.tenant_mirror = self.tenant_mirror
+                # Peer-facing credential for shard-move snapshots (mint
+                # the cluster token as [b""] + system: moves copy user
+                # keyspace).
+                s.system_token = self.authz_system_token
         # Serve-set guards are active whenever shards can move or replicate
         # (single-replica static clusters skip them entirely).
         if data_distribution or n_replicas > 1 or self.multi_region:

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from foundationdb_tpu.runtime.flow import all_of
 from foundationdb_tpu.sim.workloads import (
     AtomicOpsWorkload,
+    AuthzWorkload,
     BackupRestoreWorkload,
     ChangeFeedWorkload,
     ConflictRangeWorkload,
@@ -136,6 +137,10 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "transactionCount": "n_txns",
         "moveCount": "n_moves",
     }),
+    "Authz": (AuthzWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
     "RegionFailover": (RegionFailoverWorkload, {
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
@@ -221,6 +226,21 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             cluster_opts["multi_region"] = {
                 "satellite_tlogs": cluster_tbl["satelliteTlogs"]
             }
+        # `authz = true`: generate an operator keypair for this test
+        # cluster — processes verify with the public key; the private key
+        # stays harness-side (cluster.authz_private_pem) so workloads can
+        # mint tokens, playing the operator.
+        if cluster_tbl.get("authz"):
+            from foundationdb_tpu.runtime.authz import (
+                generate_keypair,
+                mint_token,
+            )
+
+            priv, pub = generate_keypair()
+            cluster_opts["authz_public_key"] = pub
+            cluster_opts["authz_private_pem"] = priv
+            cluster_opts["authz_system_token"] = mint_token(
+                priv, [b""], expires_at=1e12, system=True)
         specs.append(TestSpec(
             title=test.get("testTitle", "untitled"),
             workloads=workloads,

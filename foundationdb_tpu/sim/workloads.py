@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.core.mutations import MutationType
+from foundationdb_tpu.core.types import strinc
 from foundationdb_tpu.runtime.flow import all_of
 
 
@@ -1763,3 +1764,115 @@ class RegionFailoverWorkload(Workload):
         assert not missing, (
             f"{len(missing)} ACKED writes lost in region failover: "
             f"{missing[:5]}")
+
+
+class AuthzWorkload(Workload):
+    """Tenant authorization under faults (reference: the authz simulation
+    coverage around TokenSign/TenantAuthorizer): on an authz-enabled
+    cluster, clients carrying tenant-bound tokens write and read their
+    own tenant through kills/recoveries, while out-of-scope and
+    dead-tenant operations are ALWAYS denied — across every generation.
+    Requires [test.cluster] authz = true."""
+
+    name = "authz"
+
+    def __init__(self, seed: int = 0, n_txns: int = 30, n_clients: int = 2):
+        super().__init__(seed)
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self._acked: list[bytes] = []
+
+    async def setup(self, db) -> None:
+        pass  # needs the cluster (private key): everything happens in run
+
+    async def run(self, db, cluster) -> None:
+        from foundationdb_tpu.client.tenant import (
+            TenantNotFound,
+            create_tenant,
+            delete_tenant,
+        )
+        from foundationdb_tpu.core.errors import PermissionDenied
+        from foundationdb_tpu.runtime.authz import mint_token
+
+        priv = cluster.authz_private_pem
+        assert priv is not None, "AuthzWorkload needs [test.cluster] authz"
+        loop = cluster.loop
+        admin = cluster.authz_system_token
+        exp = loop.now + 1e9
+
+        prefix = await create_tenant(db, b"authz-w", token=admin)
+        token = mint_token(priv, [prefix], expires_at=exp, tenant=b"authz-w")
+        # A doomed tenant whose bound token must die with it.
+        doomed_prefix = await create_tenant(db, b"authz-doomed", token=admin)
+        doomed = mint_token(priv, [doomed_prefix], expires_at=exp,
+                            tenant=b"authz-doomed")
+        try:
+            await delete_tenant(db, b"authz-doomed", token=admin)
+        except TenantNotFound:
+            # A CommitUnknownResult retry observed our own landed delete
+            # (reference deleteTenant throws the same way; campaign-found).
+            pass
+        # Wait for every proxy/storage's mirror view to include the new
+        # tenant and drop the doomed one (0.5s refresh interval).
+        deadline = loop.now + 30
+        while loop.now < deadline:
+            view = (cluster.tenant_mirror.view
+                    if cluster.tenant_mirror else None)
+            if view is not None and b"authz-w" in view \
+                    and b"authz-doomed" not in view:
+                break
+            await loop.sleep(0.1)
+
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for i in range(counts[cid]):
+                key = prefix + b"k/%02d/%04d" % (cid, i)
+
+                async def body(tr, key=key):
+                    tr.set_option("authorization_token", token)
+                    tr.set(key, b"v")
+
+                await self._run_txn(db, body)
+                self._acked.append(key)
+                self.metrics.ops += 1
+
+                # Negative probes must ride recoveries like any client
+                # (retryable errors — killed proxy, commit-unknown — are
+                # NOT verdicts) and end in a DEFINITIVE PermissionDenied;
+                # the one outcome that fails the workload is admission.
+                async def expect_denied(body, what):
+                    try:
+                        await db.run(body)
+                    except PermissionDenied:
+                        return
+                    raise AssertionError(f"{what} admitted!")
+
+                # Out-of-scope write: denied by whatever generation serves.
+                async def outside(tr):
+                    tr.set_option("authorization_token", token)
+                    tr.set(b"other-tenant/x", b"v")
+
+                await expect_denied(outside, "out-of-scope write")
+
+                # Dead-tenant token: denied at commit AND at read.
+                async def dead_write(tr):
+                    tr.set_option("authorization_token", doomed)
+                    tr.set(doomed_prefix + b"x", b"v")
+
+                await expect_denied(dead_write, "dead-tenant write")
+
+        await all_of(
+            [cluster.loop.spawn(client(i), name=f"authz.client{i}")
+             for i in range(self.n_clients)]
+        )
+        self._token, self._prefix = token, prefix
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            tr.set_option("authorization_token", self._token)
+            return await tr.get_range(self._prefix, strinc(self._prefix))
+
+        rows = dict(await self._run_txn(db, body))
+        missing = [k for k in self._acked if k not in rows]
+        assert not missing, f"{len(missing)} acked tenant writes lost"

@@ -26,9 +26,11 @@ from foundationdb_tpu.sim.cluster import SimCluster
 @pytest.fixture
 def authz_db():
     priv, pub = generate_keypair()
+    # The cluster system token is the FULL admin form ([b""] + system):
+    # infrastructure actions (shard-move snapshots) touch user keyspace.
     c = SimCluster(seed=21, n_storages=2, authz_public_key=pub,
                    authz_system_token=mint_token(
-                       priv, [], expires_at=1e12, system=True))
+                       priv, [b""], expires_at=1e12, system=True))
     return priv, c, open_database(c)
 
 
@@ -395,3 +397,60 @@ def test_tenant_bound_token_dies_with_its_tenant(authz_db):
         return await tr.get(p2 + b"doc")
 
     assert c.loop.run(db.run(live_read)) == b"1"
+
+
+def test_selectors_and_transfer_rpcs_under_read_authz(authz_db):
+    """Review findings: (a) selector resolution must work under a
+    prefix-scoped token (scans clamp to the token's span instead of
+    running to the keyspace edge and being denied); (b) the storage
+    transfer RPCs (snapshot_range) are token-gated — an untokened peer
+    cannot bulk-dump tenants; (c) list_tenants takes a token."""
+    priv, c, db = authz_db
+    from foundationdb_tpu.client.tenant import create_tenant, list_tenants
+    from foundationdb_tpu.client.transaction import KeySelector
+
+    writer = mint_token(priv, [b"selA/"], expires_at=c.loop.now + 3600)
+    admin = mint_token(priv, [b""], expires_at=c.loop.now + 3600, system=True)
+    for k in (b"selA/a", b"selA/b", b"selA/c"):
+        put(c, db, k, b"v", token=writer)
+
+    async def sel(tr):
+        tr.set_option("authorization_token", writer)
+        first = await tr.get_key(KeySelector.first_greater_or_equal(b"selA/"))
+        nxt = await tr.get_key(KeySelector.first_greater_than(b"selA/a"))
+        # Off the end of the tenant: clamped scan returns the sentinel
+        # rather than PermissionDenied.
+        off = await tr.get_key(KeySelector.first_greater_than(b"selA/zzz"))
+        return first, nxt, off
+
+    first, nxt, off = c.loop.run(db.run(sel))
+    assert first == b"selA/a" and nxt == b"selA/b"
+    assert off == b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff"[:len(off)] or off >= b"selA0"
+
+    # (b) snapshot_range: untokened denied; system token succeeds.
+    ep = c.storage_eps[0]
+
+    async def dump(token=None):
+        return await ep.snapshot_range(b"", b"\xff", None, token=token)
+
+    with pytest.raises(PermissionDenied):
+        c.loop.run(dump())
+    c.loop.run(dump(token=c.authz_system_token))  # no raise
+
+    # (c) list_tenants carries the token.
+    c.loop.run(create_tenant(db, b"lten", token=admin))
+    names = c.loop.run(list_tenants(db, token=writer))
+    assert b"lten" in names
+    with pytest.raises(PermissionDenied):
+        c.loop.run(list_tenants(db))
+
+    # (d) user-keyspace latest-applied reads are refused (system-only
+    # escape hatch for the mirror).
+    from foundationdb_tpu.core.errors import FdbError as _F
+
+    async def dirty(tr=None):
+        return await ep.get_range(b"", b"\xff", -1,
+                                  token=c.authz_system_token)
+
+    with pytest.raises(_F):
+        c.loop.run(dirty())

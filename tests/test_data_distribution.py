@@ -251,7 +251,8 @@ class TestFetchRedelivery:
         dest.init_served([])
 
         class FakeSrc:
-            async def snapshot_range(self, begin, end, min_version=None):
+            async def snapshot_range(self, begin, end, min_version=None,
+                                     token=None):
                 return 10, [(b"a/k", b"snapval")]  # ahead of dest's cursor
 
         async def main():
