@@ -1101,12 +1101,15 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         else:
             t.serve("tlog", TLog(loop))
     elif role == "storage":
-        from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
+        from foundationdb_tpu.runtime.kvstore import make_kvstore
         from foundationdb_tpu.runtime.storage import StorageServer
 
         tlog_eps = eps("tlog")
-        kv = (KeyValueStoreSQLite(
-                  os.path.join(data_dir, f"storage{index}.db"))
+        # Engine choice (reference: DatabaseConfiguration storage engine
+        # `ssd-2` vs `ssd-redwood-1`): spec key `storage_engine`.
+        kv = (make_kvstore(
+                  os.path.join(data_dir, f"storage{index}.db"),
+                  spec.get("storage_engine", "sqlite"))
               if data_dir else None)
         ss = StorageServer(
             loop, tag=index, tlog_ep=tlog_eps[index % n_tlogs],

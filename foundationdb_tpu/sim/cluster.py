@@ -76,6 +76,7 @@ class SimCluster:
         authz_system_token: str | None = None,
         authz_private_pem: bytes | None = None,
         multi_region: dict | None = None,
+        storage_engine: str = "sqlite",
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -176,9 +177,10 @@ class SimCluster:
         def make_kvstore(i: int):
             if data_dir is None:
                 return None
-            from foundationdb_tpu.runtime.kvstore import KeyValueStoreSQLite
+            from foundationdb_tpu.runtime.kvstore import make_kvstore as mk
 
-            return KeyValueStoreSQLite(os.path.join(data_dir, f"storage{i}.db"))
+            return mk(os.path.join(data_dir, f"storage{i}.db"),
+                      storage_engine)
 
         n_storage_total = n_storages * (2 if self.multi_region else 1)
         self.storages = [

@@ -1,0 +1,268 @@
+"""Redwood-class engine (native/btree.cpp): copy-on-write page B+tree.
+
+Reference: fdbserver/VersionedBTree.actor.cpp correctness properties —
+atomic root flips (a reopen sees exactly the last committed snapshot),
+ordered scans, range clears, large-value overflow chains, and page
+reuse that never corrupts the fallback meta.
+"""
+
+import os
+import random
+
+import pytest
+
+from foundationdb_tpu.runtime.kvstore import (
+    KeyValueStoreRedwood,
+    KeyValueStoreSQLite,
+    make_kvstore,
+)
+
+
+def test_model_equivalence_with_reopen(tmp_path):
+    """Randomized flush batches vs a dict model; REOPEN after every
+    flush (every commit must be a complete, self-contained snapshot).
+    Mixes point writes, tombstones, range purges, and overflow-sized
+    values; enough keys to force splits and a multi-level tree."""
+    p = str(tmp_path / "model.rw")
+    rng = random.Random(7)
+    model: dict[bytes, bytes] = {}
+    kv = KeyValueStoreRedwood(p)
+    version = 0
+    for round_no in range(25):
+        writes: dict[bytes, bytes | None] = {}
+        for _ in range(rng.randrange(1, 120)):
+            k = b"k%06d" % rng.randrange(600)
+            if rng.random() < 0.2:
+                writes[k] = None
+            elif rng.random() < 0.07:
+                writes[k] = bytes([rng.randrange(256)]) * rng.randrange(
+                    5000, 60000)  # overflow chain
+            else:
+                writes[k] = b"v%d-%d" % (round_no, rng.randrange(1000))
+        purges = []
+        if rng.random() < 0.4:
+            b = b"k%06d" % rng.randrange(600)
+            e = b + b"\xff" if rng.random() < 0.5 else b"k%06d" % rng.randrange(600)
+            if b < e:
+                purges.append((b, e))
+        version += rng.randrange(1, 10)
+        kv.flush(writes, version, purges=purges)
+        # Model applies purges FIRST, then the dirty set (engine
+        # contract: the dirty set wins over a purge in the same flush —
+        # kvstore.py applies purges then writes in one transaction).
+        for b, e in purges:
+            for k in [k for k in model if b <= k < e]:
+                del model[k]
+        for k, v in writes.items():
+            if v is None:
+                model.pop(k, None)
+            elif any(b <= k < e for b, e in purges):
+                # engine semantics: writes applied AFTER purges
+                model[k] = v
+            else:
+                model[k] = v
+        kv.close()
+        kv = KeyValueStoreRedwood(p)
+        got_version, rows = kv.load()
+        assert got_version == version
+        assert rows == sorted(model.items()), (
+            f"round {round_no}: {len(rows)} rows vs model {len(model)}")
+    kv.close()
+
+
+def test_matches_sqlite_engine(tmp_path):
+    """Same operation stream through both engines → identical load()."""
+    rng = random.Random(11)
+    rw = KeyValueStoreRedwood(str(tmp_path / "a.rw"))
+    sq = KeyValueStoreSQLite(str(tmp_path / "a.db"))
+    version = 0
+    for _ in range(10):
+        writes = {
+            b"x%04d" % rng.randrange(200):
+                (None if rng.random() < 0.25 else os.urandom(rng.randrange(1, 300)))
+            for _ in range(rng.randrange(1, 60))
+        }
+        purges = [(b"x%04d" % 10, b"x%04d" % rng.randrange(11, 200))] \
+            if rng.random() < 0.3 else []
+        version += 5
+        rw.flush(writes, version, purges=purges)
+        sq.flush(writes, version, purges=purges)
+    assert rw.load() == sq.load()
+    rw.close()
+    sq.close()
+
+
+def test_meta_corruption_falls_back_to_previous_commit(tmp_path):
+    """Tear the NEWEST meta slot (a crash mid-meta-write): open must
+    fall back to the previous commit's complete snapshot."""
+    p = str(tmp_path / "torn.rw")
+    kv = KeyValueStoreRedwood(p)
+    kv.flush({b"a": b"1"}, 10)
+    kv.flush({b"b": b"2"}, 20)
+    kv.close()
+    # Newest meta lives in slot (seq % 2); find it by trying both: tear
+    # each slot in turn and check behavior.
+    import shutil
+
+    shutil.copy(p, p + ".bak")
+    PAGE = 16384
+    for slot in (0, 1):
+        shutil.copy(p + ".bak", p)
+        with open(p, "r+b") as f:
+            f.seek(slot * PAGE + 40)  # scribble inside the meta struct
+            f.write(b"\xde\xad\xbe\xef")
+        kv = KeyValueStoreRedwood(p)
+        v, rows = kv.load()
+        kv.close()
+        if v == 20:
+            assert rows == [(b"a", b"1"), (b"b", b"2")]
+        else:
+            # The newer slot was torn: previous commit, complete.
+            assert v == 10 and rows == [(b"a", b"1")]
+
+
+def test_page_reuse_bounded_growth(tmp_path):
+    """Overwriting the same keys forever must reuse freed pages (the
+    two-generation freelist), not grow the file without bound."""
+    p = str(tmp_path / "grow.rw")
+    kv = KeyValueStoreRedwood(p)
+    for i in range(60):
+        kv.flush({b"hot%02d" % j: b"v%d" % i for j in range(50)}, i + 1)
+    import ctypes
+
+    pages = kv._lib.rw_page_count(kv._h)
+    kv.close()
+    # 50 small cells fit a single leaf; with COW + freelist the steady
+    # state is a handful of live pages + one generation of pending —
+    # far under the ~120+ pages 60 no-reuse commits would burn.
+    assert pages < 40, f"file grew to {pages} pages — freelist not reusing"
+
+
+def test_factory_and_empty_states(tmp_path):
+    kv = make_kvstore(str(tmp_path / "e.rw"), "ssd-redwood-1")
+    assert isinstance(kv, KeyValueStoreRedwood)
+    assert kv.load() == (0, [])
+    kv.flush({}, 5)  # empty flush still advances durability
+    assert kv.durable_version == 5
+    kv.flush({b"k": b"v"}, 6)
+    kv.flush({b"k": None}, 7)  # back to empty tree
+    v, rows = kv.load()
+    assert (v, rows) == (7, [])
+    kv.close()
+    with pytest.raises(ValueError):
+        make_kvstore(str(tmp_path / "x"), "rocksdb")
+
+
+def test_cluster_full_restart_on_redwood(tmp_path):
+    """The round-1 durability done-criterion, now on the Redwood-class
+    engine: kill the WHOLE cluster, restart from disk with
+    storage_engine='redwood', and every committed key reads back."""
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.sim.cluster import SimCluster
+
+    d = str(tmp_path)
+    c1 = SimCluster(seed=401, data_dir=d, n_tlogs=2, n_replicas=2,
+                    storage_engine="redwood")
+    db1 = open_database(c1)
+
+    async def write_all():
+        for i in range(30):
+            tr = db1.transaction()
+            tr.set(b"rdur/%03d" % i, b"v%d" % i)
+            if i == 7:
+                tr.set(b"rdur/big", b"B" * 30000)  # overflow chain
+            await tr.commit()
+        tr = db1.transaction()
+        tr.set(b"zz/settle", b"1")
+        await tr.commit()
+        await c1.loop.sleep(1.5)  # let the engine flush a durable prefix
+        return "ok"
+
+    assert c1.loop.run(write_all(), timeout=600) == "ok"
+    assert any(s._durable_version > 0 for s in c1.storages)
+
+    c2 = SimCluster(seed=402, data_dir=d, n_tlogs=2, n_replicas=2,
+                    storage_engine="redwood")
+    db2 = open_database(c2)
+
+    async def read_all():
+        tr = db2.transaction()
+        rows = dict(await tr.get_range(b"rdur/", b"rdur0"))
+        assert len(rows) == 31, len(rows)
+        for i in range(30):
+            assert rows[b"rdur/%03d" % i] == b"v%d" % i
+        assert rows[b"rdur/big"] == b"B" * 30000
+        return "ok"
+
+    assert c2.loop.run(read_all(), timeout=600) == "ok"
+
+
+def test_overlapping_purges_in_one_flush(tmp_path):
+    """The storage server batches overlapping purges (a moved-away range
+    plus single-key residue purges inside it) into ONE flush — every key
+    inside ANY purge must go (review-found: the nearest-begin test let
+    keys inside a wider earlier range survive)."""
+    p = str(tmp_path / "ov.rw")
+    kv = KeyValueStoreRedwood(p)
+    kv.flush({b"p%02d" % i: b"v" for i in range(20)}, 10)
+    # Wide purge [p00, p15) overlapping narrow [p05, p05\x00).
+    kv.flush({}, 20, purges=[(b"p00", b"p15"), (b"p05", b"p05\x00")])
+    v, rows = kv.load()
+    assert v == 20
+    assert [k for k, _ in rows] == [b"p%02d" % i for i in range(15, 20)]
+    kv.close()
+
+    sq = KeyValueStoreSQLite(str(tmp_path / "ov.db"))
+    sq.flush({b"p%02d" % i: b"v" for i in range(20)}, 10)
+    sq.flush({}, 20, purges=[(b"p00", b"p15"), (b"p05", b"p05\x00")])
+    assert sq.load()[1] == rows
+    sq.close()
+
+
+def test_oversized_key_rejected_not_wedged(tmp_path):
+    kv = KeyValueStoreRedwood(str(tmp_path / "big.rw"))
+    with pytest.raises(OSError):
+        kv.flush({b"k" * 17000: b"v"}, 10)
+    # Engine still healthy afterwards.
+    kv.flush({b"ok": b"v"}, 11)
+    assert kv.load() == (11, [(b"ok", b"v")])
+    kv.close()
+
+
+def test_corrupt_store_refused_not_reinitialized(tmp_path):
+    p = str(tmp_path / "c.rw")
+    kv = KeyValueStoreRedwood(p)
+    kv.flush({b"a": b"1"}, 10)
+    kv.close()
+    PAGE = 16384
+    with open(p, "r+b") as f:  # destroy BOTH meta slots
+        f.write(b"\x00" * (2 * PAGE))
+    with pytest.raises(OSError):
+        KeyValueStoreRedwood(p)
+
+
+def test_corrupt_data_page_fails_load_loudly(tmp_path):
+    p = str(tmp_path / "d.rw")
+    kv = KeyValueStoreRedwood(p)
+    kv.flush({b"a%03d" % i: b"v" * 100 for i in range(50)}, 10)
+    kv.close()
+    PAGE = 16384
+    with open(p, "r+b") as f:  # scribble a DATA page, metas intact
+        f.seek(2 * PAGE)
+        f.write(b"\xff" * 64)
+    kv = KeyValueStoreRedwood(p)
+    with pytest.raises(OSError):
+        kv.load()
+    kv.close()
+
+
+def test_noop_flush_advances_version_without_growth(tmp_path):
+    kv = KeyValueStoreRedwood(str(tmp_path / "n.rw"))
+    kv.flush({b"a": b"1"}, 10)
+    pages0 = kv._lib.rw_page_count(kv._h)
+    for v in range(11, 60):
+        kv.flush({}, v)
+    assert kv.durable_version == 59
+    assert kv._lib.rw_page_count(kv._h) == pages0  # marker-only commits
+    assert kv.load() == (59, [(b"a", b"1")])
+    kv.close()
