@@ -24,7 +24,6 @@ import sys
 from foundationdb_tpu.client.ryw import Database, RYWTransaction
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.runtime.net import NetTransport, RealLoop
-from foundationdb_tpu.runtime.shardmap import KeyShardMap
 from foundationdb_tpu.server import load_spec, parse_addr
 
 
@@ -44,11 +43,13 @@ def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
         return [t.endpoint(parse_addr(a), service or role)
                 for a in spec[role]]
 
+    from foundationdb_tpu.server import storage_shard_map
+
     db = Database(
         loop,
         [t.endpoint(parse_addr(a), "grv_proxy") for a in spec["proxy"]],
         [t.endpoint(parse_addr(a), "commit_proxy") for a in spec["proxy"]],
-        KeyShardMap.uniform(len(spec["storage"])),
+        storage_shard_map(spec),
         eps("storage"),
     )
     db.transaction_class = RYWTransaction

@@ -96,6 +96,21 @@ def _make_tenant_mirror(loop, t, spec: dict, storage_map, spawn):
     return mirror
 
 
+def storage_shard_map(spec: dict) -> "KeyShardMap":
+    """THE deployed storage map (reference: DatabaseConfiguration
+    replication — `replicas` in the spec, default 1): shard i is owned
+    by the k-member team {i, i+1, ...} so proxies tag every replica and
+    clients/routers fail over between team members. One definition used
+    by every deployed consumer (server roles, worker recruitment, cli,
+    dr_tool) — maps diverging across processes would corrupt routing."""
+    n = len(spec["storage"])
+    k = max(1, min(int(spec.get("replicas", 1)), n))
+    teams = None
+    if k > 1:
+        teams = [tuple((i + j) % n for j in range(k)) for i in range(n)]
+    return KeyShardMap.uniform(n, teams=teams)
+
+
 def _system_token(spec: dict) -> str | None:
     """Operator-minted system-scope authz token for in-process system
     actors (TimeKeeper) — spec key `authz_system_token`, a path to the
@@ -161,22 +176,62 @@ class ReadRouter:
     """Client-facing read surface on proxy processes: forwards reads to the
     owning storage shard. Lets one-connection clients (netclient.cpp) drive
     the full path without per-shard connections; richer clients (cli.py,
-    client/transaction.py) talk to storage endpoints directly."""
+    client/transaction.py) talk to storage endpoints directly. With
+    `replicas` > 1 in the spec, reads fail over across the shard's team
+    (a dead replica costs one detection delay, not availability)."""
 
-    def __init__(self, storage_map: KeyShardMap, storage_eps: list):
+    FAILED_TTL = 4.0  # how long a failed replica is tried last
+
+    def __init__(self, storage_map: KeyShardMap, storage_eps: list,
+                 loop=None):
         self.map = storage_map
         self.eps = storage_eps
+        self.loop = loop
+        # Failed-replica memory (the router-side twin of the client's
+        # Database._order_team): a dead/lagging replica is deprioritized
+        # for a TTL so ONE request pays the detection delay, not all.
+        self._failed_at: dict[int, float] = {}
 
-    def _ep(self, key: bytes):
-        return self.eps[self.map.tag_for_key(key)]
+    def _order(self, team):
+        if self.loop is None:
+            return list(team)
+        now = self.loop.now
+        return sorted(
+            team,
+            key=lambda t: now - self._failed_at.get(t, -1e9) < self.FAILED_TTL,
+        )
+
+    async def _on_team(self, team, call):
+        """Run `call(ep)` against the team with failover: connection loss
+        AND a lagging replica (FutureVersion — e.g. freshly restarted,
+        still catching up on its tag stream) both move to the next
+        member; the last error propagates only when EVERY member fails
+        (all-lagging surfaces the retryable FutureVersion to the
+        client)."""
+        from foundationdb_tpu.core.errors import FutureVersion
+        from foundationdb_tpu.runtime.flow import BrokenPromise
+
+        last: Exception | None = None
+        for tag in self._order(team):
+            try:
+                return await call(self.eps[tag])
+            except (BrokenPromise, FutureVersion) as e:
+                if self.loop is not None:
+                    self._failed_at[tag] = self.loop.now
+                last = e
+                continue
+        raise last if last else BrokenPromise("empty storage team")
 
     @rpc
-    async def get(self, key: bytes, version: int):
-        return await self._ep(key).get(key, version)
+    async def get(self, key: bytes, version: int, token=None):
+        return await self._on_team(
+            self.map.team_for_key(key),
+            lambda ep: ep.get(key, version, token=token))
 
     @rpc
     async def get_range(self, begin: bytes, end: bytes, version: int,
-                        limit: int = 10_000, reverse: bool = False):
+                        limit: int = 10_000, reverse: bool = False,
+                        token=None):
         rows: list = []
         shards = [
             s for s in self.map.shards
@@ -185,22 +240,29 @@ class ReadRouter:
         for s in (reversed(shards) if reverse else shards):
             lo = max(begin, s.range.begin)
             hi = min(end, s.range.end)
-            got = await self.eps[s.tag].get_range(
-                lo, hi, version, limit=limit, reverse=reverse
-            )
+            got = await self._on_team(
+                s.team,
+                lambda ep, lo=lo, hi=hi: ep.get_range(
+                    lo, hi, version, limit=limit, reverse=reverse,
+                    token=token))
             rows.extend(got)
             if len(rows) >= limit:
                 return rows[:limit]
         return rows
 
     @rpc
-    async def watch(self, key: bytes, value):
-        return await self._ep(key).watch(key, value)
+    async def watch(self, key: bytes, value, token=None):
+        return await self._on_team(
+            self.map.team_for_key(key),
+            lambda ep: ep.watch(key, value, token=token))
 
     @rpc
     async def wait_for_version(self, version: int) -> None:
-        for ep in self.eps:
-            await ep.wait_for_version(version)
+        # Team semantics: ONE caught-up member per shard suffices (a dead
+        # replica must not wedge the barrier — review finding).
+        for s in self.map.shards:
+            await self._on_team(
+                s.team, lambda ep: ep.wait_for_version(version))
 
 
 def _supervise(loop: RealLoop, name: str, make_coro):
@@ -377,7 +439,7 @@ class Worker:
                         for a in resolver_addrs]
         controller_ep = self.t.endpoint(
             parse_addr(self.spec["controller"][0]), "controller")
-        storage_map = KeyShardMap.uniform(len(self.spec["storage"]))
+        storage_map = storage_shard_map(self.spec)
         proxy = CommitProxy(
             self.loop, seq_ep, resolver_eps,
             KeyShardMap.uniform(len(resolver_eps)), tlog_eps,
@@ -994,7 +1056,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
     n_storages = len(spec["storage"])
     n_tlogs = len(spec["tlog"])
     resolver_map = KeyShardMap.uniform(len(spec["resolver"]))
-    storage_map = KeyShardMap.uniform(n_storages)
+    storage_map = storage_shard_map(spec)
 
     def eps(role_name: str, service: str | None = None):
         service = service or role_name
@@ -1014,7 +1076,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         return None
     if managed and role == "proxy":
         t.serve("worker", Worker(loop, t, spec, role, index, data_dir))
-        router = ReadRouter(storage_map, eps("storage"))
+        router = ReadRouter(storage_map, eps("storage"), loop=loop)
         t.serve("read_router", router)
         t.serve("storage0", router)  # C client default service name
         return None
@@ -1116,9 +1178,19 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             tlog_replicas=tlog_eps, kvstore=kv, authz=_make_authz(spec),
         )
         ss.tenant_mirror = _make_tenant_mirror(
-            loop, t, spec, KeyShardMap.uniform(len(spec["storage"])),
+            loop, t, spec, storage_map,
             lambda name, mk: _supervise(loop, name, mk))
         ss.system_token = _system_token(spec)
+        smap = storage_map
+        if int(spec.get("replicas", 1)) > 1:
+            # Replicated deployment: serve ONLY this replica's team
+            # shards (the serve-set guard — a replica outside a shard's
+            # team has no tag stream for it and would answer with
+            # missing data instead of wrong_shard_server).
+            ss.init_served([
+                (sh.range.begin, sh.range.end)
+                for sh in smap.shards if index in sh.team
+            ])
         t.serve("storage", ss)
         _supervise(loop, f"storage{index}.run", ss.run)
         if managed:
@@ -1143,7 +1215,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                 lambda name, mk: _supervise(loop, name, mk)),
         )
         grv = GrvProxy(loop, seq_ep, rk_ep)
-        router = ReadRouter(storage_map, eps("storage"))
+        router = ReadRouter(storage_map, eps("storage"), loop=loop)
         t.serve("commit_proxy", proxy)
         t.serve("grv_proxy", grv)
         t.serve("read_router", router)
@@ -1171,7 +1243,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             loop,
             eps("proxy", "grv_proxy"),
             eps("proxy", "commit_proxy"),
-            KeyShardMap.uniform(len(spec.get("storage") or [])),
+            storage_shard_map(spec),
             eps("storage"),
         )
         tk_db.transaction_class = RYWTransaction
