@@ -24,7 +24,7 @@ import sys
 from foundationdb_tpu.client.ryw import Database, RYWTransaction
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.runtime.net import NetTransport, RealLoop
-from foundationdb_tpu.server import load_spec, parse_addr
+from foundationdb_tpu.server import load_spec, parse_addr, storage_shard_map
 
 
 def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
@@ -42,8 +42,6 @@ def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
     def eps(role: str, service: str | None = None):
         return [t.endpoint(parse_addr(a), service or role)
                 for a in spec[role]]
-
-    from foundationdb_tpu.server import storage_shard_map
 
     db = Database(
         loop,

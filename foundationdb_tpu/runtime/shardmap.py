@@ -25,6 +25,21 @@ MAX_KEY = b"\xff\xff"  # end of the user+system keyspace
 Team = tuple[int, ...]  # storage tags; [0] is the preferred read replica
 
 
+def ring_teams(n_storages: int, k: int) -> "list[Team] | None":
+    """Shard i owned by the k-member ring team {i, i+1, ...} — THE team
+    shape for both the sim recruiter and the deployed storage_shard_map
+    (one definition: sim-vs-deployed divergence here would mean the sim
+    stops exercising the deployed layout). None for k<=1 (unreplicated:
+    KeyShardMap defaults to singleton teams)."""
+    k = max(1, min(k, n_storages))
+    if k <= 1:
+        return None
+    return [
+        tuple((i + j) % n_storages for j in range(k))
+        for i in range(n_storages)
+    ]
+
+
 @dataclass(frozen=True)
 class Shard:
     range: KeyRange

@@ -45,9 +45,10 @@ import json
 import os
 import sys
 
-from foundationdb_tpu.runtime.flow import ActorCancelled, rpc
+from foundationdb_tpu.runtime.flow import ActorCancelled, BrokenPromise, rpc
 from foundationdb_tpu.runtime.net import NetTransport, RealLoop
-from foundationdb_tpu.runtime.shardmap import KeyShardMap
+from foundationdb_tpu.core.errors import FutureVersion
+from foundationdb_tpu.runtime.shardmap import KeyShardMap, ring_teams
 
 ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy", "ratekeeper",
          "controller")
@@ -104,11 +105,8 @@ def storage_shard_map(spec: dict) -> "KeyShardMap":
     by every deployed consumer (server roles, worker recruitment, cli,
     dr_tool) — maps diverging across processes would corrupt routing."""
     n = len(spec["storage"])
-    k = max(1, min(int(spec.get("replicas", 1)), n))
-    teams = None
-    if k > 1:
-        teams = [tuple((i + j) % n for j in range(k)) for i in range(n)]
-    return KeyShardMap.uniform(n, teams=teams)
+    return KeyShardMap.uniform(
+        n, teams=ring_teams(n, int(spec.get("replicas", 1))))
 
 
 def _system_token(spec: dict) -> str | None:
@@ -208,9 +206,6 @@ class ReadRouter:
         member; the last error propagates only when EVERY member fails
         (all-lagging surfaces the retryable FutureVersion to the
         client)."""
-        from foundationdb_tpu.core.errors import FutureVersion
-        from foundationdb_tpu.runtime.flow import BrokenPromise
-
         last: Exception | None = None
         for tag in self._order(team):
             try:
@@ -1182,7 +1177,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             lambda name, mk: _supervise(loop, name, mk))
         ss.system_token = _system_token(spec)
         smap = storage_map
-        if int(spec.get("replicas", 1)) > 1:
+        if any(len(sh.team) > 1 for sh in smap.shards):
             # Replicated deployment: serve ONLY this replica's team
             # shards (the serve-set guard — a replica outside a shard's
             # team has no tag stream for it and would answer with

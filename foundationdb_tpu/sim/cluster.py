@@ -138,17 +138,19 @@ class SimCluster:
         self.n_replicas = n_replicas
         self.with_ratekeeper = ratekeeper
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
-        # k-way teams: shard i is owned by storages {i, i+1, ..., i+k-1}
-        # (reference: DDTeamCollection builds overlapping teams so load
-        # spreads without k*n servers). Multi-region: REGION teams — each
-        # shard's replicas are (primary storage i, remote storage n+i),
-        # the reference's cross-region team pairing.
+        # k-way ring teams (shared with the deployed storage_shard_map —
+        # runtime/shardmap.ring_teams; reference: DDTeamCollection builds
+        # overlapping teams so load spreads without k*n servers).
+        # Multi-region: REGION teams — each shard's replicas are
+        # (primary storage i, remote storage n+i), the reference's
+        # cross-region team pairing.
+        from foundationdb_tpu.runtime.shardmap import ring_teams
+
         if self.multi_region:
             teams = [(i, n_storages + i) for i in range(n_storages)]
         else:
-            teams = [
-                tuple((i + j) % n_storages for j in range(n_replicas))
-                for i in range(n_storages)
+            teams = ring_teams(n_storages, n_replicas) or [
+                (i,) for i in range(n_storages)
             ]
         self.storage_map = KeyShardMap.uniform(n_storages, teams=teams)
         self._gen_processes: list[str] = []  # previous generation, for retirement

@@ -214,6 +214,7 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             "resolvers": "n_resolvers",
             "coordinators": "n_coordinators",
             "dataDistribution": "data_distribution",
+            "storageEngine": "storage_engine",
         }
         cluster_opts = {
             cluster_map[k]: v for k, v in cluster_tbl.items()
