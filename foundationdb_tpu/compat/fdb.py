@@ -576,25 +576,29 @@ class TenantFacade:
 
 
 class tenant_management:
-    """Reference: fdb.tenant_management module surface."""
+    """Reference: fdb.tenant_management module surface. `token` carries
+    the operator's system-grant authz token on authz-enabled clusters
+    (tenant metadata lives in the token-gated system keyspace)."""
 
     @staticmethod
-    def create_tenant(db: Database, name: bytes) -> None:
+    def create_tenant(db: Database, name: bytes,
+                      token: str | None = None) -> None:
         from foundationdb_tpu.client.tenant import create_tenant
 
-        db._block(create_tenant(db._db, name))
+        db._block(create_tenant(db._db, name, token=token))
 
     @staticmethod
-    def delete_tenant(db: Database, name: bytes) -> None:
+    def delete_tenant(db: Database, name: bytes,
+                      token: str | None = None) -> None:
         from foundationdb_tpu.client.tenant import delete_tenant
 
-        db._block(delete_tenant(db._db, name))
+        db._block(delete_tenant(db._db, name, token=token))
 
     @staticmethod
-    def list_tenants(db: Database) -> list:
+    def list_tenants(db: Database, token: str | None = None) -> list:
         from foundationdb_tpu.client.tenant import list_tenants
 
-        return db._block(list_tenants(db._db))
+        return db._block(list_tenants(db._db, token=token))
 
 
 class _TransactionOptions:
