@@ -1,0 +1,88 @@
+"""Rank the heal-window A/B artifacts (BENCH_r05*.json) and recommend
+the kernel-mode env for the next full bench.
+
+The tpuwatch heal sequence writes one artifact per mode (default =
+window history + wave accept + sparse RMQ; then ACCEPT=seq on mako,
+RMQ=blocked on ycsb, HISTORY=batch on ycsb). This reads whatever exists,
+prints a ranked table of the VALID TPU numbers, and emits the env
+recommendation — so the operator (or next round's builder) turns the
+one-factor runs into a best-combination headline without re-deriving
+anything.
+
+    python scripts/rank_ab.py [--dir /root/repo]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+FILES = {
+    "default(window,wave,sparse)": "BENCH_r05_auto.json",
+    "ACCEPT=seq (mako)": "BENCH_r05_acceptseq.json",
+    "RMQ=blocked (ycsb)": "BENCH_r05_blockedrmq.json",
+    "HISTORY=batch (ycsb)": "BENCH_r05_batchhist.json",
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = ap.parse_args()
+
+    rows = []
+    for label, name in FILES.items():
+        path = os.path.join(args.dir, name)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                d = json.loads(f.read().strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            rows.append((label, None, "unparseable"))
+            continue
+        if not d.get("valid"):
+            rows.append((label, None,
+                         f"INVALID ({d.get('error', 'no error field')[:60]})"))
+            continue
+        rows.append((label, d, ""))
+
+    if not any(d for _l, d, _n in rows):
+        print("no valid TPU artifacts yet — run after a heal window")
+        for label, _d, note in rows:
+            print(f"  {label:30s} {note}")
+        return 1
+
+    print(f"{'mode':32s} {'txns/s':>12s} {'vs_base':>8s} {'p99 ms':>8s} "
+          f"{'p99/cpu':>8s}")
+    best = None
+    for label, d, note in rows:
+        if d is None:
+            print(f"{label:32s} {note}")
+            continue
+        print(f"{label:32s} {d.get('value', 0):12,.0f} "
+              f"{d.get('vs_baseline', 0):8.3f} {d.get('p99_ms', 0):8.1f} "
+              f"{str(d.get('p99_vs_cpu', '-')):>8s}")
+        if best is None or d.get("vs_baseline", 0) > best[1].get(
+                "vs_baseline", 0):
+            best = (label, d)
+
+    label, d = best
+    env = []
+    if "seq" in label:
+        env.append("FDB_TPU_ACCEPT=seq")
+    if "blocked" in label:
+        env.append("FDB_TPU_RMQ=blocked")
+    if "batch" in label:
+        env.append("FDB_TPU_HISTORY=batch")
+    print(f"\nbest: {label}  (vs_baseline {d.get('vs_baseline')})")
+    print("recommended final bench:",
+          (" ".join(env) + " " if env else "") + "python bench.py")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
