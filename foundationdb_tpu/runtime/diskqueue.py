@@ -56,6 +56,17 @@ class DiskQueue:
         os.replace(tmp_path, self.path)
         self._f = open(self.path, "ab")
 
+    def read_all(self) -> list[object]:
+        """Every intact record of the LIVE file, no truncation side
+        effect — the tlog SPILL read path (spilled entries live only on
+        disk; the appender fsyncs before every ack, so the tail is never
+        torn while the queue is live)."""
+        self._f.flush()
+        with open(self.path, "rb") as f:
+            data = f.read()
+        out, _good_end = _parse_records(data)
+        return out
+
     def close(self) -> None:
         self._f.close()
 
@@ -64,23 +75,31 @@ class DiskQueue:
         """All intact records; truncates a torn tail in place."""
         if not os.path.exists(path):
             return []
-        out: list[object] = []
-        good_end = 0
         with open(path, "rb") as f:
             data = f.read()
-        pos = 0
-        while pos + _HDR.size <= len(data):
-            length, crc = _HDR.unpack_from(data, pos)
-            end = pos + _HDR.size + length
-            if end > len(data):
-                break  # torn final record
-            payload = data[pos + _HDR.size : end]
-            if zlib.crc32(payload) != crc:
-                break  # corruption: everything after is untrustworthy
-            out.append(pickle.loads(payload))
-            good_end = end
-            pos = end
+        out, good_end = _parse_records(data)
         if good_end < len(data):
             with open(path, "r+b") as f:
                 f.truncate(good_end)
         return out
+
+
+def _parse_records(data: bytes) -> tuple[list[object], int]:
+    """ONE frame parser for both the crash-recovery and live spill-read
+    paths (they must never diverge on what counts as an intact record):
+    → (records, end offset of the last intact record)."""
+    out: list[object] = []
+    good_end = 0
+    pos = 0
+    while pos + _HDR.size <= len(data):
+        length, crc = _HDR.unpack_from(data, pos)
+        end = pos + _HDR.size + length
+        if end > len(data):
+            break  # torn final record
+        payload = data[pos + _HDR.size : end]
+        if zlib.crc32(payload) != crc:
+            break  # corruption: everything after is untrustworthy
+        out.append(pickle.loads(payload))
+        good_end = end
+        pos = end
+    return out, good_end

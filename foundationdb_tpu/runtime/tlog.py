@@ -37,6 +37,14 @@ class TLogLocked(Exception):
 
 class TLog:
     FSYNC_SECONDS = 0.0005  # simulated durable-write latency per push
+    # In-memory budget for the un-popped suffix (reference: TLog
+    # SPILLING — SpilledData moves committed-but-unpopped data out of
+    # memory). A dead replica that never pops its tag then pins DISK,
+    # not RAM: entries beyond the budget drop out of the in-memory list
+    # and are served back from the disk queue (which already holds every
+    # pushed entry durably). Memory-only tlogs (no disk_path) cannot
+    # spill and keep the unbounded-but-honest old behavior.
+    SPILL_BYTES = 64 << 20
 
     def __init__(
         self,
@@ -71,7 +79,25 @@ class TLog:
         assert all(e.version < init_version for e in self._log)
         # Running queue size (ratekeeper polls every 100 ms; recounting the
         # whole log there would be O(queue) exactly when the queue is huge).
+        # _queue_bytes counts the WHOLE un-popped suffix (incl. spilled —
+        # the ratekeeper must see spilled backlog); _mem_bytes only what
+        # is resident (the spill criterion).
         self._queue_bytes = sum(e.nbytes for e in self._log)
+        self._mem_bytes = self._queue_bytes
+        # Spilled region bookkeeping: (version, nbytes) per spilled entry
+        # — tiny — so trims can account bytes and salvage knows exactly
+        # which disk records are live without trusting file contents
+        # below the floor.
+        self._spilled_meta: list[tuple[int, int]] = []
+        self._spilled_through = 0  # entries <= this live on disk only
+        # Parsed spill-region cache: a laggard catching up pages through
+        # the spilled region many times (tiny_peek: one entry per page);
+        # re-reading + unpickling the whole file PER PAGE would be
+        # O(pages x file) (review finding). One parse per catch-up
+        # instead; invalidated whenever the spilled set changes. The
+        # transient memory spike is bounded by the spilled region and
+        # exists only while a laggard is actively being served.
+        self._spill_cache: list | None = None
         self._version = init_version  # end of applied chain
         # True end of the APPENDED chain: duplicates are judged against
         # this, never against epoch jumps (begin_epoch raises _version
@@ -123,17 +149,30 @@ class TLog:
         recovered end is unacked and must not be served; serving it
         would apply a transaction on some shards and not others). The
         disk file is rewritten through the tmp+rename path."""
+        # Spilled entries all PRECEDE the in-memory window; a truncation
+        # reaching into the spilled region would need to also drop spilled
+        # state or it resurrects an unacked suffix — enforce the
+        # precondition instead of assuming it (review finding; both
+        # callers truncate at boot, before any spill can have happened).
+        assert version >= self._spilled_through, (
+            f"truncate_to v{version} below spilled region "
+            f"(through v{self._spilled_through})")
         before = len(self._log)
         kept = [e for e in self._log if e.version <= version]
         if len(kept) != before:
-            self._queue_bytes -= sum(
-                e.nbytes for e in self._log if e.version > version
-            )
+            dropped = sum(e.nbytes for e in self._log if e.version > version)
+            self._queue_bytes -= dropped
+            self._mem_bytes -= dropped
             self._log = kept
             self._last_appended = kept[-1].version if kept else 0
             self._version = min(self._version, version + 1)
             if self.disk is not None:
-                self.disk.rewrite([(e.version, e.tagged) for e in self._log])
+                # Spilled entries are all BELOW the in-memory window, so
+                # truncation (which drops a suffix) keeps them whole.
+                self.disk.rewrite(
+                    self._spilled_entries()
+                    + [(e.version, e.tagged) for e in self._log]
+                )
         return before - len(self._log)
 
     @rpc
@@ -186,14 +225,46 @@ class TLog:
         entry = TLogEntry(version, tagged)
         self._log.append(entry)
         self._queue_bytes += entry.nbytes
+        self._mem_bytes += entry.nbytes
         self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
         self._last_appended = version
         self.known_committed = max(self.known_committed, known_committed)
+        self._maybe_spill()
         w = self._waiters.pop(version, None)
         if w is not None:
             w.send(None)
         return version
+
+    def _maybe_spill(self) -> None:
+        if self.disk is None or self._mem_bytes <= self.SPILL_BYTES:
+            return
+        # Spill the OLDEST entries (laggard pullers' territory) down to
+        # half the budget, so spilling is amortized, not per-push.
+        cut = 0
+        while cut < len(self._log) - 1 and self._mem_bytes > self.SPILL_BYTES // 2:
+            e = self._log[cut]
+            self._mem_bytes -= e.nbytes
+            self._spilled_meta.append((e.version, e.nbytes))
+            cut += 1
+        if cut:
+            self._spilled_through = self._log[cut - 1].version
+            self._log = self._log[cut:]
+            self._spill_cache = None
+
+    def _spilled_entries(self):
+        """(version, tagged) for the LIVE spilled region, read back from
+        the disk queue (exact membership from _spilled_meta — the file
+        may also hold resident and already-trimmed versions). Cached
+        until the spilled set changes."""
+        if not self._spilled_meta:
+            return []
+        if self._spill_cache is None:
+            live = {v for v, _n in self._spilled_meta}
+            self._spill_cache = [
+                (v, t) for v, t in self.disk.read_all() if v in live
+            ]
+        return self._spill_cache
 
     @rpc
     async def peek(
@@ -214,6 +285,15 @@ class TLog:
         if self.loop.buggify("tlog.tiny_peek"):
             limit = 1  # single-entry pages: pull-loop pagination on trial
         out = []
+        if self._spilled_meta and begin_version <= self._spilled_through:
+            # Laggard puller reaching into the spilled region: serve it
+            # back from disk (rare — a replica returning from the dead —
+            # so the O(file) read is paid only by the one catching up).
+            for v, tagged in self._spilled_entries():
+                if v >= begin_version and tag in tagged:
+                    out.append((v, tagged[tag]))
+                    if len(out) >= limit:
+                        return out, out[-1][0], self.known_committed
         for e in self._log:
             if e.version >= begin_version and tag in e.tagged:
                 out.append((e.version, e.tagged[tag]))
@@ -237,15 +317,31 @@ class TLog:
             return  # nothing pushed yet (fresh post-recovery log): no trim
         floor = min(self._popped.get(t, 0) for t in self._tags_seen)
         before = len(self._log)
-        kept = [e for e in self._log if e.version > floor]
-        self._queue_bytes -= sum(e.nbytes for e in self._log if e.version <= floor)
-        self._log = kept
-        if self.disk is not None and before != len(self._log):
+        dropped_mem = sum(e.nbytes for e in self._log if e.version <= floor)
+        self._log = [e for e in self._log if e.version > floor]
+        self._queue_bytes -= dropped_mem
+        self._mem_bytes -= dropped_mem
+        # Spilled entries below the floor retire too (bytes tracked in
+        # the meta list; the file reclaims space at the next compaction).
+        dropped_spill = sum(n for v, n in self._spilled_meta if v <= floor)
+        if dropped_spill:
+            self._spilled_meta = [
+                (v, n) for v, n in self._spilled_meta if v > floor
+            ]
+            self._queue_bytes -= dropped_spill
+            self._spill_cache = None
+            if not self._spilled_meta:
+                self._spilled_through = 0
+        if self.disk is not None and (before != len(self._log) or dropped_spill):
             self._disk_trims = getattr(self, "_disk_trims", 0) + 1
             if self._disk_trims % self.DISK_COMPACT_EVERY == 0:
-                # Reclaim queue space: the in-memory log IS the un-popped
-                # suffix a restart still needs — rewrite the file to it.
-                self.disk.rewrite([(e.version, e.tagged) for e in self._log])
+                # Reclaim queue space: the un-popped suffix a restart
+                # still needs = the spilled region (read back from the
+                # file) + the in-memory log.
+                self.disk.rewrite(
+                    self._spilled_entries()
+                    + [(e.version, e.tagged) for e in self._log]
+                )
 
     @rpc
     async def lock(self) -> int:
@@ -269,7 +365,8 @@ class TLog:
         return {
             "version": self._version,
             "queue_bytes": self._queue_bytes,
-            "queue_entries": len(self._log),
+            "queue_entries": len(self._log) + len(self._spilled_meta),
+            "spilled_entries": len(self._spilled_meta),
         }
 
     @rpc
@@ -291,6 +388,9 @@ class TLog:
     @rpc
     async def recover_entries(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
         """Recovery salvage: the un-popped suffix of the log — everything
-        some storage server may not have applied yet (valid once locked)."""
+        some storage server may not have applied yet (valid once locked).
+        Includes the SPILLED region (read back from disk): forgetting it
+        would lose acked-but-unpulled commits across a recovery."""
         assert self.locked, "recover_entries on an unlocked tlog"
-        return [(e.version, e.tagged) for e in self._log]
+        return (self._spilled_entries()
+                + [(e.version, e.tagged) for e in self._log])

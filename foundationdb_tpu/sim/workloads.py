@@ -942,8 +942,19 @@ class ChangeFeedWorkload(Workload):
     async def check(self, db) -> None:
         from foundationdb_tpu.core.mutations import Mutation
 
-        # Let storage pull loops drain fully.
-        await db.cluster.loop.sleep(0.5)
+        # Deterministic quiesce (campaign-found at seed 1052: a fixed
+        # 0.5s drain lost the race against clogged/buggified pull loops
+        # — the feed was read BEFORE the final mutation applied, while
+        # the later range read waited for it): take a read version and
+        # wait until EVERY storage has applied through it; every commit
+        # is then both readable and feed-captured.
+        async def rv_body(tr):
+            return await tr.get_read_version()
+
+        rv = await self._run_txn(db, rv_body)
+        for ss in db.cluster.storages:
+            while ss._version < rv:
+                await db.cluster.loop.sleep(0.05)
         entries: list[tuple[int, Mutation]] = []
         for ss in db.cluster.storages:
             entries.extend(ss.read_change_feed(b"wl-feed", 0))
@@ -1211,7 +1222,13 @@ class BackupRestoreWorkload(Workload):
             raise WorkloadFailed("backup produced no restorable version")
         # Fresh destination cluster on the SAME loop (the sim stays one
         # deterministic world).
-        dst_c = SimCluster(loop=db.loop, seed=self.seed + 9999)
+        # process_prefix: two clusters on one Loop must NOT share process
+        # names — loop-global kills/retirement would cross clusters (a
+        # buggify-triggered recovery on the source retired "tlog0" and
+        # black-holed the destination's identically named tlog forever;
+        # campaign-found at BackupRestoreBuggify seed 1032).
+        dst_c = SimCluster(loop=db.loop, seed=self.seed + 9999,
+                           process_prefix="bkdst.")
         dst = open_database(dst_c)
         await restore(dst, self._container)
 

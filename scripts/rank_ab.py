@@ -15,7 +15,6 @@ anything.
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 

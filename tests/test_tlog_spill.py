@@ -1,0 +1,120 @@
+"""TLog spilling (reference: TLog SPILLING / SpilledData): the in-memory
+un-popped suffix is byte-bounded; overflow moves to the disk queue and is
+served back to laggard pullers, survives salvage, and retires with the
+pop floor."""
+
+import pytest
+
+from foundationdb_tpu.core.mutations import Mutation, MutationType
+from foundationdb_tpu.runtime.flow import Loop
+from foundationdb_tpu.runtime.tlog import TLog
+
+
+def mut(i: int) -> Mutation:
+    return Mutation(MutationType.SET_VALUE, b"k%05d" % i, b"v" * 200)
+
+
+def make_spilly_tlog(tmp_path, budget=4096):
+    loop = Loop(seed=3)
+    t = TLog(loop, disk_path=str(tmp_path / "q"))
+    t.SPILL_BYTES = budget  # instance attr shadows the class budget
+    return loop, t
+
+
+def push_n(loop, t, n, tags=(0, 1), start=0):
+    prev = start
+    for i in range(start + 1, start + n + 1):
+        loop.run(t.push(prev, i, {tag: [mut(i)] for tag in tags}))
+        prev = i
+
+
+def test_memory_bounded_and_laggard_served_from_disk(tmp_path):
+    loop, t = make_spilly_tlog(tmp_path)
+    push_n(loop, t, 120)
+
+    # Memory is bounded; total queue accounting still sees everything.
+    assert t._spilled_meta, "never spilled"
+    assert t._mem_bytes <= t.SPILL_BYTES
+    m = loop.run(t.metrics())
+    assert m["queue_entries"] == 120
+    assert m["spilled_entries"] > 0
+
+    # A laggard puller starting at 1 gets EVERY entry, in order, across
+    # the spilled/resident boundary (paged).
+    got, cursor = [], 1
+    while True:
+        entries, end, _kc = loop.run(t.peek(0, cursor, limit=7))
+        got.extend(v for v, _m in entries)
+        if not entries or end >= 120:
+            got.extend([])
+            if not entries:
+                break
+        cursor = end + 1
+        if cursor > 120:
+            break
+    assert got == list(range(1, 121))
+
+    # An up-to-date puller never touches the disk path.
+    entries, end, _ = loop.run(t.peek(0, t._spilled_through + 1, limit=1000))
+    assert [v for v, _m in entries] == list(
+        range(t._spilled_through + 1, 121))
+
+
+def test_pop_floor_retires_spilled_entries(tmp_path):
+    loop, t = make_spilly_tlog(tmp_path)
+    push_n(loop, t, 100)
+    assert t._spilled_meta
+    spilled_before = len(t._spilled_meta)
+    qb_before = t._queue_bytes
+
+    # Both tags pop past half the spilled region.
+    mid = t._spilled_through // 2
+    loop.run(t.pop(0, mid))
+    loop.run(t.pop(1, mid))
+    assert len(t._spilled_meta) < spilled_before
+    assert t._queue_bytes < qb_before
+    assert all(v > mid for v, _n in t._spilled_meta)
+
+    # Pop everything: spill bookkeeping empties completely.
+    loop.run(t.pop(0, 100))
+    loop.run(t.pop(1, 100))
+    assert not t._spilled_meta and t._spilled_through == 0
+    assert not t._log
+
+
+def test_salvage_includes_spilled_region(tmp_path):
+    loop, t = make_spilly_tlog(tmp_path)
+    push_n(loop, t, 80)
+    assert t._spilled_meta
+    loop.run(t.lock())
+    entries = loop.run(t.recover_entries())
+    assert [v for v, _m in entries] == list(range(1, 81))
+    # The salvage carries full tagged payloads for every entry.
+    assert all(0 in tagged and 1 in tagged for _v, tagged in entries)
+
+
+def test_compaction_with_spill_preserves_suffix(tmp_path):
+    loop, t = make_spilly_tlog(tmp_path)
+    t.DISK_COMPACT_EVERY = 1  # compact on every trim
+    push_n(loop, t, 100)
+    loop.run(t.pop(0, 40))
+    loop.run(t.pop(1, 40))  # floor 40: compaction rewrites the file
+    # The rewritten file must still serve the whole live suffix.
+    entries, end, _ = loop.run(t.peek(0, 41, limit=1000))
+    assert [v for v, _m in entries] == list(range(41, 101))
+
+    # And a RESTART from that file recovers the same suffix.
+    t.disk.fsync()
+    t2 = TLog.from_disk(loop, str(tmp_path / "q"))
+    entries2, _end, _ = loop.run(t2.peek(0, 41, limit=1000))
+    assert [v for v, _m in entries2] == list(range(41, 101))
+
+
+def test_memory_only_tlog_never_spills(tmp_path):
+    loop = Loop(seed=4)
+    t = TLog(loop)
+    t.SPILL_BYTES = 1024
+    push_n(loop, t, 50)
+    assert not t._spilled_meta  # no disk: nothing to spill to
+    entries, _end, _ = loop.run(t.peek(0, 1, limit=1000))
+    assert len(entries) == 50
