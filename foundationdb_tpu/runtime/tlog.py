@@ -10,6 +10,8 @@ freezing its end version.
 
 from __future__ import annotations
 
+import bisect
+
 from dataclasses import dataclass
 
 from foundationdb_tpu.core.mutations import Mutation
@@ -90,14 +92,18 @@ class TLog:
         # below the floor.
         self._spilled_meta: list[tuple[int, int]] = []
         self._spilled_through = 0  # entries <= this live on disk only
-        # Parsed spill-region cache: a laggard catching up pages through
-        # the spilled region many times (tiny_peek: one entry per page);
-        # re-reading + unpickling the whole file PER PAGE would be
-        # O(pages x file) (review finding). One parse per catch-up
-        # instead; invalidated whenever the spilled set changes. The
-        # transient memory spike is bounded by the spilled region and
-        # exists only while a laggard is actively being served.
+        # Parsed spill-region cache, INCREMENTALLY maintained (review
+        # findings: rebuilding it from a full-file read on every spill
+        # event made laggard catch-up O(spill_events x history), and
+        # never evicting it kept a multi-GB backlog resident forever):
+        # built from ONE disk read on the first spilled peek, extended
+        # in memory as further entries spill (they are at hand then —
+        # no disk read), shrunk by trims, and RELEASED when a peek shows
+        # the caller is past the spilled region. A parallel sorted
+        # version list gives bisect paging (tiny_peek would otherwise
+        # rescan from the front per single-entry page).
         self._spill_cache: list | None = None
+        self._spill_cache_versions: list[int] | None = None
         self._version = init_version  # end of applied chain
         # True end of the APPENDED chain: duplicates are judged against
         # this, never against epoch jumps (begin_epoch raises _version
@@ -246,17 +252,22 @@ class TLog:
             e = self._log[cut]
             self._mem_bytes -= e.nbytes
             self._spilled_meta.append((e.version, e.nbytes))
+            if self._spill_cache is not None:
+                # Extend the live cache in memory: newly spilled entries
+                # are newer than everything cached, so append keeps the
+                # version order — no disk re-read.
+                self._spill_cache.append((e.version, e.tagged))
+                self._spill_cache_versions.append(e.version)
             cut += 1
         if cut:
             self._spilled_through = self._log[cut - 1].version
             self._log = self._log[cut:]
-            self._spill_cache = None
 
     def _spilled_entries(self):
-        """(version, tagged) for the LIVE spilled region, read back from
-        the disk queue (exact membership from _spilled_meta — the file
-        may also hold resident and already-trimmed versions). Cached
-        until the spilled set changes."""
+        """(version, tagged) for the LIVE spilled region (exact
+        membership from _spilled_meta — the file may also hold resident
+        and already-trimmed versions). One disk read builds the cache;
+        spills/trims maintain it incrementally."""
         if not self._spilled_meta:
             return []
         if self._spill_cache is None:
@@ -264,6 +275,7 @@ class TLog:
             self._spill_cache = [
                 (v, t) for v, t in self.disk.read_all() if v in live
             ]
+            self._spill_cache_versions = [v for v, _t in self._spill_cache]
         return self._spill_cache
 
     @rpc
@@ -287,13 +299,21 @@ class TLog:
         out = []
         if self._spilled_meta and begin_version <= self._spilled_through:
             # Laggard puller reaching into the spilled region: serve it
-            # back from disk (rare — a replica returning from the dead —
-            # so the O(file) read is paid only by the one catching up).
-            for v, tagged in self._spilled_entries():
-                if v >= begin_version and tag in tagged:
+            # back from disk (one file read builds the cache; bisect
+            # finds the page start so tiny single-entry pages don't
+            # rescan the whole region each time).
+            entries = self._spilled_entries()
+            i = bisect.bisect_left(self._spill_cache_versions, begin_version)
+            for v, tagged in entries[i:]:
+                if tag in tagged:
                     out.append((v, tagged[tag]))
                     if len(out) >= limit:
                         return out, out[-1][0], self.known_committed
+        elif self._spill_cache is not None:
+            # Caller is past the spilled region: release the cache (the
+            # catch-up it served is over; another laggard pays one more
+            # disk read to rebuild — memory stays bounded in between).
+            self._spill_cache = self._spill_cache_versions = None
         for e in self._log:
             if e.version >= begin_version and tag in e.tagged:
                 out.append((e.version, e.tagged[tag]))
@@ -329,9 +349,16 @@ class TLog:
                 (v, n) for v, n in self._spilled_meta if v > floor
             ]
             self._queue_bytes -= dropped_spill
-            self._spill_cache = None
+            if self._spill_cache is not None:
+                self._spill_cache = [
+                    (v, t) for v, t in self._spill_cache if v > floor
+                ]
+                self._spill_cache_versions = [
+                    v for v, _t in self._spill_cache
+                ]
             if not self._spilled_meta:
                 self._spilled_through = 0
+                self._spill_cache = self._spill_cache_versions = None
         if self.disk is not None and (before != len(self._log) or dropped_spill):
             self._disk_trims = getattr(self, "_disk_trims", 0) + 1
             if self._disk_trims % self.DISK_COMPACT_EVERY == 0:

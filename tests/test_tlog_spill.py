@@ -42,16 +42,12 @@ def test_memory_bounded_and_laggard_served_from_disk(tmp_path):
     # A laggard puller starting at 1 gets EVERY entry, in order, across
     # the spilled/resident boundary (paged).
     got, cursor = [], 1
-    while True:
+    while cursor <= 120:
         entries, end, _kc = loop.run(t.peek(0, cursor, limit=7))
-        got.extend(v for v, _m in entries)
-        if not entries or end >= 120:
-            got.extend([])
-            if not entries:
-                break
-        cursor = end + 1
-        if cursor > 120:
+        if not entries and end >= 120:
             break
+        got.extend(v for v, _m in entries)
+        cursor = end + 1
     assert got == list(range(1, 121))
 
     # An up-to-date puller never touches the disk path.
