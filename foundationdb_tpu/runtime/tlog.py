@@ -47,6 +47,7 @@ class TLog:
     # pushed entry durably). Memory-only tlogs (no disk_path) cannot
     # spill and keep the unbounded-but-honest old behavior.
     SPILL_BYTES = 64 << 20
+    SPILL_CACHE_TTL = 10.0  # release the spill-read cache when cold
 
     def __init__(
         self,
@@ -303,16 +304,23 @@ class TLog:
             # finds the page start so tiny single-entry pages don't
             # rescan the whole region each time).
             entries = self._spilled_entries()
+            self._spill_cache_used = self.loop.now
             i = bisect.bisect_left(self._spill_cache_versions, begin_version)
             for v, tagged in entries[i:]:
                 if tag in tagged:
                     out.append((v, tagged[tag]))
                     if len(out) >= limit:
                         return out, out[-1][0], self.known_committed
-        elif self._spill_cache is not None:
-            # Caller is past the spilled region: release the cache (the
-            # catch-up it served is over; another laggard pays one more
-            # disk read to rebuild — memory stays bounded in between).
+        elif (self._spill_cache is not None
+              and self.loop.now - getattr(self, "_spill_cache_used", 0)
+              > self.SPILL_CACHE_TTL):
+            # The spilled region has gone COLD (no laggard touched it
+            # for a TTL): release the cache so the backlog doesn't stay
+            # resident. Keyed on staleness, NOT on "some other puller
+            # peeked above the region" — with replicas, the healthy
+            # replica's every pull would otherwise evict the cache and
+            # force a full-file rebuild per laggard page (review
+            # finding).
             self._spill_cache = self._spill_cache_versions = None
         for e in self._log:
             if e.version >= begin_version and tag in e.tagged:
@@ -350,12 +358,13 @@ class TLog:
             ]
             self._queue_bytes -= dropped_spill
             if self._spill_cache is not None:
-                self._spill_cache = [
-                    (v, t) for v, t in self._spill_cache if v > floor
-                ]
-                self._spill_cache_versions = [
-                    v for v, _t in self._spill_cache
-                ]
+                # The floor always removes a PREFIX of the version-sorted
+                # cache: bisect + del is O(dropped), not an O(region)
+                # rebuild per pop (a laggard pops per applied page —
+                # full copies made catch-up O(N^2); review finding).
+                i = bisect.bisect_right(self._spill_cache_versions, floor)
+                del self._spill_cache[:i]
+                del self._spill_cache_versions[:i]
             if not self._spilled_meta:
                 self._spilled_through = 0
                 self._spill_cache = self._spill_cache_versions = None

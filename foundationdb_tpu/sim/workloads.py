@@ -1804,6 +1804,8 @@ class AuthzWorkload(Workload):
 
     async def run(self, db, cluster) -> None:
         from foundationdb_tpu.client.tenant import (
+            Tenant,
+            TenantExists,
             TenantNotFound,
             create_tenant,
             delete_tenant,
@@ -1817,10 +1819,21 @@ class AuthzWorkload(Workload):
         admin = cluster.authz_system_token
         exp = loop.now + 1e9
 
-        prefix = await create_tenant(db, b"authz-w", token=admin)
+        async def create_idempotent(name: bytes) -> bytes:
+            # A CommitUnknownResult retry can observe our OWN landed
+            # create (campaign-found twice: delete at seed 1032-era,
+            # create at aggressive seed 2005) — resolve the prefix
+            # instead of failing; these names belong to this workload
+            # alone, so TenantExists here can only mean "we made it".
+            try:
+                return await create_tenant(db, name, token=admin)
+            except TenantExists:
+                return await Tenant(db, name, token=admin)._resolve()
+
+        prefix = await create_idempotent(b"authz-w")
         token = mint_token(priv, [prefix], expires_at=exp, tenant=b"authz-w")
         # A doomed tenant whose bound token must die with it.
-        doomed_prefix = await create_tenant(db, b"authz-doomed", token=admin)
+        doomed_prefix = await create_idempotent(b"authz-doomed")
         doomed = mint_token(priv, [doomed_prefix], expires_at=exp,
                             tenant=b"authz-doomed")
         try:

@@ -414,7 +414,7 @@ class TestDeployedChaos:
                      tries=60)
         assert "v1" in out.stdout and "v2" in out.stdout
 
-    def test_heal_with_replicated_storage(self, managed, tmp_path_factory):
+    def test_heal_with_replicated_storage(self, tmp_path_factory):
         """Managed recruitment composes with `replicas: 2`: a tlog kill
         heals with a generation change, and a storage replica death
         afterwards costs availability nothing (team failover) — the

@@ -44,8 +44,8 @@ def test_memory_bounded_and_laggard_served_from_disk(tmp_path):
     got, cursor = [], 1
     while cursor <= 120:
         entries, end, _kc = loop.run(t.peek(0, cursor, limit=7))
-        if not entries and end >= 120:
-            break
+        if not entries:
+            break  # a stalled peek fails the assert below, never hangs
         got.extend(v for v, _m in entries)
         cursor = end + 1
     assert got == list(range(1, 121))
