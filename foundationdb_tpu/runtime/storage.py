@@ -536,6 +536,19 @@ class StorageServer:
         self._check_read_authz(begin, end, token)
         f = FetchState(begin, end)
         self._fetching.append(f)
+        # RE-ACQUIRE discipline (campaign-found at DDBalance seed 3033):
+        # a retired ServedRange's in-window grace ("serve reads at
+        # version <= end_version from the old data") is only sound while
+        # the map is COMPLETE through end_version. From this registration
+        # on, in-range mutations divert into the fetch buffer instead of
+        # the map — so if this server recently LEFT the shard and its
+        # lagging pull hadn't yet applied through the handoff version,
+        # the grace window would serve committed writes as missing. Cap
+        # the OVERLAP at the version the map is actually complete
+        # through (entries are split so non-overlapping portions keep
+        # their full grace); reads past the cap get wrong_shard_server
+        # and re-route to a complete owner.
+        self._restrict_grace(begin, end, self._version)
         trace(self.loop).event("FetchKeysBegin", begin=begin, end=end)
         try:
             # The snapshot must be at/above OUR OWN applied version
@@ -586,7 +599,37 @@ class StorageServer:
             if f in self._fetching:
                 self._fetching.remove(f)
             self._purge(begin, end)  # buffered mutations were lost
+            # The purge deleted the range's map history, so any retired
+            # grace overlapping it can no longer answer correctly — drop
+            # the overlap (cap below start_version), or in-window reads
+            # would return committed keys as missing (review finding:
+            # the same stale-read class as the registration cap, on the
+            # abort path).
+            self._restrict_grace(begin, end, -1)
             raise
+
+    def _restrict_grace(self, begin: bytes, end: bytes, cap: int) -> None:
+        """Split RETIRED ServedRanges at [begin, end) and cap the
+        overlap's grace at `cap` (a cap below start_version drops the
+        overlap piece entirely). Live entries are untouched."""
+        if self.served is None:
+            return
+        out: list[ServedRange] = []
+        for s in self.served:
+            if s.end_version is None or s.end <= begin or end <= s.begin:
+                out.append(s)
+                continue
+            if s.begin < begin:
+                out.append(ServedRange(s.begin, begin,
+                                       s.start_version, s.end_version))
+            if end < s.end:
+                out.append(ServedRange(end, s.end,
+                                       s.start_version, s.end_version))
+            capped = min(s.end_version, cap)
+            if capped >= s.start_version:
+                out.append(ServedRange(max(s.begin, begin), min(s.end, end),
+                                       s.start_version, capped))
+        self.served = out
 
     def abort_fetch(self, begin: bytes, end: bytes) -> None:
         """Abandon a move: drop buffers and partial data for the range."""

@@ -277,6 +277,11 @@ class TLog:
                 (v, t) for v, t in self.disk.read_all() if v in live
             ]
             self._spill_cache_versions = [v for v, _t in self._spill_cache]
+            # Fresh build = fresh TTL: a cache rebuilt by compaction or
+            # salvage must not carry a stale stamp, or the next healthy
+            # peek evicts it immediately and every compaction re-pays
+            # the full-file read (review finding).
+            self._spill_cache_used = self.loop.now
         return self._spill_cache
 
     @rpc
@@ -306,7 +311,8 @@ class TLog:
             entries = self._spilled_entries()
             self._spill_cache_used = self.loop.now
             i = bisect.bisect_left(self._spill_cache_versions, begin_version)
-            for v, tagged in entries[i:]:
+            for j in range(i, len(entries)):  # no entries[i:] copy per page
+                v, tagged = entries[j]
                 if tag in tagged:
                     out.append((v, tagged[tag]))
                     if len(out) >= limit:
