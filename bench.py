@@ -816,6 +816,56 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
         return {"error": f"cpu-mesh run failed: {str(e)[:200]}"}
 
 
+def attach_last_valid_artifact() -> "dict | None":
+    """Best valid TPU artifact the in-round autopilot captured, if any.
+
+    scripts/tpuwatch_r05.sh writes BENCH_r05_*.json during tunnel heal
+    windows. When THIS run cannot produce a valid TPU number (tunnel down
+    again), the driver's artifact still references the captured one —
+    source file + mtime included so it is auditable, and it is never
+    promoted to this run's own value/valid fields.
+    """
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # Headline artifacts first (full sweep, then the quick validity run);
+    # A/B-ablation files only if neither exists — max-by-value across
+    # unlike configs would let a small or ablated run masquerade as the
+    # representative number.
+    preference = ["BENCH_r05_auto.json", "BENCH_r05_quick.json"]
+    try:
+        rest = sorted(
+            set(glob.glob(os.path.join(here, "BENCH_r05_*.json")))
+            - {os.path.join(here, p) for p in preference},
+            key=lambda p: -os.path.getmtime(p),
+        )
+    except OSError:  # file rotated away between glob and stat
+        rest = []
+    for path in [os.path.join(here, p) for p in preference] + rest:
+        try:
+            rec = json.loads(open(path).read().strip().splitlines()[-1])
+            if not (rec.get("backend") == "tpu" and rec.get("valid")):
+                continue
+            return {
+                "source_file": os.path.basename(path),
+                "captured_at": time.strftime(
+                    "%Y-%m-%d %H:%M:%S", time.gmtime(os.path.getmtime(path))
+                ),
+                "metric": rec.get("metric"),
+                "value": rec.get("value"),
+                "unit": rec.get("unit"),
+                "vs_baseline": rec.get("vs_baseline"),
+                "mode": rec.get("mode"),
+                "txns": rec.get("txns"),
+                "p99_ms": rec.get("p99_ms"),
+                "p99_vs_cpu": rec.get("p99_vs_cpu"),
+            }
+        except Exception:
+            continue
+    return None
+
+
 def pct(lat_ms: list[float], q: float) -> float:
     return round(float(np.percentile(lat_ms, q)), 2) if lat_ms else 0.0
 
@@ -1001,6 +1051,12 @@ def main() -> None:
                 + str(result.get("error", "likely hung on the TPU tunnel"))
             )
             result["valid"] = False
+            try:
+                att = attach_last_valid_artifact()
+                if att:
+                    result["last_valid_tpu_artifact"] = att
+            except Exception:
+                pass
             print(json.dumps(result), flush=True)
             os._exit(3)
 
@@ -1107,6 +1163,19 @@ def main() -> None:
         result["error"] = tb.splitlines()[-1][:500] if tb else "unknown"
         exit_rc = 1
     finally:
+        if not result.get("valid"):
+            # The tunnel is down more often than up (r3: one ~20-min window
+            # in 12 h; r4: none). If the in-round autopilot
+            # (scripts/tpuwatch_r05.sh) captured a valid TPU artifact during
+            # a heal window, attach it — clearly labeled with its source
+            # file and timestamp, never promoted to this run's own
+            # value/valid fields.
+            try:
+                att = attach_last_valid_artifact()
+                if att:
+                    result["last_valid_tpu_artifact"] = att
+            except Exception:
+                pass  # attachment is best-effort; never cost the JSON line
         with emit_lock:  # exactly ONE JSON line prints, watchdog or us
             bench_done.set()
             print(json.dumps(result), flush=True)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Stage-by-stage compile/dispatch probe on the live device.
+
+Times each building block of the production resolve path separately so a
+hang or pathological compile is attributable to ONE stage. Prints a line
+per stage with compile and run wall times; run with increasing --level to
+go deeper. Safe to kill at any point — every stage that completed has
+already printed.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(m):
+    print(f"{time.strftime('%H:%M:%S')} {m}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--level", type=int, default=9)
+    ap.add_argument("--capacity", type=int, default=262144)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--window", type=int, default=32)
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from foundationdb_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    log(f"import {time.perf_counter()-t0:.1f}s; devices={jax.devices()}")
+
+    # 1: trivial dispatch round-trip
+    t = time.perf_counter()
+    x = jnp.ones((8, 128), jnp.float32)
+    y = jax.jit(lambda a: (a @ a.T).sum())(x)
+    float(y)
+    log(f"L1 trivial jit+run {time.perf_counter()-t:.2f}s")
+    if args.level < 2:
+        return
+
+    # 2: big matmul (MXU sanity + HBM transfer)
+    t = time.perf_counter()
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+    f = jax.jit(lambda m: (m @ m).sum())
+    float(f(a))
+    c = time.perf_counter() - t
+    t = time.perf_counter()
+    float(f(a))
+    log(f"L2 4k matmul compile+run {c:.2f}s warm {time.perf_counter()-t:.3f}s")
+    if args.level < 3:
+        return
+
+    from foundationdb_tpu.models import conflict_kernel as ck
+    from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+    C, B = args.capacity, args.batch
+    rng = np.random.default_rng(0)
+    cs = TPUConflictSet(capacity=C, batch_size=B, max_read_ranges=2,
+                        max_write_ranges=1, max_key_bytes=12,
+                        window_versions=64)
+    W = cs.codec.width
+
+    def rand_keys(n):
+        k = np.zeros((n, W), np.int32)
+        k[:, 0] = rng.integers(0, 1 << 16, size=n).astype(np.int32)
+        k[:, 1] = rng.integers(0, 1 << 30, size=n).astype(np.int32)
+        return k
+
+    rb = rand_keys(B * 2).reshape(B, 2, W)
+    re_ = rb.copy(); re_[:, :, 1] += 1
+    wb = rand_keys(B * 1).reshape(B, 1, W)
+    we = wb.copy(); we[:, :, 1] += 1
+    batch = ck.BatchTensors(
+        read_begin=jnp.asarray(rb), read_end=jnp.asarray(re_),
+        read_mask=jnp.ones((B, 2), bool),
+        write_begin=jnp.asarray(wb), write_end=jnp.asarray(we),
+        write_mask=jnp.asarray(rng.random(size=(B, 1)) < 0.5),
+        read_version=jnp.zeros((B,), jnp.int32),
+        txn_mask=jnp.ones((B,), bool))
+    log(f"L3 state+batch built (C={C} B={B} W={W} hist={ck._HIST_DESIGN})")
+    if args.level < 4:
+        return
+
+    # 4: single-phase compiles
+    state = cs.state
+    is_hist = ck._HIST_DESIGN == "window"
+    t = time.perf_counter()
+    out = jax.jit(ck._pairwise_overlap)(batch)
+    jax.block_until_ready(out)
+    log(f"L4 pairwise compile+run {time.perf_counter()-t:.2f}s")
+    if not is_hist:
+        t = time.perf_counter()
+        out = jax.jit(ck._history_conflicts)(state, batch)
+        jax.block_until_ready(out)
+        log(f"L4 hist_conflicts compile+run {time.perf_counter()-t:.2f}s")
+    if args.level < 5:
+        return
+
+    # 5: one full resolve step (the hist-design entry used in production)
+    step_fn = ck.resolve_batch_hist if is_hist else ck.resolve_batch
+    step = jax.jit(step_fn)
+    cv = jnp.int32(1)
+    old = jnp.int32(0)
+    t = time.perf_counter()
+    out = step(state, batch, cv, old)
+    jax.block_until_ready(out)
+    log(f"L5 resolve_batch[{ck._HIST_DESIGN}] compile+run {time.perf_counter()-t:.2f}s")
+    t = time.perf_counter()
+    out = step(state, batch, cv, old)
+    jax.block_until_ready(out)
+    log(f"L5 resolve_batch warm {time.perf_counter()-t:.3f}s")
+    if args.level < 6:
+        return
+
+    # 6: the windowed scan program at --window
+    Wn = args.window
+    mb = ck.BatchTensors(*[
+        jnp.asarray(np.broadcast_to(np.asarray(x), (Wn,) + x.shape).copy())
+        for x in batch
+    ])
+    cvs = jnp.arange(1, Wn + 1, dtype=jnp.int32)
+    olds = jnp.zeros((Wn,), jnp.int32)
+    scan_fn = ck.resolve_many_hist if is_hist else ck.resolve_many
+    scan = jax.jit(scan_fn)
+    t = time.perf_counter()
+    out = scan(state, mb, cvs, olds)
+    jax.block_until_ready(out)
+    log(f"L6 resolve_many window={Wn} compile+run {time.perf_counter()-t:.2f}s")
+    t = time.perf_counter()
+    out = scan(state, mb, cvs, olds)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t
+    log(f"L6 resolve_many warm {dt:.3f}s = {Wn*B/dt:,.0f} txns/s upper bound")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+#!/bin/bash
+# Round-5 heal-window autopilot.
+#
+# The axon tunnel comes and goes (r3: one ~20-min window the whole round;
+# r4: none; r5 so far: one ~5-min window at 03:49 that closed before the
+# first full bench finished compiling). This loop probes cheaply and, the
+# moment a window opens, burns it in strict order of durable value:
+#
+#   1. quick   — small ycsb run   -> BENCH_r05_quick.json   (validity proof
+#                + warms the persistent compile cache in .jax_cache, which
+#                is what makes every later stage fit in a short window)
+#   2. profile — full ycsb + phase attribution -> TPU_PROFILE_r05.json
+#   3. diag    — on-device phase timing        -> TPU_DIAG_r05.json
+#   4. full    — the whole §5 sweep            -> BENCH_r05_auto.json
+#   5. A/Bs    — ACCEPT=seq / RMQ=blocked / HISTORY=batch, ycsb each
+#   6. rank    — scripts/rank_ab.py            -> RANK_r05.txt
+#
+# Every stage is timeout-wrapped (a dropped tunnel hangs transfers forever)
+# and SKIPPED once its artifact looks done, so successive short windows
+# resume where the last one died instead of starting over.
+set -u
+cd /root/repo
+LOG=tpuwatch_r05.log
+say() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
+
+probe() {
+  timeout 240 python - <<'PYEOF' >> "$LOG" 2>&1
+import time
+t0 = time.perf_counter()
+import jax, jax.numpy as jnp
+d = jax.devices()
+if d[0].platform == "cpu":
+    raise SystemExit(1)
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)))
+float(x)
+print(f"{time.strftime('%H:%M:%S')} PROBE-OK {d} {time.perf_counter()-t0:.1f}s",
+      flush=True)
+PYEOF
+}
+
+# have FILE JQFILTER — artifact exists and satisfies the filter
+have() {
+  [ -s "$1" ] && python - "$1" "$2" <<'PYEOF' 2>/dev/null
+import json, sys
+try:
+    rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+except Exception:
+    raise SystemExit(1)
+raise SystemExit(0 if eval(sys.argv[2], {}, {"r": rec}) else 1)
+PYEOF
+}
+
+stage() {  # stage NAME TIMEOUT ARTIFACT CHECK -- CMD...
+  name=$1 tmo=$2 art=$3 chk=$4; shift 5
+  if have "$art" "$chk"; then say "stage $name: already done"; return 0; fi
+  say "stage $name: running (timeout ${tmo}s)"
+  timeout "$tmo" env "FDB_TPU_BENCH_DEADLINE_S=$((tmo - 60))" "$@" \
+    > "$art.tmp" 2>> "$LOG"
+  rc=$?
+  if [ $rc -eq 0 ] && have "$art.tmp" "$chk"; then
+    mv "$art.tmp" "$art"; say "stage $name: DONE -> $art"; return 0
+  fi
+  say "stage $name: failed rc=$rc (artifact kept as $art.tmp for forensics)"
+  return 1
+}
+
+TPU_OK='r.get("backend") == "tpu" and r.get("valid")'
+TPU_ANY='r.get("backend") == "tpu"'
+
+say "autopilot armed (pid $$)"
+while true; do
+  if ! probe; then
+    say "probe failed"
+    rm -f /tmp/tpu_window_open
+    sleep 180
+    continue
+  fi
+  say "WINDOW OPEN — heal sequence"
+  # Signal CPU-heavy background work (campaign miner) to pause: a loaded
+  # host skews the in-run CPU skiplist baseline the artifact is judged
+  # against.
+  touch /tmp/tpu_window_open
+  trap 'rm -f /tmp/tpu_window_open' EXIT
+  stage quick 700 BENCH_r05_quick.json "$TPU_OK" -- \
+    python bench.py --mode ycsb --txns 262144 || { sleep 60; continue; }
+  stage profile 1500 TPU_PROFILE_r05.json \
+    "$TPU_OK and r.get('phase_profile_ms')" -- \
+    python bench.py --mode ycsb --profile || { sleep 60; continue; }
+  stage diag 900 TPU_DIAG_r05.json "isinstance(r, dict) and len(r) > 2" -- \
+    python scripts/tpu_diag.py || { sleep 60; continue; }
+  stage full 2400 BENCH_r05_auto.json "$TPU_OK" -- \
+    python bench.py || { sleep 60; continue; }
+  stage ab_seq 1200 BENCH_r05_seq.json "$TPU_ANY" -- \
+    env FDB_TPU_ACCEPT=seq python bench.py --mode ycsb || { sleep 60; continue; }
+  stage ab_rmq 1200 BENCH_r05_rmq.json "$TPU_ANY" -- \
+    env FDB_TPU_RMQ=blocked python bench.py --mode ycsb || { sleep 60; continue; }
+  stage ab_hist 1200 BENCH_r05_batchhist.json "$TPU_ANY" -- \
+    env FDB_TPU_HISTORY=batch python bench.py --mode ycsb || { sleep 60; continue; }
+  python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
+  rm -f /tmp/tpu_window_open
+  say "heal sequence COMPLETE — idle re-probe every 30 min"
+  sleep 1800
+done
