@@ -138,7 +138,12 @@ class Shell:
         if cmd == "get":
             (key,) = args
             async def go():
-                return await self.db.transaction().get(unescape(key))
+                # The standard retry loop (fdbcli runs its commands under
+                # onError the same way): a single blind attempt fails
+                # deterministically against proxies that are up but
+                # unrecruited (standby region, mid-recruitment).
+                return await self.db.run(
+                    lambda tr: tr.get(unescape(key)), max_retries=8)
             v = self._await(go())
             return (f"`{key}' is `{escape(v)}'" if v is not None
                     else f"`{key}': not found")
@@ -146,9 +151,10 @@ class Shell:
             begin, end = args[0], args[1]
             limit = int(args[2]) if len(args) > 2 else 25
             async def go():
-                return await self.db.transaction().get_range(
-                    unescape(begin), unescape(end), limit=limit
-                )
+                return await self.db.run(
+                    lambda tr: tr.get_range(
+                        unescape(begin), unescape(end), limit=limit),
+                    max_retries=8)
             rows = self._await(go())
             return "\n".join(
                 f"`{escape(k)}' is `{escape(v)}'" for k, v in rows
@@ -157,15 +163,15 @@ class Shell:
             if not self.writemode:
                 return ("ERROR: writemode must be enabled to set or clear "
                         "keys in the database (2112)")
-            async def go():
-                tr = self.db.transaction()
+            async def body(tr):
                 if cmd == "set":
                     tr.set(unescape(args[0]), unescape(args[1]))
                 elif cmd == "clear":
                     tr.clear(unescape(args[0]))
                 else:
                     tr.clear_range(unescape(args[0]), unescape(args[1]))
-                await tr.commit()
+            async def go():
+                await self.db.run(body, max_retries=8)
             self._await(go())
             return "Committed"
         if cmd in ("throttle", "unthrottle"):

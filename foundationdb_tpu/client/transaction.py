@@ -122,13 +122,21 @@ class Database:
         self.coordinator_eps = list(coordinator_eps or [])
         self.cluster = None  # open_database attaches; special-key reads use it
         self.epoch = 1
-        self._rr = 0
+        # Round-robin start is randomized per client: with a fixed start
+        # every fresh client hammers the same proxy first — and on a
+        # multi-region cluster eps[1] can be a standby-region proxy that
+        # serves nothing, making a non-retrying caller fail
+        # deterministically (deployed multi-region test find). Uses the
+        # loop's seeded rng: deterministic under simulation.
+        self._rr = loop.rng.randrange(1 << 16) if hasattr(loop, "rng") else 0
         self.transaction_class = Transaction  # ryw.open_database swaps in RYW
         # Failure monitoring (reference: the client's FailureMonitor):
         # storage endpoints that just failed are tried LAST for a TTL, so
         # one dead replica costs one detection delay total — not one per
         # read against its team.
         self._ep_failed_at: dict[int, float] = {}
+        # Same for proxies, keyed by endpoint address (see _pick).
+        self._proxy_failed_at: dict = {}
 
     async def refresh_client_info(self) -> None:
         """Re-fetch proxy endpoints from the cluster controller — how clients
@@ -174,6 +182,7 @@ class Database:
 
     MAX_SHARD_RETRIES = 5
     FAILED_EP_TTL = 4.0  # how long a failed replica is deprioritized
+    PROXY_FAILED_TTL = 5.0  # how long a failed proxy endpoint sits out
 
     def _order_team(self, team):
         """Team members with recently-failed replicas demoted to the end
@@ -263,12 +272,46 @@ class Database:
         raise ProcessKilled(f"no reachable storage replica for range {r.begin[:16]!r}")
 
     def _pick(self, eps: list):
+        """Round-robin over proxy endpoints, skipping recently-failed ones.
+
+        The demotion matters beyond plain failover: a retry loop calls
+        _pick twice per attempt (GRV then commit), so with a bare rotation
+        over 2 proxies the parity locks — GRV lands on the healthy proxy
+        every attempt and commit on the broken one, forever (deployed
+        multi-region find: the standby region's proxy is up but serves
+        nothing). Failed endpoints sit out PROXY_FAILED_TTL seconds."""
         if not eps:
             # No known endpoints (fresh client against a recovering
             # cluster): retryable — on_error refreshes the client info.
             raise ProcessKilled("no known proxy endpoints")
         self._rr += 1
-        return eps[self._rr % len(eps)]
+        now = self.loop.now
+        n = len(eps)
+        for j in range(n):
+            ep = eps[(self._rr + j) % n]
+            if (now - self._proxy_failed_at.get(self._ep_addr(ep), -1e9)
+                    >= self.PROXY_FAILED_TTL):
+                return ep
+        return eps[self._rr % n]  # everything demoted: plain rotation
+
+    @staticmethod
+    def _ep_addr(ep):
+        """Stable identity for a proxy endpoint (its peer address /
+        process): grv and commit endpoint objects for the same process
+        must share one demotion entry, and refreshed endpoint lists must
+        keep it. NOTE: both transports' endpoint classes synthesize RPC
+        stubs via __getattr__ for non-underscore names — only their REAL
+        attributes (`_addr`; sim `process`) are safe to probe."""
+        addr = ep.__dict__.get("_addr")  # deployed RemoteEndpoint
+        if addr is not None:
+            return addr
+        proc = ep.__dict__.get("process")  # sim Endpoint
+        if proc is not None:
+            return proc
+        return id(ep)
+
+    def note_proxy_failed(self, ep) -> None:
+        self._proxy_failed_at[self._ep_addr(ep)] = self.loop.now
 
     def transaction(self) -> "Transaction":
         return self.transaction_class(self)
@@ -363,16 +406,25 @@ class Transaction:
     async def get_read_version(self) -> int:
         self._check_timeout()
         if self._read_version is None:
+            ep = self.db._pick(self.db.grv_proxies)
             try:
-                self._read_version = await self.db._pick(
-                    self.db.grv_proxies
-                ).get_read_version(
+                self._read_version = await ep.get_read_version(
                     "default", sorted(self.tags) if self.tags else None
                 )
             except BrokenPromise as e:
                 # Dead/retired GRV proxy: retryable — on_error refreshes the
                 # proxy list from the controller before the next attempt.
+                self.db.note_proxy_failed(ep)
                 raise ProcessKilled(str(e)) from e
+            except FdbError as e:
+                if e.code == 1500 and str(e).startswith("no service"):
+                    # Proxy process up but serving no recruited role yet
+                    # (standby-region proxy, or mid-recruitment): same
+                    # recovery path as a dead proxy — demote + retry
+                    # rotates to a recruited one.
+                    self.db.note_proxy_failed(ep)
+                    raise ProcessKilled(str(e)) from e
+                raise
         return self._read_version
 
     def set_read_version(self, version: int) -> None:
@@ -694,8 +746,9 @@ class Transaction:
             lock_aware=self.lock_aware,
             token=self.authorization_token,
         )
+        commit_ep = self.db._pick(self.db.commit_proxies)
         try:
-            res = await self.db._pick(self.db.commit_proxies).commit(req)
+            res = await commit_ep.commit(req)
         except NotCommitted as e:
             # Stash the resolver's conflicting ranges for this attempt:
             # readable via \xff\xff/transaction/conflicting_keys/ until
@@ -706,7 +759,16 @@ class Transaction:
         except BrokenPromise as e:
             # Proxy died mid-commit: the batch may or may not have reached
             # the tlogs — exactly commit_unknown_result.
+            self.db.note_proxy_failed(commit_ep)
             raise CommitUnknownResult(str(e)) from e
+        except FdbError as e:
+            if e.code == 1500 and str(e).startswith("no service"):
+                # Unrecruited proxy (standby region / mid-recruitment):
+                # the commit never entered a batch, so this is a KNOWN
+                # non-commit — plain retryable, not unknown-result.
+                self.db.note_proxy_failed(commit_ep)
+                raise ProcessKilled(str(e)) from e
+            raise
         self._committed = (res.version, res.batch_order)
         self._arm_watches()
         return res.version

@@ -115,12 +115,21 @@ class TenantMapMirror:
     """Live tenant-map view for TENANT-BOUND token checks, shared by the
     commit proxies (check_commit) and the storage servers (check_read).
 
-    Refreshed from the owning storage team at its LATEST applied version
-    (version -1): pinning the read at any caller's own committed version
-    goes stale or fails outright on idle/freshly-recruited callers, and
-    would never see a tenant created through a peer proxy (review
-    finding). ``view`` is None until the first successful refresh —
-    tenant-bound tokens fail CLOSED in that window.
+    Refreshed from the owning storage team at its LATEST applied version:
+    pinning the read at any caller's own committed version goes stale or
+    fails outright on idle/freshly-recruited callers, and would never see
+    a tenant created through a peer proxy (review finding). ``view`` is
+    None until the first successful refresh — tenant-bound tokens fail
+    CLOSED in that window.
+
+    Consistency contract (same shape as the reference's proxy tenant-map
+    cache): BOUNDED staleness, version-MONOTONE. Enforcement may lag a
+    tenant delete by up to INTERVAL plus the least-lagged map replica's
+    apply lag, but once a view at version >= the delete's commit version
+    is adopted the tenant can never reappear (``_view_version`` gates
+    adopts). Tooling that needs a hard fence (e.g. the Authz workload's
+    negative probes) waits for ``_view_version`` to pass a GRV taken
+    after the delete.
     """
 
     INTERVAL = 0.5  # staleness bound on token invalidation
@@ -131,45 +140,72 @@ class TenantMapMirror:
         self._map = storage_map
         self._token = token  # system grant: the map lives in \xff
         self.view: dict[bytes, bytes] | None = None
+        # Version the current view reflects. Refreshes are MONOTONE: a
+        # replica-failover refresh that lands on a lagging replica must
+        # not regress the view — that resurrects deleted tenants into
+        # enforcement (campaign find: aggressive seed 5336 admitted a
+        # dead-tenant write after exactly that regression). A lower-
+        # versioned snapshot is dropped; the next interval retries.
+        self._view_version = -1
 
     async def run(self) -> None:
         end = strinc(TENANT_MAP_PREFIX)
         while True:
             team = self._map.team_for_key(TENANT_MAP_PREFIX)
-            for tag in team:
-                if tag >= len(self._eps):
-                    continue
+            # Ask EVERY team replica and adopt the freshest answer: under
+            # clog a single replica can lag the commit stream by longer
+            # than the refresh interval, and enforcement staleness is
+            # bounded by the LEAST-lagged replica only if we look at all
+            # of them (campaign find, aggressive seed 5336: a probe
+            # landed inside a lagging replica's [create, delete) window).
+            best = None
+            got_any = False
+            # All replicas probed CONCURRENTLY (the controller-sweep
+            # pattern): serial probing would add a dead/clogged replica's
+            # full failure-detection delay to every refresh round,
+            # inflating the very staleness bound this loop exists to
+            # keep tight.
+            probes = [
+                self.loop.spawn(
+                    self._eps[tag].system_snapshot(
+                        TENANT_MAP_PREFIX, end, token=self._token),
+                    name=f"tenant_mirror.probe{tag}")
+                for tag in team if tag < len(self._eps)
+            ]
+            for t in probes:
                 try:
-                    rows = await self._eps[tag].get_range(
-                        # limit far above any tenant count: the default
-                        # 10k would silently truncate the live view and
-                        # strand later tenants' tokens (review finding).
-                        TENANT_MAP_PREFIX, end, -1, limit=1 << 30,
-                        token=self._token,
-                    )
-                    self.view = {
-                        k[len(TENANT_MAP_PREFIX):]: v for k, v in rows
-                    }
-                    self._failures = 0
-                    break
+                    version, rows = await t
+                    got_any = True
+                    if best is None or version > best[0]:
+                        best = (version, rows)
                 except Exception:
-                    # Dead replica / mid-move: try the next, retry next
-                    # round. A PERSISTENT failure (e.g. authz on without
-                    # a system token — the mirror's own reads denied) is
-                    # surfaced instead of being eaten forever.
-                    self._failures = getattr(self, "_failures", 0) + 1
-                    if self._failures == 20:
-                        import sys as _sys
-
-                        print(
-                            "[tenant_mirror] WARNING: 20 consecutive "
-                            "refresh failures — tenant-bound tokens are "
-                            "failing closed. If authz is enabled the "
-                            "mirror needs the cluster system token "
-                            "(spec authz_system_token / SimCluster "
-                            "authz_system_token).",
-                            file=_sys.stderr, flush=True)
+                    # Dead replica / mid-move: the others still count. A
+                    # PERSISTENT all-replica failure (e.g. authz on
+                    # without a system token — the mirror's own reads
+                    # denied) is surfaced instead of being eaten forever.
                     continue
+            if best is not None and best[0] >= self._view_version:
+                # Monotone adopt: a refresh must never resurrect deleted
+                # tenants by regressing to an older replica's view.
+                self.view = {
+                    k[len(TENANT_MAP_PREFIX):]: v for k, v in best[1]
+                }
+                self._view_version = best[0]
+            if got_any:
+                self._failures = 0
+            else:
+                self._failures = getattr(self, "_failures", 0) + 1
+                if self._failures == 20:
+                    import sys as _sys
+
+                    print(
+                        "[tenant_mirror] WARNING: 20 consecutive "
+                        "refresh failures — tenant-bound tokens are "
+                        "failing closed. If authz is enabled the "
+                        "mirror needs the cluster system token "
+                        "(spec authz_system_token / SimCluster "
+                        "authz_system_token).",
+                        file=_sys.stderr, flush=True)
             await self.loop.sleep(self.INTERVAL)
 
 
@@ -290,7 +326,8 @@ class TokenAuthority:
         ``live_tenants`` (name → data prefix): the proxy's view of the
         live tenant map. A TENANT-BOUND token (mint_token tenant=) is
         denied unless its tenant exists there and still owns every token
-        prefix — delete/recreate invalidates outstanding tokens at once
+        prefix — delete/recreate invalidates outstanding tokens within
+        the mirror's bounded-staleness window, permanently once seen
         (reference: TokenSign tokens carry tenant ids checked against
         the tenant map). Fails CLOSED when the proxy has no view yet.
         """

@@ -772,6 +772,30 @@ class StorageServer:
         return self.map.at(key, version)
 
     @rpc
+    async def system_snapshot(
+        self, begin: bytes, end: bytes, token: str | None = None,
+    ) -> tuple[int, list[tuple[bytes, bytes]]]:
+        """Latest-applied system-keyspace read WITH the version it
+        reflects, for version-MONOTONE infrastructure mirrors (the
+        tenant map). A mirror failing over between replicas needs the
+        version to reject a LAGGING replica's older view — without it, a
+        refresh that lands on a behind replica resurrects deleted
+        tenants into enforcement (campaign find: aggressive seed 5336,
+        dead-tenant write admitted after the view regressed)."""
+        self._check_read_authz(begin, end, token)
+        if begin < b"\xff":
+            raise FdbError(
+                "system_snapshot is system-keyspace-only", code=2108)
+        version = self._version
+        self._check_serving(begin, end, version)
+        out: list[tuple[bytes, bytes]] = []
+        for k in self.map.range_keys(begin, end):
+            v = self.map.at(k, version)
+            if v is not None:
+                out.append((k, v))
+        return version, out
+
+    @rpc
     async def get_range(
         self,
         begin: bytes,

@@ -436,3 +436,14 @@ class TLog:
         assert self.locked, "recover_entries on an unlocked tlog"
         return (self._spilled_entries()
                 + [(e.version, e.tagged) for e in self._log])
+
+    @rpc
+    async def entries_snapshot(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
+        """recover_entries WITHOUT the lock precondition, for the one
+        caller that must not lock: the controller's bootstrap-resume path
+        seeds satellite tlogs from the resumed chain (a locked tlog can't
+        begin_epoch, and the new generation is about to serve from it).
+        Only atomic while nothing pushes — true in that window: chains
+        are resumed but no proxy generation is recruited yet."""
+        return (self._spilled_entries()
+                + [(e.version, e.tagged) for e in self._log])

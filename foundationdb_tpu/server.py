@@ -51,7 +51,7 @@ from foundationdb_tpu.core.errors import FutureVersion
 from foundationdb_tpu.runtime.shardmap import KeyShardMap, ring_teams
 
 ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy", "ratekeeper",
-         "controller")
+         "controller", "satellite_tlog")
 
 
 def load_spec(path: str) -> dict:
@@ -60,6 +60,7 @@ def load_spec(path: str) -> dict:
     for role in ("sequencer", "resolver", "tlog", "storage", "proxy"):
         if not spec.get(role):
             raise ValueError(f"cluster spec missing role {role!r}")
+    _validate_regions(spec)
     # Resolve key-material paths against the cluster file's directory at
     # LOAD time (the one choke point every entry point — server, cli,
     # dr_tool, tests — goes through), so consumers never depend on cwd.
@@ -69,6 +70,49 @@ def load_spec(path: str) -> dict:
             p = spec[k]
             spec[k] = p if os.path.isabs(p) else os.path.join(base, p)
     return spec
+
+
+REGION_CHAIN_ROLES = ("sequencer", "tlog", "resolver", "proxy")
+
+
+def _validate_regions(spec: dict) -> None:
+    """Multi-region deployed config (reference: DatabaseConfiguration
+    `regions` + satellite TLog policy). Spec shape:
+
+        "regions": {"pri": {role: [indices...]}, "rem": {...}},
+        "satellite_tlog": ["host:port", ...]   # >= 1 required
+
+    Chain-role indices must partition the role's address list between the
+    two regions (a process serves exactly one region); storage indices
+    must partition with EQUAL counts — shard j's team is (pri_storage[j],
+    rem_storage[j]), the cross-region pairing the sim uses. Managed mode
+    only (a controller drives region failover; static wiring can't)."""
+    regions = spec.get("regions")
+    if not regions:
+        return
+    if set(regions) != {"pri", "rem"}:
+        raise ValueError(
+            f"regions must be exactly {{'pri','rem'}}, got {sorted(regions)}")
+    if not spec.get("controller"):
+        raise ValueError("multi-region requires managed mode (a controller)")
+    if not spec.get("satellite_tlog"):
+        raise ValueError(
+            "multi-region requires >= 1 satellite_tlog (the synchronous "
+            "off-region stream copy that makes region failover lossless)")
+    for role in REGION_CHAIN_ROLES + ("storage",):
+        pri = list(regions["pri"].get(role, []))
+        rem = list(regions["rem"].get(role, []))
+        all_idx = sorted(pri + rem)
+        if all_idx != list(range(len(spec[role]))):
+            raise ValueError(
+                f"regions must partition {role} indices 0.."
+                f"{len(spec[role]) - 1}; got pri={pri} rem={rem}")
+        if not pri or not rem:
+            raise ValueError(f"each region needs >= 1 {role}")
+        if role == "storage" and len(pri) != len(rem):
+            raise ValueError(
+                "regions need EQUAL storage counts (shard j's team is "
+                f"(pri[j], rem[j])); got {len(pri)} vs {len(rem)}")
 
 
 def _make_tenant_mirror(loop, t, spec: dict, storage_map, spawn):
@@ -104,6 +148,15 @@ def storage_shard_map(spec: dict) -> "KeyShardMap":
     clients/routers fail over between team members. One definition used
     by every deployed consumer (server roles, worker recruitment, cli,
     dr_tool) — maps diverging across processes would corrupt routing."""
+    regions = spec.get("regions")
+    if regions:
+        # Cross-region teams: shard j lives on (pri storage j, rem
+        # storage j) — the sim's multi-region pairing (sim/cluster.py
+        # teams = [(i, n+i)]), generalized to arbitrary index layouts.
+        pri = list(regions["pri"]["storage"])
+        rem = list(regions["rem"]["storage"])
+        return KeyShardMap.uniform(
+            len(pri), teams=[(p, r) for p, r in zip(pri, rem)])
     n = len(spec["storage"])
     return KeyShardMap.uniform(
         n, teams=ring_teams(n, int(spec.get("replicas", 1))))
@@ -402,7 +455,8 @@ class Worker:
     async def recruit_proxy(self, epoch: int, tlog_addrs: list,
                             resolver_addrs: list,
                             backup_enabled: bool = False,
-                            locked: bool = False) -> int:
+                            locked: bool = False,
+                            seq_addr: "list | None" = None) -> int:
         """Rebuild this process's CommitProxy + GrvProxy against the new
         generation's LIVE tlog/resolver sets. Old actor loops are
         cancelled; the service names are re-pointed at the new objects, so
@@ -424,8 +478,10 @@ class Worker:
             for _req, p in old._queue:
                 p.fail(ProcessKilled("proxy retired by recovery"))
             old._queue = []
-        seq_ep = self.t.endpoint(parse_addr(self.spec["sequencer"][0]),
-                                 "sequencer")
+        seq_ep = self.t.endpoint(
+            tuple(seq_addr) if seq_addr
+            else parse_addr(self.spec["sequencer"][0]),
+            "sequencer")
         rk = self.spec.get("ratekeeper") or []
         rk_ep = (self.t.endpoint(parse_addr(rk[0]), "ratekeeper")
                  if rk else None)
@@ -538,6 +594,13 @@ class DeployedController:
         # back into the generation (review finding).
         self.excluded: set[tuple[str, int]] = set()
         self.desired_counts: dict[str, int] = {}
+        # Multi-region: which region hosts the transaction subsystem.
+        # PERSISTED (with the maintenance config): after a failover to
+        # "rem", a controller restart must resume rem's chain, not try to
+        # resurrect the dead primary's disks.
+        self.regions = spec.get("regions")
+        self.active_region = "pri" if self.regions else None
+        self._region_blackouts = 0  # consecutive all-dead probes of active
         self._load_maintenance()
 
     def _maintenance_path(self) -> str | None:
@@ -556,6 +619,8 @@ class DeployedController:
             self.desired_counts = {
                 r: int(n) for r, n in doc.get("configured", {}).items()
             }
+            if self.regions and doc.get("active_region") in self.regions:
+                self.active_region = doc["active_region"]
             # Sanitize a persisted config that (e.g. after a spec edit)
             # would empty a chain role: drop its exclusions, loudly.
             for role in ("tlog", "resolver", "proxy"):
@@ -580,6 +645,7 @@ class DeployedController:
             json.dump({
                 "excluded": sorted([r, i] for r, i in self.excluded),
                 "configured": dict(self.desired_counts),
+                "active_region": self.active_region,
             }, f)
             f.flush()
             os.fsync(f.fileno())
@@ -609,7 +675,7 @@ class DeployedController:
 
     @rpc
     async def get_status(self) -> dict:
-        return {
+        d = {
             "epoch": self.epoch,
             "recovery_version": self.recovery_version,
             "recoveries_completed": self.recoveries_completed,
@@ -620,6 +686,9 @@ class DeployedController:
             "excluded": sorted(f"{r}{i}" for r, i in self.excluded),
             "configured": dict(self.desired_counts),
         }
+        if self.regions:
+            d["active_region"] = self.active_region
+        return d
 
     @rpc
     async def set_excluded(self, role: str, index: int,
@@ -702,9 +771,8 @@ class DeployedController:
           restart — resume chains, truncate the unacked suffix, new epoch.
         - all fresh and blank: new cluster at epoch 1.
         """
-        n_tlogs = len(self.spec["tlog"])
-        live_tlogs, max_epoch = [], 0
-        for i in range(n_tlogs):
+        live_tlogs, live_sats, max_epoch = [], [], 0
+        for i in range(len(self.spec["tlog"])):
             try:
                 d = await self._worker("tlog", i).describe()
                 if d.get("epoch", 0) > 0:
@@ -712,12 +780,28 @@ class DeployedController:
                     max_epoch = max(max_epoch, d["epoch"])
             except Exception:
                 continue
-        if live_tlogs:
+        for i in range(len(self.spec.get("satellite_tlog") or [])):
+            try:
+                d = await self._worker("satellite_tlog", i).describe()
+                if d.get("epoch", 0) > 0:
+                    live_sats.append(i)
+                    max_epoch = max(max_epoch, d["epoch"])
+            except Exception:
+                continue
+        if live_tlogs or live_sats:
             # The recovery's next epoch derives from the OBSERVED live
             # generation — without a data dir it must still exceed it, or
             # the new generation would restart the version chain.
+            if self.regions and live_tlogs:
+                # A live chain names the active region authoritatively
+                # (stronger evidence than the persisted value, which a
+                # wiped controller data dir loses).
+                for r in ("pri", "rem"):
+                    if set(live_tlogs) & set(self.regions[r]["tlog"]):
+                        self.active_region = r
+                        break
             self.epoch = max_epoch
-            self.live = {"tlog": live_tlogs}
+            self.live = {"tlog": live_tlogs, "satellite_tlog": live_sats}
             await self._recover("controller restart over a live generation")
             return
         await self._bootstrap_resume()
@@ -727,9 +811,10 @@ class DeployedController:
         recruited tlog is live — callers check first (appends racing the
         end-version snapshot would be truncated as 'unacked')."""
         deadline = self.loop.now + self.BOOT_DEADLINE
-        n_tlogs = len(self.spec["tlog"])
+        chain = self._chain_tlog_idx()  # active region only: the standby's
+        # disks hold retired generations and must not vote on the chain end
         ends = []
-        for i in range(n_tlogs):
+        for i in chain:
             ep = self._worker("tlog", i)
             ends.append(await self._retry(ep.tlog_resume, deadline))
         minv, maxv = min(ends), max(ends)
@@ -744,7 +829,7 @@ class DeployedController:
             epoch = (_bump_epoch(self.data_dir, floor=self.epoch)
                      if self.data_dir
                      else self.epoch + 1 if self.epoch else 2)
-            for i in range(n_tlogs):
+            for i in chain:
                 await self._retry(
                     lambda i=i: self._tlog(i).truncate_to(minv - 1), deadline)
             await self._form_generation(
@@ -756,6 +841,25 @@ class DeployedController:
                 1, 0, live=self._all_live(), seed_entries=[], resume=True,
             )
 
+    def _region_idx(self, role: str) -> "list[int] | None":
+        """Active region's spec indices for a chain role (None when the
+        cluster is single-region). Storage is NOT region-filtered: both
+        regions' storages are always in the generation (the remote
+        replicas pull the stream cross-region — the DCN leg)."""
+        if not self.regions or role not in REGION_CHAIN_ROLES:
+            return None
+        return list(self.regions[self.active_region].get(role, []))
+
+    def _seq_idx(self) -> int:
+        """The generation's sequencer spec index (active region's)."""
+        r = self._region_idx("sequencer")
+        return r[0] if r else 0
+
+    def _standby_region(self) -> "str | None":
+        if not self.regions:
+            return None
+        return "rem" if self.active_region == "pri" else "pri"
+
     def _admitted(self, role: str, candidates: list[int]) -> list[int]:
         """Maintenance filter for chain roles: drop excluded processes,
         then take the first `desired_counts[role]` of what REMAINS — so
@@ -763,9 +867,18 @@ class DeployedController:
         tlog0 (review finding: counting by raw spec index made exclusion
         and configure impossible to compose). Safety valve: a config
         that would leave a chain role EMPTY (everything excluded) is
-        ignored rather than wedging recovery forever."""
+        ignored rather than wedging recovery forever.
+
+        Multi-region: chain roles recruit only in the ACTIVE region
+        (reference: the transaction subsystem lives in one DC; failover
+        moves it wholesale). Satellite tlogs and storage span regions."""
         if role == "storage":
             return candidates  # data-bearing: not excludable without DD
+        if role == "satellite_tlog":
+            return candidates  # always in the push set when present
+        region = self._region_idx(role)
+        if region is not None:
+            candidates = [i for i in candidates if i in region]
         out = [i for i in candidates if (role, i) not in self.excluded]
         n = self.desired_counts.get(role)
         if n is not None:
@@ -779,8 +892,11 @@ class DeployedController:
         return i in self._admitted(role, list(range(len(self.spec[role]))))
 
     def _all_live(self) -> dict:
+        roles = ["tlog", "resolver", "proxy", "storage"]
+        if self.spec.get("satellite_tlog"):
+            roles.append("satellite_tlog")
         return {r: self._admitted(r, list(range(len(self.spec[r]))))
-                for r in ("tlog", "resolver", "proxy", "storage")}
+                for r in roles}
 
     # -- generation formation ----------------------------------------------
 
@@ -793,6 +909,15 @@ class DeployedController:
         start = 0 if epoch == 1 else recovery_version + EPOCH_VERSION_JUMP
         tlog_addrs = self._addrs("tlog", live["tlog"])
         resolver_addrs = self._addrs("resolver", live["resolver"])
+        # Satellite tlogs are full replicas of the mutation stream IN the
+        # proxies' synchronous push set (every ack includes them — that's
+        # what makes region failover lossless), but NOT in the storage
+        # pull set (storages pull from the chain; sim/cluster.py keeps
+        # the same split).
+        sat_live = live.get("satellite_tlog", [])
+        sat_addrs = self._addrs("satellite_tlog", sat_live) if sat_live else []
+        seq_idx = self._seq_idx()
+        seq_addr = list(parse_addr(self.spec["sequencer"][seq_idx]))
 
         for i in live["resolver"]:
             await self._retry(
@@ -803,8 +928,21 @@ class DeployedController:
                 await self._retry(
                     lambda i=i: self._worker("tlog", i)
                     .recruit_tlog(epoch, start, seed_entries), deadline)
+        sat_seed = seed_entries
+        if resume and sat_live:
+            # Disk-resume bootstrap: the salvage seed is empty (the chain
+            # IS the data), but fresh satellites must still hold what
+            # lagging storages haven't applied — a region loss right
+            # after a full bounce would otherwise have no salvage source.
+            src = live["tlog"][0]
+            sat_seed = await self._retry(
+                lambda: self._tlog(src).entries_snapshot(), deadline)
+        for i in sat_live:
+            await self._retry(
+                lambda i=i: self._worker("satellite_tlog", i)
+                .recruit_tlog(epoch, start, sat_seed), deadline)
         seq_start = await self._retry(
-            lambda: self._worker("sequencer", 0)
+            lambda: self._worker("sequencer", seq_idx)
             .recruit_sequencer(epoch, recovery_version), deadline)
         assert seq_start == start
         if resume:
@@ -818,8 +956,9 @@ class DeployedController:
         for i in live["proxy"]:
             await self._retry(
                 lambda i=i: self._worker("proxy", i)
-                .recruit_proxy(epoch, tlog_addrs, resolver_addrs,
-                               self.backup_active, self.db_locked),
+                .recruit_proxy(epoch, tlog_addrs + sat_addrs, resolver_addrs,
+                               self.backup_active, self.db_locked,
+                               seq_addr=seq_addr),
                 deadline)
         for i in live["storage"]:
             await self._retry(
@@ -846,8 +985,9 @@ class DeployedController:
         are BACK (restarted by fdbmonitor) but not in the generation — a
         rejoin is folded in with a generation change, restoring full tlog
         replication."""
-        checks = [("sequencer", 0)]
-        for role in ("tlog", "resolver", "proxy", "storage"):
+        checks = [("sequencer", self._seq_idx())]
+        for role in ("tlog", "resolver", "proxy", "storage",
+                     "satellite_tlog"):
             checks.extend((role, i) for i in self.live.get(role, []))
         # All probes in flight at once: one sweep costs ONE RPC timeout
         # even with several dead/black-holed endpoints (mirrors the sim
@@ -884,8 +1024,9 @@ class DeployedController:
             return verdict
         missing = [
             (role, i)
-            for role in ("tlog", "resolver", "proxy", "storage")
-            for i in set(range(len(self.spec[role]))) - set(
+            for role in ("tlog", "resolver", "proxy", "storage",
+                         "satellite_tlog")
+            for i in set(range(len(self.spec.get(role) or []))) - set(
                 self.live.get(role, []))
             if self._admit(role, i)  # excluded processes must not rejoin
         ]
@@ -914,18 +1055,33 @@ class DeployedController:
         try:
             while True:
                 try:
-                    locked = []
-                    for i in self.live.get("tlog", []):
-                        try:
-                            locked.append((await self._tlog(i).lock(), i))
-                        except Exception:
-                            continue
+                    # Lock the generation's full push set: chain tlogs AND
+                    # satellite tlogs — on a region loss the satellites
+                    # are the only lockable members and carry every acked
+                    # commit (that is their whole purpose).
+                    locked: list[tuple[int, tuple[str, int]]] = []
+                    for role in ("tlog", "satellite_tlog"):
+                        for i in self.live.get(role, []):
+                            try:
+                                locked.append(
+                                    (await self._push_tlog(role, i).lock(),
+                                     (role, i)))
+                            except Exception:
+                                continue
+                    chain_locked = any(r == "tlog" for _, (r, _i) in locked)
+                    if chain_locked:
+                        # Debounce is per-incident: a lockable chain means
+                        # the region is NOT dark — stale counts from an
+                        # earlier blip must not let one future all-dark
+                        # probe trigger a cross-region move.
+                        self._region_blackouts = 0
                     if not locked:
-                        # No generation tlog reachable. If EVERY spec tlog
-                        # worker answers but fresh (epoch 0 — fdbmonitor
-                        # restarted them all, e.g. rack power loss), no
-                        # live chain exists to lock: fall back to the
-                        # durable disk-resume path instead of spinning.
+                        # No generation tlog reachable. If EVERY chain
+                        # tlog worker answers but fresh (epoch 0 —
+                        # fdbmonitor restarted them all, e.g. rack power
+                        # loss), no live chain exists to lock: fall back
+                        # to the durable disk-resume path instead of
+                        # spinning.
                         lock_failures += 1
                         if lock_failures >= 5 and await self._all_tlogs_fresh():
                             print("[controller] all tlogs restarted fresh — "
@@ -936,10 +1092,15 @@ class DeployedController:
                             return
                         await self.loop.sleep(self.RETRY_DELAY)
                         continue
-                    recovery_version, src = max(locked)
-                    seed = await self._tlog(src).recover_entries()
+                    if (self.regions and not chain_locked
+                            and await self._maybe_flip_region()):
+                        lock_failures = 0  # probe the new region's chain
+                    recovery_version, (src_role, src) = max(locked)
+                    seed = await self._push_tlog(
+                        src_role, src).recover_entries()
                     live = await self._probe_live()
-                    if (not live["sequencer"] or not live["tlog"]
+                    if (self._seq_idx() not in live["sequencer"]
+                            or not live["tlog"]
                             or not live["resolver"] or not live["proxy"]):
                         await self.loop.sleep(self.RETRY_DELAY)
                         continue
@@ -949,7 +1110,8 @@ class DeployedController:
                         epoch, recovery_version, live, seed, resume=False)
                     self.recoveries_completed += 1
                     print(f"[controller] recovered to epoch {epoch} "
-                          f"v{recovery_version} live={live}",
+                          f"v{recovery_version} live={live} "
+                          f"region={self.active_region}",
                           file=sys.stderr, flush=True)
                     return
                 except Exception as e:  # noqa: BLE001 — keep retrying
@@ -959,6 +1121,62 @@ class DeployedController:
                     await self.loop.sleep(self.RETRY_DELAY)
         finally:
             self._recovering = False
+
+    def _push_tlog(self, role: str, i: int):
+        """Endpoint of a push-set member (chain or satellite tlog)."""
+        return self.t.endpoint(parse_addr(self.spec[role][i]), "tlog")
+
+    async def _maybe_flip_region(self) -> bool:
+        """Region failover decision (reference: ClusterController bestDC /
+        region preference): flip to the standby when the ACTIVE region's
+        chain is completely unreachable — no sequencer, tlog, resolver or
+        proxy process answers — while the standby has a full chain up.
+        Gated on several consecutive all-dark probes so one slow sweep
+        can't move the transaction subsystem across regions; partial
+        liveness always heals IN region (the normal generation change).
+        Salvage correctness is the caller's concern: it only reaches here
+        when no chain tlog was lockable, and the satellites it DID lock
+        hold every acked commit."""
+        reachable: list = []
+        region = self.regions[self.active_region]
+        probes = [
+            (role, i, self.loop.spawn(self._worker(role, i).ping(),
+                                      name=f"flip.{role}{i}"))
+            for role in REGION_CHAIN_ROLES
+            for i in region.get(role, [])
+        ]
+        for role, i, t in probes:
+            try:
+                await t
+                reachable.append((role, i))
+            except Exception:
+                continue
+        if reachable:
+            self._region_blackouts = 0
+            return False
+        self._region_blackouts += 1
+        if self._region_blackouts < 3:
+            return False
+        standby = self._standby_region()
+        sb = self.regions[standby]
+        for role in REGION_CHAIN_ROLES:
+            alive = 0
+            for i in sb.get(role, []):
+                try:
+                    await self._worker(role, i).ping()
+                    alive += 1
+                    break
+                except Exception:
+                    continue
+            if not alive:
+                return False  # standby not viable either — keep waiting
+        print(f"[controller] REGION FAILOVER: {self.active_region} dark, "
+              f"moving transaction subsystem to {standby}",
+              file=sys.stderr, flush=True)
+        self.active_region = standby
+        self._region_blackouts = 0
+        self._save_maintenance()
+        return True
 
     async def _learn_db_flags(self) -> None:
         """Probe every spec proxy for its database flags before recruiting
@@ -977,9 +1195,16 @@ class DeployedController:
             self.backup_active = any(d["backup_enabled"] for d in answers)
             self.db_locked = any(d.get("locked") for d in answers)
 
+    def _chain_tlog_idx(self) -> list[int]:
+        """The active region's chain tlog spec indices (all, pre-
+        maintenance); every index in single-region clusters."""
+        r = self._region_idx("tlog")
+        return r if r is not None else list(range(len(self.spec["tlog"])))
+
     async def _all_tlogs_fresh(self) -> bool:
-        """Every spec tlog worker answers AND serves no recruited tlog."""
-        for i in range(len(self.spec["tlog"])):
+        """Every (active-region) chain tlog worker answers AND serves no
+        recruited tlog."""
+        for i in self._chain_tlog_idx():
             try:
                 d = await self._worker("tlog", i).describe()
             except Exception:
@@ -993,7 +1218,9 @@ class DeployedController:
         probed concurrently. Includes `sequencer`: [0] or [] — recovery
         cannot complete without the one sequencer process and waits for
         fdbmonitor to bring it back."""
-        roles = ("sequencer", "tlog", "resolver", "proxy", "storage")
+        roles = ["sequencer", "tlog", "resolver", "proxy", "storage"]
+        if self.spec.get("satellite_tlog"):
+            roles.append("satellite_tlog")
         tasks = [
             (role, i, self.loop.spawn(self._worker(role, i).ping(),
                                       name=f"probe.{role}{i}"))
@@ -1066,9 +1293,12 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             loop.spawn(cc.run(), name="controller.run")
 
         return loop.spawn(boot_controller(), name="controller.boot")
-    if managed and role in ("sequencer", "resolver", "tlog"):
+    if managed and role in ("sequencer", "resolver", "tlog",
+                            "satellite_tlog"):
         t.serve("worker", Worker(loop, t, spec, role, index, data_dir))
         return None
+    if role == "satellite_tlog":
+        raise ValueError("satellite_tlog requires managed mode (controller)")
     if managed and role == "proxy":
         t.serve("worker", Worker(loop, t, spec, role, index, data_dir))
         router = ReadRouter(storage_map, eps("storage"), loop=loop)

@@ -1,0 +1,250 @@
+"""Deployed multi-region: region failover over real TCP.
+
+The deployed counterpart of the sim's multi-region battery
+(tests/test_multi_region.py; reference: DatabaseConfiguration regions +
+satellite TLogs + ClusterController datacenter failover): a spec places
+every chain role in one of two regions with >= 1 satellite tlog in the
+synchronous push set; SIGKILL-ing the ENTIRE primary region must move
+the transaction subsystem to the standby region with zero acked-commit
+loss — the satellites are the salvage source — and the healed primary
+must be able to take the database back symmetrically.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.create_server(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_cli(spec_path: str, cmds: str):
+    return subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.cli",
+         "--cluster", spec_path, "--exec", cmds],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def cli_ok(spec_path: str, cmds: str, tries: int = 60):
+    last = None
+    for _ in range(tries):
+        last = run_cli(spec_path, cmds)
+        if last.returncode == 0 and "ERROR" not in last.stdout:
+            return last
+        time.sleep(1)
+    raise AssertionError(
+        f"cli never succeeded: {last.stdout!r} {last.stderr!r}")
+
+
+def controller_status(spec: dict) -> dict:
+    from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+    from foundationdb_tpu.server import parse_addr
+
+    loop = RealLoop()
+    t = NetTransport(loop)
+    try:
+        ep = t.endpoint(parse_addr(spec["controller"][0]), "controller")
+        return loop.run_until(ep.get_status(), timeout=10)
+    finally:
+        t._listener.close()
+
+
+def wait_status(spec: dict, pred, deadline_s: float = 120) -> dict:
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = controller_status(spec)
+            if pred(last):
+                return last
+        except Exception:
+            pass
+        time.sleep(1)
+    raise AssertionError(f"status predicate never held; last={last}")
+
+
+PRI = {"sequencer": [0], "resolver": [0], "tlog": [0, 1], "proxy": [0],
+       "storage": [0]}
+REM = {"sequencer": [1], "resolver": [1], "tlog": [2, 3], "proxy": [1],
+       "storage": [1]}
+ALL_ROLES = ("sequencer", "resolver", "tlog", "storage", "proxy",
+             "satellite_tlog")
+
+
+@pytest.fixture
+def multiregion(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mregion")
+    ports = iter(free_ports(14))
+    spec = {
+        "controller": [f"127.0.0.1:{next(ports)}"],
+        "sequencer": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "resolver": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(4)],
+        "storage": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(2)],
+        "satellite_tlog": [f"127.0.0.1:{next(ports)}"],
+        "regions": {"pri": PRI, "rem": REM},
+        "engine": "cpu",
+    }
+    spec_path = tmp / "cluster.json"
+    spec_path.write_text(json.dumps(spec))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs: dict[tuple, subprocess.Popen] = {}
+
+    def launch(role, i):
+        d = tmp / "data" / f"{role}{i}"
+        d.mkdir(parents=True, exist_ok=True)
+        errlog = open(tmp / f"{role}{i}.err.log", "ab")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.server",
+             "--cluster", str(spec_path), "--role", role,
+             "--index", str(i), "--data-dir", str(d)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=errlog, text=True,
+        )
+        errlog.close()
+        procs[(role, i)] = p
+        return p
+
+    for role in ALL_ROLES:
+        for i in range(len(spec[role])):
+            launch(role, i)
+    launch("controller", 0)
+
+    try:
+        for p in procs.values():
+            line = p.stdout.readline()
+            assert "ready" in line, line
+        yield spec, str(spec_path), procs, launch
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs.values():
+            p.wait()
+
+
+def kill_region(procs, region: dict) -> None:
+    for role, idxs in region.items():
+        for i in idxs:
+            p = procs[(role, i)]
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+            p.wait()
+
+
+class TestRegionFailover:
+    def test_primary_region_loss_is_lossless(self, multiregion):
+        spec, spec_path, procs, launch = multiregion
+        cli_ok(spec_path, "writemode on; set mr/a v1; set mr/b v2")
+        st = controller_status(spec)
+        assert st.get("active_region") == "pri"
+        assert st["generation"].get("satellite_tlog") == [0]
+
+        # The ENTIRE primary region goes dark — chain roles AND storage.
+        kill_region(procs, PRI)
+
+        st = wait_status(
+            spec, lambda s: s.get("active_region") == "rem"
+            and not s["recovering"])
+        assert st["generation"]["tlog"] == [2, 3]
+        # Every acked commit survived (satellite salvage + remote replica)
+        # and the database accepts writes in the new region.
+        out = cli_ok(spec_path,
+                     "writemode on; set mr/c v3; getrange mr/ mr0")
+        assert all(v in out.stdout for v in ("v1", "v2", "v3")), out.stdout
+
+    def test_failback_after_heal(self, multiregion):
+        spec, spec_path, procs, launch = multiregion
+        cli_ok(spec_path, "writemode on; set fb/a v1")
+        kill_region(procs, PRI)
+        wait_status(spec, lambda s: s.get("active_region") == "rem"
+                    and not s["recovering"])
+        cli_ok(spec_path, "writemode on; set fb/b v2")
+
+        # fdbmonitor restarts the primary region's processes; they rejoin
+        # as standby (storage replica catches up from the rem chain).
+        for role, idxs in PRI.items():
+            for i in idxs:
+                launch(role, i)
+                assert "ready" in procs[(role, i)].stdout.readline()
+        wait_status(
+            spec, lambda s: sorted(s["generation"].get("storage", []))
+            == [0, 1] and not s["recovering"])
+        cli_ok(spec_path, "writemode on; set fb/c v3")
+
+        # Now the REM region dies: the database must move back to pri —
+        # including commits that only ever existed in the rem generation.
+        kill_region(procs, REM)
+        wait_status(spec, lambda s: s.get("active_region") == "pri"
+                    and not s["recovering"])
+        out = cli_ok(spec_path,
+                     "writemode on; set fb/d v4; getrange fb/ fb0")
+        assert all(v in out.stdout for v in ("v1", "v2", "v3", "v4")), \
+            out.stdout
+
+
+class TestRegionSpecValidation:
+    def base(self) -> dict:
+        return {
+            "controller": ["h:1"],
+            "sequencer": ["h:2", "h:3"],
+            "resolver": ["h:4", "h:5"],
+            "tlog": ["h:6", "h:7", "h:8", "h:9"],
+            "storage": ["h:10", "h:11"],
+            "proxy": ["h:12", "h:13"],
+            "satellite_tlog": ["h:14"],
+            "regions": {"pri": dict(PRI), "rem": dict(REM)},
+        }
+
+    def check(self, spec) -> None:
+        from foundationdb_tpu.server import _validate_regions
+
+        _validate_regions(spec)
+
+    def test_valid_spec_passes(self):
+        self.check(self.base())
+
+    def test_requires_satellites(self):
+        spec = self.base()
+        spec["satellite_tlog"] = []
+        with pytest.raises(ValueError, match="satellite"):
+            self.check(spec)
+
+    def test_requires_controller(self):
+        spec = self.base()
+        spec["controller"] = []
+        with pytest.raises(ValueError, match="managed"):
+            self.check(spec)
+
+    def test_indices_must_partition(self):
+        spec = self.base()
+        spec["regions"]["rem"] = dict(spec["regions"]["rem"], tlog=[2])
+        with pytest.raises(ValueError, match="partition"):
+            self.check(spec)
+
+    def test_equal_storage_counts(self):
+        spec = self.base()
+        spec["storage"] = ["h:10", "h:11", "h:15"]
+        spec["regions"]["rem"] = dict(
+            spec["regions"]["rem"], storage=[1, 2])
+        with pytest.raises(ValueError, match="EQUAL storage"):
+            self.check(spec)
