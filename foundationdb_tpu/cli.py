@@ -27,6 +27,30 @@ from foundationdb_tpu.runtime.net import NetTransport, RealLoop
 from foundationdb_tpu.server import load_spec, parse_addr, storage_shard_map
 
 
+class _DeployedClientInfo:
+    """Adapter giving a deployed Database the sim client's
+    refresh_client_info surface: the controller's get_client_info RPC
+    returns generation proxy ADDRESSES (endpoints don't cross the wire);
+    this turns them into live endpoint objects on the client's own
+    transport."""
+
+    def __init__(self, t: NetTransport, ctrl_ep):
+        self._t = t
+        self._ep = ctrl_ep
+
+    async def get_client_info(self):
+        from types import SimpleNamespace
+
+        d = await self._ep.get_client_info()
+        return SimpleNamespace(
+            epoch=d["epoch"],
+            grv_proxy_eps=[self._t.endpoint(tuple(a), "grv_proxy")
+                           for a in d["proxy_addrs"]],
+            commit_proxy_eps=[self._t.endpoint(tuple(a), "commit_proxy")
+                              for a in d["proxy_addrs"]],
+        )
+
+
 def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
                  t: "NetTransport | None" = None):
     """Connect to a deployed cluster: returns (loop, transport, db).
@@ -43,12 +67,17 @@ def open_cluster(spec_path: str, loop: "RealLoop | None" = None,
         return [t.endpoint(parse_addr(a), service or role)
                 for a in spec[role]]
 
+    ctrl = None
+    if spec.get("controller"):
+        ctrl = _DeployedClientInfo(
+            t, t.endpoint(parse_addr(spec["controller"][0]), "controller"))
     db = Database(
         loop,
         [t.endpoint(parse_addr(a), "grv_proxy") for a in spec["proxy"]],
         [t.endpoint(parse_addr(a), "commit_proxy") for a in spec["proxy"]],
         storage_shard_map(spec),
         eps("storage"),
+        controller_ep=ctrl,
     )
     db.transaction_class = RYWTransaction
     return loop, t, db

@@ -53,8 +53,13 @@ async def get_estimated_range_size_bytes(tr, begin: bytes, end: bytes) -> int:
     Sums each covered shard's primary-replica byte stats."""
     db = tr.db
     await db.refresh_client_info()
+    # Estimate at the transaction's read version: shard_stats waits for
+    # the storage apply loop (known-committed fence) to reach it, so the
+    # caller's own committed writes are counted.
+    version = await tr.get_read_version()
     total = 0
     for sub, tag in db.storage_map.split_range(KeyRange(begin, end)):
-        stats = await db.storage_eps[tag].shard_stats(sub.begin, sub.end)
+        stats = await db.storage_eps[tag].shard_stats(
+            sub.begin, sub.end, version)
         total += int(stats.get("bytes", 0))
     return total

@@ -41,6 +41,7 @@ STATUS_JSON_KEY = b"\xff\xff/status/json"
 CONFLICTING_KEYS_PREFIX = b"\xff\xff/transaction/conflicting_keys/"
 WORKER_INTERFACES_PREFIX = b"\xff\xff/worker_interfaces/"
 from foundationdb_tpu.core.errors import (
+    FutureVersion,
     KeyOutsideLegalRange,
     KeyTooLarge,
     NotCommitted,
@@ -202,6 +203,7 @@ class Database:
         for _ in range(self.MAX_SHARD_RETRIES):
             team = self.storage_map.team_for_key(key)
             wrong_shard = False
+            last_future = None
             for tag in self._order_team(team):
                 try:
                     return await self.storage_eps[tag].get(
@@ -209,9 +211,19 @@ class Database:
                 except BrokenPromise:
                     self._ep_failed_at[tag] = self.loop.now
                     continue  # dead/partitioned replica: try the next
+                except FutureVersion as e:
+                    # Replica behind the read version (pull lag, or a
+                    # partitioned region's fenced replica that can NEVER
+                    # reach a successor-generation version): demote it
+                    # and try a caught-up team member before giving up.
+                    self._ep_failed_at[tag] = self.loop.now
+                    last_future = e
+                    continue
                 except WrongShardServer:
                     wrong_shard = True
                     break
+            if last_future is not None and not wrong_shard:
+                raise last_future
             self.refresh_shard_map()
             if not wrong_shard:
                 # Whole team unreachable: brief pause, maybe a recovery or
@@ -255,6 +267,7 @@ class Database:
         token: str | None = None,
     ) -> list[tuple[bytes, bytes]]:
         last_wrong: Exception | None = None
+        last_future: Exception | None = None
         for tag in self._order_team(team):
             try:
                 return await self.storage_eps[tag].get_range(
@@ -264,11 +277,19 @@ class Database:
             except BrokenPromise:
                 self._ep_failed_at[tag] = self.loop.now
                 continue
+            except FutureVersion as e:
+                # Lagging/fenced replica (see get()): demote, try the
+                # rest of the team at this version.
+                self._ep_failed_at[tag] = self.loop.now
+                last_future = e
+                continue
             except WrongShardServer as e:
                 last_wrong = e
                 continue
         if last_wrong is not None:
             raise last_wrong
+        if last_future is not None:
+            raise last_future
         raise ProcessKilled(f"no reachable storage replica for range {r.begin[:16]!r}")
 
     def _pick(self, eps: list):

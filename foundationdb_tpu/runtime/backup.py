@@ -157,15 +157,22 @@ class BackupWorker:
         while not self._stop:
             tlog = self.cluster.tlog_eps[0]
             try:
-                entries, end_version, _kc = await tlog.peek(
+                entries, end_version, kc = await tlog.peek(
                     BACKUP_TAG, self._version + 1
                 )
+                # Same known-committed fence as the storage pull loop: an
+                # unacked suffix (worst case: a partitioned zombie
+                # generation's fork) must never enter the backup stream —
+                # a restore would replay commits that the surviving
+                # timeline rejected.
                 for version, mutations in entries:
+                    if version > kc:
+                        break
                     if version > self._version:
                         self.container.add_log(version, mutations)
                         self._version = version
-                if end_version > self._version:
-                    self._version = end_version
+                if min(end_version, kc) > self._version:
+                    self._version = min(end_version, kc)
                 self.container.log_covered = max(
                     self.container.log_covered, self._version
                 )

@@ -295,7 +295,12 @@ class CommitProxy:
                 [
                     self.loop.spawn(
                         self._with_retry(
-                            lambda t=t: t.push(prev_version, version, tagged, kc)
+                            # epoch stamps the push for the tlog's
+                            # generation fence: a retired proxy's push
+                            # must FAIL at a newer generation's tlog,
+                            # never false-ack as a duplicate.
+                            lambda t=t: t.push(prev_version, version, tagged,
+                                               kc, epoch=self.epoch)
                         ),
                         name=f"tlog_push@{version}",
                     )

@@ -115,6 +115,16 @@ class DRAgent:
             return base
         await self.backup.start()
         await self.backup.snapshot()
+        # The log worker's covered cursor trails at the known-committed
+        # bound (it must — an unacked suffix can never enter the backup
+        # stream), so the container may lag the snapshot cut by one
+        # in-flight batch. The idle push cadence lifts it within an
+        # interval or two.
+        loop = self.src_cluster.loop
+        deadline = loop.now + 30
+        while (self.backup.container.restorable_version() is None
+               and loop.now < deadline):
+            await loop.sleep(0.05)
         if self.lock_secondary:
             await set_database_lock(self.dst_db, True)
         base = await restore(self._dst_run_facade(), self.backup.container)
@@ -209,8 +219,16 @@ class DRAgent:
         """Versions the secondary trails the pulled stream end (the old
         lag definition — still useful to split 'puller stalled' from
         'applier behind': total lag >> pulled_lag ⇒ the puller is the
-        laggard)."""
-        return max(0, self.backup.container.log_end_version - self.applied)
+        laggard). Uses the same applied-through rule as lag(): with no
+        pending log entries the applier IS caught up with the stream —
+        idle coverage (versions with no mutations to apply) must not
+        read as applier lag, or this reports up to a whole idle interval
+        of phantom backlog."""
+        cont = self.backup.container
+        pending = any(v > self.applied for v, _ in cont.log)
+        through = self.applied if pending else max(self.applied,
+                                                   cont.log_covered)
+        return max(0, cont.log_end_version - through)
 
     # -- internals ---------------------------------------------------------
 

@@ -26,10 +26,24 @@ class GrvProxy:
 
     MAX_TAG_TOKENS = 100.0
 
-    def __init__(self, loop: Loop, sequencer_ep, ratekeeper_ep=None):
+    def __init__(self, loop: Loop, sequencer_ep, ratekeeper_ep=None,
+                 tlog_eps: list | None = None, epoch: int = 0):
         self.loop = loop
         self.sequencer = sequencer_ep
         self.ratekeeper = ratekeeper_ep
+        # Epoch-liveness confirmation set (reference: confirmEpochLive).
+        # When given, every GRV batch confirms the generation's WHOLE
+        # push set (chain + satellite tlogs — the same all-members rule
+        # commits ack against) before replying: a read version is only
+        # externally consistent if this generation could still commit at
+        # mint time. A displaced generation's proxy (its tlogs locked or
+        # epoch-fenced by recovery, its satellite unreachable across a
+        # partition) must hand out NO read versions — otherwise a client
+        # reads pre-fork state after another client's commit acked in
+        # the successor generation. None = unconfirmed mode (static
+        # wiring / unit harnesses without a recruitment protocol).
+        self.tlogs = tlog_eps
+        self.epoch = epoch
         # Queue entries: (promise, txn tags) — tags from the TAG
         # transaction option (reference: TagThrottle at the GRV proxy).
         self._queue: list[tuple[Promise, tuple[str, ...]]] = []
@@ -122,6 +136,7 @@ class GrvProxy:
                 continue
             try:
                 version = await self.sequencer.get_live_committed_version()
+                await self._confirm_epoch_live()
             except Exception as e:
                 for p in batch:
                     p.fail(e)
@@ -129,6 +144,31 @@ class GrvProxy:
             self.grvs_served += len(batch)
             for p in batch:
                 p.send(version)
+
+    async def _confirm_epoch_live(self) -> None:
+        """One parallel confirm round per GRV batch (the reference's
+        amortization: confirmEpochLive per batch, not per request). ALL
+        members must answer — commit acks require all, so liveness does
+        too; any locked/fenced/unreachable member means this generation
+        can no longer commit and must stop minting read versions."""
+        if not self.tlogs:
+            return
+        tasks = [
+            self.loop.spawn(t.confirm_epoch(self.epoch),
+                            name="grv.confirm_epoch")
+            for t in self.tlogs
+        ]
+        failed = None
+        for t in tasks:
+            try:
+                await t
+            except Exception as e:
+                failed = e
+        if failed is not None:
+            from foundationdb_tpu.core.errors import ProcessKilled
+
+            raise ProcessKilled(
+                f"grv epoch {self.epoch} unconfirmed: {failed}") from failed
 
     async def _rate_poller(self) -> None:
         if self.ratekeeper is None:

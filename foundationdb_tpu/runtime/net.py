@@ -58,12 +58,19 @@ def rpc_methods(obj: object) -> frozenset[str]:
 
 
 class RealLoop(Loop):
-    """flow.Loop over wall-clock time + socket readiness."""
+    """flow.Loop over wall-clock time + socket readiness.
+
+    The rng is ENTROPY-seeded by default: determinism across processes is
+    a sim property (SimLoop), and a real deployment needs the opposite —
+    with a fixed seed every fresh client draws the SAME randomized
+    round-robin start, so e.g. every CLI process parity-locks its commits
+    onto the same (possibly zombie) proxy forever (deployed multi-region
+    partition find)."""
 
     MAX_IDLE_WAIT = 0.05  # bound each select() so new work is noticed
     WALL_TIME = True  # `now` is monotonic; tracers add epoch WallTime stamps
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: "int | None" = None):
         super().__init__(seed=seed, start_time=time.monotonic())
         self.selector = selectors.DefaultSelector()
 
@@ -379,6 +386,13 @@ class NetTransport:
                 f"{type(obj).__name__} and no explicit allowlist given"
             )
         self._services[name] = (obj, allow)
+
+    def unserve(self, name: str) -> None:
+        """Withdraw a service: later calls fail with "no service" (1500) —
+        how a stood-down role (a retired generation's proxy/tlog on a
+        rejoined region) tells clients to look elsewhere; their retry
+        loops demote the endpoint and rotate on."""
+        self._services.pop(name, None)
 
     def _accept(self, _sock) -> None:
         try:

@@ -253,11 +253,23 @@ class StorageServer:
                 if gen != self._tlog_gen:
                     continue  # stale reply from a pre-recovery tlog: discard
                 self.known_committed = max(self.known_committed, known_committed)
+                # Apply ONLY the known-committed prefix. Anything beyond
+                # it is an unacked suffix: normally just one in-flight
+                # batch (the next peek delivers it once its ack lands),
+                # but after a region partition it is a ZOMBIE generation's
+                # divergent timeline — pri proxies keep appending to their
+                # local tlogs while the locked satellites fence every ack,
+                # so kc freezes exactly at the fork point and this cap is
+                # what keeps the fork out of storage state
+                # (tests/test_deployed_multiregion.py TestRegionPartition).
+                cap = self.known_committed
                 before = self._version
                 for version, mutations in entries:
+                    if version > cap:
+                        break
                     self._apply(version, mutations)
-                if end_version > self._version:
-                    self._advance(end_version)  # mutation-free versions (idle tag)
+                if min(end_version, cap) > self._version:
+                    self._advance(min(end_version, cap))  # idle-tag versions
                 if self._version > before:
                     # Pop on every advance (not just on mutations) so cold
                     # tags still raise the tlog's trim floor — without this a
@@ -280,9 +292,21 @@ class StorageServer:
                             await rep.pop(self.tag, pop_v)
                         except BrokenPromise:
                             pass  # dead replica: recovery will retire it
+                        except FdbError as e:
+                            if e.code != 1500:
+                                raise
+                            # stood-down replica: retired, nothing to trim
             except BrokenPromise:
                 # Only unreachability is survivable; apply-path errors are
                 # real bugs and must crash the actor, not spin silently.
+                await self.loop.sleep(self.TLOG_RETRY)
+                continue
+            except FdbError as e:
+                if e.code != 1500:
+                    raise
+                # "no service": the tlog worker stood its retired log down
+                # (zombie retirement) before recovery re-pointed us — same
+                # park-and-wait as unreachability, per unserve's contract.
                 await self.loop.sleep(self.TLOG_RETRY)
                 continue
             if self.loop.now - last_gc >= self.GC_INTERVAL:
@@ -711,9 +735,16 @@ class StorageServer:
             )
 
     @rpc
-    async def shard_stats(self, begin: bytes, end: bytes) -> dict:
+    async def shard_stats(self, begin: bytes, end: bytes,
+                          version: int | None = None) -> dict:
         """DataDistributor inputs: byte size + a median split key
-        (reference: StorageMetrics / splitMetrics)."""
+        (reference: StorageMetrics / splitMetrics). `version`: wait for
+        the apply loop to reach it first — client-facing size estimates
+        must see the caller's own committed writes, which the pull
+        loop's known-committed fence holds back for one push interval.
+        DD's balance sampling passes None (best-effort latest)."""
+        if version is not None:
+            await self._check_version(version)
         total, n = 0, 0
         sizes: list[tuple[bytes, int]] = []
         for k in self.map.range_keys(begin, end):

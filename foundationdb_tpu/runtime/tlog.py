@@ -57,6 +57,7 @@ class TLog:
         retired_tags: set[int] | None = None,
         disk_path: str | None = None,
         disk_preserved: bool = False,
+        epoch: int = 0,
     ):
         """`seed`: prior-generation entries salvaged by recovery (versions
         all < init_version); storage servers finish pulling them from this
@@ -118,11 +119,27 @@ class TLog:
             t for e in self._log for t in e.tagged if t not in self._retired
         }
         self.locked = False
+        # Generation fence (reference: the epoch/recovery-count every
+        # TLogCommitRequest carries): pushes stamped with a DIFFERENT
+        # epoch are rejected outright. 0 = unfenced (static wiring /
+        # direct drivers). Without this, a partitioned old generation's
+        # proxy can get its push FALSE-ACKED by a new generation's tlog
+        # through the duplicate-retransmit path — the fresh chain's
+        # _last_appended sits an epoch-jump ahead, so any stale version
+        # reads as "already durable" — and a client receives an ack for
+        # a write that exists only on the doomed region's logs (deployed
+        # multi-region partition find).
+        self.epoch = epoch
         # Highest version the pushing proxies know is durable on EVERY tlog
         # (reference: knownCommittedVersion in TLogCommitRequest). Storage
-        # reads this off peek replies to bound its MVCC GC floor: anything
-        # above it may be an unacked suffix recovery could roll back.
-        self.known_committed = 0
+        # reads this off peek replies and applies ONLY up to it: anything
+        # above may be an unacked suffix — in the worst case a partitioned
+        # zombie generation's divergent timeline (deployed multi-region
+        # find: pri proxies kept appending locally while fenced by the
+        # locked satellites; a pri storage applied that fork). Seeded
+        # entries are salvage — acked by construction — so they start
+        # the bound.
+        self.known_committed = self._last_appended
 
     @classmethod
     def from_disk(cls, loop: Loop, disk_path: str,
@@ -173,6 +190,9 @@ class TLog:
             self._log = kept
             self._last_appended = kept[-1].version if kept else 0
             self._version = min(self._version, version + 1)
+            # The truncated suffix is unacked by definition; the
+            # committed bound must not point into it.
+            self.known_committed = min(self.known_committed, version)
             if self.disk is not None:
                 # Spilled entries are all BELOW the in-memory window, so
                 # truncation (which drops a suffix) keeps them whole.
@@ -204,12 +224,19 @@ class TLog:
         prev_version: int,
         version: int,
         tagged: dict[int, list[Mutation]],
-        known_committed: int = 0,
+        known_committed: "int | None" = None,
+        epoch: "int | None" = None,
     ) -> int:
         """Append one batch; ack (returning the durable version) after fsync.
 
         Idempotent under retransmit: a push whose version is already in the
-        chain (its ack was lost to a partition) re-acks without re-appending."""
+        chain (its ack was lost to a partition) re-acks without re-appending.
+        The duplicate re-ack is gated on the epoch fence below: only the
+        SAME generation's retransmits qualify — a stale generation's push
+        must fail, never false-ack (see self.epoch)."""
+        if epoch is not None and self.epoch and epoch != self.epoch:
+            raise TLogLocked(
+                f"push from epoch {epoch} fenced by epoch {self.epoch} tlog")
         while self._version != prev_version and not self.locked:
             if version <= self._last_appended:
                 return version  # duplicate of an already-durable batch
@@ -236,7 +263,15 @@ class TLog:
         self._tags_seen.update(t for t in tagged if t not in self._retired)
         self._version = version
         self._last_appended = version
-        self.known_committed = max(self.known_committed, known_committed)
+        # None = direct driver (unit tests / single-writer harnesses)
+        # without an ack protocol: treat its pushes as committed. Real
+        # proxies ALWAYS pass their known-committed bound — that is the
+        # fence that keeps a partitioned generation's unacked appends
+        # out of storage state.
+        self.known_committed = max(
+            self.known_committed,
+            version if known_committed is None else known_committed,
+        )
         self._maybe_spill()
         w = self._waiters.pop(version, None)
         if w is not None:
@@ -398,6 +433,26 @@ class TLog:
 
     @rpc
     async def get_version(self) -> int:
+        return self._version
+
+    @rpc
+    async def confirm_epoch(self, epoch: int) -> int:
+        """GRV liveness confirmation (reference: confirmEpochLive — the
+        master pings its tlog set before read versions are handed out).
+        A read version is only externally consistent if the generation
+        that mints it could still COMMIT at mint time — i.e. its whole
+        push set is reachable, unlocked, and un-displaced. A partitioned
+        region's chain fails here (its satellite is locked/fenced by the
+        new generation), so its zombie proxies can serve NO read version
+        — closing the stale-read window where a client reads pre-fork
+        state after another client's commit landed in the new region
+        (deployed multi-region partition find). Epoch 0 = unfenced
+        caller/log (static wiring), matching the push fence."""
+        if self.locked:
+            raise TLogLocked("confirm_epoch after lock")
+        if epoch and self.epoch and epoch != self.epoch:
+            raise TLogLocked(
+                f"epoch {epoch} displaced by epoch {self.epoch}")
         return self._version
 
     @rpc

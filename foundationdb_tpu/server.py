@@ -359,6 +359,66 @@ class Worker:
             d["locked"] = cp.locked
         return d
 
+    @rpc
+    async def stand_down(self, expect_epoch: int) -> bool:
+        """Retire this process's recruited chain role (reference: a
+        displaced tlog/proxy halts when it learns a newer generation owns
+        the database — worker_removed). The controller's sweep calls this
+        on ZOMBIES: processes serving an epoch older than the current
+        generation that are not in it — after a region partition heals,
+        the dark side's proxies are still alive and ANSWERING commits
+        (every one failing at the fenced satellite), and a client that
+        keeps rotating onto them burns its whole retry budget (deployed
+        multi-region partition find). Standing down turns them into
+        "no service" answers, which clients demote and route around.
+
+        `expect_epoch` is the stale epoch the sweep OBSERVED — if a
+        recovery recruited this worker in between, the epoch moved and
+        this call must be a no-op (the race guard)."""
+        if expect_epoch == 0 or self.epoch != expect_epoch:
+            return False
+        from foundationdb_tpu.core.errors import ProcessKilled
+
+        self._cancel_runs()
+        if self.role == "proxy":
+            cp = getattr(self, "_commit_proxy", None)
+            if cp is not None:
+                for _req, p in cp._queue:
+                    p.fail(ProcessKilled("proxy stood down: generation retired"))
+                cp._queue = []
+                self._commit_proxy = None
+            # GRV requests parked in the batch queues hang forever once
+            # their consumer loop is cancelled — fail them retryably too
+            # (review finding: the commit queue got this, GRV didn't).
+            self._fail_grv_queue("proxy stood down: generation retired")
+            self.t.unserve("commit_proxy")
+            self.t.unserve("grv_proxy")
+        elif self.role in ("tlog", "satellite_tlog"):
+            self._tlog = None
+            self.t.unserve("tlog")
+        elif self.role == "sequencer":
+            self.t.unserve("sequencer")
+        elif self.role == "resolver":
+            self.t.unserve("resolver")
+        self.epoch = 0  # fresh: recruitable into a future generation
+        return True
+
+    def _fail_grv_queue(self, reason: str) -> None:
+        """Fail every queued get_read_version promise retryably: their
+        consumer (grv.run) is cancelled on retire/stand-down, so a parked
+        request would otherwise hang its client forever over a healthy
+        connection."""
+        from foundationdb_tpu.core.errors import ProcessKilled
+
+        g = getattr(self, "_grv_proxy", None)
+        if g is None:
+            return
+        for q in (g._queue, g._batch_queue):
+            for p, _tags in q:
+                p.fail(ProcessKilled(reason))
+            q.clear()
+        self._grv_proxy = None
+
     # -- role recruitment (controller-only callers) -----------------------
 
     def _cancel_runs(self) -> None:
@@ -396,6 +456,7 @@ class Worker:
         start (a no-op for a fresh epoch-1 chain) and the epoch stamp the
         controller's sweep checks."""
         await self._tlog.begin_epoch(start_version)
+        self._tlog.epoch = epoch  # arm the generation fence on the chain
         self.epoch = epoch
         return start_version
 
@@ -422,7 +483,8 @@ class Worker:
         disk = (os.path.join(self.data_dir, f"tlog{self.index}.e{epoch}.q")
                 if self.data_dir else None)
         tlog = TLog(self.loop, init_version=start_version,
-                    seed=[(v, t) for v, t in seed_entries], disk_path=disk)
+                    seed=[(v, t) for v, t in seed_entries], disk_path=disk,
+                    epoch=epoch)
         self._tlog = tlog
         self.t.serve("tlog", tlog)
         self.epoch = epoch
@@ -478,6 +540,7 @@ class Worker:
             for _req, p in old._queue:
                 p.fail(ProcessKilled("proxy retired by recovery"))
             old._queue = []
+        self._fail_grv_queue("proxy retired by recovery")
         seq_ep = self.t.endpoint(
             tuple(seq_addr) if seq_addr
             else parse_addr(self.spec["sequencer"][0]),
@@ -503,7 +566,11 @@ class Worker:
         proxy.backup_enabled = backup_enabled
         proxy.locked = locked
         self._commit_proxy = proxy
-        grv = GrvProxy(self.loop, seq_ep, rk_ep)
+        # tlog_addrs already includes the satellites (the controller
+        # passes the full push set) — exactly the confirmEpochLive set.
+        grv = GrvProxy(self.loop, seq_ep, rk_ep, tlog_eps=tlog_eps,
+                       epoch=epoch)
+        self._grv_proxy = grv
         self.t.serve("commit_proxy", proxy)
         self.t.serve("grv_proxy", grv)
         self._spawn(f"proxy{self.index}.run", proxy.run)
@@ -689,6 +756,21 @@ class DeployedController:
         if self.regions:
             d["active_region"] = self.active_region
         return d
+
+    @rpc
+    async def get_client_info(self) -> dict:
+        """The deployed ClientDBInfo (reference: clients monitor the
+        cluster controller's ClientDBInfo and swap proxy connections on
+        generation change). Returns the CURRENT generation's proxy
+        addresses; clients refresh on commit_unknown/process-killed
+        errors and stop routing to retired proxies — without this, a
+        deployed client only ever knows the static spec list and can
+        keep handing commits to a zombie region's proxy (deployed
+        multi-region partition find)."""
+        return {
+            "epoch": self.epoch,
+            "proxy_addrs": self._addrs("proxy", self.live.get("proxy", [])),
+        }
 
     @rpc
     async def set_excluded(self, role: str, index: int,
@@ -1041,7 +1123,47 @@ class DeployedController:
             except Exception:
                 continue
             verdict = verdict or f"{role}{i} rejoined"
+        if verdict is None:
+            # Healthy sweeps only: a failed sweep is about to run a
+            # recovery — the next quiet sweep mops zombies up.
+            await self._stand_down_zombies()
         return verdict
+
+    async def _stand_down_zombies(self) -> None:
+        """Retire chain roles still serving a RETIRED epoch outside the
+        generation (reference: displaced roles halt via worker_removed).
+        Exists for the region-partition case: the dark region's whole
+        chain keeps running — its proxies answer commits that can only
+        fail at the fenced satellite — and nothing else ever tells it
+        the database moved (region-filtered recruitment never touches
+        it until failback). Also mops up an excluded proxy/tlog after
+        its generation retires."""
+        members = {
+            "sequencer": {self._seq_idx()},
+            "tlog": set(self.live.get("tlog", [])),
+            "resolver": set(self.live.get("resolver", [])),
+            "proxy": set(self.live.get("proxy", [])),
+            "satellite_tlog": set(self.live.get("satellite_tlog", [])),
+        }
+        probes = [
+            (role, i, self.loop.spawn(self._worker(role, i).describe(),
+                                      name=f"zombie.{role}{i}"))
+            for role, mem in members.items()
+            for i in set(range(len(self.spec.get(role) or []))) - mem
+        ]
+        for role, i, t in probes:
+            try:
+                d = await t
+            except Exception:
+                continue
+            stale = d.get("epoch", 0)
+            if 0 < stale < self.epoch:
+                try:
+                    if await self._worker(role, i).stand_down(stale):
+                        print(f"[controller] stood down zombie {role}{i} "
+                              f"(epoch {stale})", file=sys.stderr, flush=True)
+                except Exception:
+                    continue  # unreachable again: next sweep retries
 
     async def _recover(self, reason: str) -> None:
         """Lock → salvage → recruit (runtime/recovery.py's state machine,
@@ -1439,7 +1561,10 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                 loop, t, spec, storage_map,
                 lambda name, mk: _supervise(loop, name, mk)),
         )
-        grv = GrvProxy(loop, seq_ep, rk_ep)
+        # Static wiring: epoch 0 = unfenced (no recruitment protocol),
+        # but the confirm round still refuses GRVs once recovery locks
+        # the chain.
+        grv = GrvProxy(loop, seq_ep, rk_ep, tlog_eps=eps("tlog"))
         router = ReadRouter(storage_map, eps("storage"), loop=loop)
         t.serve("commit_proxy", proxy)
         t.serve("grv_proxy", grv)

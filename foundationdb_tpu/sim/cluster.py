@@ -561,7 +561,8 @@ class SimCluster:
 
         self.tlogs = [
             TLog(self.loop, init_version=start_version, seed=list(seed_entries),
-                 retired_tags=set(self.retired_tags), disk_path=tlog_disk(i))
+                 retired_tags=set(self.retired_tags), disk_path=tlog_disk(i),
+                 epoch=epoch)
             for i in range(self.n_tlogs)
         ]
         self.tlog_eps = [
@@ -578,7 +579,7 @@ class SimCluster:
             self.satellite_tlogs = [
                 TLog(self.loop, init_version=start_version,
                      seed=list(seed_entries),
-                     retired_tags=set(self.retired_tags))
+                     retired_tags=set(self.retired_tags), epoch=epoch)
                 for _ in range(self.n_satellite_tlogs)
             ]
             sat_eps = [
@@ -605,7 +606,10 @@ class SimCluster:
         )
 
         self.grv_proxies = [
-            GrvProxy(self.loop, self.sequencer_ep, self.ratekeeper_ep)
+            # tlog_eps includes the satellites — the full push set is the
+            # confirmEpochLive set (see runtime/grv_proxy.py).
+            GrvProxy(self.loop, self.sequencer_ep, self.ratekeeper_ep,
+                     tlog_eps=self.tlog_eps, epoch=epoch)
             for _ in range(self.n_proxies)
         ]
         self.grv_proxy_eps = [

@@ -99,6 +99,15 @@ class TestClog:
 
         async def main():
             await w.setup(db)
+            # Let every storage apply the setup stream first: the buggy
+            # no-wait read must see STALE values (the lost-update case),
+            # not missing ones — the pull loop's known-committed fence
+            # holds applies one push interval behind the setup commit's
+            # ack, and a None read would crash the workload body instead
+            # of corrupting the cycle.
+            target = await c.sequencer.get_live_committed_version()
+            while any(s._version < target for s in c.storages):
+                await c.loop.sleep(0.01)
             t = c.loop.spawn(clogger(), name="clogger")
             await w.run(db, c)
             await t

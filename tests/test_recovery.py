@@ -260,9 +260,10 @@ class TestRecovery:
 
     def test_unacked_write_rolls_back_with_lost_tlog(self):
         """A write durable on only one tlog (push to the other stalled, so
-        never acked) can reach storage via the pull loop; if that tlog then
-        dies, recovery's version comes from the survivor — storage must ROLL
-        BACK the orphaned write, not expose state the durable log lost."""
+        never acked) must never surface: the pull loop's known-committed
+        fence keeps it OUT of storage state entirely (it sits in the tlog
+        beyond kc), and after the holding tlog dies, recovery derives its
+        version from the survivor — the orphan is gone for good."""
         c, db = make_db(seed=13, n_tlogs=2)
 
         async def main():
@@ -284,10 +285,13 @@ class TestRecovery:
                     pass  # commit_unknown_result — expected
 
             t = c.loop.spawn(orphan())
-            await c.loop.sleep(0.5)  # storage has pulled orphan@v from tlog0
+            await c.loop.sleep(0.5)
+            # The entry is durable on tlog0 and peeked by storage's pull
+            # loop, but the known-committed fence must keep the unacked
+            # write out of applied state.
             assert c.storages[c.storage_map.tag_for_key(b"orphan")].map.latest(
                 b"orphan"
-            ) == b"torn"
+            ) is None
             c.net.kill("tlog0")
             # Keep the partition until recovery locks tlog1 — otherwise the
             # stalled push retry could land, making the orphan durable.
