@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType
+from foundationdb_tpu.runtime.tlog import TLog
 
 # The pseudo storage tag backup mutations ride under (reference: backup
 # workers get their own tag ranges; storage tags here are >= 0).
@@ -165,14 +166,14 @@ class BackupWorker:
                 # generation's fork) must never enter the backup stream —
                 # a restore would replay commits that the surviving
                 # timeline rejected.
-                for version, mutations in entries:
-                    if version > kc:
-                        break
+                streamable, advance_to = TLog.committed_prefix(
+                    entries, end_version, kc)
+                for version, mutations in streamable:
                     if version > self._version:
                         self.container.add_log(version, mutations)
                         self._version = version
-                if min(end_version, kc) > self._version:
-                    self._version = min(end_version, kc)
+                if advance_to > self._version:
+                    self._version = advance_to
                 self.container.log_covered = max(
                     self.container.log_covered, self._version
                 )

@@ -31,6 +31,7 @@ from foundationdb_tpu.core.errors import (
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
+from foundationdb_tpu.runtime.tlog import TLog
 from foundationdb_tpu.runtime.trace import trace
 
 
@@ -262,14 +263,13 @@ class StorageServer:
                 # so kc freezes exactly at the fork point and this cap is
                 # what keeps the fork out of storage state
                 # (tests/test_deployed_multiregion.py TestRegionPartition).
-                cap = self.known_committed
+                applyable, advance_to = TLog.committed_prefix(
+                    entries, end_version, self.known_committed)
                 before = self._version
-                for version, mutations in entries:
-                    if version > cap:
-                        break
+                for version, mutations in applyable:
                     self._apply(version, mutations)
-                if min(end_version, cap) > self._version:
-                    self._advance(min(end_version, cap))  # idle-tag versions
+                if advance_to > self._version:
+                    self._advance(advance_to)  # idle-tag versions
                 if self._version > before:
                     # Pop on every advance (not just on mutations) so cold
                     # tags still raise the tlog's trim floor — without this a

@@ -141,6 +141,17 @@ class TLog:
         # the bound.
         self.known_committed = self._last_appended
 
+    @staticmethod
+    def committed_prefix(entries, end_version: int, known_committed: int):
+        """Split a peek reply at the known-committed bound: the ONE rule
+        every tlog consumer (storage pull loop, backup/DR stream) must
+        apply — entries above kc are an unacked suffix (worst case: a
+        partitioned zombie generation's divergent fork) and must neither
+        be consumed nor advance the consumer's cursor. Returns
+        (consumable entries, version to advance through)."""
+        return ([e for e in entries if e[0] <= known_committed],
+                min(end_version, known_committed))
+
     @classmethod
     def from_disk(cls, loop: Loop, disk_path: str,
                   retired_tags: set[int] | None = None) -> "TLog":

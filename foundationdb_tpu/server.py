@@ -377,19 +377,9 @@ class Worker:
         this call must be a no-op (the race guard)."""
         if expect_epoch == 0 or self.epoch != expect_epoch:
             return False
-        from foundationdb_tpu.core.errors import ProcessKilled
-
         self._cancel_runs()
         if self.role == "proxy":
-            cp = getattr(self, "_commit_proxy", None)
-            if cp is not None:
-                for _req, p in cp._queue:
-                    p.fail(ProcessKilled("proxy stood down: generation retired"))
-                cp._queue = []
-                self._commit_proxy = None
-            # GRV requests parked in the batch queues hang forever once
-            # their consumer loop is cancelled — fail them retryably too
-            # (review finding: the commit queue got this, GRV didn't).
+            self._fail_commit_queue("proxy stood down: generation retired")
             self._fail_grv_queue("proxy stood down: generation retired")
             self.t.unserve("commit_proxy")
             self.t.unserve("grv_proxy")
@@ -403,11 +393,24 @@ class Worker:
         self.epoch = 0  # fresh: recruitable into a future generation
         return True
 
+    def _fail_commit_queue(self, reason: str) -> None:
+        """Fail every queued commit promise retryably: the batch loop is
+        cancelled on retire/stand-down, so a parked commit would otherwise
+        hang its client forever over a healthy connection (the client's
+        on_error resubmits against the new generation)."""
+        from foundationdb_tpu.core.errors import ProcessKilled
+
+        cp = getattr(self, "_commit_proxy", None)
+        if cp is None:
+            return
+        for _req, p in cp._queue:
+            p.fail(ProcessKilled(reason))
+        cp._queue = []
+        self._commit_proxy = None
+
     def _fail_grv_queue(self, reason: str) -> None:
-        """Fail every queued get_read_version promise retryably: their
-        consumer (grv.run) is cancelled on retire/stand-down, so a parked
-        request would otherwise hang its client forever over a healthy
-        connection."""
+        """The GRV twin of _fail_commit_queue (same parked-request
+        contract for get_read_version promises)."""
         from foundationdb_tpu.core.errors import ProcessKilled
 
         g = getattr(self, "_grv_proxy", None)
@@ -527,19 +530,11 @@ class Worker:
         `backup_enabled`/`locked` carry the database flags across the
         generation change (the sim recruiter propagates the same pair —
         sim/cluster.py)."""
-        from foundationdb_tpu.core.errors import ProcessKilled
         from foundationdb_tpu.runtime.commit_proxy import CommitProxy
         from foundationdb_tpu.runtime.grv_proxy import GrvProxy
 
         self._cancel_runs()
-        old = getattr(self, "_commit_proxy", None)
-        if old is not None:
-            # Queued commits of the retired generation would hang forever
-            # (their batch loop is cancelled) — fail them retryably; the
-            # client's on_error loop resubmits against the new generation.
-            for _req, p in old._queue:
-                p.fail(ProcessKilled("proxy retired by recovery"))
-            old._queue = []
+        self._fail_commit_queue("proxy retired by recovery")
         self._fail_grv_queue("proxy retired by recovery")
         seq_ep = self.t.endpoint(
             tuple(seq_addr) if seq_addr

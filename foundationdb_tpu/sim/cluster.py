@@ -438,8 +438,15 @@ class SimCluster:
         (fdbserver/ClusterController.actor.cpp bestDC logic)."""
         if not self.multi_region:
             return None
-        if (self.net.region_dead(self.active_region + "/")
-                and not self.net.region_dead(self.standby_region + "/")):
+
+        def dark(region: str) -> bool:
+            # Dead (blackout) and partitioned-alive both read as dark
+            # from the controller's side — the deployed controller makes
+            # the same call from failed probes, unable to distinguish.
+            return (self.net.region_dead(region + "/")
+                    or self.net.region_partitioned(region + "/"))
+
+        if dark(self.active_region) and not dark(self.standby_region):
             from foundationdb_tpu.runtime.trace import Severity, trace
 
             trace(self.loop).event(

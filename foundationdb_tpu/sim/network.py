@@ -69,6 +69,9 @@ class SimNetwork:
         # recovery that recruited into a dead region simply stalls and
         # retries elsewhere.
         self._dead_regions: set[str] = set()
+        # Partitioned regions: alive but severed at the boundary (the
+        # zombie-generation mode — see partition_region()).
+        self._partitioned_regions: set[str] = set()
         # Clogs: slow-but-alive links (reference: sim2's clogging — the
         # failure mode BETWEEN healthy and partitioned that shakes out
         # timeout/ordering assumptions). pair -> (latency multiplier,
@@ -118,6 +121,30 @@ class SimNetwork:
     def _in_dead_region(self, process: str) -> bool:
         return any(process.startswith(r) for r in self._dead_regions)
 
+    def partition_region(self, prefix: str) -> None:
+        """The HARD region-failure mode (vs fail_region's blackout):
+        every process under `prefix` stays ALIVE with its intra-region
+        links intact, but nothing crosses the region boundary in either
+        direction. The region's chain keeps running as a ZOMBIE
+        generation — proxies keep pushing to in-region tlogs while the
+        out-of-region satellite fences every ack — which is exactly the
+        scenario the known-committed/epoch fences exist for
+        (tests/test_deployed_multiregion.py TestRegionPartition; sim
+        twin in tests/test_multi_region.py)."""
+        self._partitioned_regions.add(self.process_prefix + prefix)
+
+    def heal_region_partition(self, prefix: str) -> None:
+        self._partitioned_regions.discard(self.process_prefix + prefix)
+
+    def region_partitioned(self, prefix: str) -> bool:
+        return (self.process_prefix + prefix) in self._partitioned_regions
+
+    def _crosses_partitioned_region(self, src: str, dst: str) -> bool:
+        for r in self._partitioned_regions:
+            if src.startswith(r) != dst.startswith(r):
+                return True
+        return False
+
     def partition(self, a: str, b: str) -> None:
         self._partitions.add(frozenset(
             (self.process_prefix + a, self.process_prefix + b)))
@@ -149,6 +176,8 @@ class SimNetwork:
             or (src != dst and frozenset((src, dst)) in self._partitions)
             or (self._dead_regions
                 and (self._in_dead_region(dst) or self._in_dead_region(src)))
+            or (self._partitioned_regions
+                and self._crosses_partitioned_region(src, dst))
         )
 
     def _latency(self, src: str | None = None, dst: str | None = None) -> float:

@@ -146,6 +146,7 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "clientCount": "n_clients",
         "failAfter": "fail_after",
         "heal": "heal",
+        "mode": "mode",
     }),
 }
 

@@ -1749,12 +1749,20 @@ class RegionFailoverWorkload(Workload):
     name = "region_failover"
 
     def __init__(self, seed: int = 0, n_txns: int = 40, n_clients: int = 2,
-                 fail_after: int = 10, heal: bool = False):
+                 fail_after: int = 10, heal: bool = False,
+                 mode: str = "fail"):
         super().__init__(seed)
         self.n_txns = n_txns
         self.n_clients = n_clients
         self.fail_after = fail_after  # acked txns before the region dies
         self.heal = heal  # heal the failed region mid-run (failback test)
+        # "fail" = blackout (processes die); "partition" = the HARD mode:
+        # the region stays alive-but-severed, its chain running on as a
+        # zombie generation — what the known-committed/epoch fences and
+        # GRV epoch confirmation exist for (see
+        # tests/test_multi_region.py::test_region_partition_fences_zombie_generation).
+        assert mode in ("fail", "partition"), mode
+        self.mode = mode
         self._acked: list[bytes] = []
         self._failed_region = None
 
@@ -1788,10 +1796,19 @@ class RegionFailoverWorkload(Workload):
             while total_acked[0] < self.fail_after:
                 await cluster.loop.sleep(0.05)
             self._failed_region = cluster.active_region
-            cluster.net.fail_region(self._failed_region + "/")
-            if self.heal:
-                await cluster.loop.sleep(5.0)
-                cluster.heal_region(self._failed_region)
+            if self.mode == "partition":
+                cluster.net.partition_region(self._failed_region + "/")
+                if self.heal:
+                    await cluster.loop.sleep(5.0)
+                    # Partition heal: nothing died — the severed links
+                    # return and the fenced replicas catch up in place.
+                    cluster.net.heal_region_partition(
+                        self._failed_region + "/")
+            else:
+                cluster.net.fail_region(self._failed_region + "/")
+                if self.heal:
+                    await cluster.loop.sleep(5.0)
+                    cluster.heal_region(self._failed_region)
 
         await all_of(
             [cluster.loop.spawn(client(i), name=f"rf.client{i}")

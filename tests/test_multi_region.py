@@ -147,6 +147,120 @@ def test_region_failback_after_heal():
     assert loop.run(main(), timeout=600) == "ok"
 
 
+def test_region_partition_fences_zombie_generation():
+    """The HARD region-failure mode, sim twin of the deployed
+    TestRegionPartition: the primary region is PARTITIONED (every process
+    alive, intra-region links fine) rather than killed. Its chain keeps
+    running as a zombie — an in-region agent can still drive a commit
+    through the old proxies, which lands on the in-region tlogs while
+    the out-of-region satellite fences the ack. The contract under test:
+
+    - the known-committed fence keeps the zombie fork OUT of storage
+      applied state (a committed-nowhere write must never be readable);
+    - the zombie generation mints NO read versions (confirmEpochLive —
+      its GRV batches can't confirm the satellite);
+    - the controller still fails over losslessly, writes flow in the new
+      region, and after the partition heals the re-pointed primary
+      replicas converge to the legit timeline with the fork gone."""
+    loop, c, db = make_mr(seed=81)
+
+    from foundationdb_tpu.core.errors import FdbError
+    from foundationdb_tpu.core.mutations import Mutation, MutationType
+    from foundationdb_tpu.core.types import single_key_range
+    from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+
+    async def main():
+        await put(db, [(b"zp/%03d" % i, b"v%d" % i) for i in range(40)])
+        epoch0 = c.controller.generation.epoch
+        zombie_commit = c.commit_proxy_eps[0]
+        zombie_grv = c.grv_proxy_eps[0]
+        # The generation's tlog OBJECTS, captured now: by the time the
+        # zombie write resolves, failover may already have replaced
+        # c.tlogs with the new generation's.
+        zombie_tlogs = list(c.tlogs)
+        pre_version = await db.transaction().get_read_version()
+        fork_tag = c.storage_map.tag_for_key(b"zp/fork")
+
+        c.net.partition_region("pri/")
+
+        async def zombie_write() -> str:
+            req = CommitRequest(
+                read_version=pre_version,
+                mutations=[Mutation(MutationType.SET_VALUE,
+                                    b"zp/fork", b"zombie")],
+                read_ranges=[], write_ranges=[single_key_range(b"zp/fork")],
+            )
+            try:
+                await zombie_commit.commit(req)
+                return "acked"
+            except FdbError as e:
+                return f"refused:{e.code}"
+
+        async def zombie_read() -> str:
+            try:
+                await zombie_grv.get_read_version("default", None)
+                return "served"
+            except FdbError as e:
+                return f"refused:{e.code}"
+
+        # In-region agents: they can reach the zombie chain (the client
+        # outside the partition cannot).
+        wt = loop.spawn(zombie_write(), process="pri/agent")
+        rt = loop.spawn(zombie_read(), process="pri/agent")
+
+        # The zombie commit must NOT ack (satellite fenced), and the
+        # zombie GRV must refuse (epoch unconfirmable) — retryable codes
+        # a real client would rotate on, never an answer.
+        wres, rres = await wt, await rt
+        assert wres.startswith("refused:"), wres
+        assert rres.startswith("refused:"), rres
+
+        # The fork IS durable on the zombie chain tlogs (the un-acked
+        # suffix) — but the kc fence keeps it out of the in-region
+        # replica's applied state: a committed-nowhere write must never
+        # become readable.
+        def holds_fork(t) -> bool:
+            return any(
+                m.param1 == b"zp/fork"
+                for e in t._log for ms in e.tagged.values() for m in ms
+            )
+
+        assert any(holds_fork(t) for t in zombie_tlogs), \
+            "zombie write never reached the in-region tlogs"
+        assert c.storages[fork_tag].map.latest(b"zp/fork") is None
+
+        # Controller fails over to rem; every acked commit reads back and
+        # new writes flow.
+        deadline = loop.now + 120
+        while loop.now < deadline and not (
+                c.controller.generation.epoch > epoch0
+                and c.active_region == "rem"):
+            await loop.sleep(0.25)
+        assert c.active_region == "rem", "failover never happened"
+        rows = dict(await scan(db, b"zp/", b"zp0"))
+        assert len(rows) == 40, len(rows)
+        assert b"zp/fork" not in rows
+        await put(db, [(b"zp/post", b"after")])
+
+        # Heal: the re-pointed primary replicas catch up from the new
+        # chain; the fork stays gone everywhere, forever.
+        c.net.heal_region_partition("pri/")
+        target = await c.sequencer.get_live_committed_version()
+        n = len(c.storage_map.shards)
+        deadline = loop.now + 120
+        while loop.now < deadline and not all(
+                s._version >= target for s in c.storages[:n]):
+            await loop.sleep(0.25)
+        assert all(s._version >= target for s in c.storages[:n]), \
+            "primary replicas never caught up after heal"
+        assert c.storages[fork_tag].map.latest(b"zp/fork") is None
+        rows = dict(await scan(db, b"zp/", b"zp0"))
+        assert len(rows) == 41 and rows[b"zp/post"] == b"after"
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
 def test_single_region_unaffected():
     """multi_region=None keeps every process name and behavior unchanged
     (no region prefixes anywhere)."""
