@@ -516,8 +516,12 @@ class Transaction:
         dead = cluster.loop.dead_processes
         gen = cluster.controller.generation
         procs: dict[str, str] = {p: "generation" for p in gen.heartbeat_eps}
-        for i in range(len(cluster.storages)):
-            procs.setdefault(f"storage{i}", "storage")
+        for p in cluster.storage_procs():
+            # Real process names — region-prefixed on multi-region
+            # clusters, where a bare "storage0" would advertise a row
+            # that names nothing (kills through it no-op, dead-filter
+            # never matches).
+            procs.setdefault(p, "storage")
         for p in sorted(procs):
             if p in dead:
                 continue

@@ -429,6 +429,16 @@ class SimCluster:
         single-region clusters (zero behavior change there)."""
         return f"{region}/{name}" if region else name
 
+    def storage_procs(self) -> list[str]:
+        """Actual storage process names, region-prefixed on multi-region
+        clusters — the ONE place the scheme lives. A bare "storage0"
+        names nothing there, so any consumer building its own (fault
+        injection, worker_interfaces discovery) silently no-ops."""
+        return [
+            self._region_proc(self._storage_region(i), f"storage{i}")
+            for i in range(len(self.storages))
+        ]
+
     def _pick_active_region(self) -> str | None:
         """Recruitment-time region choice (the automatic failover seam):
         if the active region is dead and the standby is not, flip — the

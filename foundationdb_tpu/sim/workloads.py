@@ -636,6 +636,11 @@ class FaultInjector:
     def stop(self) -> None:
         self._stop = True
 
+    def _storage_procs(self) -> list[str]:
+        """Actual storage process names (see SimCluster.storage_procs —
+        bare "storage{i}" would silently no-op on multi-region)."""
+        return self.cluster.storage_procs()
+
     async def run(self) -> None:
         loop = self.cluster.loop
         rng = loop.rng
@@ -683,9 +688,7 @@ class FaultInjector:
             if self._stop:
                 return
             gen = self.cluster.controller.generation
-            procs = sorted(gen.heartbeat_eps) + [
-                f"storage{i}" for i in range(len(self.cluster.storages))
-            ]
+            procs = sorted(gen.heartbeat_eps) + self._storage_procs()
             a = procs[rng.randrange(len(procs))]
             b = procs[rng.randrange(len(procs))]
             if a == b:
@@ -707,9 +710,7 @@ class FaultInjector:
             if self._stop:
                 return
             gen = self.cluster.controller.generation
-            procs = sorted(gen.heartbeat_eps) + [
-                f"storage{i}" for i in range(len(self.cluster.storages))
-            ] + ["<main>"]  # client-side links clog too
+            procs = sorted(gen.heartbeat_eps) + self._storage_procs() + ["<main>"]  # client-side links clog too
             a = procs[rng.randrange(len(procs))]
             b = procs[rng.randrange(len(procs))]
             if a == b:
