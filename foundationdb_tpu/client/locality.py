@@ -60,6 +60,7 @@ async def get_estimated_range_size_bytes(tr, begin: bytes, end: bytes) -> int:
     total = 0
     for sub, tag in db.storage_map.split_range(KeyRange(begin, end)):
         stats = await db.storage_eps[tag].shard_stats(
-            sub.begin, sub.end, version)
+            sub.begin, sub.end, version,
+            token=getattr(tr, "authorization_token", None))
         total += int(stats.get("bytes", 0))
     return total

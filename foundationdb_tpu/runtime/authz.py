@@ -284,6 +284,16 @@ class TokenAuthority:
             claims = self.verify(token, now)
             prefixes, system_ok = claims.prefixes, claims.system
             check_tenant_alive(claims, live_tenants)
+        if begin < b"\xff" < end:
+            # A range straddling the user/system boundary (the shard
+            # map's LAST shard always does: [.., b"\xff\xff")) is
+            # authorized iff BOTH halves are — split and check each, so
+            # an admin token (prefixes=[b""] + system) covers it and
+            # DD's stats pass over the final shard isn't denied (review
+            # find: the original two-branch check covered neither half).
+            self.check_read(begin, b"\xff", token, now, live_tenants)
+            self.check_read(b"\xff", end, token, now, live_tenants)
+            return
         if begin >= b"\xff":
             if system_ok:
                 return

@@ -139,10 +139,13 @@ class DataDistributor:
         """Stats from any live team member (kills are permanent in the sim:
         a dead primary must not wedge the monitor forever)."""
         err: Exception | None = None
+        # Infrastructure actor: carries the system token on authz-armed
+        # clusters (shard_stats is token-checked like every read).
+        token = getattr(self.cluster, "authz_system_token", None)
         for tag in shard.team:
             try:
                 return await self.cluster.storage_eps[tag].shard_stats(
-                    shard.range.begin, shard.range.end
+                    shard.range.begin, shard.range.end, token=token
                 )
             except Exception as e:
                 err = e
@@ -297,10 +300,12 @@ class DataDistributor:
                     )
                 await self.loop.sleep(0.05)
             snap_versions: dict[int, int] = {}
+            token = getattr(self.cluster, "authz_system_token", None)
             for tag in newcomers:
                 dst_ep = self.cluster.storage_eps[tag]
                 snap_versions[tag] = await self._retry(
-                    lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep, floor)
+                    lambda ep=dst_ep: ep.fetch_keys(begin, end, src_ep,
+                                                    floor, token=token)
                 )
             # Every newcomer must be applied past its snapshot before it can
             # answer reads issued after the flip (fetch_keys itself already

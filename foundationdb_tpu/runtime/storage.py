@@ -736,13 +736,21 @@ class StorageServer:
 
     @rpc
     async def shard_stats(self, begin: bytes, end: bytes,
-                          version: int | None = None) -> dict:
+                          version: int | None = None,
+                          token: str | None = None) -> dict:
         """DataDistributor inputs: byte size + a median split key
         (reference: StorageMetrics / splitMetrics). `version`: wait for
         the apply loop to reach it first — client-facing size estimates
         must see the caller's own committed writes, which the pull
         loop's known-committed fence holds back for one push interval.
-        DD's balance sampling passes None (best-effort latest)."""
+        DD's balance sampling passes None (best-effort latest).
+
+        Token-checked like every other client-facing read when authz is
+        armed: the reply includes a median SPLIT KEY — real key bytes —
+        so an unchecked call would leak another tenant's key material
+        and data-size side channel to any tokened client. DD carries the
+        cluster's system token."""
+        self._check_read_authz(begin, end, token)
         if version is not None:
             await self._check_version(version)
         total, n = 0, 0

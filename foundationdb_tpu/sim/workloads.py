@@ -1766,12 +1766,29 @@ class RegionFailoverWorkload(Workload):
         self.mode = mode
         self._acked: list[bytes] = []
         self._failed_region = None
+        self._token: str | None = None  # minted lazily on authz clusters
 
     def _key(self, cid: int, i: int) -> bytes:
         return b"rf/%02d/%04d" % (cid, i)
 
+    def _tokenize(self, db, tr) -> None:
+        """On an authz-armed cluster this workload plays a tenant scoped
+        to its own rf/ prefix (untokened writes would be denied) — the
+        AuthzAcrossRegionFailover spec composes it with the Authz
+        workload's isolation probes."""
+        if self._token is None:
+            cluster = getattr(db, "cluster", None)
+            priv = getattr(cluster, "authz_private_pem", None)
+            if priv is None:
+                return
+            from foundationdb_tpu.runtime.authz import mint_token
+
+            self._token = mint_token(priv, [b"rf/"], expires_at=1e12)
+        tr.set_option("authorization_token", self._token)
+
     async def setup(self, db) -> None:
         async def body(tr):
+            self._tokenize(db, tr)
             tr.clear_range(b"rf/", b"rf0")
 
         await self._run_txn(db, body)
@@ -1786,6 +1803,7 @@ class RegionFailoverWorkload(Workload):
                 key = self._key(cid, i)
 
                 async def body(tr, key=key):
+                    self._tokenize(db, tr)
                     tr.set(key, b"v")
 
                 await self._run_txn(db, body)
@@ -1825,6 +1843,7 @@ class RegionFailoverWorkload(Workload):
             "active region never flipped")
 
         async def body(tr):
+            self._tokenize(db, tr)
             return await tr.get_range(b"rf/", b"rf0")
 
         rows = dict(await self._run_txn(db, body))

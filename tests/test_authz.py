@@ -316,6 +316,75 @@ def test_reads_scoped_to_tenant_prefixes(authz_db):
         rd_sys(b"\xff/tenant/map/acme")
 
 
+def test_shard_stats_requires_read_scope(authz_db):
+    """Size estimates carry the same read boundary as data reads: the
+    shard_stats reply includes a median SPLIT KEY — real key bytes — so
+    an unchecked call leaks another tenant's key material plus a
+    data-size side channel (reference: storage metrics requests are
+    authorization-checked like reads). DD keeps working via the system
+    token; in-scope estimates work for the tenant."""
+    from foundationdb_tpu.client.locality import (
+        get_estimated_range_size_bytes,
+    )
+
+    priv, c, db = authz_db
+    writer = mint_token(priv, [b""], expires_at=c.loop.now + 3600)
+    a_tok = mint_token(priv, [b"tenantA/"], expires_at=c.loop.now + 3600)
+    put(c, db, b"tenantA/k", b"x" * 100, token=writer)
+    put(c, db, b"tenantB/k", b"y" * 100, token=writer)
+
+    def est(begin, end, token=None):
+        async def body(tr):
+            if token:
+                tr.set_option("authorization_token", token)
+            return await get_estimated_range_size_bytes(tr, begin, end)
+
+        return c.loop.run(db.run(body))
+
+    assert est(b"tenantA/", b"tenantA0", token=a_tok) >= 100
+    with pytest.raises(PermissionDenied):
+        est(b"tenantB/", b"tenantB0", token=a_tok)
+    with pytest.raises(PermissionDenied):
+        est(b"tenantA/", b"tenantA0")  # untokened
+
+    # Raw RPC with no token: denied outright — this is the path that
+    # would otherwise hand out split keys.
+    with pytest.raises(PermissionDenied):
+        c.loop.run(c.storage_eps[
+            c.storage_map.tag_for_key(b"tenantB/k")
+        ].shard_stats(b"tenantB/", b"tenantB0"))
+
+
+def test_data_distribution_runs_on_authz_cluster():
+    """DD's stats pass must complete under authz: its last shard ALWAYS
+    straddles the user/system boundary ([.., b"\\xff\\xff")), which the
+    system token must cover by the split-at-\\xff rule in check_read
+    (review find: the original two-branch check denied that range, and
+    DD's run loop swallowed the PermissionDenied forever — no splits, no
+    merges, no dd_shard_bytes for the resolver split derivation)."""
+    priv, pub = generate_keypair()
+    c = SimCluster(seed=33, n_storages=2, data_distribution=True,
+                   authz_public_key=pub,
+                   authz_system_token=mint_token(
+                       priv, [b""], expires_at=1e12, system=True))
+    db = open_database(c)
+    writer = mint_token(priv, [b""], expires_at=1e12)
+
+    async def main():
+        async def fill(tr):
+            tr.set_option("authorization_token", writer)
+            for i in range(16):
+                tr.set(b"dd/%03d" % i, b"x" * 50)
+
+        await db.run(fill)
+        await c.data_distributor._pass()  # raises on any denial
+        assert c.dd_shard_bytes, "stats pass published nothing"
+        assert sum(b for _, _, b in c.dd_shard_bytes) > 0
+        return "ok"
+
+    assert c.loop.run(main(), timeout=120) == "ok"
+
+
 def test_watch_requires_read_scope(authz_db):
     """Watches reveal change timing — they carry the same read boundary."""
     priv, c, db = authz_db
