@@ -202,17 +202,26 @@ class TestRegionFailover:
             out.stdout
 
 
-def admin_rpc(spec: dict, role: str, i: int, method: str, *rpc_args):
+def role_rpc(spec: dict, role: str, i: int, service: str, method: str,
+             *rpc_args, timeout: float = 10):
+    """One-shot RPC against a deployed process's named service, with full
+    transport teardown (t.close() — not just the listener — so the test
+    process doesn't accumulate leaked connections across calls)."""
     from foundationdb_tpu.runtime.net import NetTransport, RealLoop
     from foundationdb_tpu.server import parse_addr
 
     loop = RealLoop()
     t = NetTransport(loop)
     try:
-        ep = t.endpoint(parse_addr(spec[role][i]), "admin")
-        return loop.run_until(getattr(ep, method)(*rpc_args), timeout=10)
+        ep = t.endpoint(parse_addr(spec[role][i]), service)
+        return loop.run_until(getattr(ep, method)(*rpc_args),
+                              timeout=timeout)
     finally:
-        t._listener.close()
+        t.close()
+
+
+def admin_rpc(spec: dict, role: str, i: int, method: str, *rpc_args):
+    return role_rpc(spec, role, i, "admin", method, *rpc_args)
 
 
 class TestRegionPartition:
@@ -245,6 +254,25 @@ class TestRegionPartition:
                 ph, ppt = spec[prole][pi].rsplit(":", 1)
                 admin_rpc(spec, orole, oi, "inject_fault",
                           ph, int(ppt), "drop", 0.05, dur)
+
+        # While the partition is live, the zombie generation must mint NO
+        # read versions (confirmEpochLive over TCP): proxy0's grv_proxy
+        # is up and answering, but its per-batch confirm can't reach the
+        # fenced satellite. First prove the zombie IS up (a dead proxy
+        # would make any refusal vacuous), then demand the GRV fails —
+        # as a wire-delivered FdbError (the refusal) or a timeout (batch
+        # parked unconfirmable) — never with a version, and never with a
+        # transport error that would mean the probe tested nothing.
+        from foundationdb_tpu.core.errors import FdbError
+
+        d = role_rpc(spec, "proxy", 0, "worker", "describe")
+        assert d.get("epoch") == 1, d  # alive, still serving epoch 1
+        try:
+            v = role_rpc(spec, "proxy", 0, "grv_proxy", "get_read_version",
+                         "default", None, timeout=5)
+            raise AssertionError(f"zombie grv served read version {v}")
+        except (FdbError, TimeoutError):
+            pass  # refused or unconfirmable — no version minted
 
         st = wait_status(
             spec, lambda s: s.get("active_region") == "rem"

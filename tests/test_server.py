@@ -69,11 +69,24 @@ def cluster(tmp_path_factory):
                     text=True,
                 ))
         # Readiness: every process prints "ready ..." once listening.
-        deadline = time.monotonic() + 30
+        # Generous deadline: each boot imports jax (~seconds of CPU), and
+        # a loaded single-core runner boots the dozen processes serially
+        # — 30s flaked under a concurrent seed-mining batch. The select
+        # gate makes the deadline real: a bare readline() would block
+        # forever on a process wedged before its first line.
+        import select
+
+        deadline = time.monotonic() + 120
         for p in procs:
+            while True:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, "cluster boot timed out"
+                readable, _, _ = select.select(
+                    [p.stdout], [], [], min(remaining, 5))
+                if readable:
+                    break
             line = p.stdout.readline()
             assert "ready" in line, line
-            assert time.monotonic() < deadline, "cluster boot timed out"
         yield str(spec_path)
     finally:
         for p in procs:
