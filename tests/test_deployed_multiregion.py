@@ -224,6 +224,21 @@ def admin_rpc(spec: dict, role: str, i: int, method: str, *rpc_args):
     return role_rpc(spec, role, i, "admin", method, *rpc_args)
 
 
+def partition_primary(spec: dict, outside: list, dur: float) -> None:
+    """Two-sided drop rules between every primary-region process and each
+    `outside` (role, index): the pri region stays internally connected —
+    alive, but dark to the rest of the cluster."""
+    pri_addrs = [(role, i) for role, idxs in PRI.items() for i in idxs]
+    for prole, pi in pri_addrs:
+        for orole, oi in outside:
+            oh, op = spec[orole][oi].rsplit(":", 1)
+            admin_rpc(spec, prole, pi, "inject_fault",
+                      oh, int(op), "drop", 0.05, dur)
+            ph, ppt = spec[prole][pi].rsplit(":", 1)
+            admin_rpc(spec, orole, oi, "inject_fault",
+                      ph, int(ppt), "drop", 0.05, dur)
+
+
 class TestRegionPartition:
     def test_partitioned_primary_fails_over_without_loss(self, multiregion):
         """The HARD region-failure mode: the primary region is network-
@@ -237,23 +252,11 @@ class TestRegionPartition:
         spec, spec_path, procs, launch = multiregion
         cli_ok(spec_path, "writemode on; set pp/a v1; set pp/b v2")
 
-        # Two-sided drop rules between every pri process and every
-        # non-pri process (controller, rem region, satellite). The pri
-        # region stays internally connected — alive, but dark from the
-        # controller's side.
-        pri_addrs = [(role, i) for role, idxs in PRI.items() for i in idxs]
-        outside = ([("controller", 0), ("satellite_tlog", 0)]
-                   + [(role, i) for role, idxs in REM.items()
-                      for i in idxs])
-        dur = 60.0
-        for prole, pi in pri_addrs:
-            for orole, oi in outside:
-                oh, op = spec[orole][oi].rsplit(":", 1)
-                admin_rpc(spec, prole, pi, "inject_fault",
-                          oh, int(op), "drop", 0.05, dur)
-                ph, ppt = spec[prole][pi].rsplit(":", 1)
-                admin_rpc(spec, orole, oi, "inject_fault",
-                          ph, int(ppt), "drop", 0.05, dur)
+        partition_primary(
+            spec,
+            [("controller", 0), ("satellite_tlog", 0)]
+            + [(role, i) for role, idxs in REM.items() for i in idxs],
+            dur=60.0)
 
         # While the partition is live, the zombie generation must mint NO
         # read versions (confirmEpochLive over TCP): proxy0's grv_proxy
@@ -295,6 +298,57 @@ class TestRegionPartition:
                      "writemode on; set pp/d v4; getrange pp/ pp0")
         assert all(v in out.stdout
                    for v in ("v1", "v2", "v3", "v4")), out.stdout
+
+
+class TestNoFlipWithoutSalvage:
+    def test_partition_plus_dead_satellite_stays_put(self, multiregion):
+        """Double fault over real TCP: the primary region partitions AND
+        the satellite dies. Nothing in the old push set is lockable, so
+        the controller must NOT move the database (a flip without
+        salvage forks the timeline and loses acked commits) — it has to
+        wait. When the partition expires it locks the primary's own
+        tlogs and heals IN region; the restarted satellite folds back
+        into a later generation; every ack survives."""
+        spec, spec_path, procs, launch = multiregion
+        cli_ok(spec_path, "writemode on; set nf/a v1; set nf/b v2")
+        st = controller_status(spec)
+        assert st.get("active_region") == "pri"
+
+        p = procs[("satellite_tlog", 0)]
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+        partition_primary(
+            spec,
+            [("controller", 0)]
+            + [(role, i) for role, idxs in REM.items() for i in idxs],
+            dur=45.0)
+
+        # Ample time to (wrongly) flip: the active region must not move
+        # — there is nothing to salvage from. Transient status timeouts
+        # (the controller is mid-retry against black-holed links) just
+        # continue the poll; only an OBSERVED flip fails.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                st = controller_status(spec)
+            except Exception:
+                time.sleep(3)
+                continue
+            assert st.get("active_region") == "pri", st
+            time.sleep(3)
+
+        # Partition expires: the controller heals IN region from the
+        # primary's own tlogs; the relaunched satellite rejoins.
+        launch("satellite_tlog", 0)
+        assert "ready" in procs[("satellite_tlog", 0)].stdout.readline()
+        wait_status(
+            spec, lambda s: s.get("active_region") == "pri"
+            and not s["recovering"]
+            and s["generation"].get("satellite_tlog") == [0]
+            and s["epoch"] > 1, deadline_s=120)
+        out = cli_ok(spec_path,
+                     "writemode on; set nf/c v3; getrange nf/ nf0")
+        assert all(v in out.stdout for v in ("v1", "v2", "v3")), out.stdout
 
 
 class TestRegionSpecValidation:

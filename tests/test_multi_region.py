@@ -261,6 +261,45 @@ def test_region_partition_fences_zombie_generation():
     assert loop.run(main(), timeout=600) == "ok"
 
 
+def test_no_flip_without_salvage_source():
+    """Double fault: the primary region partitions AND the satellites die.
+    With no lockable member of the old push set, the standby region must
+    NOT take over — a flip without salvage would fork the database and
+    lose acked commits. The controller has to wait; when the partition
+    heals, recovery locks the primary's own tlogs and heals IN region
+    with everything acked intact (reference: recovery cannot proceed past
+    locking without a quorum of the old generation's logs)."""
+    loop, c, db = make_mr(seed=83)
+
+    async def main():
+        await put(db, [(b"nf/%02d" % i, b"v%d" % i) for i in range(12)])
+        epoch0 = c.controller.generation.epoch
+
+        c.net.partition_region("pri/")
+        for i, t in enumerate(c.satellite_tlogs):
+            c.net.kill(f"sat/tlog_s{i}")
+
+        # Give the controller ample time to (wrongly) flip: it must not.
+        await loop.sleep(20)
+        assert c.active_region == "pri", "flipped with no salvage source!"
+
+        c.net.heal_region_partition("pri/")
+        deadline = loop.now + 120
+        while loop.now < deadline and not (
+                c.controller.generation.epoch > epoch0
+                and not getattr(c.controller, "_recovering", False)):
+            await loop.sleep(0.25)
+        assert c.controller.generation.epoch > epoch0, "never recovered"
+        assert c.active_region == "pri"
+
+        rows = dict(await scan(db, b"nf/", b"nf0"))
+        assert len(rows) == 12, len(rows)
+        await put(db, [(b"nf/post", b"y")])
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
 def test_single_region_unaffected():
     """multi_region=None keeps every process name and behavior unchanged
     (no region prefixes anywhere)."""
