@@ -1011,7 +1011,25 @@ def main() -> None:
                     help="mesh-sharded resolver count (§5 4-resolver config)")
     ap.add_argument("--window", type=int, default=32,
                     help="resolver batches per device dispatch")
+    ap.add_argument("--repair-sim", action="store_true",
+                    help="run the transaction-repair goodput harness "
+                         "(deterministic sim, oracle-verified; no TPU) "
+                         "instead of the resolver kernel bench")
+    ap.add_argument("--repair-txns", type=int, default=240)
+    ap.add_argument("--repair-clients", type=int, default=12)
+    ap.add_argument("--repair-keys", type=int, default=12)
     args = ap.parse_args()
+    if args.repair_sim:
+        # Pure simulation (the conflict engine is the python oracle): pin
+        # CPU so importing the client stack can never touch the TPU tunnel.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from foundationdb_tpu.repair.bench import run_repair_goodput
+
+        print(json.dumps(run_repair_goodput(
+            n_txns=args.repair_txns, n_clients=args.repair_clients,
+            n_keys=args.repair_keys, seed=args.seed,
+        )), flush=True)
+        return
     if (os.environ.get("FDB_TPU_FORCE_CPU") == "1"
             and os.environ.get("FDB_TPU_ALLOW_CPU") != "1"):
         # Hang-recovery re-exec landed on CPU: diagnostic run only — keep

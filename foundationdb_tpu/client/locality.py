@@ -50,17 +50,23 @@ async def get_addresses_for_key(tr, key: bytes) -> list[str]:
 async def get_estimated_range_size_bytes(tr, begin: bytes, end: bytes) -> int:
     """Estimated bytes stored in [begin, end) (reference:
     Transaction::getEstimatedRangeSizeBytes, backed by StorageMetrics).
-    Sums each covered shard's primary-replica byte stats."""
+    Sums each covered shard's byte stats, with the same replica failover
+    the read path uses (Database.first_of_team): a dead or lagging/fenced
+    replica is demoted and the next team member answers, instead of the
+    whole estimate failing on the primary tag alone (ADVICE.md r5)."""
     db = tr.db
     await db.refresh_client_info()
     # Estimate at the transaction's read version: shard_stats waits for
     # the storage apply loop (known-committed fence) to reach it, so the
     # caller's own committed writes are counted.
     version = await tr.get_read_version()
+    token = getattr(tr, "authorization_token", None)
     total = 0
-    for sub, tag in db.storage_map.split_range(KeyRange(begin, end)):
-        stats = await db.storage_eps[tag].shard_stats(
-            sub.begin, sub.end, version,
-            token=getattr(tr, "authorization_token", None))
+    for sub, team in db.storage_map.split_range_teams(KeyRange(begin, end)):
+        stats = await db.first_of_team(
+            team,
+            lambda tag, sub=sub: db.storage_eps[tag].shard_stats(
+                sub.begin, sub.end, version, token=token),
+        )
         total += int(stats.get("bytes", 0))
     return total

@@ -262,24 +262,27 @@ class Database:
                 self.refresh_shard_map()
         raise ProcessKilled("shard map kept changing under range read")
 
-    async def _read_part(
-        self, r: KeyRange, team, version: int, limit: int, reverse: bool,
-        token: str | None = None,
-    ) -> list[tuple[bytes, bytes]]:
+    async def first_of_team(self, team, make_call):
+        """Try `await make_call(tag)` on every team member in
+        failure-demoted order — THE team-failover policy (one definition,
+        shared by range reads and locality's shard_stats): dead
+        (BrokenPromise) and lagging/fenced (FutureVersion) replicas are
+        demoted and the next member tried; a member that no longer serves
+        the shard (WrongShardServer) is noted but the rest still get
+        their shot. Raise preference: wrong-shard (caller refreshes the
+        map) > future-version (caller retries) > no-reachable-replica.
+        (Point reads keep their own loop: they STOP at the first
+        wrong-shard answer — same team, same stale map — instead of
+        trying the remaining members.)"""
         last_wrong: Exception | None = None
         last_future: Exception | None = None
         for tag in self._order_team(team):
             try:
-                return await self.storage_eps[tag].get_range(
-                    r.begin, r.end, version, limit=limit, reverse=reverse,
-                    token=token,
-                )
+                return await make_call(tag)
             except BrokenPromise:
                 self._ep_failed_at[tag] = self.loop.now
                 continue
             except FutureVersion as e:
-                # Lagging/fenced replica (see get()): demote, try the
-                # rest of the team at this version.
                 self._ep_failed_at[tag] = self.loop.now
                 last_future = e
                 continue
@@ -290,7 +293,19 @@ class Database:
             raise last_wrong
         if last_future is not None:
             raise last_future
-        raise ProcessKilled(f"no reachable storage replica for range {r.begin[:16]!r}")
+        raise ProcessKilled("no reachable storage replica in team")
+
+    async def _read_part(
+        self, r: KeyRange, team, version: int, limit: int, reverse: bool,
+        token: str | None = None,
+    ) -> list[tuple[bytes, bytes]]:
+        return await self.first_of_team(
+            team,
+            lambda tag: self.storage_eps[tag].get_range(
+                r.begin, r.end, version, limit=limit, reverse=reverse,
+                token=token,
+            ),
+        )
 
     def _pick(self, eps: list):
         """Round-robin over proxy endpoints, skipping recently-failed ones.
@@ -437,6 +452,14 @@ class Transaction:
                 # proxy list from the controller before the next attempt.
                 self.db.note_proxy_failed(ep)
                 raise ProcessKilled(str(e)) from e
+            except ProcessKilled as e:
+                if "unconfirmed" in str(e) and str(e).startswith("grv epoch"):
+                    # The proxy's epoch-liveness confirm failed (its tlog
+                    # set is locked/fenced/unreachable): it can mint no
+                    # read versions until stand-down — demote it so the
+                    # retry rotates to a confirmable proxy immediately.
+                    self.db.note_proxy_failed(ep)
+                raise
             except FdbError as e:
                 if e.code == 1500 and str(e).startswith("no service"):
                     # Proxy process up but serving no recruited role yet
@@ -473,11 +496,26 @@ class Transaction:
             return await self._get_special(key)
         _check_key(key)
         version = await self.get_read_version()
-        value = await self.db.read_key(key, version,
-                                        token=self.authorization_token)
+        value = await self._fetch_key(key, version)
         if not snapshot:
             self.read_ranges.append(single_key_range(key))
         return value
+
+    # Storage-fetch seams: the repair engine's transaction subclass
+    # (repair/engine.py RepairableTransaction) overrides these to serve
+    # replayed reads from its recorded cache — conflict-range accounting
+    # above stays identical either way.
+
+    async def _fetch_key(self, key: bytes, version: int) -> bytes | None:
+        return await self.db.read_key(key, version,
+                                      token=self.authorization_token)
+
+    async def _fetch_range(
+        self, begin: bytes, end: bytes, version: int, limit: int,
+        reverse: bool,
+    ) -> list[tuple[bytes, bytes]]:
+        return await self.db.read_range(begin, end, version, limit, reverse,
+                                        token=self.authorization_token)
 
     async def _get_special(self, key: bytes) -> bytes | None:
         """The special key space (reference: SpecialKeySpace — synthetic
@@ -572,8 +610,7 @@ class Transaction:
             return rows[:limit] if limit > 0 else rows
         version = await self.get_read_version()
         cap = limit if limit > 0 else 1 << 30
-        rows = await self.db.read_range(begin, end, version, cap, reverse,
-                                        token=self.authorization_token)
+        rows = await self._fetch_range(begin, end, version, cap, reverse)
         rows = rows[:cap]
         if not snapshot:
             if limit > 0 and len(rows) == cap and rows:
@@ -778,7 +815,9 @@ class Transaction:
             # Stash the resolver's conflicting ranges for this attempt:
             # readable via \xff\xff/transaction/conflicting_keys/ until
             # the next reset (reference: SpecialKeySpace module backed by
-            # the commit reply's conflictingKRIndices).
+            # the commit reply's conflictingKRIndices). The failed batch's
+            # commit version + hot-range odds stay on the exception —
+            # that's what the repair engine consumes (repair/engine.py).
             self._conflicting_ranges = list(e.conflicting_ranges or [])
             raise
         except BrokenPromise as e:

@@ -32,20 +32,56 @@ class NotCommitted(FdbError):
     When the client requested report_conflicting_keys, the resolver's
     conflicting read ranges ride along (reference: conflictingKRIndices
     in the commit reply feeding \\xff\\xff/transaction/conflicting_keys/).
+    The commit proxy additionally attaches the failed batch's commit
+    version (``fail_version``) and the conflict-odds scores of the losing
+    ranges from its hot-range sketch (``hot_ranges``) — the inputs the
+    client-side transaction-repair engine (repair/engine.py) needs to
+    re-read only the lost ranges and to back off on futile hot ranges.
     """
 
     code = 1020
 
     def __init__(self, message: str = "",
                  conflicting_ranges: "list[tuple[bytes, bytes]] | None" = None,
-                 code: int | None = None):
+                 code: int | None = None,
+                 fail_version: int | None = None,
+                 hot_ranges: "list[tuple[bytes, bytes, float]] | None" = None):
         super().__init__(message, code)
+        # Wire payload is a dict (was: bare range list). Decode accepts
+        # both shapes, so new clients read old proxies; the REVERSE pair
+        # (old client, new proxy) is not supported — deploy proxies and
+        # clients from one tree, as the repo's drivers do.
+        extra: dict = {}
         if conflicting_ranges is not None:
-            self.wire_extra = [tuple(r) for r in conflicting_ranges]
+            extra["r"] = [tuple(r) for r in conflicting_ranges]
+        if fail_version is not None:
+            extra["v"] = int(fail_version)
+        if hot_ranges is not None:
+            extra["h"] = [tuple(h) for h in hot_ranges]
+        if extra:
+            self.wire_extra = extra
 
     @property
     def conflicting_ranges(self) -> "list[tuple[bytes, bytes]] | None":
-        return self.wire_extra
+        if isinstance(self.wire_extra, dict):
+            return self.wire_extra.get("r")
+        return self.wire_extra  # legacy bare-list payload (old wire peers)
+
+    @property
+    def fail_version(self) -> "int | None":
+        """Commit version of the batch this txn lost in — the snapshot the
+        repair engine re-reads at (minus one: same-batch winners' writes
+        land exactly at this version and must stay in the re-validation
+        window of the repaired resubmit)."""
+        if isinstance(self.wire_extra, dict):
+            return self.wire_extra.get("v")
+        return None
+
+    @property
+    def hot_ranges(self) -> "list[tuple[bytes, bytes, float]] | None":
+        if isinstance(self.wire_extra, dict):
+            return self.wire_extra.get("h")
+        return None
 
 
 class TransactionTooOld(FdbError):

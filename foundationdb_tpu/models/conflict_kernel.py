@@ -122,8 +122,12 @@ def init_state(capacity: int, width: int, min_key) -> ConflictState:
 # ---------------------------------------------------------------------------
 
 
-def _history_conflicts(state: ConflictState, batch: BatchTensors) -> jax.Array:
-    """bool [B]: some read range overlaps a historical write newer than rv."""
+def _history_conflict_ranges(
+    state: ConflictState, batch: BatchTensors
+) -> jax.Array:
+    """bool [B, R]: read range slot overlaps a historical write newer than
+    rv — the per-range form the conflicting-keys report path needs (which
+    read ranges LOST, reference: conflictingKRIndices)."""
     b, r, w = batch.read_begin.shape
     rb = batch.read_begin.reshape(b * r, w)
     re_ = batch.read_end.reshape(b * r, w)
@@ -148,8 +152,41 @@ def _history_conflicts(state: ConflictState, batch: BatchTensors) -> jax.Array:
         ).reshape(b, r)
     nonempty = lex_lt(batch.read_begin, batch.read_end)
     live = batch.read_mask & nonempty
-    conflict = live & (newest > batch.read_version[:, None])
-    return jnp.any(conflict, axis=1)
+    return live & (newest > batch.read_version[:, None])
+
+
+def _history_conflicts(state: ConflictState, batch: BatchTensors) -> jax.Array:
+    """bool [B]: some read range overlaps a historical write newer than rv."""
+    return jnp.any(_history_conflict_ranges(state, batch), axis=1)
+
+
+def _read_vs_accepted_writes(
+    rb: jax.Array,
+    re_: jax.Array,
+    read_live: jax.Array,
+    wb: jax.Array,
+    we: jax.Array,
+    write_live: jax.Array,
+    accepted: jax.Array,
+) -> jax.Array:
+    """bool [B, R]: read range slot overlaps SOME accepted txn's write
+    range (rank space). The intra-batch half of the loser-range report:
+    all of a batch's accepted writes land at the same commit version, so
+    a rejected txn repairing at that version must re-read every one of
+    its ranges an accepted peer wrote — earlier OR later in batch order
+    (the report is for re-reading a snapshot, not for blame assignment).
+    A txn's own writes never qualify (it was rejected, so it is not in
+    `accepted`)."""
+    b, q = wb.shape
+    aw = (write_live & accepted[:, None]).reshape(b * q)
+    wbf = wb.reshape(b * q)
+    wef = we.reshape(b * q)
+    hit = (
+        (rb[:, :, None] < wef[None, None, :])
+        & (wbf[None, None, :] < re_[:, :, None])
+        & aw[None, None, :]
+    )
+    return read_live & jnp.any(hit, axis=2)
 
 
 # ---------------------------------------------------------------------------
@@ -607,24 +644,50 @@ def assemble_verdicts(
     )
 
 
+def loser_range_mask(
+    hist_mask: jax.Array,
+    ranks: tuple[jax.Array, ...],
+    accepted: jax.Array,
+    verdicts: jax.Array,
+) -> jax.Array:
+    """bool [B, R]: which read range slots of each CONFLICT txn lost —
+    history conflicts exactly, plus overlaps with accepted peers' writes
+    (whose mutations land at this batch's commit version). Surfaced to the
+    host so the resolver's conflicting-keys report (and the client repair
+    engine behind it) re-reads only these, not the whole read set."""
+    rb, re_, read_live, wb, we, write_live = ranks
+    intra = _read_vs_accepted_writes(
+        rb, re_, read_live, wb, we, write_live, accepted
+    )
+    return (hist_mask | intra) & (verdicts == V_CONFLICT)[:, None]
+
+
 def resolve_batch(
     state: ConflictState,
     batch: BatchTensors,
     commit_version: jax.Array,
     new_oldest: jax.Array,
-) -> tuple[jax.Array, ConflictState]:
+    report: bool = False,
+):
     """Resolve one batch and fold its accepted writes into the history.
 
-    Returns (verdicts int8 [B], new_state). Mirrors the reference call
+    Returns (verdicts int8 [B], new_state) — with `report` (a static
+    Python flag; each value compiles its own program), (verdicts,
+    loser_mask bool [B, R], new_state). Mirrors the reference call
     sequence ConflictBatch::detectConflicts → combineWriteConflictRanges →
     SkipList::addConflictRanges, as one compiled program.
     """
     floor, too_old = too_old_mask(state, batch, new_oldest)
-    hist_conflict = _history_conflicts(state, batch)
+    hist_mask = _history_conflict_ranges(state, batch)
+    hist_conflict = jnp.any(hist_mask, axis=1)
     base = batch.txn_mask & ~too_old & ~hist_conflict
-    accepted = _block_accept_fused(base, *endpoint_ranks_live(batch))
+    ranks = endpoint_ranks_live(batch)
+    accepted = _block_accept_fused(base, *ranks)
     verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     new_state = _paint_and_compact(state, batch, accepted, commit_version, floor)
+    if report:
+        losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
+        return verdicts, losers, new_state
     return verdicts, new_state
 
 
@@ -795,11 +858,11 @@ def _maybe_merge(hist: HistState, demand: jax.Array,
     return jax.lax.cond(need, do_merge, lambda h: h, hist)
 
 
-def _history_conflicts_hist(base: ConflictState, base_st: jax.Array,
-                            delta: ConflictState,
-                            batch: BatchTensors) -> jax.Array:
-    """bool [B]: _history_conflicts against base (prebuilt table) + delta
-    (small per-batch table)."""
+def _history_conflict_ranges_hist(base: ConflictState, base_st: jax.Array,
+                                  delta: ConflictState,
+                                  batch: BatchTensors) -> jax.Array:
+    """bool [B, R]: _history_conflict_ranges against base (prebuilt table)
+    + delta (small per-batch table)."""
     b, r, w = batch.read_begin.shape
     rb = batch.read_begin.reshape(b * r, w)
     re_ = batch.read_end.reshape(b * r, w)
@@ -818,8 +881,16 @@ def _history_conflicts_hist(base: ConflictState, base_st: jax.Array,
     newest = jnp.maximum(newest_b, newest_d).reshape(b, r)
     nonempty = lex_lt(batch.read_begin, batch.read_end)
     live = batch.read_mask & nonempty
-    conflict = live & (newest > batch.read_version[:, None])
-    return jnp.any(conflict, axis=1)
+    return live & (newest > batch.read_version[:, None])
+
+
+def _history_conflicts_hist(base: ConflictState, base_st: jax.Array,
+                            delta: ConflictState,
+                            batch: BatchTensors) -> jax.Array:
+    """bool [B]: any-reduce of _history_conflict_ranges_hist."""
+    return jnp.any(
+        _history_conflict_ranges_hist(base, base_st, delta, batch), axis=1
+    )
 
 
 def resolve_batch_hist(
@@ -827,10 +898,12 @@ def resolve_batch_hist(
     batch: BatchTensors,
     commit_version: jax.Array,
     new_oldest: jax.Array,
-) -> tuple[jax.Array, HistState]:
+    report: bool = False,
+):
     """resolve_batch over the two-level history. Identical verdicts to
     resolve_batch (oracle-tested); only the history data structure
-    differs."""
+    differs. `report` (static) additionally returns the loser-range mask
+    bool [B, R] (see loser_range_mask)."""
     floor, too_old = too_old_mask(hist.delta, batch, new_oldest)
     demand = 2 * jnp.sum(
         (batch.write_mask & lex_lt(batch.write_begin, batch.write_end))
@@ -838,12 +911,18 @@ def resolve_batch_hist(
     )
     hist = _maybe_merge(hist, demand, floor)
     base_h, base_st, delta = hist
-    hist_conflict = _history_conflicts_hist(base_h, base_st, delta, batch)
+    hist_mask = _history_conflict_ranges_hist(base_h, base_st, delta, batch)
+    hist_conflict = jnp.any(hist_mask, axis=1)
     ok = batch.txn_mask & ~too_old & ~hist_conflict
-    accepted = _block_accept_fused(ok, *endpoint_ranks_live(batch))
+    ranks = endpoint_ranks_live(batch)
+    accepted = _block_accept_fused(ok, *ranks)
     verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     delta = _paint_and_compact(delta, batch, accepted, commit_version, floor)
-    return verdicts, HistState(base_h, base_st, delta)
+    new_hist = HistState(base_h, base_st, delta)
+    if report:
+        losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
+        return verdicts, losers, new_hist
+    return verdicts, new_hist
 
 
 def resolve_many_hist(
@@ -879,6 +958,18 @@ def advance_hist(hist: HistState, commit_version: jax.Array,
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _resolve_hist_jit(hist, batch, commit_version, new_oldest):
     return resolve_batch_hist(hist, batch, commit_version, new_oldest)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_hist_jit(hist, batch, commit_version, new_oldest):
+    return resolve_batch_hist(hist, batch, commit_version, new_oldest,
+                              report=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_jit(state, batch, commit_version, new_oldest):
+    return resolve_batch(state, batch, commit_version, new_oldest,
+                         report=True)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -56,6 +56,12 @@ class TPUConflictSet:
         self.base_version: int | None = None
         self.oldest_version: int = 0  # absolute; advances monotonically
         self._last_commit: int = 0
+        # Exact conflicting read ranges of the LAST resolve() call, by txn
+        # index — populated only when some txn asked
+        # (report_conflicting_keys) so the hot path pays nothing. Same
+        # surface as the oracle's (reference: conflictingKRIndices); the
+        # runtime Resolver reads it for the repair subsystem's reports.
+        self.last_conflicting: dict[int, list[KeyRange]] = {}
         self._init_engine()
 
     def _init_engine(self) -> None:
@@ -67,6 +73,7 @@ class TPUConflictSet:
                 self.delta_capacity,
             )
             self._resolve_fn = ck._resolve_hist_jit
+            self._resolve_report_fn = ck._resolve_report_hist_jit
             self._resolve_many_fn = ck._resolve_many_hist_jit
             self._rebase_fn = ck._rebase_hist_jit
         else:
@@ -74,6 +81,7 @@ class TPUConflictSet:
                 self.capacity, self.codec.width, self.codec.min_key
             )
             self._resolve_fn = ck._resolve_jit
+            self._resolve_report_fn = ck._resolve_report_jit
             self._resolve_many_fn = ck._resolve_many_jit
             self._rebase_fn = ck._rebase_jit
 
@@ -96,16 +104,35 @@ class TPUConflictSet:
         """Dispatch every chunk to the device immediately and return a
         collector. The caller (resolver role, bench) packs/dispatches the
         NEXT batch while the device still computes this one — materializing
-        verdicts (the device→host sync) is deferred to the collector."""
+        verdicts (the device→host sync) is deferred to the collector.
+
+        When some txn set report_conflicting_keys (and the engine compiled
+        a report entry point), the kernel's loser-range mask rides along
+        and the collector populates ``last_conflicting`` — exact
+        conflicting read ranges per txn index, the same surface the oracle
+        provides."""
+        can_report = getattr(self, "_resolve_report_fn", None) is not None
         self._begin_resolve(commit_version, oldest_version)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
         pending: list[tuple] = []
         for i in range(0, len(txns), self.batch_size):
             chunk = txns[i : i + self.batch_size]
-            batch = self._pack(chunk)
-            verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
-            pending.append((verdicts, len(chunk)))
+            # Per CHUNK: only chunks that actually contain a reporting txn
+            # pay the report program + host-side range bookkeeping.
+            if can_report and any(t.report_conflicting_keys for t in chunk):
+                batch, reads = self._pack(chunk, collect_reads=True)
+                verdicts, losers, self.state = self._resolve_report_fn(
+                    self.state, batch, cv, oldest
+                )
+                flags = [t.report_conflicting_keys for t in chunk]
+                pending.append((verdicts, len(chunk), losers, reads, flags))
+            else:
+                batch = self._pack(chunk)
+                verdicts, self.state = self._resolve_fn(
+                    self.state, batch, cv, oldest
+                )
+                pending.append((verdicts, len(chunk), None, None, None))
         return lambda: self._collect(pending)
 
     def resolve_wire(
@@ -149,11 +176,11 @@ class TPUConflictSet:
             n = min(remaining, self.batch_size)
             batch, offset = self._pack_wire(buf, offset, n)
             verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
-            pending.append((verdicts, n))
+            pending.append((verdicts, n, None, None, None))
             remaining -= n
         if as_array:
             return lambda: np.concatenate(
-                [np.asarray(v)[:n] for v, n in pending]
+                [np.asarray(v)[:n] for v, n, *_rest in pending]
             )
         return lambda: self._collect(pending)
 
@@ -232,12 +259,30 @@ class TPUConflictSet:
         )
         return lambda: np.asarray(verdicts)[:, :count]
 
-    @staticmethod
-    def _collect(pending: list[tuple]) -> list[Verdict]:
+    def _collect(self, pending: list[tuple]) -> list[Verdict]:
         out: list[Verdict] = []
-        for verdicts, n in pending:
+        self.last_conflicting = {}
+        gi = 0
+        for verdicts, n, losers, reads, flags in pending:
             v = np.asarray(verdicts)[:n]
+            if losers is not None:
+                m = np.asarray(losers)[:n]
+                for j in range(n):
+                    if v[j] == Verdict.CONFLICT and flags[j]:
+                        cols = [
+                            reads[j][c]
+                            for c in np.nonzero(m[j])[0]
+                            if c < len(reads[j])
+                        ]
+                        # Mask column c maps to the txn's c-th COALESCED
+                        # read range (the conservative covering ranges
+                        # _pack submitted) — a loser report may therefore
+                        # be slightly wider than the raw read set, never
+                        # narrower. Empty mask (shouldn't happen for a
+                        # real conflict) degrades to the full read set.
+                        self.last_conflicting[gi + j] = cols or list(reads[j])
             out.extend(Verdict(int(x)) for x in v)
+            gi += n
         return out
 
     def _begin_resolve(self, commit_version: int, oldest_version: int | None) -> None:
@@ -391,7 +436,7 @@ class TPUConflictSet:
             raise ValueError("malformed resolver wire batch")
         return bt, int(new_off)
 
-    def _pack(self, txns: list[TxnConflictInfo]) -> ck.BatchTensors:
+    def _pack(self, txns: list[TxnConflictInfo], collect_reads: bool = False):
         bt = self._empty_batch()
         read_begin, read_end, read_mask = bt.read_begin, bt.read_end, bt.read_mask
         write_begin, write_end, write_mask = bt.write_begin, bt.write_end, bt.write_mask
@@ -402,10 +447,16 @@ class TPUConflictSet:
         # per-txn Python work is just index bookkeeping).
         r_rows, r_cols, r_pairs = [], [], []
         w_rows, w_cols, w_pairs = [], [], []
+        reads_per_txn: list[list[KeyRange]] = []
         for i, t in enumerate(txns):
             txn_mask[i] = True
             read_version[i] = self._rel_read(t.read_version)
-            for c, x in enumerate(_coalesce(t.read_ranges, r)):
+            creads = _coalesce(t.read_ranges, r)
+            if collect_reads:
+                # Kept in slot order: the report path maps the kernel's
+                # loser-mask columns back to these ranges.
+                reads_per_txn.append(creads)
+            for c, x in enumerate(creads):
                 r_rows.append(i)
                 r_cols.append(c)
                 r_pairs.append((x.begin, x.end))
@@ -424,6 +475,8 @@ class TPUConflictSet:
             write_end[w_rows, w_cols] = we
             write_mask[w_rows, w_cols] = True
 
+        if collect_reads:
+            return bt, reads_per_txn
         return bt
 
 

@@ -216,6 +216,9 @@ class ShardedConflictSet(TPUConflictSet):
             ),
             donate_argnums=(0,),
         )
+        # No mesh report entry yet: conflicting-keys reports degrade to
+        # the resolver-side conservative superset (runtime/resolver.py).
+        self._resolve_report_fn = None
 
     def shard_occupancy(self) -> list[int]:
         """Live history boundary count per shard — the load-balance signal

@@ -1,0 +1,90 @@
+"""Hot-range conflict statistics: an exponentially-decayed loss sketch.
+
+Every transaction the resolver rejects lost on some set of read ranges.
+Recording those losses — decayed with a half-life so the sketch tracks
+the *current* contention picture, not history — yields per-range conflict
+odds. The resolver keeps one sketch per key shard (fed inside
+``Resolver.resolve``), the commit proxy aggregates the combined verdicts
+across resolvers into its own sketch, status JSON exports the proxy's
+top-k, and the proxy piggybacks the scores of a losing transaction's own
+ranges on its NotCommitted reply so the client's repair engine can apply
+jittered backoff on ranges where immediate retry is futile.
+
+Deliberately tiny and exact-keyed (begin, end) with bounded entries —
+conflict ranges under contention are the same few hot ranges over and
+over, which is precisely when the sketch matters. Decay is lazy (applied
+on touch), so an idle sketch costs nothing.
+"""
+
+from __future__ import annotations
+
+
+class HotRangeSketch:
+    def __init__(self, now_fn, half_life: float = 5.0,
+                 max_entries: int = 128):
+        self._now = now_fn
+        self.half_life = half_life
+        self.max_entries = max_entries
+        # (begin, end) -> [score, last_touched]
+        self._entries: dict[tuple[bytes, bytes], list[float]] = {}
+        self.losses_recorded = 0
+
+    def _decayed(self, score: float, last: float, now: float) -> float:
+        return score * 0.5 ** ((now - last) / self.half_life)
+
+    def record(self, ranges, weight: float = 1.0) -> None:
+        """One conflict loss on each of `ranges` ([(begin, end), ...])."""
+        now = self._now()
+        for begin, end in ranges:
+            k = (bytes(begin), bytes(end))
+            e = self._entries.get(k)
+            if e is None:
+                self._entries[k] = [weight, now]
+            else:
+                e[0] = self._decayed(e[0], e[1], now) + weight
+                e[1] = now
+        self.losses_recorded += len(ranges)
+        if len(self._entries) > self.max_entries:
+            self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        """Keep the hottest 3/4 (hysteresis so eviction is not per-record)."""
+        ranked = sorted(
+            self._entries.items(),
+            key=lambda kv: self._decayed(kv[1][0], kv[1][1], now),
+            reverse=True,
+        )
+        self._entries = dict(ranked[: (3 * self.max_entries) // 4])
+
+    def score(self, begin: bytes, end: bytes) -> float:
+        """Decayed loss mass overlapping [begin, end)."""
+        now = self._now()
+        return sum(
+            self._decayed(s, t, now)
+            for (b, e), (s, t) in self._entries.items()
+            if b < end and begin < e
+        )
+
+    def scores(self, ranges, limit: int = 8):
+        """[(begin, end, score), ...] for the caller's own ranges — the
+        payload a NotCommitted reply carries back to the repair engine."""
+        return [
+            (bytes(b), bytes(e), round(self.score(b, e), 3))
+            for b, e in list(ranges)[:limit]
+        ]
+
+    def top(self, k: int = 16, min_score: float = 0.01) -> list[dict]:
+        """Top-k hottest ranges as JSON-able dicts (status export)."""
+        now = self._now()
+        ranked = sorted(
+            (
+                (self._decayed(s, t, now), b, e)
+                for (b, e), (s, t) in self._entries.items()
+            ),
+            reverse=True,
+        )
+        return [
+            {"begin": b.hex(), "end": e.hex(), "score": round(s, 3)}
+            for s, b, e in ranked[:k]
+            if s >= min_score
+        ]

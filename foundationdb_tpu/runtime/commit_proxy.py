@@ -26,6 +26,7 @@ from foundationdb_tpu.core.mutations import (
     resolve_versionstamps,
 )
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.backup import BACKUP_TAG
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of, rpc
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
@@ -109,6 +110,13 @@ class CommitProxy:
         # None until the first refresh — tenant-bound tokens fail CLOSED
         # in that window.
         self.tenant_mirror = tenant_mirror
+        # Aggregated hot-range conflict statistics (repair subsystem):
+        # the resolvers' per-shard loss reports, ANDed into combined
+        # verdicts here, feed one decayed sketch per proxy — exported in
+        # get_metrics / status JSON and piggybacked (with the failed
+        # batch version) on every NotCommitted so the client repair
+        # engine can re-read only the losers and back off on hot ranges.
+        self.hot_ranges = HotRangeSketch(lambda: loop.now)
         self._queue: list[tuple[CommitRequest, Promise]] = []
         self._inflight: set[int] = set()  # batch versions being processed
         # Batches popped from _queue but not yet in _inflight (awaiting
@@ -158,6 +166,8 @@ class CommitProxy:
             "txns_committed": self.txns_committed,
             "txns_conflicted": self.txns_conflicted,
             "queued": len(self._queue),
+            "hot_ranges": self.hot_ranges.top(),
+            "conflict_losses": self.hot_ranges.losses_recorded,
         }
 
     # -- batch engine ---------------------------------------------------------
@@ -282,7 +292,7 @@ class CommitProxy:
         version: int,
     ) -> None:
         try:
-            verdicts, conflicting = await self._resolve(
+            verdicts, conflicting, fail_safe = await self._resolve(
                 batch, prev_version, version
             )
             tagged = self._assemble(batch, verdicts, version)
@@ -326,7 +336,7 @@ class CommitProxy:
                     name=f"request_recovery@{version}",
                 )
             return
-        for i, ((_req, p), v) in enumerate(zip(batch, verdicts)):
+        for i, ((req, p), v) in enumerate(zip(batch, verdicts)):
             if v == Verdict.COMMITTED:
                 self.txns_committed += 1
                 p.send(CommitResult(version, i))
@@ -334,8 +344,28 @@ class CommitProxy:
                 p.fail(TransactionTooOld())
             else:
                 self.txns_conflicted += 1
+                ranges = conflicting.get(i)
+                # Feed the aggregate sketch with the loser ranges (exact
+                # when a resolver reported them, else the txn's read set)
+                # — but NOT for fail-safe batches: those rejections are
+                # spurious and would score uncontended ranges hot (the
+                # resolver-side sketch skips them for the same reason).
+                feed = ranges if ranges is not None else [
+                    (r.begin, r.end) for r in req.read_ranges if not r.empty
+                ]
+                if not fail_safe:
+                    self.hot_ranges.record(feed)
                 p.fail(NotCommitted(
-                    conflicting_ranges=conflicting.get(i)
+                    conflicting_ranges=ranges,
+                    # No fail_version on fail-safe batches: the rejection
+                    # is capacity pressure, not contention, and a repair
+                    # client re-submitting instantly (repair skips the
+                    # exponential backoff) would amplify load on exactly
+                    # the overloaded resolver. Without it the repair
+                    # engine declines and the canonical backoff runs.
+                    fail_version=None if fail_safe else version,
+                    hot_ranges=(None if fail_safe
+                                else self.hot_ranges.scores(feed)),
                 ))
 
     RPC_RETRIES = 4  # worst case ~4.4s — must finish under WEDGE_TIMEOUT
@@ -358,7 +388,7 @@ class CommitProxy:
         batch: list[tuple[CommitRequest, Promise]],
         prev_version: int,
         version: int,
-    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]]]:
+    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
         """Fan the batch out to every resolver (filtered to its key shard)
         and AND the verdicts. Conflicts are never missed: any read/write
         overlap lands on whichever resolver owns those keys. As in the
@@ -397,8 +427,11 @@ class CommitProxy:
         )
         combined: list[Verdict] = []
         conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
+        # Any shard in fail-safe taints the whole batch's conflict stats:
+        # its CONFLICTs are spurious capacity rejections, not contention.
+        fail_safe = any(fs for _v, _c, fs in replies)
         for i in range(len(batch)):
-            vs = [verdicts[i] for verdicts, _conf in replies]
+            vs = [verdicts[i] for verdicts, _conf, _fs in replies]
             if Verdict.TOO_OLD in vs:
                 combined.append(Verdict.TOO_OLD)
             elif Verdict.CONFLICT in vs:
@@ -406,13 +439,13 @@ class CommitProxy:
                 # Union the per-resolver conflicting ranges (each resolver
                 # reports only its own key shard's clipped subranges).
                 ranges = [
-                    r for _v, conf in replies for r in conf.get(i, [])
+                    r for _v, conf, _fs in replies for r in conf.get(i, [])
                 ]
                 if ranges:
                     conflicting[i] = ranges
             else:
                 combined.append(Verdict.COMMITTED)
-        return combined, conflicting
+        return combined, conflicting, fail_safe
 
     def _assemble(
         self,

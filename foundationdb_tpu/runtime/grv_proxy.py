@@ -150,8 +150,14 @@ class GrvProxy:
         amortization: confirmEpochLive per batch, not per request). ALL
         members must answer — commit acks require all, so liveness does
         too; any locked/fenced/unreachable member means this generation
-        can no longer commit and must stop minting read versions."""
-        if not self.tlogs:
+        can no longer commit and must stop minting read versions.
+
+        Epoch 0 (static wiring, no recruitment protocol) skips the round
+        entirely: with no generations there is nothing to fence against,
+        so the check is vacuous and the fan-out is pure per-batch latency
+        in the common read path; a recovery lock is still observed via
+        the normal commit/read paths (ADVICE.md r5)."""
+        if not self.tlogs or not self.epoch:
             return
         tasks = [
             self.loop.spawn(t.confirm_epoch(self.epoch),

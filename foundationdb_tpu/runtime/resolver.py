@@ -12,6 +12,7 @@ all exposing resolve(txns, commit_version, oldest_version) → verdicts.
 from __future__ import annotations
 
 from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
+from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 from foundationdb_tpu.runtime.trace import Severity, trace
@@ -40,6 +41,11 @@ class Resolver:
         self._unsafe_until: int | None = None  # version; set on true overflow
         self.overflow_events = 0
         self.txns_rejected_fail_safe = 0
+        # Per-range conflict-loss sketch for THIS resolver's key shard:
+        # every rejected txn's losing read ranges are recorded (decayed),
+        # exported via get_metrics and aggregated at the commit proxy
+        # (repair subsystem — repair/hotrange.py).
+        self.hot_ranges = HotRangeSketch(lambda: loop.now)
 
     @rpc
     async def begin_epoch(self, start_version: int) -> int:
@@ -60,10 +66,13 @@ class Resolver:
         version: int,
         txns: list[TxnConflictInfo],
         oldest_version: int | None = None,
-    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]]]:
-        """→ (verdicts, conflicting): conflicting maps a txn's batch index
-        to its conflicting read ranges, for txns that set
-        report_conflicting_keys and got CONFLICT."""
+    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
+        """→ (verdicts, conflicting, fail_safe): conflicting maps a txn's
+        batch index to its conflicting read ranges, for txns that set
+        report_conflicting_keys and got CONFLICT. fail_safe marks a batch
+        rejected wholesale by the capacity fail-safe — its conflicts are
+        spurious, so downstream hot-range accounting must skip them (the
+        proxy's sketch would otherwise score uncontended ranges hot)."""
         while self._version != prev_version:
             if prev_version < self._version:
                 # Retransmit of a batch whose reply was lost (proxy↔resolver
@@ -104,16 +113,23 @@ class Resolver:
         exact = None if fail_safe else getattr(self.cs, "last_conflicting", None)
         conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
         for i, (t, v) in enumerate(zip(txns, verdicts)):
-            if v != Verdict.CONFLICT or not t.report_conflicting_keys:
+            if v != Verdict.CONFLICT:
                 continue
             ranges = exact.get(i) if exact is not None else None
             if ranges is None:
                 ranges = [r for r in t.read_ranges if not r.empty]
-            conflicting[i] = [(r.begin, r.end) for r in ranges]
+            pairs = [(r.begin, r.end) for r in ranges]
+            # Hot-range loss statistics (repair subsystem): every REAL
+            # loss is recorded, reporting-opt-in or not; fail-safe
+            # rejections are spurious and would poison the sketch.
+            if not fail_safe:
+                self.hot_ranges.record(pairs)
+            if t.report_conflicting_keys:
+                conflicting[i] = pairs
         self.batches_resolved += 1
         self.txns_resolved += len(txns)
         self._version = version
-        reply = (verdicts, conflicting)
+        reply = (verdicts, conflicting, fail_safe)
         self._replies[version] = reply
         if len(self._replies) > self.REPLY_CACHE_SIZE:
             del self._replies[min(self._replies)]
@@ -212,4 +228,6 @@ class Resolver:
             "overflow_events": self.overflow_events,
             "txns_rejected_fail_safe": self.txns_rejected_fail_safe,
             "history_headroom": self._headroom,
+            "hot_ranges": self.hot_ranges.top(),
+            "conflict_losses": self.hot_ranges.losses_recorded,
         }

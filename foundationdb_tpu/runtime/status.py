@@ -67,6 +67,10 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
             "transactions": {"committed": 0, "conflicted": 0},
             "grvs_served": 0,
             "resolver": {"batches": 0, "txns": 0},
+            # Hot-range conflict statistics (repair subsystem): the
+            # proxies' aggregated decayed loss sketches, hottest first.
+            "hot_ranges": [],
+            "conflict_losses": 0,
         },
         "qos": {},
         "processes": {},
@@ -77,12 +81,23 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         doc["processes"][ep.process] = {"role": "grv_proxy", "reachable": m is not None}
         doc["workload"]["grvs_served"] += m["grvs_served"] if m else 0
 
+    # Same range recorded at several proxies = one global hot range: merge
+    # by (begin, end), summing the decayed loss mass, before ranking.
+    hot: dict[tuple, float] = {}
     for ep, mt in zip(commit_eps, commit_ms):
         m = await mt
         doc["processes"][ep.process] = {"role": "commit_proxy", "reachable": m is not None}
         if m:
             doc["workload"]["transactions"]["committed"] += m["txns_committed"]
             doc["workload"]["transactions"]["conflicted"] += m["txns_conflicted"]
+            for h in m.get("hot_ranges") or []:
+                k = (h["begin"], h["end"])
+                hot[k] = hot.get(k, 0.0) + h["score"]
+            doc["workload"]["conflict_losses"] += m.get("conflict_losses", 0)
+    doc["workload"]["hot_ranges"] = [
+        {"begin": b, "end": e, "score": round(s, 3)}
+        for (b, e), s in sorted(hot.items(), key=lambda kv: -kv[1])[:16]
+    ]
 
     for ep, mt in zip(resolver_eps, resolver_ms):
         m = await mt

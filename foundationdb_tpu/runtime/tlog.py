@@ -130,6 +130,11 @@ class TLog:
         # a write that exists only on the doomed region's logs (deployed
         # multi-region partition find).
         self.epoch = epoch
+        # Operator/system credential gating entries_snapshot (set by the
+        # server wiring from the spec's authz_system_token, like
+        # StorageServer.system_token): when configured, ONLY a matching
+        # token may take the unlocked full-log snapshot.
+        self.system_token: str | None = None
         # Highest version the pushing proxies know is durable on EVERY tlog
         # (reference: knownCommittedVersion in TLogCommitRequest). Storage
         # reads this off peek replies and applies ONLY up to it: anything
@@ -504,12 +509,35 @@ class TLog:
                 + [(e.version, e.tagged) for e in self._log])
 
     @rpc
-    async def entries_snapshot(self) -> list[tuple[int, dict[int, list[Mutation]]]]:
+    async def entries_snapshot(
+        self, epoch: int = 0, token: str | None = None,
+    ) -> list[tuple[int, dict[int, list[Mutation]]]]:
         """recover_entries WITHOUT the lock precondition, for the one
         caller that must not lock: the controller's bootstrap-resume path
         seeds satellite tlogs from the resumed chain (a locked tlog can't
         begin_epoch, and the new generation is about to serve from it).
         Only atomic while nothing pushes — true in that window: chains
-        are resumed but no proxy generation is recruited yet."""
+        are resumed but no proxy generation is recruited yet.
+
+        GATED (ADVICE.md r5 — the precondition used to be docstring-only):
+        with a system token configured, only a matching token may read;
+        otherwise the caller must either hold the lock-equivalent (tlog
+        locked — recover_entries' own precondition) or present a
+        generation epoch at/after ours while the tlog is quiescent (no
+        parked pushes). A mistimed or displaced caller can no longer read
+        a torn snapshot including the unacked fork suffix."""
+        if not self._snapshot_allowed(epoch, token):
+            raise TLogLocked(
+                f"entries_snapshot denied: caller epoch {epoch} vs tlog "
+                f"epoch {self.epoch} (locked={self.locked}, "
+                f"parked={len(self._waiters)}, "
+                f"token={'set' if self.system_token else 'unset'})")
         return (self._spilled_entries()
                 + [(e.version, e.tagged) for e in self._log])
+
+    def _snapshot_allowed(self, epoch: int, token: str | None) -> bool:
+        if self.system_token is not None:
+            return token == self.system_token
+        if self.locked:
+            return True  # same precondition recover_entries asserts
+        return epoch >= self.epoch and not self._waiters

@@ -449,6 +449,7 @@ class Worker:
             tlog = TLog(self.loop)
         else:
             tlog = TLog.from_disk(self.loop, self._newest_queue())
+        tlog.system_token = _system_token(self.spec)
         self._tlog = tlog
         self.t.serve("tlog", tlog)
         return await tlog.get_version()
@@ -488,6 +489,7 @@ class Worker:
         tlog = TLog(self.loop, init_version=start_version,
                     seed=[(v, t) for v, t in seed_entries], disk_path=disk,
                     epoch=epoch)
+        tlog.system_token = _system_token(self.spec)
         self._tlog = tlog
         self.t.serve("tlog", tlog)
         self.epoch = epoch
@@ -1011,9 +1013,14 @@ class DeployedController:
             # IS the data), but fresh satellites must still hold what
             # lagging storages haven't applied — a region loss right
             # after a full bounce would otherwise have no salvage source.
+            # The snapshot is gated (tlog.entries_snapshot): pass the
+            # forming epoch + the system token so the tlog can tell this
+            # bootstrap call from a mistimed/displaced reader.
             src = live["tlog"][0]
             sat_seed = await self._retry(
-                lambda: self._tlog(src).entries_snapshot(), deadline)
+                lambda: self._tlog(src).entries_snapshot(
+                    epoch=epoch, token=_system_token(self.spec)),
+                deadline)
         for i in sat_live:
             await self._retry(
                 lambda i=i: self._worker("satellite_tlog", i)
@@ -1501,9 +1508,11 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
 
         if data_dir:
             disk = os.path.join(data_dir, f"tlog{index}.q")
-            t.serve("tlog", TLog.from_disk(loop, disk))
+            tlog = TLog.from_disk(loop, disk)
         else:
-            t.serve("tlog", TLog(loop))
+            tlog = TLog(loop)
+        tlog.system_token = _system_token(spec)  # gates entries_snapshot
+        t.serve("tlog", tlog)
     elif role == "storage":
         from foundationdb_tpu.runtime.kvstore import make_kvstore
         from foundationdb_tpu.runtime.storage import StorageServer
@@ -1556,9 +1565,11 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                 loop, t, spec, storage_map,
                 lambda name, mk: _supervise(loop, name, mk)),
         )
-        # Static wiring: epoch 0 = unfenced (no recruitment protocol),
-        # but the confirm round still refuses GRVs once recovery locks
-        # the chain.
+        # Static wiring: epoch 0 = unfenced (no recruitment protocol).
+        # GrvProxy skips the per-batch confirm_epoch fan-out at epoch 0 —
+        # the fence check is vacuous there and the tlog round trip is
+        # pure latency in the common read path; lock detection rides the
+        # normal commit/read paths instead (ADVICE.md r5).
         grv = GrvProxy(loop, seq_ep, rk_ep, tlog_eps=eps("tlog"))
         router = ReadRouter(storage_map, eps("storage"), loop=loop)
         t.serve("commit_proxy", proxy)

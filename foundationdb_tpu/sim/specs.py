@@ -47,6 +47,7 @@ from foundationdb_tpu.sim.workloads import (
     WatchesWorkload,
     WorkloadMetrics,
     WriteDuringReadWorkload,
+    ZipfRepairWorkload,
 )
 
 # testName -> (workload class, TOML key -> constructor kwarg). Unknown TOML
@@ -111,6 +112,14 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
+    }),
+    "ZipfRepair": (ZipfRepairWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "theta": "theta",
+        "readsPerTransaction": "reads_per_txn",
+        "repair": "repair",
     }),
     "WriteDuringRead": (WriteDuringReadWorkload, {
         "keyCount": "n_keys",
