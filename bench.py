@@ -533,6 +533,8 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
         cs.resolve_wire_async(blob[lo:hi], b + 1, count=B, as_array=True)()
     lo, hi = int(txn_ends[warm_batches * B]), int(txn_ends[(warm_batches + 1) * B])
     batch, _ = cs._pack_wire(np.asarray(blob[lo:hi]), 0, B)
+    batch = cs._dev_batch(batch)  # PackedBatch under FDB_TPU_PACKED
+    packed = ck._PACKED
     state = cs.state
     cv = np.int32(warm_batches + 1)
     oldest = np.int32(max(0, warm_batches + 1 - WINDOW))
@@ -548,36 +550,52 @@ def profile_phases(capacity, blob, txn_ends, warm_batches: int = 8,
         log(f"[profile] {label}: {ms:.3f} ms")
         return out
 
+    timings["packed"] = packed
     if isinstance(state, ck.HistState):
         # Window-history engine: base RMQ rides a prebuilt table; the
         # per-batch history cost is the delta table + queries, paint
         # touches only the delta, and the amortized merge is timed
         # separately (it runs once per ~Cd/(2BQ_live) batches).
         timings["history_design"] = "window"
-        hist = timeit("history_check", ck._phase_history_hist_jit, state, batch)
-        ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
-        floor, too_old = ck.too_old_mask(state.delta, batch, oldest)
+        hist_fn = (ck._phase_history_hist_packed_jit if packed
+                   else ck._phase_history_hist_jit)
+        ranks_fn = ck._phase_ranks_packed_jit if packed else ck._phase_ranks_jit
+        paint_fn = (ck._phase_paint_hist_packed_jit if packed
+                    else ck._phase_paint_hist_jit)
+        too_old_fn = ck.too_old_mask_packed if packed else ck.too_old_mask
+        hist = timeit("history_check", hist_fn, state, batch)
+        ranks_live = timeit("endpoint_ranks", ranks_fn, batch)
+        floor, too_old = too_old_fn(state.delta, batch, oldest)
         base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
         acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
-        timeit("paint_compact", ck._phase_paint_hist_jit, state, batch, acc,
-               cv, oldest)
+        timeit("paint_compact", paint_fn, state, batch, acc, cv, oldest)
         timeit("merge_amortized", ck._phase_merge_hist_jit, state, oldest)
-        full = jax.jit(ck.resolve_batch_hist)  # non-donating twin
+        full = jax.jit(ck.resolve_batch_hist_packed if packed
+                       else ck.resolve_batch_hist)  # non-donating twin
         timeit("full_resolve", full, state, batch, cv, oldest)
         phase_sum = sum(
             v for k, v in timings.items()
-            if k not in ("full_resolve", "merge_amortized", "history_design")
+            if k not in ("full_resolve", "merge_amortized", "history_design",
+                         "packed")
         )
     else:
-        hist = timeit("history_check", ck._phase_history_jit, state, batch)
-        ranks_live = timeit("endpoint_ranks", ck._phase_ranks_jit, batch)
-        floor, too_old = ck.too_old_mask(state, batch, oldest)
+        hist_fn = (ck._phase_history_packed_jit if packed
+                   else ck._phase_history_jit)
+        ranks_fn = ck._phase_ranks_packed_jit if packed else ck._phase_ranks_jit
+        paint_fn = (ck._phase_paint_packed_jit if packed
+                    else ck._phase_paint_jit)
+        too_old_fn = ck.too_old_mask_packed if packed else ck.too_old_mask
+        hist = timeit("history_check", hist_fn, state, batch)
+        ranks_live = timeit("endpoint_ranks", ranks_fn, batch)
+        floor, too_old = too_old_fn(state, batch, oldest)
         base = np.asarray(batch.txn_mask) & ~np.asarray(too_old) & ~np.asarray(hist)
         acc = timeit("block_accept_fused", ck._phase_accept_jit, base, *ranks_live)
-        timeit("paint_compact", ck._phase_paint_jit, state, batch, acc, cv, oldest)
-        full = jax.jit(ck.resolve_batch)  # non-donating twin for repeat timing
+        timeit("paint_compact", paint_fn, state, batch, acc, cv, oldest)
+        full = jax.jit(ck.resolve_batch_packed if packed
+                       else ck.resolve_batch)  # non-donating twin
         timeit("full_resolve", full, state, batch, cv, oldest)
-        phase_sum = sum(v for k, v in timings.items() if k != "full_resolve")
+        phase_sum = sum(v for k, v in timings.items()
+                        if k not in ("full_resolve", "packed"))
     timings["phase_sum_vs_full"] = round(
         phase_sum / timings["full_resolve"], 2
     ) if timings.get("full_resolve") else None
@@ -668,56 +686,109 @@ V5E_HBM_BYTES_PER_S = 819e9  # HBM bandwidth
 V5E_VPU_INT_OPS_PER_S = 4e12  # order-of-magnitude VPU lane throughput
 
 
-def roofline_estimate(mode: ModeConfig, capacity: int,
-                      wave_rounds: int = 4) -> dict:
-    """Per-batch work estimate for resolve_batch at this mode's shapes.
-
-    Models the CURRENT kernel (block-sequential acceptance, G=512
-    blocks): history sparse-table build + searchsorted + RMQ, endpoint
-    rank sort, and per-block fused overlap rows [G, B] (never a
-    materialized [B, B]) with cross-block [G, B]@[B] matvecs plus
-    within-block [G, G] waves, then the merge/compact paint. Word width
-    W is the packed-key int32 width; sorts modeled as bitonic log²N.
-    Bounds which resource saturates and what peak txns/s/chip the
-    hardware admits — not exact."""
+def _roofline_one(mode: ModeConfig, capacity: int, wave_rounds: int,
+                  packed: bool, hist_design: str) -> dict:
+    """One design point of the analytic per-batch model (see
+    roofline_estimate). Both the packed and unpacked kernels are scored
+    with the SAME term structure so the bytes ratio isolates the format
+    change, and the history terms follow FDB_TPU_HISTORY (the window
+    design amortizes the base table rebuild + merge over the batches one
+    delta fill lasts)."""
     B, R, Q = mode.batch, mode.n_reads, mode.n_writes
     H = capacity
     G = min(512, B)  # conflict_kernel._ACCEPT_BLOCK
     nblk = max(1, B // G)
     W = (KEY_BYTES + 3) // 4 + 1  # +1 length/terminator word (keypack)
+    kb = 4 * W  # bytes per packed key row
     lgH = max(1.0, np.log2(H))
-    N = 2 * B * (R + Q)  # batch endpoints entering the rank sort
+    N = 2 * B * (R + Q)  # batch endpoints (the deduped dict size bound)
     lgN = max(1.0, np.log2(N))
-    sort_passes = lgN * (lgN + 1) / 2  # bitonic network depth
-    M = H + 2 * B * Q  # merged boundary set in paint/compact
+    n2 = 2 * B * Q  # paint endpoints
+    lgn2 = max(1.0, np.log2(max(n2, 2)))
+    probes = 2 * B * R  # read endpoints probing the history
 
-    int_ops = (
-        lgH * H  # sparse-table build passes
-        + 2 * B * R * lgH * W * 2  # history searchsorted word compares
-        + 2 * B * R * 8  # sparse-table RMQ combine
-        + sort_passes * N * W  # endpoint rank sort compares
-        + 2 * N * lgN * W  # rank searchsorted
-        + B * B * R * Q * 3  # fused overlap rows, summed over blocks
-        + M * np.log2(max(M, 2)) * W  # merge/compact
-    )
-    mxu_flops = (
-        nblk * 2.0 * G * B  # cross-block demotion matvecs
-        + nblk * wave_rounds * 2.0 * 2 * G * G  # within-block wave rounds
-    )
-    bytes_moved = (
-        lgH * H * 4 * 2  # sparse-table build read+write
-        + 2 * B * R * lgH * 4 * W  # searchsorted gathers (uncoalesced bound)
-        + 2 * B * R * 16
-        + sort_passes * N * 4 * W * 2  # sort read+write per pass
-        + B * B  # per-block [G, B] rows written+consumed once (bf16-ish)
-        + nblk * wave_rounds * 2 * G * G  # wave tile traffic
-        + 6 * M * 4 * W  # compact passes
-    )
+    def sp(lg):  # bitonic sort network depth
+        return lg * (lg + 1) / 2
+
+    windowed = hist_design == "window"
+    cd = min(H, n2 + 2)  # delta capacity (conflict_set default sizing)
+    lgCd = max(1.0, np.log2(cd))
+    live = max(1.0, n2 * mode.write_frac)  # endpoints painted per batch
+    period = max(1.0, cd / live)  # batches between delta→base merges
+
+    # RMQ table builds; window design pays the delta table per batch and
+    # the base rebuild once per merge.
+    if windowed:
+        table_bytes = lgCd * cd * 8 + (lgH * H * 8) / period
+        table_ops = lgCd * cd + (lgH * H) / period
+        lg_probe = lgH + lgCd  # each endpoint probes base AND delta
+    else:
+        table_bytes = lgH * H * 8
+        table_ops = lgH * H
+        lg_probe = lgH
+
+    # History probes + endpoint rank space + paint endpoint sort.
+    if packed:
+        # One fingerprint search per UNIQUE dictionary key per side: every
+        # step gathers the 4-byte first-word column; full-width rows only
+        # on first-word ties (~2 per probe); slots gather bounds by rank.
+        # The endpoint rank sort is GONE (host packer dedups+sorts), and
+        # the paint sorts 1-word ranks + an index payload, gathering keys
+        # back from the dictionary.
+        searches = 2 * (N + 1)
+        search_bytes = searches * (lg_probe * 4 + 2 * kb) + probes * 8
+        search_ops = searches * (lg_probe + 2 * W) + probes * 2
+        dict_bytes = (N + 1) * kb
+        rank_sort_bytes = rank_sort_ops = 0.0
+        paint_sort_bytes = sp(lgn2) * n2 * 8 * 2 + n2 * kb
+        paint_sort_ops = sp(lgn2) * n2 + n2 * W
+        # Bit-packed masks: uint32 bitset rows and wave tiles.
+        rows_bytes = B * B / 8
+        wave_bytes = nblk * wave_rounds * 2 * G * G / 8
+        mask_ops = (B * B + nblk * wave_rounds * 2 * G * G) / 32
+        mxu_flops = 0.0  # acceptance is pure VPU bitwise under packing
+    else:
+        search_bytes = probes * lg_probe * kb + probes * 16
+        search_ops = probes * lg_probe * W * 2 + probes * 8
+        dict_bytes = 0.0
+        rank_sort_bytes = sp(lgN) * N * kb * 2
+        rank_sort_ops = sp(lgN) * N * W + 2 * N * lgN * W
+        paint_sort_bytes = sp(lgn2) * n2 * (kb + 12) * 2
+        paint_sort_ops = sp(lgn2) * n2 * W
+        rows_bytes = B * B  # bool rows written+consumed once
+        wave_bytes = nblk * wave_rounds * 2 * G * G
+        mask_ops = 0.0
+        mxu_flops = (
+            nblk * 2.0 * G * B  # cross-block demotion matvecs
+            + nblk * wave_rounds * 2.0 * 2 * G * G  # wave rounds
+        )
+    overlap_ops = B * B * R * Q * 3  # fused overlap compares (both forms)
+
+    # Paint/compact streaming; window design compacts the small delta per
+    # batch and the full base once per merge.
+    if windowed:
+        m_batch = cd + n2
+        m_merge = H + cd
+        compact_bytes = 6 * m_batch * kb + (6 * m_merge * kb) / period
+        compact_ops = (
+            m_batch * np.log2(max(m_batch, 2)) * W
+            + (m_merge * np.log2(max(m_merge, 2)) * W) / period
+        )
+    else:
+        m_batch = H + n2
+        compact_bytes = 6 * m_batch * kb
+        compact_ops = m_batch * np.log2(max(m_batch, 2)) * W
+
+    int_ops = (table_ops + search_ops + rank_sort_ops + paint_sort_ops
+               + overlap_ops + mask_ops + compact_ops)
+    bytes_moved = (table_bytes + search_bytes + dict_bytes + rank_sort_bytes
+                   + paint_sort_bytes + rows_bytes + wave_bytes
+                   + compact_bytes)
     t_vpu = int_ops / V5E_VPU_INT_OPS_PER_S
     t_mxu = mxu_flops / V5E_BF16_FLOPS
     t_hbm = bytes_moved / V5E_HBM_BYTES_PER_S
     t_bound = max(t_vpu, t_mxu, t_hbm)
-    bound = {t_vpu: "vpu", t_mxu: "mxu", t_hbm: "hbm"}[t_bound]
+    bound = "vpu" if t_bound == t_vpu else ("hbm" if t_bound == t_hbm else "mxu")
     return {
         "int_ops_per_batch": round(float(int_ops)),
         "mxu_flops_per_batch": round(float(mxu_flops)),
@@ -727,8 +798,43 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
         "t_us_hbm": round(t_hbm * 1e6, 2),
         "bound": bound,
         "projected_peak_txns_per_sec": round(B / t_bound),
-        "assumes": "public TPU v5e peaks: 197 TF bf16, 819 GB/s HBM, ~4e12 VPU int-ops/s",
     }
+
+
+def roofline_estimate(mode: ModeConfig, capacity: int,
+                      wave_rounds: int = 4, packed: "bool | None" = None,
+                      hist_design: "str | None" = None) -> dict:
+    """Per-batch work estimate for resolve_batch at this mode's shapes.
+
+    Models the kernel under the ACTIVE design flags (FDB_TPU_PACKED /
+    FDB_TPU_HISTORY, defaulting to the env the way conflict_kernel reads
+    them): history table builds + probes (fingerprint dictionary probes
+    when packed), endpoint rank space (host-side when packed), per-block
+    fused overlap rows [G, B] (uint32 bitsets when packed) with the
+    within-block [G, G] waves, then the merge/compact paint. Word width
+    W is the packed-key int32 width; sorts modeled as bitonic log²N.
+    Bounds which resource saturates and what peak txns/s/chip the
+    hardware admits — not exact. Always carries the UNPACKED counterfactual
+    (same shapes, same term structure) so the packed-format byte cut is
+    auditable from one record."""
+    import os
+
+    if packed is None:
+        packed = os.environ.get("FDB_TPU_PACKED", "1") != "0"
+    if hist_design is None:
+        hist_design = os.environ.get("FDB_TPU_HISTORY", "window")
+    est = _roofline_one(mode, capacity, wave_rounds, packed, hist_design)
+    base = (est if not packed
+            else _roofline_one(mode, capacity, wave_rounds, False, hist_design))
+    est["packed"] = packed
+    est["history_design"] = hist_design
+    est["bytes_per_batch_unpacked"] = base["bytes_per_batch"]
+    est["packed_bytes_ratio"] = round(
+        base["bytes_per_batch"] / max(est["bytes_per_batch"], 1), 2
+    )
+    est["assumes"] = ("public TPU v5e peaks: 197 TF bf16, 819 GB/s HBM, "
+                      "~4e12 VPU int-ops/s")
+    return est
 
 
 # ---------------------------------------------------------------------------
@@ -762,16 +868,17 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
                    + " --xla_force_host_platform_device_count=8").strip(),
     )
     child_txns = min(max(sweep_txns, 65_536), 131_072)
-    # ≥4 dispatch windows so the mid-run density reshard (run_tpu_wire
-    # reshard_mid) actually fires and the artifact records before/after.
-    child_window = max(1, (child_txns // MODES["ycsb"].batch) // 4)
 
-    def child_run(n: int, timeout_s: float) -> dict:
+    def child_run(n: int, timeout_s: float, txns: "int | None" = None) -> dict:
+        txns = txns or child_txns
+        # ≥4 dispatch windows so the mid-run density reshard (run_tpu_wire
+        # reshard_mid) actually fires and the artifact records before/after.
+        window = max(1, (txns // MODES["ycsb"].batch) // 4)
         cmd = [sys.executable, sys.argv[0] if sys.argv else "bench.py",
                "--mode", "ycsb", "--resolvers", str(n),
-               "--txns", str(child_txns),
+               "--txns", str(txns),
                "--keys", str(args.keys), "--capacity", str(args.capacity),
-               "--seed", str(args.seed + 1), "--window", str(child_window)]
+               "--seed", str(args.seed + 1), "--window", str(window)]
         log(f"[{cname}] launching cpu-mesh subprocess: {' '.join(cmd[1:])}")
         r = subprocess.run(
             cmd, env=env, capture_output=True, text=True, timeout=timeout_s,
@@ -780,6 +887,7 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
         return json.loads(line)
 
     try:
+        t_mesh0 = time.perf_counter()
         budget = max(300.0, budget_s - 60.0)
         child = child_run(nres, budget)
         keep = ("value", "vs_baseline", "txns", "conflict_rate",
@@ -790,13 +898,19 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
                    note="virtual 8-device CPU mesh: occupancy/balance "
                         "signal, not TPU perf")
         # Throughput SCALING curve (VERDICT r4 item 10): the same stream
-        # on the same cpu-mesh backend with ONE resolver; ratio of the
-        # windowed rates says what n-way sharding actually buys — a
+        # shape on the same cpu-mesh backend with ONE resolver; ratio of
+        # the windowed RATES says what n-way sharding actually buys — a
         # load-balance claim becomes a throughput measurement (still
-        # labeled cpu-mesh, never a TPU number).
-        if budget_s > 900:
+        # labeled cpu-mesh, never a TPU number). The probe runs at a
+        # REDUCED txn count: rates are size-independent past a few
+        # dispatch windows, and r5's full-size probe was skipped every
+        # round by the "deadline budget" gate it could never clear.
+        scale_txns = min(child_txns, 4 * MODES["ycsb"].batch)
+        remaining = budget_s - (time.perf_counter() - t_mesh0)
+        if remaining > 180:
             try:
-                one = child_run(1, budget / 2)
+                one = child_run(1, max(180.0, min(600.0, remaining - 60.0)),
+                                txns=scale_txns)
                 n_rate = (child.get("windowed") or {}).get("value") or child.get("value")
                 one_rate = ((one.get("windowed") or {}).get("value")
                             or one.get("value"))
@@ -806,6 +920,7 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
                     "ratio": (round(n_rate / one_rate, 2)
                               if n_rate and one_rate else None),
                     "ideal": nres,
+                    "probe_txns": scale_txns,
                 }
             except Exception as e:  # noqa: BLE001
                 out["scaling"] = {"error": str(e)[:200]}
@@ -1003,7 +1118,9 @@ def main() -> None:
     ap.add_argument("--keys", type=int, default=1 << 16)
     ap.add_argument("--capacity", type=int, default=1 << 18)
     ap.add_argument("--seed", type=int, default=20260729)
-    ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--profile", action="store_true",
+                    help="also run the per-phase profiler on the sweep "
+                         "configs (the headline config is always profiled)")
     ap.add_argument("--mode", choices=sorted(MODES), default=None,
                     help="run ONLY this config (default: ycsb headline plus "
                          "reduced-size mako/tpcc/4-resolver sweeps)")
@@ -1033,7 +1150,7 @@ def main() -> None:
     if (os.environ.get("FDB_TPU_FORCE_CPU") == "1"
             and os.environ.get("FDB_TPU_ALLOW_CPU") != "1"):
         # Hang-recovery re-exec landed on CPU: diagnostic run only — keep
-        # it small; the artifact will be valid:false with rc=2 regardless.
+        # it small; the artifact is valid:false (cpu_fallback) regardless.
         args.txns = min(args.txns, 131_072)
     single = args.mode is not None or args.resolvers > 1
     headline_mode = MODES[args.mode or "ycsb"]
@@ -1116,12 +1233,16 @@ def main() -> None:
             return deadline - (time.perf_counter() - _T0)
 
         # Headline config: full-size run (ycsb unless --mode overrides).
+        # The per-phase profiler runs UNCONDITIONALLY on the headline (it
+        # costs a few extra compiles on an already-warm cache) so every
+        # round's artifact carries byte/phase attribution — r5 shipped
+        # phase_profile_ms: null because --profile wasn't passed.
         head = run_config(
             args.mode or "ycsb", headline_mode, args.txns, args.keys,
             args.seed, args.capacity, platform,
             repeats=3 if on_tpu else 2,
             n_resolvers=args.resolvers, window=args.window,
-            profile=args.profile,
+            profile=True,
         )
         result.update({k: v for k, v in head.items() if k != "overflowed"})
         result["resolvers"] = args.resolvers
@@ -1160,6 +1281,7 @@ def main() -> None:
                         cname, cmode, sweep_txns, args.keys, args.seed + 1,
                         args.capacity, platform, repeats=1,
                         n_resolvers=nres, window=args.window,
+                        profile=args.profile and nres == 1,
                     )
                 except Exception as e:  # noqa: BLE001 — one sweep failing
                     # must not cost the others or the headline result
@@ -1172,9 +1294,14 @@ def main() -> None:
                 "error", "ran on CPU fallback — no TPU backend available"
             )
             if not allow_cpu:
-                # The artifact must tell the truth to tooling that only
-                # checks rc: a CPU-fallback run is NOT a benchmark result.
-                exit_rc = 2
+                # valid:false already marks this record as non-evidence;
+                # rc stays 0 because the harness itself worked. Nonzero rc
+                # is RESERVED for real harness errors (exception → 1,
+                # watchdog → 3) so the heal-window autopilot can tell a
+                # healthy CPU-fallback diagnostic from a broken bench —
+                # r5's rc=2-on-fallback made them indistinguishable
+                # (BENCH_r05.json: rc=2, parsed: null).
+                result["cpu_fallback"] = True
     except Exception:
         tb = traceback.format_exc()
         log(tb)

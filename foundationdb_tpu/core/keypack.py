@@ -27,6 +27,18 @@ INT32_MAX = np.int32(2**31 - 1)
 _BIAS = np.uint32(0x80000000)
 
 
+def row_sort_keys(a: np.ndarray) -> np.ndarray:
+    """Host-side lexicographic sort keys for packed int32 key rows.
+
+    Byte order equals signed-int32 numeric order (the packing bias), so
+    re-bias to uint32 and big-endian the words — memcmp order on the void
+    view then matches key order. Shared by the sharded resolver's history
+    redistribution and the packed-batch dictionary builder."""
+    u = (a.astype(np.int64) + (1 << 31)).astype(np.uint64).astype(">u4")
+    u = np.ascontiguousarray(u)
+    return u.view([("k", f"V{4 * a.shape[-1]}")]).ravel()
+
+
 class KeyCodec:
     """Packs byte keys to biased int32 word vectors of static width."""
 

@@ -20,7 +20,7 @@ from typing import Callable
 
 import numpy as np
 
-from foundationdb_tpu.core.keypack import INT32_MAX, KeyCodec
+from foundationdb_tpu.core.keypack import INT32_MAX, KeyCodec, row_sort_keys
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
 from foundationdb_tpu.models import conflict_kernel as ck
 
@@ -62,28 +62,88 @@ class TPUConflictSet:
         # surface as the oracle's (reference: conflictingKRIndices); the
         # runtime Resolver reads it for the repair subsystem's reports.
         self.last_conflicting: dict[int, list[KeyRange]] = {}
+        self._empty_dev_batch = None  # advance()'s constant batch, packed lazily
         self._init_engine()
 
     def _init_engine(self) -> None:
         """Build device state + entry points. Subclasses (the mesh-sharded
-        engine) override this; all host-side logic is shared."""
+        engine) override this; all host-side logic is shared. Under
+        FDB_TPU_PACKED (default) the packer additionally emits the batch's
+        deduped key dictionary (_pack_dict) and the device runs the
+        rank-space kernel entry points."""
+        self._dev_batch = self._pack_dict if ck._PACKED else (lambda bt: bt)
         if ck._HIST_DESIGN == "window":
             self.state = ck.init_hist(
                 self.capacity, self.codec.width, self.codec.min_key,
                 self.delta_capacity,
             )
-            self._resolve_fn = ck._resolve_hist_jit
-            self._resolve_report_fn = ck._resolve_report_hist_jit
-            self._resolve_many_fn = ck._resolve_many_hist_jit
+            if ck._PACKED:
+                self._resolve_fn = ck._resolve_hist_packed_jit
+                self._resolve_report_fn = ck._resolve_report_hist_packed_jit
+                self._resolve_many_fn = ck._resolve_many_hist_packed_jit
+            else:
+                self._resolve_fn = ck._resolve_hist_jit
+                self._resolve_report_fn = ck._resolve_report_hist_jit
+                self._resolve_many_fn = ck._resolve_many_hist_jit
             self._rebase_fn = ck._rebase_hist_jit
         else:
             self.state = ck.init_state(
                 self.capacity, self.codec.width, self.codec.min_key
             )
-            self._resolve_fn = ck._resolve_jit
-            self._resolve_report_fn = ck._resolve_report_jit
-            self._resolve_many_fn = ck._resolve_many_jit
+            if ck._PACKED:
+                self._resolve_fn = ck._resolve_packed_jit
+                self._resolve_report_fn = ck._resolve_report_packed_jit
+                self._resolve_many_fn = ck._resolve_many_packed_jit
+            else:
+                self._resolve_fn = ck._resolve_jit
+                self._resolve_report_fn = ck._resolve_report_jit
+                self._resolve_many_fn = ck._resolve_many_jit
             self._rebase_fn = ck._rebase_jit
+
+    def _pack_dict(self, bt: ck.BatchTensors) -> ck.PackedBatch:
+        """Dedup+sort ALL batch endpoint keys once per dispatch (host
+        numpy — a memcmp sort over the biased byte view) and rewrite the
+        batch in rank space: the kernel receives the sorted unique key
+        dictionary plus int32 ranks per endpoint slot. The dictionary's
+        static size is the endpoint count + 1, with the last row always
+        +inf (paint parks masked slots there); ranks are exact order
+        isomorphisms (equal keys share a rank)."""
+        rb = np.asarray(bt.read_begin)
+        if rb.ndim == 4:  # [k, B, R, W] window path: pack per scan step
+            parts = [
+                self._pack_dict(
+                    ck.BatchTensors(*(np.asarray(x)[i] for x in bt))
+                )
+                for i in range(rb.shape[0])
+            ]
+            return ck.PackedBatch(*(np.stack(x) for x in zip(*parts)))
+        b, r, w = rb.shape
+        q = bt.write_begin.shape[1]
+        flat = np.concatenate([
+            rb.reshape(-1, w),
+            np.asarray(bt.read_end).reshape(-1, w),
+            np.asarray(bt.write_begin).reshape(-1, w),
+            np.asarray(bt.write_end).reshape(-1, w),
+        ])
+        _, first, inverse = np.unique(
+            row_sort_keys(flat), return_index=True, return_inverse=True
+        )
+        n = flat.shape[0]
+        dict_keys = np.full((n + 1, w), INT32_MAX, np.int32)
+        dict_keys[: len(first)] = flat[first]
+        inv = inverse.astype(np.int32)
+        n_r, n_q = b * r, b * q
+        return ck.PackedBatch(
+            dict_keys=dict_keys,
+            read_begin=inv[:n_r].reshape(b, r),
+            read_end=inv[n_r : 2 * n_r].reshape(b, r),
+            read_mask=np.asarray(bt.read_mask),
+            write_begin=inv[2 * n_r : 2 * n_r + n_q].reshape(b, q),
+            write_end=inv[2 * n_r + n_q :].reshape(b, q),
+            write_mask=np.asarray(bt.write_mask),
+            read_version=np.asarray(bt.read_version),
+            txn_mask=np.asarray(bt.txn_mask),
+        )
 
     # -- public API ---------------------------------------------------------
 
@@ -123,14 +183,14 @@ class TPUConflictSet:
             if can_report and any(t.report_conflicting_keys for t in chunk):
                 batch, reads = self._pack(chunk, collect_reads=True)
                 verdicts, losers, self.state = self._resolve_report_fn(
-                    self.state, batch, cv, oldest
+                    self.state, self._dev_batch(batch), cv, oldest
                 )
                 flags = [t.report_conflicting_keys for t in chunk]
                 pending.append((verdicts, len(chunk), losers, reads, flags))
             else:
                 batch = self._pack(chunk)
                 verdicts, self.state = self._resolve_fn(
-                    self.state, batch, cv, oldest
+                    self.state, self._dev_batch(batch), cv, oldest
                 )
                 pending.append((verdicts, len(chunk), None, None, None))
         return lambda: self._collect(pending)
@@ -175,7 +235,9 @@ class TPUConflictSet:
         while remaining > 0:
             n = min(remaining, self.batch_size)
             batch, offset = self._pack_wire(buf, offset, n)
-            verdicts, self.state = self._resolve_fn(self.state, batch, cv, oldest)
+            verdicts, self.state = self._resolve_fn(
+                self.state, self._dev_batch(batch), cv, oldest
+            )
             pending.append((verdicts, n, None, None, None))
             remaining -= n
         if as_array:
@@ -255,7 +317,7 @@ class TPUConflictSet:
             if offset < 0:
                 raise ValueError("malformed resolver wire batch")
         verdicts, self.state = self._resolve_many_fn(
-            self.state, batches, cvs_rel, olds_rel
+            self.state, self._dev_batch(batches), cvs_rel, olds_rel
         )
         return lambda: np.asarray(verdicts)[:, :count]
 
@@ -267,6 +329,14 @@ class TPUConflictSet:
             v = np.asarray(verdicts)[:n]
             if losers is not None:
                 m = np.asarray(losers)[:n]
+                if m.dtype != np.bool_:
+                    # uint32 bitset rows (packed kernel): bit c = coalesced
+                    # read slot c lost — unpack to the bool [n, R] layout.
+                    m = (
+                        (m[:, None]
+                         >> np.arange(self.max_read_ranges, dtype=np.uint32))
+                        & 1
+                    ).astype(bool)
                 for j in range(n):
                     if v[j] == Verdict.CONFLICT and flags[j]:
                         cols = [
@@ -368,7 +438,14 @@ class TPUConflictSet:
         if self._is_hist:
             _, self.state = ck._advance_hist_jit(self.state, cv, oldest)
             return
-        _, self.state = self._resolve_fn(self.state, self._empty_batch(), cv, oldest)
+        if self._empty_dev_batch is None:
+            # The packed dictionary build is real host work (np.unique over
+            # all endpoint rows) and advance()'s all-masked batch is a
+            # constant — pack it once. The batch argument is never donated.
+            self._empty_dev_batch = self._dev_batch(self._empty_batch())
+        _, self.state = self._resolve_fn(
+            self.state, self._empty_dev_batch, cv, oldest
+        )
 
     # -- internals ----------------------------------------------------------
 

@@ -78,6 +78,85 @@ def searchsorted_words(
     return lo
 
 
+def searchsorted_words_2sided_fp(
+    sorted_keys: jax.Array, queries: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(left, right) insertion indices in one pass — the column-cascade
+    FINGERPRINT search.
+
+    Maintains a per-query candidate run [lo, hi) and narrows it one
+    4-byte WORD COLUMN at a time: within the incoming run all earlier
+    words are already equal, so the run restricted to column j is sorted
+    and the sub-run matching the query's word j falls out of two scalar
+    binary searches. Every probe step gathers 4 bytes — never a full
+    ``4*W`` row — a word whose column is constant inside the run costs
+    O(1) (the shared-prefix shortcut: exactly the case of common-prefix
+    keyspaces, where full-width compares waste W-1 words per step), and
+    the early-exit while_loop stops the moment every query's bounds
+    converge. After the last word the run IS the equal-key run, so its
+    edges are both searchsorted sides at once.
+    """
+    sorted_keys = jnp.asarray(sorted_keys)
+    queries = jnp.asarray(queries)
+    n, w = sorted_keys.shape
+    shape = queries.shape[:-1]
+    if n == 0:
+        z = jnp.zeros(shape, dtype=jnp.int32)
+        return z, z
+    lo = jnp.zeros(shape, dtype=jnp.int32)
+    hi = jnp.full(shape, n, dtype=jnp.int32)
+    for j in range(w):
+        col = sorted_keys[:, j]
+        qj = queries[..., j]
+        nonempty = hi > lo
+        col_lo = col[jnp.minimum(lo, n - 1)]  # run minimum (col sorted in-run)
+        col_hi = col[jnp.maximum(hi - 1, 0)]  # run maximum
+        # Shortcut-converged states from the run's two edge words alone:
+        # col_lo >= qj pins the left bound at lo, col_hi <= qj pins the
+        # right bound at hi, and a query word outside [col_lo, col_hi]
+        # pins both — so a column that is CONSTANT inside the run (the
+        # shared-prefix case) costs two 4-byte gathers and no search.
+        l_known = ~nonempty | (col_lo >= qj) | (col_hi < qj)
+        l_res = jnp.where(nonempty & (col_hi < qj), hi, lo)
+        r_known = ~nonempty | (col_lo > qj) | (col_hi <= qj)
+        r_res = jnp.where(nonempty & (col_hi <= qj), hi, lo)
+        lL = jnp.where(l_known, l_res, lo)
+        hL = jnp.where(l_known, l_res, hi)
+        lR = jnp.where(r_known, r_res, lo)
+        hR = jnp.where(r_known, r_res, hi)
+
+        def cond(s):
+            lL, hL, lR, hR = s
+            return jnp.any((lL < hL) | (lR < hR))
+
+        def body(s):
+            lL, hL, lR, hR = s
+            mL = (lL + hL) >> 1
+            go_l = col[mL] < qj  # left bound: first index with col >= qj
+            a_l = lL < hL
+            lL = jnp.where(a_l & go_l, mL + 1, lL)
+            hL = jnp.where(a_l & ~go_l, mL, hL)
+            mR = (lR + hR) >> 1
+            go_r = col[mR] <= qj  # right bound: first index with col > qj
+            a_r = lR < hR
+            lR = jnp.where(a_r & go_r, mR + 1, lR)
+            hR = jnp.where(a_r & ~go_r, mR, hR)
+            return lL, hL, lR, hR
+
+        lL, _, lR, _ = jax.lax.while_loop(cond, body, (lL, hL, lR, hR))
+        lo, hi = lL, lR
+    return lo, hi
+
+
+def searchsorted_words_fp(
+    sorted_keys: jax.Array, queries: jax.Array, side: str = "left"
+) -> jax.Array:
+    """searchsorted_words via the column-cascade fingerprint search
+    (identical results; see searchsorted_words_2sided_fp)."""
+    left, right = searchsorted_words_2sided_fp(sorted_keys, queries)
+    return left if side == "left" else right
+
+
 def sort_keys_with_payload(
     keys: jax.Array, *payloads: jax.Array
 ) -> tuple[jax.Array, ...]:
@@ -91,3 +170,17 @@ def sort_keys_with_payload(
     res = jax.lax.sort(cols + tuple(payloads), num_keys=w, is_stable=True)
     sorted_keys = jnp.stack(res[:w], axis=-1)
     return (sorted_keys,) + tuple(res[w:])
+
+
+def sort_ranks_with_payload(
+    ranks: jax.Array, *payloads: jax.Array
+) -> tuple[jax.Array, ...]:
+    """Stable sort of int32 [N] RANKS with payload columns.
+
+    The packed kernel's replacement for sort_keys_with_payload: when keys
+    already live in a deduped dictionary, their ranks are order-isomorphic
+    (equal keys share a rank), so a single-word int32 sort produces the
+    identical permutation while streaming 1/W of the key bytes per pass.
+    """
+    return jax.lax.sort((ranks,) + tuple(payloads), num_keys=1,
+                        is_stable=True)

@@ -96,6 +96,10 @@ while true; do
     env FDB_TPU_RMQ=blocked python bench.py --mode ycsb || { sleep 60; continue; }
   stage ab_hist 1200 BENCH_r05_batchhist.json "$TPU_ANY" -- \
     env FDB_TPU_HISTORY=batch python bench.py --mode ycsb || { sleep 60; continue; }
+  stage ab_packed 2000 KERNEL_AB_r05.json \
+    'r.get("metric") == "kernel_ab_packed_vs_unpacked"' -- \
+    env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=KERNEL_AB_r05_rec.json \
+    bash scripts/kernel_ab.sh || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"

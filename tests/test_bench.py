@@ -160,5 +160,18 @@ def test_latency_and_roofline_fields():
         assert r["bound"] in ("vpu", "mxu", "hbm")
         assert r["projected_peak_txns_per_sec"] > 0
         assert all(r[k] > 0 for k in
-                   ("int_ops_per_batch", "mxu_flops_per_batch",
-                    "bytes_per_batch"))
+                   ("int_ops_per_batch", "bytes_per_batch"))
+        # Packed acceptance is pure VPU bitwise — zero MXU flops is legal.
+        assert r["mxu_flops_per_batch"] >= 0
+        # Tentpole acceptance: the packed formats cut modeled HBM bytes
+        # >= 4x vs the unpacked kernel at the same shapes, under both
+        # history designs.
+        for hist in ("window", "batch"):
+            rp = bench.roofline_estimate(m, 1 << 18, packed=True,
+                                         hist_design=hist)
+            assert rp["bytes_per_batch_unpacked"] >= 4 * rp["bytes_per_batch"], \
+                (m, hist, rp)
+            assert rp["packed_bytes_ratio"] >= 4.0
+        ru = bench.roofline_estimate(m, 1 << 18, packed=False)
+        assert ru["packed_bytes_ratio"] == 1.0
+        assert ru["mxu_flops_per_batch"] > 0

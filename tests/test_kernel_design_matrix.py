@@ -1,0 +1,130 @@
+"""Oracle parity across the kernel-design env-flag matrix.
+
+The four knobs (FDB_TPU_RMQ, FDB_TPU_HISTORY, FDB_TPU_ACCEPT,
+FDB_TPU_PACKED) are read ONCE at import (flipping mid-process would split
+jit caches), so every combination must be exercised in a fresh
+subprocess. Each child runs the randomized multi-batch oracle-parity
+workload PLUS the loser-range report check, asserting inside the child.
+
+Tier-1 runs the defaults in-process (the rest of the suite) plus each
+non-default flag flipped alone and the all-flipped corner here; the full
+2x2x2x2 product is @slow.
+"""
+
+import itertools
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:  # the wedged axon tunnel can hang even CPU-backend init (conftest.py)
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):
+    pass
+from foundationdb_tpu.utils import enable_compilation_cache
+enable_compilation_cache()
+import numpy as np
+from foundationdb_tpu.core.types import KeyRange, Verdict
+from foundationdb_tpu.models import conflict_kernel as ck
+from foundationdb_tpu.models.conflict_set import TPUConflictSet
+from foundationdb_tpu.sim.oracle import OracleConflictSet
+from tests.test_conflict_oracle import rand_txn
+
+# The import-once snapshot must reflect the env this child was spawned
+# with — a false pass here would mean the matrix never left the defaults.
+assert ck._RMQ_DESIGN == os.environ.get("FDB_TPU_RMQ", "sparse")
+assert ck._HIST_DESIGN == os.environ.get("FDB_TPU_HISTORY", "window")
+assert ck._ACCEPT_DESIGN == os.environ.get("FDB_TPU_ACCEPT", "wave")
+assert ck._PACKED == (os.environ.get("FDB_TPU_PACKED", "1") != "0")
+
+rng = np.random.default_rng(29)
+cs = TPUConflictSet(capacity=512, batch_size=32, max_read_ranges=4,
+                    max_write_ranges=4, max_key_bytes=8)
+oracle = OracleConflictSet()
+cv = 1000
+for batch_i in range(6):
+    cv += int(rng.integers(1, 40))
+    txns = [
+        rand_txn(rng, read_version=int(rng.integers(max(0, cv - 200), cv)))
+        for _ in range(int(rng.integers(8, 32)))
+    ]
+    for t in txns[::3]:  # loser-range report path rides along
+        object.__setattr__(t, "report_conflicting_keys", True)
+    oldest = cv - 150
+    got = cs.resolve(txns, cv, oldest_version=oldest)
+    oracle.oldest_version = max(oracle.oldest_version, oldest)
+    want = oracle.resolve(txns, cv)
+    assert got == want, f"batch {batch_i}: {got} != {want}"
+    # Loser-range completeness: every oracle conflicting range must be
+    # covered by the kernel's (possibly coalesced-wider) report.
+    for i, ranges in oracle.last_conflicting.items():
+        kernel = cs.last_conflicting.get(i)
+        assert kernel is not None, f"batch {batch_i} txn {i}: no report"
+        for r in ranges:
+            assert any(k.begin <= r.begin and r.end <= k.end for k in kernel), \
+                f"batch {batch_i} txn {i}: {r} not covered by {kernel}"
+assert not cs.overflowed
+print("MATRIX-OK")
+"""
+
+_FLAGS = {
+    "FDB_TPU_RMQ": ("sparse", "blocked"),
+    "FDB_TPU_HISTORY": ("window", "batch"),
+    "FDB_TPU_ACCEPT": ("wave", "seq"),
+    "FDB_TPU_PACKED": ("1", "0"),
+}
+
+
+def _run_combo(env_flags: dict) -> None:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_flags)
+    for k in _FLAGS:
+        env.pop(k, None)
+    env.update(env_flags)
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, capture_output=True,
+        text=True, timeout=600, cwd=_REPO,
+    )
+    assert r.returncode == 0, f"{env_flags}: {r.stderr[-2000:]}"
+    assert r.stdout.strip().splitlines()[-1] == "MATRIX-OK"
+
+
+# Fast tier: each non-default value flipped alone, plus the all-flipped
+# corner (defaults themselves are exercised in-process by the whole suite).
+_FAST = [
+    {"FDB_TPU_PACKED": "0"},
+    {"FDB_TPU_RMQ": "blocked"},
+    {"FDB_TPU_HISTORY": "batch"},
+    {"FDB_TPU_ACCEPT": "seq"},
+    {"FDB_TPU_RMQ": "blocked", "FDB_TPU_HISTORY": "batch",
+     "FDB_TPU_ACCEPT": "seq", "FDB_TPU_PACKED": "0"},
+]
+
+
+@pytest.mark.parametrize(
+    "flags", _FAST, ids=lambda f: ",".join(f"{k[8:]}={v}" for k, v in f.items())
+)
+def test_design_flag_parity(flags):
+    _run_combo(flags)
+
+
+_FULL = [
+    dict(zip(_FLAGS, combo))
+    for combo in itertools.product(*_FLAGS.values())
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "flags", _FULL, ids=lambda f: ",".join(f"{k[8:]}={v}" for k, v in f.items())
+)
+def test_design_flag_parity_full_matrix(flags):
+    _run_combo(flags)

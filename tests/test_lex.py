@@ -9,7 +9,10 @@ from foundationdb_tpu.ops.lex import (
     lex_le,
     lex_lt,
     searchsorted_words,
+    searchsorted_words_2sided_fp,
+    searchsorted_words_fp,
     sort_keys_with_payload,
+    sort_ranks_with_payload,
 )
 from tests.test_keypack import np_lex_lt, random_key
 
@@ -53,6 +56,55 @@ def test_searchsorted_with_duplicates(rng):
     q = codec.pack([b"a", b"b", b"c", b"", b"d"], "begin")
     assert np.asarray(searchsorted_words(packed, q, "left")).tolist() == [0, 2, 5, 0, 6]
     assert np.asarray(searchsorted_words(packed, q, "right")).tolist() == [2, 5, 6, 0, 6]
+
+
+def test_searchsorted_fp_matches_plain(rng):
+    """The column-cascade fingerprint search must be bit-identical to
+    searchsorted_words on every alphabet shape: wide-entropy keys (first
+    word decides), shared-prefix keys (leading words constant — the
+    shortcut path), duplicates, and +inf padding rows."""
+    codec = KeyCodec(16)
+    for alphabet, prefix in [(256, b""), (3, b""), (4, b"\x00" * 6), (2, b"pre")]:
+        keys = sorted(
+            prefix + k
+            for k in set(random_key(rng, max_len=8) for _ in range(200))
+        )
+        packed = codec.pack(keys, "begin")
+        # Table with +inf padding rows, the way the kernel stores history.
+        inf = np.full((7, codec.width), np.iinfo(np.int32).max, np.int32)
+        table = np.concatenate([packed, inf])
+        qkeys = [prefix + random_key(rng, max_len=8) for _ in range(300)]
+        qkeys += keys[::5]  # exact hits exercise the tie path
+        qp = np.concatenate(
+            [codec.pack(qkeys, "begin"), inf[:2]]  # +inf queries too
+        )
+        left, right = searchsorted_words_2sided_fp(table, qp)
+        assert (
+            np.asarray(left) == np.asarray(searchsorted_words(table, qp, "left"))
+        ).all(), (alphabet, prefix)
+        assert (
+            np.asarray(right) == np.asarray(searchsorted_words(table, qp, "right"))
+        ).all(), (alphabet, prefix)
+        one = searchsorted_words_fp(table, qp, "right")
+        assert (np.asarray(one) == np.asarray(right)).all()
+
+
+def test_sort_ranks_with_payload_matches_key_sort(rng):
+    """Sorting by rank (with dictionary gather) must reproduce the stable
+    W-word key sort exactly — the packed paint pass's core equivalence."""
+    codec = KeyCodec(8)
+    pool = [random_key(rng, max_len=4) for _ in range(20)]
+    keys = [pool[int(i)] for i in rng.integers(0, 20, size=64)]  # duplicates
+    packed = codec.pack(keys, "begin")
+    uniq = sorted(set(keys))
+    up = codec.pack(uniq, "begin")
+    ranks = np.array([uniq.index(k) for k in keys], np.int32)
+    payload = np.arange(64, dtype=np.int32)
+
+    skeys, spay = sort_keys_with_payload(packed, payload)
+    sranks, spay2 = sort_ranks_with_payload(ranks, payload)
+    assert (np.asarray(spay) == np.asarray(spay2)).all()
+    assert (np.asarray(skeys) == up[np.asarray(sranks)]).all()
 
 
 def test_sort_keys_with_payload(rng):
