@@ -502,6 +502,165 @@ def run_tpu_batch_latency(
     return lat_ms, time.perf_counter() - t0
 
 
+def run_tpu_adaptive(
+    n_batches, capacity, blob, txn_ends,
+    mode: ModeConfig = MODES["ycsb"], offered_tps: float | None = None,
+    budget_ms: float = 250.0, max_window: int = 8,
+    max_duration_s: float = 600.0, threaded: bool = True,
+    repeats: int = 2,
+) -> dict:
+    """Adaptive dispatch (sched subsystem) over the same wire stream.
+
+    Replaces the fixed ``batches_per_dispatch`` with the deadline
+    coalescer: batches arrive paced at ``offered_tps`` (the fixed-window
+    path's measured throughput, so the A/B compares latency at EQUAL
+    offered load), the coalescer picks the window depth online from its
+    fitted dispatch-cost model under the latency budget, and the
+    PipelinedWindowRunner packs window N+1 on a worker thread while the
+    device executes window N (double-buffered host packing).
+
+    Latency per batch is arrival→verdict (queue wait + pack + dispatch +
+    collect) — a strictly HARSHER accounting than the fixed path's
+    submit→collect, so the recorded p99 cut is conservative.
+
+    Window depths are quantized to powers of two and each candidate depth
+    is warm-compiled OUTSIDE the timed loop (each distinct k is its own
+    scan program; candidate depths the coalescer may never pick cost only
+    compile time, which the persistent cache amortizes across runs).
+    """
+    from foundationdb_tpu.models.conflict_set import TPUConflictSet
+    from foundationdb_tpu.sched.coalescer import AdaptiveCoalescer, quantized_depths
+    from foundationdb_tpu.sched.packing import PipelinedWindowRunner
+
+    B = mode.batch
+    max_window = max(1, min(max_window, n_batches))
+    depths = quantized_depths(max_window)
+    kw = dict(
+        capacity=capacity, batch_size=B, max_read_ranges=mode.n_reads,
+        max_write_ranges=mode.n_writes, max_key_bytes=KEY_BYTES,
+        window_versions=WINDOW,
+    )
+    interarrival = (B / offered_tps) if offered_tps else 0.0
+    # Bound the paced run's wall time (offered load may be slow on CPU).
+    n_use = n_batches
+    if interarrival > 0:
+        n_use = max(2, min(n_batches, int(max_duration_s / interarrival) + 1))
+
+    # Warm-compile every candidate depth outside the timed loop.
+    cs = TPUConflictSet(**kw)
+    cv = 1
+    for d in depths:
+        if d > n_use:
+            break
+        hi = int(txn_ends[d * B])
+        cs.resolve_wire_window_async(blob[:hi], list(range(cv, cv + d)), B)()
+        cv += d
+
+    def one_rep() -> dict:
+        cs = TPUConflictSet(**kw)
+        runner = PipelinedWindowRunner(cs, threaded=threaded)
+        coal = AdaptiveCoalescer(budget_ms=budget_ms, max_window=max_window)
+        lat_ms = [0.0] * n_use
+        arrive_t = [0.0] * n_use
+        inflight: list[tuple[int, int, float]] = []  # (first, k, submit_t)
+        depth_hist: dict[int, int] = {}
+        conflicts = 0
+        head = 0      # next batch to dispatch
+        arrived = 0   # batches whose arrival time has passed
+        backlog_max = 0
+        t0 = time.perf_counter()
+
+        def collect_one() -> None:
+            nonlocal conflicts
+            j, k, st = inflight.pop(0)
+            v = runner.collect_next()
+            tend = time.perf_counter()
+            coal.observe_dispatch(k, (tend - st) * 1e3)
+            conflicts += int((np.asarray(v) == 1).sum())
+            for b in range(j, j + k):
+                lat_ms[b] = (tend - arrive_t[b]) * 1e3
+
+        while head < n_use:
+            now = time.perf_counter()
+            if interarrival > 0:
+                due = min(n_use, int((now - t0) / interarrival) + 1)
+            else:
+                due = n_use
+            while arrived < due:
+                arrive_t[arrived] = t0 + arrived * interarrival
+                coal.note_arrival(arrive_t[arrived] * 1e3)
+                arrived += 1
+            queued = arrived - head
+            backlog_max = max(backlog_max, queued)
+            if queued == 0:
+                time.sleep(
+                    min(max(t0 + arrived * interarrival - now, 0.0), 0.05)
+                )
+                continue
+            oldest_age_ms = (now - arrive_t[head]) * 1e3
+            k = coal.decide(queued, oldest_age_ms)
+            if k <= 0:
+                hint_s = coal.wait_hint_ms(queued, oldest_age_ms) / 1e3
+                next_arr = (t0 + arrived * interarrival - now
+                            if arrived < n_use and interarrival > 0 else hint_s)
+                time.sleep(min(max(min(hint_s, next_arr), 1e-4), 0.05))
+                continue
+            # Snap to a warm-compiled (quantized) depth — never a fresh
+            # compile inside the timed loop.
+            k = max(d for d in depths if d <= min(k, n_use - head))
+            lo, hi = int(txn_ends[head * B]), int(txn_ends[(head + k) * B])
+            runner.submit(blob[lo:hi], list(range(head + 1, head + k + 1)), B)
+            inflight.append((head, k, time.perf_counter()))
+            head += k
+            depth_hist[k] = depth_hist.get(k, 0) + 1
+            runner.dispatch_ready()  # push packed windows to the device
+            while len(inflight) > 2:  # double-buffered: ≤2 windows in flight
+                collect_one()
+        while inflight:
+            collect_one()
+        dt = time.perf_counter() - t0
+        runner.close()
+        n_txns = n_use * B
+        mean_depth = (sum(k * c for k, c in depth_hist.items())
+                      / max(1, sum(depth_hist.values())))
+        return {
+            "value": round(n_txns / dt, 1),
+            "txns": n_txns,
+            "p50_ms": pct(lat_ms, 50),
+            "p99_ms": pct(lat_ms, 99),
+            "latency_budget_ms": budget_ms,
+            "offered_tps": round(offered_tps, 1) if offered_tps else None,
+            "max_window": max_window,
+            "mean_depth": round(mean_depth, 2),
+            "depth_hist": {str(k): c for k, c in sorted(depth_hist.items())},
+            "windows": sum(depth_hist.values()),
+            "conflicts": conflicts,
+            "backlog_max": backlog_max,
+            # Kept up with the offered load: the dispatch queue never grew
+            # past two full windows, so the achieved rate IS the offered
+            # rate and the p99 is a steady-state number, not a
+            # growing-queue artifact.
+            "kept_up": backlog_max <= 2 * max_window,
+            "pack_busy_s": round(runner.pack_busy_s, 3),
+            "double_buffered": threaded,
+        }
+
+    # Best-of-N, mirroring the fixed windowed path's repeats: a paced run
+    # is wall-clock sensitive (one host-contended window IS the p99 of a
+    # ~30-window run), so each side gets the same number of attempts and
+    # reports its best. Preference: kept-up reps by lowest p99.
+    best: dict | None = None
+    for rep in range(max(1, repeats)):
+        rec = one_rep()
+        log(f"[adaptive] rep {rep}: {rec['value']:,.0f} txns/s "
+            f"p99 {rec['p99_ms']}ms kept_up={rec['kept_up']}")
+        if best is None or (rec["kept_up"], -rec["p99_ms"]) > (
+            best["kept_up"], -best["p99_ms"]
+        ):
+            best = rec
+    return best
+
+
 # ---------------------------------------------------------------------------
 # Per-phase profiling (--profile): attribute one warm batch's device cost
 # ---------------------------------------------------------------------------
@@ -878,7 +1037,10 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
                "--mode", "ycsb", "--resolvers", str(n),
                "--txns", str(txns),
                "--keys", str(args.keys), "--capacity", str(args.capacity),
-               "--seed", str(args.seed + 1), "--window", str(window)]
+               "--seed", str(args.seed + 1), "--window", str(window),
+               # occupancy/scaling probes stay lean: the adaptive pass is
+               # the main process's A/B, not the mesh child's.
+               "--no-adaptive"]
         log(f"[{cname}] launching cpu-mesh subprocess: {' '.join(cmd[1:])}")
         r = subprocess.run(
             cmd, env=env, capture_output=True, text=True, timeout=timeout_s,
@@ -892,7 +1054,8 @@ def run_cpu_mesh_sharded(cname: str, nres: int, sweep_txns: int, args,
         child = child_run(nres, budget)
         keep = ("value", "vs_baseline", "txns", "conflict_rate",
                 "verdict_parity", "cpu_baseline_txns_per_sec", "p50_ms",
-                "p99_ms", "windowed", "shard_occupancy")
+                "p99_ms", "windowed", "adaptive", "phase_profile_ms",
+                "shard_occupancy")
         out = {k: child.get(k) for k in keep}
         out.update(backend="cpu-mesh", resolvers=nres, valid=False,
                    note="virtual 8-device CPU mesh: occupancy/balance "
@@ -985,10 +1148,28 @@ def pct(lat_ms: list[float], q: float) -> float:
     return round(float(np.percentile(lat_ms, q)), 2) if lat_ms else 0.0
 
 
+def _adaptive_vs_windowed(adaptive_rec, windowed_rate, windowed_lat) -> "dict | None":
+    """Attach the fixed-vs-adaptive comparison the scheduler A/B is judged
+    on (acceptance: ≥5× p99 cut at equal-or-better throughput)."""
+    if not adaptive_rec or adaptive_rec.get("error"):
+        return adaptive_rec
+    w_p99 = pct(windowed_lat, 99)
+    out = dict(adaptive_rec)
+    if out.get("p99_ms"):
+        out["p99_windowed_over_adaptive"] = (
+            round(w_p99 / out["p99_ms"], 2) if w_p99 else None
+        )
+    if windowed_rate:
+        out["throughput_vs_windowed"] = round(out["value"] / windowed_rate, 3)
+    return out
+
+
 def run_config(
     name: str, mode: ModeConfig, n_txns: int, n_keys: int, seed: int,
     capacity: int, platform: str, repeats: int = 3, n_resolvers: int = 1,
-    window: int = 32, profile: bool = False,
+    window: int = 32, profile: bool = False, smoke: bool = False,
+    latency_budget_ms: float = 250.0, adaptive_max_window: int = 8,
+    adaptive: bool = True,
 ) -> dict:
     """Run one §5 benchmark configuration end-to-end (CPU baseline + TPU
     path on the same stream) and return its result dict."""
@@ -1040,7 +1221,7 @@ def run_config(
     log(f"[tpu] {name}: {tpu_dt:.2f}s → {tpu_rate:,.0f} txns/s "
         f"({tpu_conf} conflicts, {tpu_conf / n_txns:.1%})")
     batch_lat, batch_dt, batch_n = [], 0.0, 0
-    if n_resolvers == 1:
+    if n_resolvers == 1 and not smoke:
         batch_lat, batch_dt = run_tpu_batch_latency(
             n_batches, capacity, blob, txn_ends, mode=mode
         )
@@ -1048,9 +1229,39 @@ def run_config(
         log(f"[tpu] {name}: per-batch pipelined latency p50 "
             f"{pct(batch_lat, 50)}ms p99 {pct(batch_lat, 99)}ms "
             f"({batch_n * mode.batch / batch_dt:,.0f} txns/s at depth 2)")
-    phase_profile: dict = {}
+    # Adaptive dispatch (sched subsystem) on the same stream, offered at
+    # the fixed windowed path's measured rate — the A/B the scheduler PR
+    # is judged on (scripts/sched_ab.sh extracts windowed vs adaptive).
+    adaptive_rec: "dict | None" = None
+    if adaptive and n_resolvers == 1 and not smoke:
+        try:
+            adaptive_rec = run_tpu_adaptive(
+                n_batches, capacity, blob, txn_ends, mode=mode,
+                offered_tps=tpu_rate, budget_ms=latency_budget_ms,
+                max_window=adaptive_max_window,
+                repeats=max(1, min(repeats, 2)),
+            )
+            log(f"[tpu] {name}: adaptive dispatch {adaptive_rec['value']:,.0f}"
+                f" txns/s p50 {adaptive_rec['p50_ms']}ms "
+                f"p99 {adaptive_rec['p99_ms']}ms "
+                f"(mean depth {adaptive_rec['mean_depth']})")
+        except Exception as e:  # noqa: BLE001 — adaptive must not cost the run
+            log(f"[tpu] {name}: adaptive dispatch failed: {e}")
+            adaptive_rec = {"error": str(e)[:300]}
+    # Phase attribution must land in EVERY headline record (windowed or
+    # CPU-fallback — BENCH_r05 shipped phase_profile_ms:null throughout):
+    # a failure/skip is recorded as such, never as null.
     if profile:
-        phase_profile = profile_phases(capacity, blob, txn_ends, mode=mode)
+        try:
+            phase_profile = profile_phases(capacity, blob, txn_ends, mode=mode)
+            if not phase_profile:
+                phase_profile = {"skipped": "needs >= 2 batches of txns"}
+        except Exception as e:  # noqa: BLE001
+            log(f"[profile] {name} failed: {e}")
+            phase_profile = {"error": str(e)[:300]}
+    else:
+        phase_profile = {"skipped": "smoke run" if smoke
+                         else "profiling disabled for this config"}
     if tpu_conf != cpu_conf:
         log(f"[warn] {name}: verdict divergence: tpu={tpu_conf} "
             f"cpu={cpu_conf} ({abs(tpu_conf - cpu_conf) / n_txns:.2%})")
@@ -1074,6 +1285,7 @@ def run_config(
         "headline_mode": "pipelined_depth2" if pipeline_rate else "windowed",
         "txns": n_txns,
         "conflict_rate": round(tpu_conf / n_txns, 4),
+        "conflicts": tpu_conf,
         "verdict_parity": tpu_conf == cpu_conf,
         "cpu_baseline_txns_per_sec": round(cpu_rate, 1),
         # Headline latency: submit→verdict of a single pipelined batch —
@@ -1087,7 +1299,8 @@ def run_config(
         "cpu_p50_ms": pct(cpu_lat, 50),
         "cpu_p99_ms": cpu_p99,
         # Secondary: the windowed (32-batch scan) dispatch mode — higher
-        # throughput, but each verdict waits for the whole window.
+        # throughput, but each verdict waits for the whole window. This is
+        # the FIXED-window baseline the adaptive scheduler is A/B'd against.
         "windowed": {
             "value": round(tpu_rate, 1),
             "vs_baseline": round(tpu_rate / cpu_rate, 3),
@@ -1095,10 +1308,14 @@ def run_config(
             "p99_ms": pct(tpu_lat, 99),
             "batches_per_dispatch": window,
         },
+        # Adaptive dispatch (sched subsystem): deadline coalescing +
+        # online window depth + double-buffered host packing, offered at
+        # the windowed path's measured rate (equal-load latency A/B).
+        "adaptive": _adaptive_vs_windowed(adaptive_rec, tpu_rate, tpu_lat),
         "resolvers": n_resolvers,
         "shard_occupancy": occupancy or None,
         "overflowed": overflowed,
-        "phase_profile_ms": phase_profile or None,
+        "phase_profile_ms": phase_profile,
         "roofline": roofline_estimate(mode, capacity),
         "valid": (not overflowed) and platform not in ("cpu", "none"),
     }
@@ -1127,7 +1344,20 @@ def main() -> None:
     ap.add_argument("--resolvers", type=int, default=1,
                     help="mesh-sharded resolver count (§5 4-resolver config)")
     ap.add_argument("--window", type=int, default=32,
-                    help="resolver batches per device dispatch")
+                    help="FIXED-dispatch resolver batches per device "
+                         "dispatch (the adaptive scheduler's A/B baseline)")
+    ap.add_argument("--latency-budget-ms", type=float, default=250.0,
+                    help="adaptive dispatch: target submit→verdict latency "
+                         "budget (sched coalescer)")
+    ap.add_argument("--adaptive-max-window", type=int, default=8,
+                    help="adaptive dispatch: max window depth (quantized "
+                         "power-of-two depths are warm-compiled upfront)")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="skip the adaptive-dispatch pass")
+    ap.add_argument("--smoke", action="store_true",
+                    help="minimal validity run: one repeat, no latency "
+                         "probe / profiler / adaptive pass / sweeps "
+                         "(used by the CPU-fallback exit-status test)")
     ap.add_argument("--repair-sim", action="store_true",
                     help="run the transaction-repair goodput harness "
                          "(deterministic sim, oracle-verified; no TPU) "
@@ -1240,16 +1470,19 @@ def main() -> None:
         head = run_config(
             args.mode or "ycsb", headline_mode, args.txns, args.keys,
             args.seed, args.capacity, platform,
-            repeats=3 if on_tpu else 2,
+            repeats=1 if args.smoke else (3 if on_tpu else 2),
             n_resolvers=args.resolvers, window=args.window,
-            profile=True,
+            profile=not args.smoke, smoke=args.smoke,
+            latency_budget_ms=args.latency_budget_ms,
+            adaptive_max_window=args.adaptive_max_window,
+            adaptive=not args.no_adaptive,
         )
         result.update({k: v for k, v in head.items() if k != "overflowed"})
         result["resolvers"] = args.resolvers
 
         # Remaining §5 configs (VERDICT r2 item 6): mako 90/10, TPC-C
         # new-order, 4-resolver sharded — reduced size, one artifact.
-        if not single:
+        if not single and not args.smoke:
             sweeps = [
                 ("mako", MODES["mako"], 1),
                 ("tpcc", MODES["tpcc"], 1),
@@ -1281,7 +1514,13 @@ def main() -> None:
                         cname, cmode, sweep_txns, args.keys, args.seed + 1,
                         args.capacity, platform, repeats=1,
                         n_resolvers=nres, window=args.window,
-                        profile=args.profile and nres == 1,
+                        # Always attribute phases on single-resolver sweeps
+                        # (BENCH_r05 shipped null there): a warm cache makes
+                        # it a few extra compiles at most.
+                        profile=nres == 1,
+                        latency_budget_ms=args.latency_budget_ms,
+                        adaptive_max_window=args.adaptive_max_window,
+                        adaptive=not args.no_adaptive,
                     )
                 except Exception as e:  # noqa: BLE001 — one sweep failing
                     # must not cost the others or the headline result

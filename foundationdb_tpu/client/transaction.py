@@ -376,6 +376,10 @@ class Transaction:
         self.access_system_keys = False  # option 301
         self.lock_aware = False  # option 306: commit despite database lock
         self.authorization_token: str | None = None  # option 2000
+        # Admission lane (reference: PRIORITY_SYSTEM_IMMEDIATE option 200 /
+        # PRIORITY_BATCH option 201): shapes both the GRV lane and the
+        # commit proxy's batch formation (sched/lanes.py).
+        self.priority = "default"
         self._retries = 0  # attempts consumed by on_error (for retry_limit)
         self._reset()
 
@@ -408,6 +412,10 @@ class Transaction:
             self.access_system_keys = True
         elif name == "lock_aware":
             self.lock_aware = True
+        elif name == "priority_system_immediate":
+            self.priority = "system"
+        elif name == "priority_batch":
+            self.priority = "batch"
         elif name == "authorization_token":
             if not value:
                 raise FdbError("authorization_token requires a value",
@@ -445,7 +453,10 @@ class Transaction:
             ep = self.db._pick(self.db.grv_proxies)
             try:
                 self._read_version = await ep.get_read_version(
-                    "default", sorted(self.tags) if self.tags else None
+                    # The GRV proxy models default/batch lanes; system
+                    # traffic rides the default (unthrottled-first) lane.
+                    "batch" if self.priority == "batch" else "default",
+                    sorted(self.tags) if self.tags else None,
                 )
             except BrokenPromise as e:
                 # Dead/retired GRV proxy: retryable — on_error refreshes the
@@ -807,6 +818,7 @@ class Transaction:
             report_conflicting_keys=self.report_conflicting_keys,
             lock_aware=self.lock_aware,
             token=self.authorization_token,
+            priority=self.priority,
         )
         commit_ep = self.db._pick(self.db.commit_proxies)
         try:

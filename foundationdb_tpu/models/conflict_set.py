@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import numpy as np
 
@@ -26,6 +26,23 @@ from foundationdb_tpu.models import conflict_kernel as ck
 
 DEFAULT_WINDOW_VERSIONS = 5_000_000  # ~5s at 1M versions/sec, reference MVCC window
 _REBASE_THRESHOLD = 1 << 30
+
+
+class PreparedWindow(NamedTuple):
+    """A host-packed dispatch window awaiting device dispatch.
+
+    The pack half (``pack_wire_window``) is pure host work — the C wire
+    pass, padding, and (under FDB_TPU_PACKED) the ``_pack_dict``
+    dedup+sort — so a scheduler can run it on a worker thread for window
+    N+1 while the device still executes window N (sched/packing.py). The
+    dispatch half (``dispatch_window``) threads device state and must run
+    on the dispatching thread, in commit-version order."""
+
+    batch: object  # device-format batch tensors, k-leading axis
+    cvs_rel: np.ndarray
+    olds_rel: np.ndarray
+    count: int
+    rebase_delta: int  # deferred device rebase; applied before dispatch
 
 
 class TPUConflictSet:
@@ -270,8 +287,26 @@ class TPUConflictSet:
         trips. Returns a collector yielding verdicts int8 [k, count].
 
         Callers should keep k fixed across calls (each distinct k compiles
-        its own program).
+        its own program). The pack/dispatch halves are separately callable
+        (``pack_wire_window`` / ``dispatch_window``) so a scheduler can
+        double-buffer host packing against device execution.
         """
+        return self.dispatch_window(
+            self.pack_wire_window(wire, commit_versions, count)
+        )
+
+    def pack_wire_window(
+        self,
+        wire: bytes | np.ndarray,
+        commit_versions,
+        count: int,
+    ) -> PreparedWindow:
+        """Host half of the window path: validate, advance version
+        bookkeeping, and pack wire bytes into device-format tensors. Pure
+        host work (the device rebase, if one fell due, is DEFERRED into the
+        PreparedWindow), so it may run on a packing thread concurrently
+        with ``dispatch_window`` of the PREVIOUS window — never concurrently
+        with another pack (packs are commit-version ordered)."""
         buf = (
             np.frombuffer(wire, dtype=np.uint8)
             if isinstance(wire, (bytes, bytearray))
@@ -285,41 +320,73 @@ class TPUConflictSet:
         if counted < k * count:
             raise ValueError("malformed resolver wire batch")
 
-        oldest_abs = np.empty(k, np.int64)
-        for i, cv in enumerate(commit_versions):
-            self._begin_resolve(int(cv), None)
-            oldest_abs[i] = self.oldest_version
-        # base_version is final after all _begin_resolve rebases — convert
-        # now. A rebase mid-window can lift base above floors snapshotted
-        # earlier; clamp those to 0 (everything below base is already
-        # expired on device, so a zero floor is exact — the kernel takes
-        # max(state.oldest, new_oldest) and never regresses).
-        cvs_rel = np.asarray(
-            [self._rel(int(cv)) for cv in commit_versions], np.int32
-        )
-        olds_rel = np.asarray(
-            [max(0, int(v) - self.base_version) for v in oldest_abs], np.int32
+        # A raise below must leave the host bookkeeping untouched: with a
+        # deferred rebase, base_version would otherwise run ahead of the
+        # never-rebased device state and silently skew every later
+        # window's relative versions. Restoring the snapshot makes a
+        # failed pack fully transactional (host-only — thread-safe on the
+        # packing thread).
+        snap = (self.base_version, self.oldest_version, self._last_commit)
+        try:
+            rebase_delta = 0
+            oldest_abs = np.empty(k, np.int64)
+            for i, cv in enumerate(commit_versions):
+                rebase_delta += self._begin_resolve(
+                    int(cv), None, defer_rebase=True
+                )
+                oldest_abs[i] = self.oldest_version
+            # base_version is final after all _begin_resolve rebases —
+            # convert now. A rebase mid-window can lift base above floors
+            # snapshotted earlier; clamp those to 0 (everything below base
+            # is already expired on device, so a zero floor is exact — the
+            # kernel takes max(state.oldest, new_oldest), never regresses).
+            cvs_rel = np.asarray(
+                [self._rel(int(cv)) for cv in commit_versions], np.int32
+            )
+            olds_rel = np.asarray(
+                [max(0, int(v) - self.base_version) for v in oldest_abs],
+                np.int32,
+            )
+
+            batches = self._empty_batch(k)
+            offset = 0
+            for i in range(k):
+                offset = lib.kp_pack_batch(
+                    _u8(buf), buf.size, offset, count,
+                    self.batch_size, self.max_read_ranges,
+                    self.max_write_ranges,
+                    self.codec.n_words, self.base_version,
+                    _i32(batches.read_begin[i]), _i32(batches.read_end[i]),
+                    _u8(batches.read_mask[i]),
+                    _i32(batches.write_begin[i]), _i32(batches.write_end[i]),
+                    _u8(batches.write_mask[i]),
+                    _i32(batches.read_version[i]), _u8(batches.txn_mask[i]),
+                )
+                if offset < 0:
+                    raise ValueError("malformed resolver wire batch")
+        except BaseException:
+            self.base_version, self.oldest_version, self._last_commit = snap
+            raise
+        return PreparedWindow(
+            batch=self._dev_batch(batches),
+            cvs_rel=cvs_rel,
+            olds_rel=olds_rel,
+            count=count,
+            rebase_delta=rebase_delta,
         )
 
-        batches = self._empty_batch(k)
-        offset = 0
-        for i in range(k):
-            offset = lib.kp_pack_batch(
-                _u8(buf), buf.size, offset, count,
-                self.batch_size, self.max_read_ranges, self.max_write_ranges,
-                self.codec.n_words, self.base_version,
-                _i32(batches.read_begin[i]), _i32(batches.read_end[i]),
-                _u8(batches.read_mask[i]),
-                _i32(batches.write_begin[i]), _i32(batches.write_end[i]),
-                _u8(batches.write_mask[i]),
-                _i32(batches.read_version[i]), _u8(batches.txn_mask[i]),
+    def dispatch_window(self, prepared: PreparedWindow) -> Callable[[], np.ndarray]:
+        """Device half of the window path: thread state through the scan
+        program. Must run on the dispatching thread, in the same order the
+        windows were packed."""
+        if prepared.rebase_delta:
+            self.state = self._rebase_fn(
+                self.state, np.int32(min(prepared.rebase_delta, 2**31 - 1))
             )
-            if offset < 0:
-                raise ValueError("malformed resolver wire batch")
         verdicts, self.state = self._resolve_many_fn(
-            self.state, self._dev_batch(batches), cvs_rel, olds_rel
+            self.state, prepared.batch, prepared.cvs_rel, prepared.olds_rel
         )
-        return lambda: np.asarray(verdicts)[:, :count]
+        return lambda: np.asarray(verdicts)[:, : prepared.count]
 
     def _collect(self, pending: list[tuple]) -> list[Verdict]:
         out: list[Verdict] = []
@@ -355,7 +422,17 @@ class TPUConflictSet:
             gi += n
         return out
 
-    def _begin_resolve(self, commit_version: int, oldest_version: int | None) -> None:
+    def _begin_resolve(
+        self,
+        commit_version: int,
+        oldest_version: int | None,
+        defer_rebase: bool = False,
+    ) -> int:
+        """Advance host-side version bookkeeping for one dispatch. Returns
+        the version delta of a rebase that fell due: 0 normally, applied to
+        device state immediately — unless ``defer_rebase``, in which case
+        the caller must apply it before the next device op (the packing
+        thread may not touch device state)."""
         if commit_version <= self._last_commit:
             raise ValueError(
                 f"commit versions must advance: {commit_version} <= {self._last_commit}"
@@ -367,8 +444,9 @@ class TPUConflictSet:
         self.oldest_version = max(
             self.oldest_version, commit_version - self.window_versions
         )
-        self._maybe_rebase(commit_version)
+        delta = self._maybe_rebase(commit_version, defer=defer_rebase)
         self._last_commit = commit_version
+        return delta
 
     @property
     def _is_hist(self) -> bool:
@@ -463,18 +541,20 @@ class TPUConflictSet:
         assert self.base_version is not None
         return max(-1, v - self.base_version)
 
-    def _maybe_rebase(self, commit_version: int) -> None:
+    def _maybe_rebase(self, commit_version: int, defer: bool = False) -> int:
         assert self.base_version is not None
         if commit_version - self.base_version < _REBASE_THRESHOLD:
-            return
+            return 0
         delta = self.oldest_version - self.base_version
         if delta <= 0:
-            return
+            return 0
         # Device versions < delta are all expired; the kernel clamps them to
         # the sentinel, so saturating the device delta at int32 max is exact
         # even for astronomically large jumps.
-        self.state = self._rebase_fn(self.state, np.int32(min(delta, 2**31 - 1)))
+        if not defer:
+            self.state = self._rebase_fn(self.state, np.int32(min(delta, 2**31 - 1)))
         self.base_version += delta
+        return delta
 
     def _empty_batch(self, k: int | None = None) -> ck.BatchTensors:
         """Padded all-masked-out batch tensors (shared by both packers so

@@ -31,6 +31,7 @@ from foundationdb_tpu.runtime.backup import BACKUP_TAG
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of, rpc
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 from foundationdb_tpu.runtime.trace import Severity, trace
+from foundationdb_tpu.sched.lanes import LaneQueue
 
 
 @dataclass
@@ -48,6 +49,11 @@ class CommitRequest:
     # Tenant authorization token (reference: AUTHORIZATION_TOKEN option):
     # verified by the proxy when the cluster enables authz (runtime/authz).
     token: str | None = None
+    # Admission lane (reference: TransactionPriority — SYSTEM_IMMEDIATE /
+    # DEFAULT / BATCH): "system" traffic (recovery, system keyspace) is
+    # batched ahead of everything, "batch" bulk load is batched last with
+    # starvation-free aging (sched/lanes.py).
+    priority: str = "default"
 
 
 @dataclass(frozen=True)
@@ -117,7 +123,12 @@ class CommitProxy:
         # batch version) on every NotCommitted so the client repair
         # engine can re-read only the losers and back off on hot ranges.
         self.hot_ranges = HotRangeSketch(lambda: loop.now)
-        self._queue: list[tuple[CommitRequest, Promise]] = []
+        # Priority-laned commit admission (sched subsystem): batch
+        # formation drains system → default → batch, so a bulk load's
+        # backlog never delays system traffic by more than the window
+        # already being formed; aged batch entries promote to default
+        # (starvation-free).
+        self._queue: LaneQueue = LaneQueue(lambda: loop.now)
         self._inflight: set[int] = set()  # batch versions being processed
         # Batches popped from _queue but not yet in _inflight (awaiting
         # their commit version): quiesce() must see them or a batch could
@@ -135,7 +146,7 @@ class CommitProxy:
     @rpc
     async def commit(self, req: CommitRequest) -> CommitResult:
         p = Promise()
-        self._queue.append((req, p))
+        self._queue.push((req, p), getattr(req, "priority", "default"))
         return await p.future
 
     @rpc
@@ -166,6 +177,8 @@ class CommitProxy:
             "txns_committed": self.txns_committed,
             "txns_conflicted": self.txns_conflicted,
             "queued": len(self._queue),
+            "lanes": self._queue.depths(),
+            "lane_promotions": self._queue.promoted,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,
         }
@@ -180,7 +193,7 @@ class CommitProxy:
         last_batch = self.loop.now
         while True:
             await self.loop.sleep(self.BATCH_INTERVAL)
-            if not self._queue:
+            if not len(self._queue):
                 if self.loop.now - last_batch < self.IDLE_BATCH_INTERVAL:
                     continue
                 batch = []  # idle: empty batch keeps the version chain hot
@@ -190,8 +203,10 @@ class CommitProxy:
                 # BUGGIFY'd COMMIT_TRANSACTION_BATCH_COUNT_MAX).
                 max_batch = 1 if self.loop.buggify("commit_proxy.tiny_batch") \
                     else self.MAX_BATCH
-                batch, self._queue = \
-                    self._queue[:max_batch], self._queue[max_batch:]
+                # Lane-ordered drain: system first, then default, then
+                # batch (with aging) — a system txn is never queued behind
+                # more than the window already forming.
+                batch = self._queue.pop(max_batch)
             if self.locked and batch:
                 # Database locked (reference error 1038, checked at the
                 # proxy): reject non-lock-aware commits; DR/operator txns
@@ -269,7 +284,7 @@ class CommitProxy:
         after locking: a batch that passed the lock check pre-lock is
         still entitled to its backup tagging, so dual-tagging must stay
         on until nothing admitted remains in flight."""
-        while self._queue or self._inflight or self._admitting:
+        while len(self._queue) or self._inflight or self._admitting:
             await self.loop.sleep(self.BATCH_INTERVAL)
 
     async def _wedge_watchdog(self, version: int) -> None:

@@ -39,14 +39,22 @@ class Ratekeeper:
     SQ_HARD = 64 << 20
     TQ_SOFT = 64 << 20  # tlog queue bytes (reference: TARGET_BYTES_PER_TLOG)
     TQ_HARD = 256 << 20
+    # Resolver dispatch-queue depth (batches parked behind the conflict
+    # engine — sched subsystem backpressure): admission slows before the
+    # resolver's queue, and ultimately its history capacity, overflows.
+    RQ_SOFT = 16
+    RQ_HARD = 128
     # Batch lane throttles at this fraction of every threshold.
     BATCH_FRACTION = 0.5
 
     def __init__(self, loop: Loop, storage_eps: list, tlog_eps: list | None = None,
-                 proxy_eps: list | None = None):
+                 proxy_eps: list | None = None, resolver_eps: list | None = None):
         self.loop = loop
         self.storages = storage_eps
         self.tlogs = list(tlog_eps or [])
+        # Resolvers report dispatch-queue depth + occupancy (the sched
+        # subsystem's backpressure surface in Resolver.get_metrics).
+        self.resolvers = list(resolver_eps or [])
         # Commit proxies report txns_committed; their delta per poll is the
         # cluster's MEASURED service rate (reference: proxies report
         # released-transaction counts to the ratekeeper, which smooths
@@ -62,6 +70,8 @@ class Ratekeeper:
         self.worst_durability_lag = 0
         self.worst_storage_queue = 0
         self.worst_tlog_queue = 0
+        self.worst_resolver_queue = 0
+        self.worst_resolver_occupancy = 0.0
         self.limiting_reason = "none"
         # Per-tag tps quotas (reference: TagThrottleApi manual throttles in
         # \xff\x02/throttle/): enforced by the GRV proxies' per-tag buckets.
@@ -91,6 +101,18 @@ class Ratekeeper:
                     tmetrics = await all_of([t.metrics() for t in self.tlogs])
                     self.worst_tlog_queue = max(
                         (m["queue_bytes"] for m in tmetrics), default=0
+                    )
+                if self.resolvers:
+                    rmetrics = await all_of(
+                        [r.get_metrics() for r in self.resolvers]
+                    )
+                    self.worst_resolver_queue = max(
+                        (m.get("queue_depth", 0) for m in rmetrics), default=0
+                    )
+                    self.worst_resolver_occupancy = max(
+                        ((m.get("queue") or {}).get("dispatch_occupancy", 0.0)
+                         for m in rmetrics),
+                        default=0.0,
                     )
                 await self._calibrate()
                 self.tps_limit = self.base_tps * self._scale(1.0)
@@ -131,7 +153,11 @@ class Ratekeeper:
                 self._last_committed = None  # membership degraded: re-baseline
                 return
         committed = sum(m.get("txns_committed", 0) for m in ms)
-        backlog = sum(m.get("queued", 0) for m in ms)
+        # Backlog = admission-limited evidence: commits queued at the
+        # proxies PLUS batches parked in resolver dispatch queues (the
+        # sched subsystem's occupancy signal) — either means the flow is
+        # pushing harder than the roles service.
+        backlog = sum(m.get("queued", 0) for m in ms) + self.worst_resolver_queue
         if self._last_committed is None or committed < self._last_committed:
             self._last_committed = committed
             return
@@ -159,6 +185,8 @@ class Ratekeeper:
             ("storage_queue", self.worst_storage_queue,
              self.SQ_SOFT, self.SQ_HARD),
             ("tlog_queue", self.worst_tlog_queue, self.TQ_SOFT, self.TQ_HARD),
+            ("resolver_queue", self.worst_resolver_queue,
+             self.RQ_SOFT, self.RQ_HARD),
         ]
         worst, reason = 1.0, "none"
         for name, value, soft, hard in signals:
@@ -197,6 +225,8 @@ class Ratekeeper:
             "worst_durability_lag": self.worst_durability_lag,
             "worst_storage_queue_bytes": self.worst_storage_queue,
             "worst_tlog_queue_bytes": self.worst_tlog_queue,
+            "worst_resolver_queue": self.worst_resolver_queue,
+            "resolver_dispatch_occupancy": self.worst_resolver_occupancy,
             "tag_rates": dict(self.tag_quotas),
             "base_tps": self.base_tps,
             "measured_tps": self.measured_tps,

@@ -11,22 +11,46 @@ all exposing resolve(txns, commit_version, oldest_version) → verdicts.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
 from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 from foundationdb_tpu.runtime.trace import Severity, trace
+from foundationdb_tpu.sched.resolver_queue import ResolveScheduler
+
+
+@dataclass
+class _QueuedBatch:
+    """A chain-admitted batch parked in the dispatch queue."""
+
+    version: int
+    txns: list
+    oldest_version: int | None
+    reply: Promise
 
 
 class Resolver:
     REPLY_CACHE_SIZE = 256  # recent batches kept for retransmit replay
 
-    def __init__(self, loop: Loop, conflict_set, init_version: int = 0):
+    def __init__(self, loop: Loop, conflict_set, init_version: int = 0,
+                 scheduler: ResolveScheduler | None = None):
         self.loop = loop
         self.cs = conflict_set
-        self._version = init_version  # end of the applied version chain
+        self._version = init_version  # end of the ADMITTED version chain
         self._waiters: dict[int, Promise] = {}  # prev_version -> wakeup
         self._replies: dict[int, list[Verdict]] = {}  # version -> verdicts
+        # Admitted but not yet dispatched/resolved (retransmits of these
+        # versions await the pending reply instead of erroring stale).
+        self._pending: dict[int, Promise] = {}
+        # Dispatch queue between chain admission and the engine: groups
+        # consecutive batches per the deadline coalescer, exports queue
+        # depth/occupancy for ratekeeper backpressure (sched subsystem).
+        # Default budget 0 = immediate dispatch, semantics identical to the
+        # unscheduled resolver.
+        self.sched = scheduler or ResolveScheduler(loop)
+        self.sched.attach(self._dispatch_group)
         self.batches_resolved = 0
         self.txns_resolved = 0
         # History-capacity fail-safe (engines exposing headroom(), i.e. the
@@ -72,19 +96,84 @@ class Resolver:
         report_conflicting_keys and got CONFLICT. fail_safe marks a batch
         rejected wholesale by the capacity fail-safe — its conflicts are
         spurious, so downstream hot-range accounting must skip them (the
-        proxy's sketch would otherwise score uncontended ranges hot)."""
+        proxy's sketch would otherwise score uncontended ranges hot).
+
+        Chain admission is decoupled from engine dispatch: once a batch's
+        prev_version matches, it takes its chain position immediately (so
+        successors can queue behind it and the coalescer can form a
+        window) and parks in the dispatch queue; the reply resolves when
+        the scheduler dispatches its group."""
         while self._version != prev_version:
             if prev_version < self._version:
                 # Retransmit of a batch whose reply was lost (proxy↔resolver
                 # partition healed): replay the cached verdicts — resolving
-                # again would double-paint its writes.
-                if version in self._replies:
-                    return self._replies[version]
+                # again would double-paint its writes. A retransmit of a
+                # batch still PARKED in the dispatch queue shares its
+                # pending reply.
+                cached = self._replies.get(version)
+                if cached is not None:
+                    if isinstance(cached, BaseException):
+                        raise cached  # replayed failure (see _dispatch_group)
+                    return cached
+                pend = self._pending.get(version)
+                if pend is not None:
+                    return await pend.future
                 raise ValueError(
                     f"stale resolve batch: prev={prev_version} < applied={self._version}"
                 )
             p = self._waiters.setdefault(prev_version, Promise())
             await p.future
+        # Chain position acquired: advance the admitted chain and wake the
+        # successor BEFORE resolving, so consecutive batches pile into the
+        # dispatch queue and coalesce.
+        self._version = version
+        reply = Promise()
+        self._pending[version] = reply
+        self.sched.enqueue(
+            _QueuedBatch(version, txns, oldest_version, reply)
+        )
+        w = self._waiters.pop(version, None)
+        if w is not None:
+            w.send(None)
+        return await reply.future
+
+    async def _dispatch_group(self, group: list[_QueuedBatch]) -> None:
+        """Scheduler dispatch callback: resolve a consecutive run of
+        admitted batches, version order preserved.
+
+        Failure contract: chain admission already advanced past a failing
+        batch, so its FAILURE is cached in the reply slot and replayed to
+        retransmits (same determinism as a cached verdict). Correctness
+        holds because a batch with no verdicts never commits — the proxy
+        skips the tlog push and fails its clients with
+        commit_unknown_result — so its writes belong in no history, and
+        successors resolving without them is exact (a partial paint from
+        a mid-batch engine error only ADDS spurious conflicts, never
+        misses one)."""
+        for entry in group:
+            try:
+                reply = self._resolve_entry(entry)
+            except BaseException as e:  # noqa: BLE001 — fail the RPC waiter
+                self._replies[entry.version] = e
+                self._trim_replies()
+                self._pending.pop(entry.version, None)
+                entry.reply.fail(e)
+                continue
+            self._replies[entry.version] = reply
+            self._trim_replies()
+            self._pending.pop(entry.version, None)
+            entry.reply.send(reply)
+
+    def _trim_replies(self) -> None:
+        if len(self._replies) > self.REPLY_CACHE_SIZE:
+            del self._replies[min(self._replies)]
+
+    def _resolve_entry(
+        self, entry: _QueuedBatch
+    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
+        version, txns, oldest_version = (
+            entry.version, entry.txns, entry.oldest_version,
+        )
         if oldest_version is None:
             oldest_version = max(0, version - MVCC_WINDOW_VERSIONS)
         fail_safe = self._should_fail_safe(len(txns), version, oldest_version)
@@ -128,15 +217,7 @@ class Resolver:
                 conflicting[i] = pairs
         self.batches_resolved += 1
         self.txns_resolved += len(txns)
-        self._version = version
-        reply = (verdicts, conflicting, fail_safe)
-        self._replies[version] = reply
-        if len(self._replies) > self.REPLY_CACHE_SIZE:
-            del self._replies[min(self._replies)]
-        w = self._waiters.pop(version, None)
-        if w is not None:
-            w.send(None)
-        return reply
+        return (verdicts, conflicting, fail_safe)
 
     # -- history-capacity fail-safe -----------------------------------------
 
@@ -230,4 +311,9 @@ class Resolver:
             "history_headroom": self._headroom,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,
+            # Dispatch-queue backpressure (sched subsystem): the ratekeeper
+            # throttles admission on queue_depth before the resolver
+            # overflows; status JSON reports the full queue dict.
+            "queue_depth": self.sched.queue_depth,
+            "queue": self.sched.metrics(),
         }

@@ -67,6 +67,17 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
             "transactions": {"committed": 0, "conflicted": 0},
             "grvs_served": 0,
             "resolver": {"batches": 0, "txns": 0},
+            # Resolve-dispatch scheduler backpressure (sched subsystem):
+            # depth/age are the worst over resolvers (the binding signal
+            # for admission), dispatch counts are cluster totals.
+            "resolver_queue": {
+                "depth": 0,
+                "oldest_age_s": 0.0,
+                "dispatch_occupancy": 0.0,
+                "target_depth": 0,
+                "windows_dispatched": 0,
+                "batches_dispatched": 0,
+            },
             # Hot-range conflict statistics (repair subsystem): the
             # proxies' aggregated decayed loss sketches, hottest first.
             "hot_ranges": [],
@@ -99,12 +110,26 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         for (b, e), s in sorted(hot.items(), key=lambda kv: -kv[1])[:16]
     ]
 
+    rq = doc["workload"]["resolver_queue"]
     for ep, mt in zip(resolver_eps, resolver_ms):
         m = await mt
         doc["processes"][ep.process] = {"role": "resolver", "reachable": m is not None}
         if m:
             doc["workload"]["resolver"]["batches"] += m["batches_resolved"]
             doc["workload"]["resolver"]["txns"] += m["txns_resolved"]
+            q = m.get("queue") or {}
+            rq["depth"] = max(rq["depth"], q.get("depth", 0))
+            rq["oldest_age_s"] = max(
+                rq["oldest_age_s"], q.get("oldest_age_s", 0.0)
+            )
+            rq["dispatch_occupancy"] = max(
+                rq["dispatch_occupancy"], q.get("dispatch_occupancy", 0.0)
+            )
+            rq["target_depth"] = max(
+                rq["target_depth"], q.get("target_depth", 0)
+            )
+            rq["windows_dispatched"] += q.get("windows_dispatched", 0)
+            rq["batches_dispatched"] += q.get("batches_dispatched", 0)
 
     for ep, vt in zip(tlog_eps, tlog_vers):
         ver = await vt

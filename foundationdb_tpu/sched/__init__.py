@@ -1,0 +1,33 @@
+"""Adaptive resolve-dispatch scheduling.
+
+The subsystem between the commit proxies and the conflict engine
+(``TPUConflictSet``): priority lanes for commit admission, a deadline
+coalescer that forms dispatch windows (dispatch when the window fills OR a
+latency budget expires, window depth adapted online from measured dispatch
+time and arrival rate), double-buffered host packing (pack window N+1 while
+the device executes window N), and queue-depth/occupancy backpressure fed
+to the Ratekeeper and status JSON.
+
+Pieces:
+
+- ``lanes``      — ``Priority`` + ``LaneQueue`` (system/default/batch with
+                   starvation-free aging), used by the commit proxy.
+- ``coalescer``  — ``DispatchCostModel`` + ``AdaptiveCoalescer``, the pure
+                   decision brain (clock passed in, fully deterministic).
+- ``resolver_queue`` — ``ResolveScheduler``: the Resolver role's dispatch
+                   queue on the flow Loop (virtual time; no threads).
+- ``packing``    — ``PipelinedWindowRunner``: the real-path runner that
+                   overlaps host packing with device execution (threads),
+                   with an inline mode for deterministic tests.
+"""
+
+from foundationdb_tpu.sched.coalescer import AdaptiveCoalescer, DispatchCostModel
+from foundationdb_tpu.sched.lanes import PRIORITY_NAMES, LaneQueue, Priority
+
+__all__ = [
+    "AdaptiveCoalescer",
+    "DispatchCostModel",
+    "LaneQueue",
+    "Priority",
+    "PRIORITY_NAMES",
+]

@@ -1,0 +1,90 @@
+"""Priority lanes for commit admission.
+
+Reference: TransactionPriority (fdbclient/FDBTypes.h) — SYSTEM_IMMEDIATE /
+DEFAULT / BATCH. The reference applies lanes at the GRV gate (GrvProxy
+already mirrors the default/batch split); this queue applies the same lanes
+at the commit proxy's batch formation, so resolver-bound dispatch never
+parks recovery or system traffic behind a bulk load's backlog.
+
+Starvation freedom: strict priority alone would let a saturating default
+stream starve the batch lane forever. A batch-lane entry older than
+``aging_s`` is promoted to the tail of the default lane — from then on only
+the default traffic already queued ahead of it can precede it, so every
+entry is served in bounded time under any sustained load mix. The system
+lane is never throttled and never aged into (it is reserved for recovery /
+system-keyspace traffic, the reference's immediate priority).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from typing import Any, Callable
+
+
+class Priority(enum.IntEnum):
+    """Lane index; lower value = served first."""
+
+    SYSTEM = 0
+    DEFAULT = 1
+    BATCH = 2
+
+
+# Wire/string names (CommitRequest.priority, client option values).
+PRIORITY_NAMES = {
+    "system": Priority.SYSTEM,
+    "default": Priority.DEFAULT,
+    "batch": Priority.BATCH,
+}
+
+
+class LaneQueue:
+    """Three-lane FIFO with strict priority + batch-lane aging."""
+
+    AGING_S = 1.0  # batch entry older than this is promoted to default
+
+    def __init__(self, clock: Callable[[], float], aging_s: float = AGING_S):
+        self._clock = clock
+        self._aging_s = aging_s
+        self._lanes: dict[Priority, deque] = {p: deque() for p in Priority}
+        self.promoted = 0  # batch entries aged into the default lane
+
+    def push(self, item: Any, priority: Priority | str = Priority.DEFAULT) -> None:
+        if isinstance(priority, str):
+            priority = PRIORITY_NAMES.get(priority, Priority.DEFAULT)
+        self._lanes[Priority(priority)].append((self._clock(), item))
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._lanes.values())
+
+    def depths(self) -> dict[str, int]:
+        return {p.name.lower(): len(self._lanes[p]) for p in Priority}
+
+    def oldest_age(self) -> float:
+        """Age of the oldest queued entry (any lane), seconds."""
+        now = self._clock()
+        heads = [q[0][0] for q in self._lanes.values() if q]
+        return (now - min(heads)) if heads else 0.0
+
+    def _promote_aged(self) -> None:
+        now = self._clock()
+        batch, default = self._lanes[Priority.BATCH], self._lanes[Priority.DEFAULT]
+        while batch and now - batch[0][0] >= self._aging_s:
+            default.append(batch.popleft())
+            self.promoted += 1
+
+    def pop(self, n: int) -> list[Any]:
+        """Up to ``n`` items: system first, then default, then batch (each
+        FIFO), after promoting aged batch entries into the default lane."""
+        self._promote_aged()
+        out: list[Any] = []
+        for p in Priority:
+            q = self._lanes[p]
+            while q and len(out) < n:
+                out.append(q.popleft()[1])
+            if len(out) >= n:
+                break
+        return out
+
+    def drain(self) -> list[Any]:
+        return self.pop(len(self))

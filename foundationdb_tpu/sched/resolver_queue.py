@@ -1,0 +1,132 @@
+"""ResolveScheduler: the Resolver role's dispatch queue on the flow Loop.
+
+Chain-ordered resolver batches (already admitted in (prev_version, version)
+order by the Resolver) queue here; the coalescer groups consecutive batches
+into one engine dispatch and a deadline timer bounds how long any batch can
+wait. Runs on the deterministic Loop — virtual-time timers, no threads —
+so sim campaigns replay identically; the real wire-path overlap of host
+packing with device execution lives in ``sched.packing`` (the thread side
+of the same policy).
+
+Backpressure surface: ``queue_depth`` / ``oldest_age_s`` /
+``dispatch_occupancy`` are exported through Resolver.get_metrics to the
+Ratekeeper (admission slows before the resolver overflows) and status JSON
+(``workload.resolver_queue``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Awaitable, Callable
+
+from foundationdb_tpu.runtime.flow import Promise, any_of
+
+from foundationdb_tpu.sched.coalescer import AdaptiveCoalescer
+
+
+class ResolveScheduler:
+    # Default: immediate mode — zero added latency, identical semantics to
+    # the unscheduled resolver; deployments opt into a coalescing budget.
+    BUDGET_S = 0.0
+    MAX_WINDOW = 32
+
+    def __init__(self, loop, budget_s: float = BUDGET_S,
+                 max_window: int = MAX_WINDOW,
+                 coalescer: AdaptiveCoalescer | None = None):
+        self.loop = loop
+        self.budget_s = budget_s
+        self.coalescer = coalescer or AdaptiveCoalescer(
+            budget_ms=budget_s * 1e3, max_window=max_window
+        )
+        self._queue: deque[tuple[float, Any]] = deque()  # (enqueue_t, entry)
+        self._dispatch_fn: Callable[[list], Awaitable[None]] | None = None
+        self._pumping = False
+        self._wakeup: Promise | None = None  # set while the pump sleeps
+        # Occupancy bookkeeping: fraction of elapsed time a dispatch was in
+        # flight since the first enqueue (virtual seconds in sim).
+        self._t_first: float | None = None
+        self._busy_s = 0.0
+        self.windows_dispatched = 0
+        self.batches_dispatched = 0
+
+    def attach(self, dispatch_fn: Callable[[list], Awaitable[None]]) -> None:
+        """dispatch_fn(entries) resolves a consecutive group in order."""
+        self._dispatch_fn = dispatch_fn
+
+    # -- metrics -------------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def oldest_age_s(self) -> float:
+        return (self.loop.now - self._queue[0][0]) if self._queue else 0.0
+
+    def dispatch_occupancy(self) -> float:
+        if self._t_first is None:
+            return 0.0
+        elapsed = self.loop.now - self._t_first
+        if elapsed <= 0:
+            return 0.0
+        return min(1.0, self._busy_s / elapsed)
+
+    def metrics(self) -> dict:
+        return {
+            "depth": self.queue_depth,
+            "oldest_age_s": round(self.oldest_age_s(), 6),
+            "dispatch_occupancy": round(self.dispatch_occupancy(), 4),
+            "windows_dispatched": self.windows_dispatched,
+            "batches_dispatched": self.batches_dispatched,
+            "target_depth": self.coalescer.target_depth(),
+            "budget_ms": self.coalescer.budget_ms,
+        }
+
+    # -- queue ---------------------------------------------------------------
+
+    def enqueue(self, entry: Any) -> None:
+        assert self._dispatch_fn is not None, "attach() a dispatch fn first"
+        now = self.loop.now
+        if self._t_first is None:
+            self._t_first = now
+        self._queue.append((now, entry))
+        self.coalescer.note_arrival(now * 1e3)
+        if not self._pumping:
+            self._pumping = True
+            self.loop.spawn(self._pump(), name="resolve_sched.pump")
+        elif self._wakeup is not None:
+            # Pump is parked on its deadline timer: wake it so a window
+            # that just filled dispatches NOW instead of waiting out the
+            # rest of the hint (the fill-OR-deadline contract).
+            w, self._wakeup = self._wakeup, None
+            w.send(None)
+
+    async def _pump(self) -> None:
+        try:
+            while self._queue:
+                age_ms = self.oldest_age_s() * 1e3
+                k = self.coalescer.decide(len(self._queue), age_ms)
+                if k <= 0:
+                    hint = self.coalescer.wait_hint_ms(len(self._queue), age_ms)
+                    # Park until the deadline hint OR the next arrival
+                    # (enqueue wakes us) — whichever first — then re-decide.
+                    self._wakeup = Promise()
+                    await any_of([
+                        self.loop.sleep(max(hint / 1e3, 1e-4)),
+                        self._wakeup.future,
+                    ])
+                    self._wakeup = None
+                    continue
+                k = min(k, len(self._queue))
+                group = [self._queue.popleft()[1] for _ in range(k)]
+                t0 = self.loop.now
+                await self._dispatch_fn(group)
+                dt = self.loop.now - t0
+                self._busy_s += dt
+                self.coalescer.observe_dispatch(k, dt * 1e3)
+                self.windows_dispatched += 1
+                self.batches_dispatched += k
+        finally:
+            self._pumping = False
+            if self._queue:  # entries raced in during the final dispatch
+                self._pumping = True
+                self.loop.spawn(self._pump(), name="resolve_sched.pump")

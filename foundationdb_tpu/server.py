@@ -403,9 +403,8 @@ class Worker:
         cp = getattr(self, "_commit_proxy", None)
         if cp is None:
             return
-        for _req, p in cp._queue:
+        for _req, p in cp._queue.drain():  # every lane (sched/lanes.py)
             p.fail(ProcessKilled(reason))
-        cp._queue = []
         self._commit_proxy = None
 
     def _fail_grv_queue(self, reason: str) -> None:
@@ -1582,7 +1581,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
 
         rk = Ratekeeper(loop, eps("storage"), eps("tlog"),
-                        proxy_eps=eps("proxy", "commit_proxy"))
+                        proxy_eps=eps("proxy", "commit_proxy"),
+                        resolver_eps=eps("resolver"))
         t.serve("ratekeeper", rk)
         _supervise(loop, "ratekeeper.run", rk.run)
         # TimeKeeper rides in the FIRST ratekeeper process only (the

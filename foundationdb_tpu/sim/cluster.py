@@ -612,7 +612,10 @@ class SimCluster:
             )
 
         self.ratekeeper = (
-            Ratekeeper(self.loop, self.storage_eps, self.tlog_eps)
+            # resolver_eps: the sched subsystem's backpressure loop —
+            # resolver dispatch-queue depth throttles admission.
+            Ratekeeper(self.loop, self.storage_eps, self.tlog_eps,
+                       resolver_eps=self.resolver_eps)
             if self.with_ratekeeper
             else None
         )

@@ -84,7 +84,7 @@ while true; do
   stage quick 700 BENCH_r05_quick.json "$TPU_OK" -- \
     python bench.py --mode ycsb --txns 262144 || { sleep 60; continue; }
   stage profile 1500 TPU_PROFILE_r05.json \
-    "$TPU_OK and r.get('phase_profile_ms')" -- \
+    "$TPU_OK and (r.get('phase_profile_ms') or {}).get('full_resolve')" -- \
     python bench.py --mode ycsb --profile || { sleep 60; continue; }
   stage diag 900 TPU_DIAG_r05.json "isinstance(r, dict) and len(r) > 2" -- \
     python scripts/tpu_diag.py || { sleep 60; continue; }
@@ -100,6 +100,10 @@ while true; do
     'r.get("metric") == "kernel_ab_packed_vs_unpacked"' -- \
     env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=KERNEL_AB_r05_rec.json \
     bash scripts/kernel_ab.sh || { sleep 60; continue; }
+  stage ab_sched 1800 SCHED_AB_r05.json \
+    'r.get("metric") == "sched_ab_fixed_vs_adaptive" and r.get("fixed_windowed_txns_per_sec") and r.get("adaptive_txns_per_sec")' -- \
+    env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=SCHED_AB_r05_rec.json \
+    bash scripts/sched_ab.sh || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"

@@ -1,0 +1,704 @@
+"""Adaptive resolve-dispatch scheduler (foundationdb_tpu/sched/).
+
+Covers the four tentpole pieces plus the satellites' regression points:
+
+- priority lanes (system/default/batch with starvation-free aging) at the
+  commit proxy — including the acceptance property: a system-priority txn
+  is never queued behind more than ONE full bulk window;
+- the deadline coalescer: online cost model, budget-capped window depth,
+  keep-up escalation under overload, deadline-fired short windows;
+- the Resolver's dispatch queue: chain order preserved, consecutive
+  batches coalesce into one dispatch, retransmits of parked batches share
+  the pending reply, queue metrics exported;
+- ratekeeper backpressure: get_rates() reflects resolver queue depth and
+  admitted tps recovers after the queue drains (deterministic sim);
+- the conflict set's pack/dispatch split and the threaded packer's parity
+  with inline packing (double-buffered host packing).
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.runtime.flow import Loop, Promise
+from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
+from foundationdb_tpu.runtime.resolver import Resolver
+from foundationdb_tpu.sched.coalescer import (
+    AdaptiveCoalescer,
+    DispatchCostModel,
+    quantized_depths,
+)
+from foundationdb_tpu.sched.lanes import LaneQueue, Priority
+from foundationdb_tpu.sched.resolver_queue import ResolveScheduler
+from foundationdb_tpu.sim.oracle import OracleConflictSet
+
+
+def _key(i: int) -> bytes:
+    return b"s%08d" % i
+
+
+def _txn(i: int, rv: int = 0) -> TxnConflictInfo:
+    k = _key(i)
+    return TxnConflictInfo(
+        read_version=rv,
+        read_ranges=[KeyRange(k, k + b"\x00")],
+        write_ranges=[KeyRange(k, k + b"\x00")],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lanes
+# ---------------------------------------------------------------------------
+
+
+class TestLaneQueue:
+    def test_strict_priority_order(self):
+        now = [0.0]
+        q = LaneQueue(lambda: now[0])
+        q.push("bulk1", Priority.BATCH)
+        q.push("d1", Priority.DEFAULT)
+        q.push("sys", Priority.SYSTEM)
+        q.push("d2", "default")
+        assert q.pop(10) == ["sys", "d1", "d2", "bulk1"]
+        assert len(q) == 0
+
+    def test_partial_pop_leaves_lower_lanes_queued(self):
+        now = [0.0]
+        q = LaneQueue(lambda: now[0])
+        for i in range(3):
+            q.push(f"b{i}", Priority.BATCH)
+        q.push("sys", Priority.SYSTEM)
+        assert q.pop(2) == ["sys", "b0"]
+        assert q.depths() == {"system": 0, "default": 0, "batch": 2}
+
+    def test_batch_aging_is_starvation_free(self):
+        """A batch entry older than aging_s is promoted into the default
+        lane, so a saturating default stream cannot starve it forever."""
+        now = [0.0]
+        q = LaneQueue(lambda: now[0], aging_s=1.0)
+        q.push("old_bulk", Priority.BATCH)
+        q.push("d0", Priority.DEFAULT)
+        now[0] = 2.0  # past the aging threshold
+        q.push("d1", Priority.DEFAULT)
+        # old_bulk promotes behind the default entries queued before its
+        # promotion, but ahead of everything that arrives after.
+        got = q.pop(2)
+        assert got == ["d0", "d1"]
+        q.push("d2", Priority.DEFAULT)
+        assert q.pop(2) == ["old_bulk", "d2"]
+        assert q.promoted == 1
+
+    def test_oldest_age_spans_lanes(self):
+        now = [0.0]
+        q = LaneQueue(lambda: now[0])
+        q.push("b", Priority.BATCH)
+        now[0] = 3.0
+        q.push("s", Priority.SYSTEM)
+        assert q.oldest_age() == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Coalescer
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchCostModel:
+    def test_fits_affine_cost(self):
+        m = DispatchCostModel()
+        for _ in range(8):
+            m.observe(1, 12.0)  # 10 + 2*1
+            m.observe(4, 18.0)  # 10 + 2*4
+            m.observe(8, 26.0)  # 10 + 2*8
+        assert m.overhead_ms == pytest.approx(10.0, abs=0.5)
+        assert m.per_batch_ms == pytest.approx(2.0, abs=0.2)
+        assert m.predict(16) == pytest.approx(42.0, abs=1.5)
+
+    def test_single_depth_degenerates_to_rate(self):
+        m = DispatchCostModel()
+        for _ in range(4):
+            m.observe(2, 10.0)
+        # No amortization claim from one depth: cost scales through origin.
+        assert m.predict(4) == pytest.approx(20.0, rel=0.05)
+
+    def test_quantized_depths(self):
+        assert quantized_depths(32) == [1, 2, 4, 8, 16, 32]
+        assert quantized_depths(12) == [1, 2, 4, 8, 12]
+        assert quantized_depths(1) == [1]
+
+
+class TestAdaptiveCoalescer:
+    def _coal(self, budget=100.0, max_window=32):
+        c = AdaptiveCoalescer(budget_ms=budget, max_window=max_window)
+        return c
+
+    def test_budget_caps_depth(self):
+        c = self._coal(budget=100.0)
+        for _ in range(8):
+            c.observe_dispatch(1, 11.0)  # 10 overhead + 1/batch
+            c.observe_dispatch(8, 18.0)
+        # predict(d) = 10 + d; cap = 50ms → largest power-of-two d ≤ 40.
+        assert c.target_depth() == 32
+        c2 = self._coal(budget=30.0)
+        for _ in range(8):
+            c2.observe_dispatch(1, 11.0)
+            c2.observe_dispatch(8, 18.0)
+        # cap = 15ms → 10 + d ≤ 15 → d ≤ 5 → depth 4.
+        assert c2.target_depth() == 4
+
+    def test_overload_escalates_depth_for_keep_up(self):
+        """Arrivals faster than the latency-optimal depth can service →
+        depth escalates (amortization is the only way to keep up)."""
+        c = self._coal(budget=20.0)
+        for _ in range(8):
+            c.observe_dispatch(1, 11.0)
+            c.observe_dispatch(8, 18.0)
+        # Latency cap alone: 10 + d ≤ 10 → depth 1.
+        assert c.target_depth() == 1
+        # 2ms interarrival: depth 1 services 1/11ms ≪ 1/2ms — needs d with
+        # 10 + d ≤ 2d → d ≥ 10 → quantized 16.
+        t = 0.0
+        for _ in range(32):
+            c.note_arrival(t)
+            t += 2.0
+        assert c.target_depth() == 16
+
+    def test_deadline_fires_short_window(self):
+        c = self._coal(budget=50.0)
+        for _ in range(8):
+            c.observe_dispatch(1, 6.0)
+            c.observe_dispatch(8, 20.0)
+        assert c.target_depth() > 2
+        # Fresh queue of 2: wait for fill.
+        assert c.decide(2, oldest_age_ms=0.0) == 0
+        # Same queue at 45ms age: dispatching now costs ~8ms → would blow
+        # the 50ms budget → ship the short window.
+        assert c.decide(2, oldest_age_ms=45.0) == 2
+
+    def test_full_window_dispatches_immediately(self):
+        c = self._coal(budget=50.0, max_window=8)
+        for _ in range(8):
+            c.observe_dispatch(1, 2.0)
+            c.observe_dispatch(8, 9.0)
+        assert c.decide(64, oldest_age_ms=0.0) == c.target_depth() > 0
+
+    def test_zero_budget_is_immediate_mode(self):
+        c = self._coal(budget=0.0)
+        assert c.decide(3, oldest_age_ms=0.0) == 3
+        assert c.decide(0, oldest_age_ms=0.0) == 0
+        assert c.wait_hint_ms(1, 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# ResolveScheduler on the sim loop
+# ---------------------------------------------------------------------------
+
+
+class TestResolveScheduler:
+    def test_coalesces_queued_entries_into_one_dispatch(self):
+        loop = Loop(seed=0)
+        groups: list[int] = []
+        sched = ResolveScheduler(loop, budget_s=0.05, max_window=8)
+
+        async def dispatch(entries):
+            groups.append(len(entries))
+
+        sched.attach(dispatch)
+
+        async def main():
+            for i in range(4):
+                sched.enqueue(i)
+            await loop.sleep(1.0)
+
+        loop.run(main(), timeout=10)
+        assert sum(groups) == 4
+        assert len(groups) == 1  # one deadline-coalesced window
+        m = sched.metrics()
+        assert m["windows_dispatched"] == 1
+        assert m["batches_dispatched"] == 4
+        assert m["depth"] == 0
+
+    def test_zero_budget_dispatches_immediately(self):
+        loop = Loop(seed=0)
+        groups: list[int] = []
+        sched = ResolveScheduler(loop)  # default budget 0
+
+        async def dispatch(entries):
+            groups.append(len(entries))
+
+        sched.attach(dispatch)
+
+        async def main():
+            sched.enqueue("a")
+            await loop.sleep(0.001)
+            sched.enqueue("b")
+            await loop.sleep(0.001)
+
+        loop.run(main(), timeout=10)
+        assert groups == [1, 1]
+
+    def test_arrival_wakes_parked_pump_when_window_fills(self):
+        """The pump parks on the deadline timer with a long budget; an
+        arrival that fills the target window must wake it immediately
+        (fill-OR-deadline), not wait out the rest of the hint."""
+        loop = Loop(seed=6)
+        groups: list[tuple[float, int]] = []
+        sched = ResolveScheduler(loop, budget_s=10.0, max_window=4)
+
+        async def dispatch(entries):
+            groups.append((loop.now, len(entries)))
+
+        sched.attach(dispatch)
+
+        async def main():
+            sched.enqueue("a")  # parks on a ~10s deadline hint
+            await loop.sleep(0.01)
+            for x in ("b", "c", "d"):  # fills the target window
+                sched.enqueue(x)
+            await loop.sleep(0.01)
+            return list(groups)
+
+        got = loop.run(main(), timeout=30)
+        assert got and got[0][1] == 4
+        assert got[0][0] < 1.0, got  # dispatched on fill, not on deadline
+
+    def test_queue_depth_visible_while_dispatch_blocked(self):
+        loop = Loop(seed=0)
+        gate = Promise()
+        sched = ResolveScheduler(loop)
+
+        async def dispatch(entries):
+            await gate.future
+
+        sched.attach(dispatch)
+
+        async def main():
+            sched.enqueue("a")  # starts a dispatch that parks on the gate
+            await loop.sleep(0.01)
+            for x in ("b", "c", "d"):
+                sched.enqueue(x)
+            await loop.sleep(0.01)
+            depth_while_busy = sched.queue_depth
+            age = sched.oldest_age_s()
+            gate.send(None)
+            await loop.sleep(0.1)
+            return depth_while_busy, age
+
+        depth, age = loop.run(main(), timeout=10)
+        assert depth == 3
+        assert age > 0
+        assert sched.queue_depth == 0
+        assert sched.batches_dispatched == 4
+
+
+class TestResolverDispatchQueue:
+    def _verdicts(self, got):
+        return [v for v in got]
+
+    def test_chain_order_and_verdict_parity_with_budget(self):
+        """Three chain-ordered batches admitted back-to-back coalesce into
+        one dispatch; verdicts equal an oracle fed the same stream."""
+        loop = Loop(seed=1)
+        res = Resolver(
+            loop, OracleConflictSet(),
+            scheduler=ResolveScheduler(loop, budget_s=0.01, max_window=8),
+        )
+        oracle = OracleConflictSet()
+        batches = [
+            [_txn(1), _txn(2)],
+            [_txn(1), _txn(3)],   # conflicts with batch 0's write of key 1
+            [_txn(2), _txn(4)],
+        ]
+
+        async def main():
+            tasks = [
+                loop.spawn(
+                    res.resolve(i * 10, (i + 1) * 10, txns),
+                    name=f"resolve{i}",
+                )
+                for i, txns in enumerate(batches)
+            ]
+            return [await t for t in tasks]
+
+        replies = loop.run(main(), timeout=10)
+        got = [v for verdicts, _c, _fs in replies for v in verdicts]
+        want = []
+        for i, txns in enumerate(batches):
+            want.extend(oracle.resolve(txns, (i + 1) * 10, 0))
+        assert got == want
+        assert res.sched.windows_dispatched == 1
+        assert res.sched.batches_dispatched == 3
+        assert res.version == 30
+
+    def test_retransmit_of_parked_batch_shares_pending_reply(self):
+        """A retransmit that arrives while the original batch is still in
+        the dispatch queue must await the same reply — not error stale,
+        not double-paint."""
+        loop = Loop(seed=2)
+        gate = Promise()
+
+        class GatedOracle(OracleConflictSet):
+            def __init__(self):
+                super().__init__()
+                self.resolves = 0
+
+            def resolve(self, txns, cv, oldest=None):
+                self.resolves += 1
+                return super().resolve(txns, cv, oldest)
+
+        engine = GatedOracle()
+        sched = ResolveScheduler(loop, budget_s=0.05, max_window=4)
+        res = Resolver(loop, engine, scheduler=sched)
+
+        async def main():
+            t1 = loop.spawn(res.resolve(0, 10, [_txn(1)]), name="orig")
+            await loop.sleep(0.001)  # admitted, parked on the coalescer
+            assert res.version == 10
+            t2 = loop.spawn(res.resolve(0, 10, [_txn(1)]), name="retransmit")
+            gate.send(None)
+            r1, r2 = await t1, await t2
+            return r1, r2
+
+        r1, r2 = loop.run(main(), timeout=10)
+        assert r1 == r2
+        assert engine.resolves == 1  # resolved exactly once
+
+    def test_dispatch_failure_cached_and_replayed_to_retransmits(self):
+        """Chain admission advances past a batch whose engine dispatch
+        raised — the failure is cached like a verdict, so a late
+        retransmit replays it deterministically instead of erroring
+        stale, and the engine is never re-driven (no double paint)."""
+        loop = Loop(seed=4)
+
+        class BoomEngine(OracleConflictSet):
+            def __init__(self):
+                super().__init__()
+                self.calls = 0
+
+            def resolve(self, txns, cv, oldest=None):
+                self.calls += 1
+                raise ValueError("boom")
+
+        engine = BoomEngine()
+        res = Resolver(loop, engine)
+
+        async def main():
+            errors = []
+            for _ in range(2):  # original + late retransmit
+                try:
+                    await res.resolve(0, 10, [_txn(1)])
+                except ValueError as e:
+                    errors.append(str(e))
+            return errors
+
+        errors = loop.run(main(), timeout=10)
+        assert errors == ["boom", "boom"]
+        assert engine.calls == 1
+        assert res.version == 10  # chain advanced; successors unaffected
+
+    def test_default_scheduler_metrics_exported(self):
+        loop = Loop(seed=3)
+        res = Resolver(loop, OracleConflictSet())
+
+        async def main():
+            await res.resolve(0, 10, [_txn(1)])
+            return await res.get_metrics()
+
+        m = loop.run(main(), timeout=10)
+        assert m["queue_depth"] == 0
+        q = m["queue"]
+        assert q["windows_dispatched"] == 1
+        assert q["batches_dispatched"] == 1
+        assert "dispatch_occupancy" in q and "target_depth" in q
+
+
+# ---------------------------------------------------------------------------
+# Commit-proxy priority lanes (sim acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestCommitPriorityLanes:
+    def test_system_txn_never_behind_one_bulk_window(self):
+        """Acceptance (ISSUE 4): with a deep batch-priority backlog, a
+        system-priority commit is queued behind at most ONE full bulk
+        window (the batch already forming when it arrived)."""
+        from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        loop = Loop(seed=11)
+        c = SimCluster(loop, n_proxies=1, engine="oracle",
+                       ratekeeper=False, timekeeper=False)
+        proxy = c.commit_proxies[0]
+        proxy.MAX_BATCH = 8  # small windows keep the test cheap
+        ep = c.commit_proxy_eps[0]
+
+        def req(i: int, priority: str) -> CommitRequest:
+            k = b"lane%06d" % i
+            return CommitRequest(
+                read_version=0,
+                write_ranges=[KeyRange(k, k + b"\x00")],
+                priority=priority,
+            )
+
+        async def main():
+            bulk = [
+                loop.spawn(ep.commit(req(i, "batch")), name=f"bulk{i}")
+                for i in range(48)
+            ]
+            # Let roughly one window form, then submit the system txn.
+            await loop.sleep(proxy.BATCH_INTERVAL * 1.5)
+            sys_res = await ep.commit(req(999, "system"))
+            bulk_res = [await t for t in bulk]
+            return sys_res, bulk_res
+
+        sys_res, bulk_res = loop.run(main(), timeout=60)
+        ahead = sum(1 for r in bulk_res if r.version < sys_res.version)
+        assert ahead <= proxy.MAX_BATCH, (
+            f"system txn queued behind {ahead} bulk txns "
+            f"(> one full {proxy.MAX_BATCH}-txn window)"
+        )
+        # And the bulk load did NOT starve: everything committed.
+        assert len(bulk_res) == 48
+
+    def test_lane_depths_in_proxy_metrics(self):
+        from foundationdb_tpu.runtime.commit_proxy import CommitProxy, CommitRequest
+
+        loop = Loop(seed=0)
+        proxy = CommitProxy.__new__(CommitProxy)
+        proxy.loop = loop
+        proxy._queue = LaneQueue(lambda: loop.now)
+        proxy.txns_committed = proxy.txns_conflicted = 0
+        from foundationdb_tpu.repair.hotrange import HotRangeSketch
+
+        proxy.hot_ranges = HotRangeSketch(lambda: loop.now)
+        proxy._queue.push((CommitRequest(read_version=0), Promise()), "batch")
+
+        async def main():
+            return await proxy.get_metrics()
+
+        m = loop.run(main(), timeout=10)
+        assert m["queued"] == 1
+        assert m["lanes"] == {"system": 0, "default": 0, "batch": 1}
+
+
+# ---------------------------------------------------------------------------
+# Ratekeeper backpressure (satellite: deterministic sim, seeded)
+# ---------------------------------------------------------------------------
+
+
+class _FakeStorage:
+    def __init__(self, loop):
+        self.loop = loop
+
+    def metrics(self):
+        async def get():
+            return {"version_lag": 0, "durability_lag": 0, "queue_bytes": 0}
+
+        return self.loop.spawn(get(), name="fake_storage.metrics")
+
+
+class _FakeQueueResolver:
+    """Resolver endpoint stub exposing only the sched backpressure shape."""
+
+    def __init__(self, loop):
+        self.loop = loop
+        self.depth = 0
+        self.occupancy = 0.0
+
+    def get_metrics(self):
+        async def get():
+            return {
+                "batches_resolved": 0,
+                "txns_resolved": 0,
+                "queue_depth": self.depth,
+                "queue": {
+                    "depth": self.depth,
+                    "dispatch_occupancy": self.occupancy,
+                },
+            }
+
+        return self.loop.spawn(get(), name="fake_resolver.metrics")
+
+
+class TestRatekeeperResolverBackpressure:
+    def test_rates_reflect_queue_and_recover_after_drain(self):
+        loop = Loop(seed=42)
+        resolver = _FakeQueueResolver(loop)
+        rk = Ratekeeper(loop, [_FakeStorage(loop)], [],
+                        resolver_eps=[resolver])
+
+        async def main():
+            loop.spawn(rk.run(), name="rk")
+            await loop.sleep(0.5)
+            healthy = await rk.get_rates()
+
+            resolver.depth = Ratekeeper.RQ_HARD  # saturated dispatch queue
+            resolver.occupancy = 1.0
+            await loop.sleep(0.5)
+            throttled = await rk.get_rates()
+
+            resolver.depth = 0  # queue drained
+            resolver.occupancy = 0.0
+            await loop.sleep(0.5)
+            recovered = await rk.get_rates()
+            return healthy, throttled, recovered
+
+        healthy, throttled, recovered = loop.run(main(), timeout=30)
+        assert healthy["tps_limit"] == Ratekeeper.BASE_TPS
+        assert healthy["worst_resolver_queue"] == 0
+
+        assert throttled["tps_limit"] == 0.0
+        assert throttled["limiting_reason"] == "resolver_queue"
+        assert throttled["worst_resolver_queue"] == Ratekeeper.RQ_HARD
+        assert throttled["resolver_dispatch_occupancy"] == 1.0
+
+        assert recovered["tps_limit"] == Ratekeeper.BASE_TPS
+        assert recovered["limiting_reason"] == "none"
+        assert recovered["worst_resolver_queue"] == 0
+
+    def test_soft_threshold_scales_batch_lane_first(self):
+        loop = Loop(seed=43)
+        resolver = _FakeQueueResolver(loop)
+        resolver.depth = int(Ratekeeper.RQ_SOFT * 0.75)  # over batch soft
+        rk = Ratekeeper(loop, [_FakeStorage(loop)], [],
+                        resolver_eps=[resolver])
+
+        async def main():
+            loop.spawn(rk.run(), name="rk")
+            await loop.sleep(0.5)
+            return await rk.get_rates()
+
+        rates = loop.run(main(), timeout=30)
+        assert rates["tps_limit"] == Ratekeeper.BASE_TPS
+        assert rates["batch_tps_limit"] < Ratekeeper.BASE_TPS
+
+
+# ---------------------------------------------------------------------------
+# Status JSON (satellite: workload.resolver_queue fields)
+# ---------------------------------------------------------------------------
+
+
+class TestStatusResolverQueue:
+    def test_fields_present_on_sim_cluster(self):
+        from foundationdb_tpu.runtime.status import fetch_status
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        loop = Loop(seed=5)
+        c = SimCluster(loop, engine="oracle", timekeeper=False)
+
+        async def main():
+            await loop.sleep(0.5)  # let idle batches flow through resolvers
+            return await loop.spawn(fetch_status(c), name="status")
+
+        doc = loop.run(main(), timeout=60)
+        rq = doc["workload"]["resolver_queue"]
+        assert set(rq) == {
+            "depth", "oldest_age_s", "dispatch_occupancy", "target_depth",
+            "windows_dispatched", "batches_dispatched",
+        }
+        assert rq["windows_dispatched"] >= 1  # idle batches dispatched
+        assert rq["depth"] == 0
+        qos = doc["qos"]["ratekeeper"]
+        assert "worst_resolver_queue" in qos
+        assert "resolver_dispatch_occupancy" in qos
+
+
+# ---------------------------------------------------------------------------
+# Pack/dispatch split + double-buffered packing parity
+# ---------------------------------------------------------------------------
+
+
+def _small_stream(n_batches: int, batch: int, seed: int = 29):
+    from foundationdb_tpu.models.conflict_set import encode_resolve_batch
+
+    rng = np.random.default_rng(seed)
+    wire = b""
+    all_txns = []
+    for b in range(n_batches):
+        txns = [
+            _txn(int(k), rv=max(0, b - 1))
+            for k in rng.integers(0, 64, size=batch)
+        ]
+        wire += encode_resolve_batch(txns)
+        all_txns.append(txns)
+    return wire, all_txns
+
+
+class TestPackDispatchSplit:
+    BATCH = 16
+
+    def _cs(self):
+        from foundationdb_tpu.models.conflict_set import TPUConflictSet
+
+        return TPUConflictSet(
+            capacity=1 << 10, batch_size=self.BATCH, max_read_ranges=2,
+            max_write_ranges=2, max_key_bytes=12,
+        )
+
+    def test_split_path_matches_monolithic_and_oracle(self):
+        wire, all_txns = _small_stream(4, self.BATCH)
+        cs_mono, cs_split = self._cs(), self._cs()
+        cvs = list(range(1, 5))
+        mono = cs_mono.resolve_wire_window_async(wire, cvs, self.BATCH)()
+        prepared = cs_split.pack_wire_window(wire, cvs, self.BATCH)
+        assert prepared.rebase_delta == 0
+        split = cs_split.dispatch_window(prepared)()
+        assert np.array_equal(np.asarray(mono), np.asarray(split))
+        oracle = OracleConflictSet()
+        want = []
+        for i, txns in enumerate(all_txns):
+            want.append([int(v) for v in oracle.resolve(txns, i + 1, 0)])
+        assert np.asarray(mono).tolist() == want
+
+    @pytest.mark.parametrize("threaded", [False, True])
+    def test_pipelined_runner_parity(self, threaded):
+        from foundationdb_tpu.sched.packing import PipelinedWindowRunner
+
+        wire, _ = _small_stream(4, self.BATCH)
+        want = self._cs().resolve_wire_window_async(
+            wire, list(range(1, 5)), self.BATCH
+        )()
+        # Same stream as two 2-batch windows through the runner: window 2
+        # packs while window 1 executes (threaded mode).
+        cs = self._cs()
+        runner = PipelinedWindowRunner(cs, threaded=threaded)
+        half = len(wire) // 2
+        runner.submit(wire[:half], [1, 2], self.BATCH)
+        runner.submit(wire[half:], [3, 4], self.BATCH)
+        got = np.concatenate(
+            [np.asarray(runner.collect_next()), np.asarray(runner.collect_next())]
+        )
+        runner.close()
+        assert np.array_equal(np.asarray(want), got)
+
+    def test_failed_pack_is_transactional_on_host_bookkeeping(self):
+        """A pack that raises AFTER advancing version bookkeeping must
+        roll it back (a deferred rebase would otherwise leave
+        base_version ahead of the never-rebased device state) — the
+        engine stays usable on the same version chain."""
+        cs = self._cs()
+        wire, _ = _small_stream(2, self.BATCH)
+        cs.resolve_wire_window(wire, [1, 2], self.BATCH)
+        with pytest.raises(ValueError, match="must advance"):
+            # Second cv repeats the first: raises after the first
+            # _begin_resolve already advanced the bookkeeping.
+            cs.pack_wire_window(wire, [3, 3], self.BATCH)
+        wire2, txns2 = _small_stream(2, self.BATCH, seed=37)
+        got = cs.resolve_wire_window(wire2, [3, 4], self.BATCH)
+        oracle = OracleConflictSet()
+        for i, txns in enumerate(_small_stream(2, self.BATCH)[1]):
+            oracle.resolve(txns, i + 1, 0)
+        want = [
+            [int(v) for v in oracle.resolve(t, cv, 0)]
+            for t, cv in zip(txns2, (3, 4))
+        ]
+        assert np.asarray(got).tolist() == want
+
+    def test_runner_surfaces_pack_errors(self):
+        from foundationdb_tpu.sched.packing import PipelinedWindowRunner
+
+        cs = self._cs()
+        runner = PipelinedWindowRunner(cs, threaded=True)
+        runner.submit(b"\x01garbage", [1], self.BATCH)
+        with pytest.raises(ValueError, match="malformed"):
+            runner.collect_next()
+        runner.close()
