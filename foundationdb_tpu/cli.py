@@ -123,6 +123,11 @@ include ROLEN           return an excluded process to service
 configure ROLE=N ...    chain-role counts for the next generation, e.g.
                         `configure proxies=1 tlogs=2` (fdbcli configure)
 coordinators            show the coordination/controller endpoints
+consistencycheck [T]    walk every shard team at one snapshot version and
+                        byte-compare the replicas through each member's own
+                        serve path; prints the divergence report (JSON).
+                        T = wait budget in seconds (default 120; the audit
+                        paces itself, so big datasets need more)
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -315,6 +320,28 @@ class Shell:
             if ctrl:
                 return f"controller (singleton coordination): {ctrl[0]}"
             return "static wiring: no coordination processes"
+        if cmd == "consistencycheck":
+            # Replica byte-parity audit (consistency subsystem; reference:
+            # the consistencycheck fdbcli surface over
+            # ConsistencyCheck.actor.cpp). Walks every shard team — ring
+            # replicas, or pri/rem cross-region teams under a regions
+            # spec — at one snapshot version via each storage's own serve
+            # path and prints the machine-readable divergence report.
+            # Optional TIMEOUT_S raises the wait for large datasets (the
+            # audit paces itself at ~4 MiB/s, harder under ratekeeper
+            # pressure, so wall time scales with data size by design).
+            if len(args) > 1:
+                return "usage: consistencycheck [TIMEOUT_S]"
+            timeout_s = float(args[0]) if args else 120.0
+            from foundationdb_tpu.consistency.checker import (
+                run_deployed_check,
+            )
+
+            report = self._await(
+                run_deployed_check(self.loop, self.t, self.spec, self.db),
+                timeout=timeout_s,
+            )
+            return json.dumps(report, indent=1, sort_keys=True)
         if cmd == "status":
             return json.dumps(self._status(), indent=1, sort_keys=True)
         return f"ERROR: unknown command `{cmd}' (try help)"

@@ -1,0 +1,241 @@
+"""Chunked byte-parity scanner: compare one key range across N members.
+
+Reference: ConsistencyCheck.actor.cpp's per-shard loop — read the range in
+bounded chunks at one version from EVERY member of the team through each
+member's own serve path, checksum-compare the chunks, and on mismatch walk
+the rows for the exact first divergent key. Chunks are paced (ratekeeper-
+aware) so a full-keyspace audit never starves foreground traffic — the
+reference's rateLimit on consistency-check reads.
+
+A "member" is just ``(name, read)`` where ``read(begin, end, version,
+limit)`` is that member's own async range-read surface: a storage
+endpoint's ``get_range`` (sim or deployed), a client-level paged read for
+a DR secondary, anything that answers rows in key order. The scanner never
+touches storage internals, so what it audits is exactly what readers see.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from foundationdb_tpu.core.errors import FutureVersion
+
+#: sim-scale chunk bounds (reference: CHECK_SIZE_BYTES — upstream uses MBs;
+#: the sim keyspace is a few KB so smaller chunks exercise the chunk loop).
+DEFAULT_CHUNK_BYTES = 2048
+DEFAULT_MAX_ROWS = 128
+
+
+def printable(b: bytes) -> str:
+    """JSON-safe fdbcli-style key escaping (\\xNN for non-printables)."""
+    return "".join(
+        chr(c) if 32 <= c < 127 and c != 0x5C else f"\\x{c:02x}" for c in b
+    )
+
+
+def rolling_checksum(rows: list[tuple[bytes, bytes]]) -> int:
+    """FNV-1a over length-framed key/value bytes: order- and
+    boundary-sensitive, so any torn/missing/extra/mutated row changes it."""
+    h = 0xCBF29CE484222325
+    for k, v in rows:
+        for part in (len(k).to_bytes(4, "big"), k,
+                     len(v).to_bytes(4, "big"), v):
+            for byte in part:
+                h = ((h ^ byte) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+@dataclass
+class Divergence:
+    """One replica-disagreement found inside a compared chunk."""
+
+    begin: bytes  # chunk range compared
+    end: bytes
+    first_divergent_key: bytes
+    kind: str  # value_mismatch | missing_row | extra_row
+    reference: str  # member the chunk was defined from
+    member: str  # member that disagreed
+    checksums: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "range_begin": printable(self.begin),
+            "range_end": printable(self.end),
+            "first_divergent_key": printable(self.first_divergent_key),
+            "kind": self.kind,
+            "reference": self.reference,
+            "member": self.member,
+            "checksums": {m: f"{c:016x}" for m, c in self.checksums.items()},
+        }
+
+
+@dataclass
+class ScanResult:
+    chunks: int = 0
+    rows_compared: int = 0
+    bytes_compared: int = 0
+    paced_s: float = 0.0
+    divergences: list[Divergence] = field(default_factory=list)
+
+    def merge(self, other: "ScanResult") -> None:
+        self.chunks += other.chunks
+        self.rows_compared += other.rows_compared
+        self.bytes_compared += other.bytes_compared
+        self.paced_s += other.paced_s
+        self.divergences.extend(other.divergences)
+
+
+class RatekeeperPacer:
+    """Chunk pacing: a byte budget per second, throttled harder whenever
+    the ratekeeper reports a limiting signal (the audit is strictly
+    background work — foreground QoS degradation must slow it first)."""
+
+    REFRESH_S = 1.0  # how often to re-poll the ratekeeper
+    DEGRADED_BACKOFF = 4.0  # delay multiplier while a signal is limiting
+
+    def __init__(self, loop, ratekeeper_ep=None,
+                 bytes_per_s: float = 256 * 1024):
+        self.loop = loop
+        self.ratekeeper_ep = ratekeeper_ep
+        self.bytes_per_s = float(bytes_per_s)
+        self._degraded = False
+        self._last_poll = -1e18
+
+    async def _refresh(self) -> None:
+        if self.ratekeeper_ep is None:
+            return
+        if self.loop.now - self._last_poll < self.REFRESH_S:
+            return
+        self._last_poll = self.loop.now
+        try:
+            rates = await self.ratekeeper_ep.get_rates()
+            self._degraded = rates.get("limiting_reason", "none") != "none"
+        except Exception:
+            pass  # unreachable ratekeeper: keep the last verdict
+
+    async def pace(self, nbytes: int) -> float:
+        """Sleep off `nbytes` of audit reads; returns the delay taken."""
+        await self._refresh()
+        delay = nbytes / max(1.0, self.bytes_per_s)
+        if self._degraded:
+            delay *= self.DEGRADED_BACKOFF
+        if delay > 0:
+            await self.loop.sleep(delay)
+        return delay
+
+
+def first_divergence(
+    ref_rows: list[tuple[bytes, bytes]], other_rows: list[tuple[bytes, bytes]]
+) -> tuple[bytes, str] | None:
+    """Exact first divergent key between two sorted row lists.
+
+    kind is from the OTHER member's perspective: ``missing_row`` = the
+    reference holds a key the member lacks; ``extra_row`` = the member
+    holds a key the reference lacks."""
+    i = j = 0
+    while i < len(ref_rows) and j < len(other_rows):
+        (ka, va), (kb, vb) = ref_rows[i], other_rows[j]
+        if ka == kb:
+            if va != vb:
+                return ka, "value_mismatch"
+            i += 1
+            j += 1
+        elif ka < kb:
+            return ka, "missing_row"
+        else:
+            return kb, "extra_row"
+    if i < len(ref_rows):
+        return ref_rows[i][0], "missing_row"
+    if j < len(other_rows):
+        return other_rows[j][0], "extra_row"
+    return None
+
+
+class RangeScanner:
+    """Scan [begin, end) at one read version across all members in bounded
+    chunks: the first member defines each chunk's extent, every other
+    member reads the SAME sub-range through its own serve path, checksums
+    compare, and mismatched chunks get exact first-divergent-key reports."""
+
+    FUTURE_RETRIES = 20  # lagging member: each get_range already waits ~1s
+    FUTURE_RETRY_S = 0.25
+
+    def __init__(self, loop, members: list[tuple], *,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 max_rows: int = DEFAULT_MAX_ROWS, pacer=None):
+        assert members, "scanner needs at least one member"
+        self.loop = loop
+        self.members = list(members)
+        self.chunk_bytes = chunk_bytes
+        self.max_rows = max_rows
+        self.pacer = pacer
+
+    async def _read(self, read, begin: bytes, end: bytes, version: int,
+                    limit: int) -> list[tuple[bytes, bytes]]:
+        """One member read with lagging-replica patience: FutureVersion
+        means the member's apply loop hasn't reached the audit version yet
+        (fresh standby, async remote region) — wait, don't report it
+        divergent. WrongShardServer propagates: membership changed and the
+        CALLER must re-resolve the team (data movement tolerance)."""
+        for attempt in range(self.FUTURE_RETRIES):
+            try:
+                return await read(begin, end, version, limit)
+            except FutureVersion:
+                if attempt == self.FUTURE_RETRIES - 1:
+                    raise
+                await self.loop.sleep(self.FUTURE_RETRY_S)
+        raise AssertionError("unreachable")
+
+    async def scan_chunk(
+        self, pos: bytes, end: bytes, version: int
+    ) -> tuple[ScanResult, bytes]:
+        """One bounded chunk starting at `pos`; returns (result, next_pos).
+
+        Exposed so callers can make PER-CHUNK progress: a fault mid-shard
+        (moved team, expired audit version, dead member) must not restart
+        the whole shard — a paced scan of a large shard can outlive the
+        MVCC window by construction, so whole-shard retries could never
+        terminate (review finding)."""
+        res = ScanResult()
+        ref_name, ref_read = self.members[0]
+        rows = await self._read(ref_read, pos, end, version,
+                                self.max_rows + 1)
+        take: list[tuple[bytes, bytes]] = []
+        nbytes = 0
+        for k, v in rows[: self.max_rows]:
+            take.append((k, v))
+            nbytes += len(k) + len(v)
+            if nbytes >= self.chunk_bytes:
+                break
+        exhausted = len(rows) <= len(take)
+        chunk_end = end if exhausted else take[-1][0] + b"\x00"
+        ref_sum = rolling_checksum(take)
+        for name, read in self.members[1:]:
+            other = await self._read(read, pos, chunk_end, version,
+                                     len(take) + 2)
+            other_sum = rolling_checksum(other)
+            res.rows_compared += len(other)
+            res.bytes_compared += sum(len(k) + len(v) for k, v in other)
+            if other_sum == ref_sum:
+                continue
+            div = first_divergence(take, other)
+            key, kind = div if div else (pos, "checksum_mismatch")
+            res.divergences.append(Divergence(
+                begin=pos, end=chunk_end, first_divergent_key=key,
+                kind=kind, reference=ref_name, member=name,
+                checksums={ref_name: ref_sum, name: other_sum},
+            ))
+        res.chunks += 1
+        res.rows_compared += len(take)
+        res.bytes_compared += nbytes
+        if self.pacer is not None:
+            res.paced_s += await self.pacer.pace(nbytes)
+        return res, chunk_end
+
+    async def scan(self, begin: bytes, end: bytes, version: int) -> ScanResult:
+        res = ScanResult()
+        pos = begin
+        while pos < end:
+            chunk, pos = await self.scan_chunk(pos, end, version)
+            res.merge(chunk)
+        return res

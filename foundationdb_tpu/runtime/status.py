@@ -82,6 +82,13 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
             # proxies' aggregated decayed loss sketches, hottest first.
             "hot_ranges": [],
             "conflict_losses": 0,
+            # Replica byte-parity audit (consistency subsystem): summary
+            # of the most recent ConsistencyChecker run against this
+            # cluster, or never_run.
+            "consistency": (
+                getattr(cluster, "consistency_status", None)
+                or {"status": "never_run"}
+            ),
         },
         "qos": {},
         "processes": {},

@@ -21,7 +21,11 @@ Example (the shape the reference uses, reference: tests/fast/Cycle.toml):
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # python 3.10: API-compatible backport
+    import tomli as tomllib
+
 from dataclasses import dataclass, field
 
 from foundationdb_tpu.runtime.flow import all_of
@@ -31,6 +35,7 @@ from foundationdb_tpu.sim.workloads import (
     BackupRestoreWorkload,
     ChangeFeedWorkload,
     ConflictRangeWorkload,
+    ConsistencyCheckWorkload,
     CycleWorkload,
     FaultInjector,
     IncrementWorkload,
@@ -147,6 +152,11 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "moveCount": "n_moves",
     }),
     "Authz": (AuthzWorkload, {
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+    }),
+    "ConsistencyCheck": (ConsistencyCheckWorkload, {
+        "keyCount": "n_keys",
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
     }),

@@ -14,9 +14,26 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
     service queueing), so a warm cache is the difference between a 30 s and
     a 30 min run. Safe to call before or after backend init; silently a
     no-op if the running JAX lacks the config knobs.
+
+    CPU guard: jaxlib 0.4.36's CPU executable deserialization is UNSOUND
+    for mesh/shard_map programs — reloading a persisted executable heap-
+    corrupts the process (nondeterministic segfaults/aborts/hangs in any
+    warm-cache run of the 8-virtual-device suite; cold runs pass, and a
+    reload can even hit within ONE process when a second engine instance
+    recompiles the same shapes). Per-call opt-outs don't exist: jax
+    memoizes the cache-enabled check at the first jit. CPU compiles of
+    this repo's shapes cost seconds, so CPU-pinned processes (the test
+    suite, bench's cpu-mesh child, FORCE_CPU fallbacks) simply keep the
+    persistent cache OFF; ``FDB_TPU_CPU_CACHE=1`` re-enables it for
+    debugging the upstream issue.
     """
     import jax
 
+    if os.environ.get("FDB_TPU_CPU_CACHE") != "1" and (
+        "cpu" in os.environ.get("JAX_PLATFORMS", "")
+        or os.environ.get("FDB_TPU_FORCE_CPU") == "1"
+    ):
+        return
     try:
         jax.config.update(
             "jax_compilation_cache_dir",

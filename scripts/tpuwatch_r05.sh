@@ -83,6 +83,13 @@ while true; do
   trap 'rm -f /tmp/tpu_window_open' EXIT
   stage quick 700 BENCH_r05_quick.json "$TPU_OK" -- \
     python bench.py --mode ycsb --txns 262144 || { sleep 60; continue; }
+  # Replica byte-parity audit (consistency subsystem): CPU-only sim audit
+  # of a replicated cluster under load — validates the build's data plane
+  # during the heal window without burning device time.
+  stage consistency 600 CONSISTENCY_r05.json \
+    'r.get("metric") == "consistency_check" and r.get("status") == "consistent"' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.consistency \
+    || { sleep 60; continue; }
   stage profile 1500 TPU_PROFILE_r05.json \
     "$TPU_OK and (r.get('phase_profile_ms') or {}).get('full_resolve')" -- \
     python bench.py --mode ycsb --profile || { sleep 60; continue; }

@@ -294,6 +294,51 @@ def test_dr_apply_idempotent_on_commit_unknown_result():
     assert loop.run(main(), timeout=600) == "ok"
 
 
+def test_dr_consistency_check_audits_secondary():
+    """Consistency subsystem over the DR plane: with the apply stream
+    drained past the audit version, the checker byte-compares the
+    primary's user keyspace against the SECONDARY through its own client
+    read path — green when they match, and a seeded corruption of the
+    secondary's store is reported with the exact key."""
+    from foundationdb_tpu.consistency.checker import ConsistencyChecker
+    from foundationdb_tpu.consistency.scanner import printable
+
+    loop, src, src_db, dst_db, dst = make_pair(seed=51)
+
+    async def main():
+        await put(src_db, [(b"au/%03d" % i, b"v%d" % i) for i in range(30)])
+        agent = DRAgent(src, src_db, dst_db)
+        await agent.start()
+        # Quiesced primary + drained stream: the sound-compare precondition.
+        deadline = loop.now + 30
+        while await agent.lag() > 0 and loop.now < deadline:
+            await loop.sleep(0.05)
+
+        report = await ConsistencyChecker(src, src_db, dr=agent).run()
+        assert report["status"] == "consistent", report
+        assert report["dr"]["checked"]
+        assert report["dr"]["divergences"] == []
+        assert report["dr"]["rows_compared"] > 0
+
+        # Corrupt ONE byte in the secondary's store behind its serve path.
+        key = b"au/011"
+        tag = dst.storage_map.tag_for_key(key)
+        chain = dst.storages[tag].map._chains[key]
+        v, val = chain[-1]
+        chain[-1] = (v, bytes([val[0] ^ 0x01]) + val[1:])
+
+        report2 = await ConsistencyChecker(src, src_db, dr=agent).run()
+        assert report2["status"] == "divergent"
+        (d,) = report2["dr"]["divergences"]
+        assert d["first_divergent_key"] == printable(key)
+        assert d["member"] == "dr_secondary"
+        assert d["kind"] == "value_mismatch"
+        await agent.abort()
+        return "ok"
+
+    assert loop.run(main(), timeout=600) == "ok"
+
+
 def test_dr_lag_grows_when_puller_wedges():
     """lag() measures against the primary's LIVE committed version: wedge
     the backup worker (cancel its pull task) and keep committing — lag

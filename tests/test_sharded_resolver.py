@@ -139,9 +139,13 @@ class TestDensitySplits:
         ]
 
         def run(splits, reshard_every=0):
+            # auto_reshard off: this test A/Bs split POLICIES explicitly —
+            # the engine's (new) default auto-resharding would fix the
+            # uniform baseline mid-run and erase the comparison.
             cs = ShardedConflictSet(
                 n_shards=4, splits=splits, capacity=4096, batch_size=16,
                 max_read_ranges=2, max_write_ranges=2, max_key_bytes=12,
+                auto_reshard=False,
             )
             v = 0
             seen: list[bytes] = []
@@ -177,6 +181,64 @@ class TestDensitySplits:
         occ_resplit = run(density_splits(4, sample), reshard_every=8)
         lo, hi = min(occ_resplit), max(occ_resplit)
         assert hi <= 2 * lo, (occ_resplit, occ_static, occ_uniform)
+
+    def test_auto_reshard_is_the_default_and_bounds_skew(self):
+        """Density resharding as the RUNTIME DEFAULT: a Zipf-skewed stream
+        on out-of-the-box uniform splits must trigger the engine's own
+        occupancy-driven re-split (no harness involvement) and land
+        bounded per-shard skew — never the [N, 1, 1, 1] degeneracy."""
+        rng = np.random.default_rng(29)
+        n_txns = 512
+        ids = np.minimum(rng.zipf(1.3, (n_txns, 2)) - 1, 2000)
+        keyss = [[int(i).to_bytes(8, "big") for i in row] for row in ids]
+
+        def run(auto: bool):
+            cs = ShardedConflictSet(
+                n_shards=4, capacity=4096, batch_size=16,
+                max_read_ranges=2, max_write_ranges=2, max_key_bytes=12,
+                auto_reshard=auto, reshard_interval=4,
+            )
+            assert cs.auto_reshard == auto
+            v = 0
+            for i in range(0, n_txns, 16):
+                v += 1
+                txns = [
+                    TxnConflictInfo(
+                        read_version=v - 1,
+                        read_ranges=[KeyRange(k, k + b"\x00") for k in ks],
+                        write_ranges=[KeyRange(k, k + b"\x00") for k in ks],
+                    )
+                    for ks in keyss[i : i + 16]
+                ]
+                cs.resolve(txns, v)
+            return cs
+
+        off = run(auto=False)
+        occ_off = off.shard_occupancy()
+        # 8-byte int keys all share first byte 0: uniform splits leave
+        # every boundary in shard 0 — the degeneracy the default fixes.
+        assert max(occ_off[1:]) <= 1 and off.auto_reshards == 0
+
+        on = run(auto=True)
+        occ_on = on.shard_occupancy()
+        assert on.auto_reshards >= 1  # the default policy actually fired
+        lo, hi = max(1, min(occ_on)), max(occ_on)
+        assert hi <= on.reshard_skew * lo, (occ_on, occ_off)
+
+    def test_auto_reshard_preserves_verdicts_vs_oracle(self):
+        """The default policy must never change a verdict: same stream
+        through the auto-resharding engine and the oracle."""
+        rng = np.random.default_rng(41)
+        cs = make_sharded(4, capacity=1024, auto_reshard=True,
+                          reshard_interval=2, reshard_skew=1.5)
+        oracle = OracleConflictSet()
+        cv = 0
+        for step in range(10):
+            cv += int(rng.integers(1, 10))
+            txns = [rand_txn(rng, read_version=max(0, cv - 5))
+                    for _ in range(int(rng.integers(1, 24)))]
+            assert cs.resolve(txns, cv) == oracle.resolve(txns, cv), step
+        assert not cs.overflowed
 
     def test_reshard_preserves_verdicts(self):
         """reshard() between batches must not change any verdict: the
