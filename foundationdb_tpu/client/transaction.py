@@ -453,9 +453,12 @@ class Transaction:
             ep = self.db._pick(self.db.grv_proxies)
             try:
                 self._read_version = await ep.get_read_version(
-                    # The GRV proxy models default/batch lanes; system
-                    # traffic rides the default (unthrottled-first) lane.
-                    "batch" if self.priority == "batch" else "default",
+                    # Lane pass-through: system traffic must reach the GRV
+                    # proxy AS system — it bypasses ratekeeper admission
+                    # there (campaign find: mapping system onto the default
+                    # lane let resolver-queue backpressure starve system
+                    # txns behind the very storm they outrank).
+                    self.priority,
                     sorted(self.tags) if self.tags else None,
                 )
             except BrokenPromise as e:

@@ -154,9 +154,28 @@ class ConsistencyChecker:
             # retry could not — review finding).
             while pos < sub_end:
                 if members is None:
-                    members = await self._probe_members(
-                        [self._member(t) for t in shard.team],
-                        pos, sub_end, version, report["unreachable"])
+                    try:
+                        members = await self._probe_members(
+                            [self._member(t) for t in shard.team],
+                            pos, sub_end, version, report["unreachable"])
+                    except WrongShardServer:
+                        # The team flipped between map resolution and the
+                        # probe (nemesis-campaign find: the audit CRASHED
+                        # here while racing live movement under clogs —
+                        # the probe path lacked the scan path's
+                        # moved-shard handling): re-resolve and retry,
+                        # same as a mid-scan move.
+                        faults += 1
+                        if faults > self.MAX_SHARD_RETRIES:
+                            raise ConsistencyCheckError(
+                                f"shard at {printable(pos)} kept moving: "
+                                f"{self.MAX_SHARD_RETRIES} rescans "
+                                f"exhausted")
+                        report["moved_rescans"] += 1
+                        await loop.sleep(self.MOVED_RETRY_S)
+                        shard = self.cluster.storage_map.shard_for_key(pos)
+                        sub_end = min(shard.range.end, self.end)
+                        continue
                     if not members:
                         pos = sub_end  # whole team dark: recorded, move on
                         break
@@ -213,6 +232,13 @@ class ConsistencyChecker:
                     members = None
                     continue
                 self._fold(report, chunk, shard)
+                # PROGRESS resets the fault budget: under sustained churn
+                # (an auto-resharding storm) a shard may legitimately move
+                # more than MAX_SHARD_RETRIES times across a long paced
+                # scan — only consecutive faults with NO forward progress
+                # indicate a wedge (nemesis-campaign find: the audit gave
+                # up mid-walk while every retry was in fact advancing).
+                faults = 0
             report["shards_checked"] += 1
         if self.dr is not None:
             report["dr"] = await self._check_dr(version)

@@ -57,8 +57,18 @@ def _unpack_params(blob: bytes) -> dict:
 
 
 class TaskBucket:
-    def __init__(self, subspace: Subspace):
+    def __init__(self, subspace: Subspace, token: str | None = None):
+        # token: authorization token applied to every queue transaction —
+        # on an authz-armed cluster the bucket's keyspace is gated like
+        # any other write, and executors coordinating work across tenants
+        # carry the operator/tenant credential here once instead of
+        # wrapping every call site.
         self.ss = subspace
+        self.token = token
+
+    def _tokenize(self, tr) -> None:
+        if self.token:
+            tr.set_option("authorization_token", self.token)
 
     def _avail_prefix(self) -> bytes:
         return self.ss.key() + _AVAIL
@@ -70,6 +80,7 @@ class TaskBucket:
         """Enqueue (FIFO by commit order: the key is versionstamped)."""
 
         async def body(tr):
+            self._tokenize(tr)
             tr.atomic_op(
                 MutationType.SET_VERSIONSTAMPED_KEY,
                 self._avail_prefix() + b"\x00" * 10
@@ -84,6 +95,7 @@ class TaskBucket:
         into the leased set under now+lease. Returns Task or None."""
 
         async def body(tr):
+            self._tokenize(tr)
             # Clock INSIDE the attempt: a conflict-retried claim must not
             # grant a lease computed from a pre-backoff timestamp (it
             # could be born expired) nor miss leases that expired during
@@ -120,6 +132,7 @@ class TaskBucket:
         returns the refreshed Task, or None if the lease was lost."""
 
         async def body(tr):
+            self._tokenize(tr)
             now = db.loop.now  # per attempt (see claim)
             blob = await tr.get(task.lease_key)
             if blob is None:
@@ -138,6 +151,7 @@ class TaskBucket:
         exactly the reference's contract)."""
 
         async def body(tr):
+            self._tokenize(tr)
             if await tr.get(task.lease_key) is None:
                 return False
             tr.clear(task.lease_key)
@@ -149,6 +163,7 @@ class TaskBucket:
         """(available, leased) — monitoring."""
 
         async def body(tr):
+            self._tokenize(tr)
             ap, lp = self._avail_prefix(), self._leased_prefix()
             a = await tr.get_range(ap, strinc(ap))
             le = await tr.get_range(lp, strinc(lp))

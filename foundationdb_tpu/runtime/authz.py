@@ -40,21 +40,31 @@ def _b64d(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
+try:  # prefer the C implementation; PEM/wire formats are identical
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ed
+
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # pure-Python RFC 8032 fallback (see module)
+    from foundationdb_tpu.runtime import _ed25519 as _pyed
+
+    _HAVE_CRYPTOGRAPHY = False
+
+
 def generate_keypair() -> tuple[bytes, bytes]:
     """(private_pem, public_pem) — Ed25519, the reference's default."""
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric import ed25519
-
-    priv = ed25519.Ed25519PrivateKey.generate()
+    if not _HAVE_CRYPTOGRAPHY:
+        return _pyed.generate_keypair_pem()
+    priv = _ed.Ed25519PrivateKey.generate()
     return (
         priv.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.PKCS8,
-            serialization.NoEncryption(),
+            _ser.Encoding.PEM,
+            _ser.PrivateFormat.PKCS8,
+            _ser.NoEncryption(),
         ),
         priv.public_key().public_bytes(
-            serialization.Encoding.PEM,
-            serialization.PublicFormat.SubjectPublicKeyInfo,
+            _ser.Encoding.PEM,
+            _ser.PublicFormat.SubjectPublicKeyInfo,
         ),
     )
 
@@ -79,9 +89,6 @@ def mint_token(private_pem: bytes, prefixes: list[bytes],
     outstanding tokens immediately, instead of letting them write into
     dead prefix space until expiry. Unbound prefix tokens skip the check
     (operator/DR credentials)."""
-    from cryptography.hazmat.primitives import serialization
-
-    priv = serialization.load_pem_private_key(private_pem, password=None)
     doc = {
         "prefixes": [p.hex() for p in prefixes],
         "exp": expires_at,
@@ -91,7 +98,12 @@ def mint_token(private_pem: bytes, prefixes: list[bytes],
     if tenant is not None:
         doc["tenant"] = tenant.hex()
     payload = json.dumps(doc, sort_keys=True).encode()
-    return _b64e(payload) + "." + _b64e(priv.sign(payload))
+    if _HAVE_CRYPTOGRAPHY:
+        priv = _ser.load_pem_private_key(private_pem, password=None)
+        sig = priv.sign(payload)
+    else:
+        sig = _pyed.sign(_pyed.seed_from_private_pem(private_pem), payload)
+    return _b64e(payload) + "." + _b64e(sig)
 
 
 class TokenClaims(NamedTuple):
@@ -236,10 +248,18 @@ class TokenAuthority:
     CACHE_MAX = 1024
 
     def __init__(self, public_pem: bytes):
-        from cryptography.hazmat.primitives import serialization
-
-        self._pub = serialization.load_pem_public_key(public_pem)
+        if _HAVE_CRYPTOGRAPHY:
+            self._pub = _ser.load_pem_public_key(public_pem)
+        else:
+            self._pub = None
+            self._pub_raw = _pyed.public_from_public_pem(public_pem)
         self._cache: dict[str, tuple] = {}
+
+    def _verify_sig(self, sig: bytes, payload: bytes) -> None:
+        if self._pub is not None:
+            self._pub.verify(sig, payload)  # raises InvalidSignature
+        elif not _pyed.verify(self._pub_raw, sig, payload):
+            raise ValueError("bad signature")
 
     def verify(self, token: str, now: float) -> "TokenClaims":
         """→ TokenClaims(prefixes, system, tenant); raises
@@ -249,7 +269,7 @@ class TokenAuthority:
             try:
                 payload_s, sig_s = token.split(".", 1)
                 payload = _b64d(payload_s)
-                self._pub.verify(_b64d(sig_s), payload)
+                self._verify_sig(_b64d(sig_s), payload)
                 doc = json.loads(payload)
                 tenant = doc.get("tenant")
                 hit = ([bytes.fromhex(p) for p in doc["prefixes"]],

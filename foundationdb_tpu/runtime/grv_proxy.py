@@ -17,6 +17,13 @@ from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 
 PRIORITY_DEFAULT = "default"
 PRIORITY_BATCH = "batch"
+# Reference TransactionPriority::SYSTEM_IMMEDIATE: recovery/system-keyspace
+# traffic is NEVER ratekeeper-throttled. Nemesis-campaign find
+# (LaneStarvationHotStorm): system txns rode the default GRV bucket, so
+# resolver_queue backpressure starved the system lane exactly when the
+# cluster most needed it — lock checks, DR progress writes and system
+# probes all stalled behind the storm they were supposed to outrank.
+PRIORITY_SYSTEM = "system"
 
 
 class GrvProxy:
@@ -48,8 +55,20 @@ class GrvProxy:
         # transaction option (reference: TagThrottle at the GRV proxy).
         self._queue: list[tuple[Promise, tuple[str, ...]]] = []
         self._batch_queue: list[tuple[Promise, tuple[str, ...]]] = []
+        # System lane: admitted UNCONDITIONALLY every interval — no rate
+        # bucket, no tag buckets (reference: SYSTEM_IMMEDIATE skips
+        # ratekeeper). See PRIORITY_SYSTEM for the campaign find.
+        self._system_queue: list[tuple[Promise, tuple[str, ...]]] = []
         self._tokens = self.MAX_TOKENS
         self._batch_tokens = self.MAX_TOKENS
+        # Tagged admission is DEFERRED until the first rate poll lands:
+        # a freshly recruited proxy has no tag buckets yet, and admitting
+        # tagged traffic ungated in that window silently bypasses every
+        # operator quota at each recovery (nemesis-campaign find,
+        # QuotaAbuseUnderKills: kill-triggered generations gave an abusive
+        # tag a free burst per kill). Queuing is the conservative choice;
+        # untagged traffic is unaffected.
+        self._have_tag_rates = ratekeeper_ep is None
         unlimited = float("inf") if ratekeeper_ep is None else 0.0
         self._rate = unlimited
         self._batch_rate = unlimited
@@ -63,8 +82,11 @@ class GrvProxy:
                                tags: list[str] | None = None) -> int:
         p = Promise()
         entry = (p, tuple(tags or ()))
-        (self._batch_queue if priority == PRIORITY_BATCH
-         else self._queue).append(entry)
+        queue = {
+            PRIORITY_BATCH: self._batch_queue,
+            PRIORITY_SYSTEM: self._system_queue,
+        }.get(priority, self._queue)
+        queue.append(entry)
         return await p.future
 
     @rpc
@@ -87,6 +109,12 @@ class GrvProxy:
         kept: list = []
         for p, tags in queue:
             if tokens != float("inf") and tokens < 1:
+                kept.append((p, tags))
+                continue
+            if tags and not self._have_tag_rates:
+                # No rates seen yet (fresh recruit): a tagged request
+                # cannot be admission-checked, so it waits (see __init__).
+                self.tag_throttled += 1
                 kept.append((p, tags))
                 continue
             starved = [
@@ -123,15 +151,20 @@ class GrvProxy:
                     self._tag_tokens.get(tag, 0.0)
                     + rate * self.BATCH_INTERVAL,
                 )
-            if not self._queue and not self._batch_queue:
+            if (not self._queue and not self._batch_queue
+                    and not self._system_queue):
                 continue
+            # System lane first, never gated: every queued system request
+            # is admitted this interval regardless of buckets.
+            s_admitted = [p for p, _tags in self._system_queue]
+            self._system_queue = []
             admitted, self._queue, self._tokens = self._admit(
                 self._queue, self._tokens
             )
             b_admitted, self._batch_queue, self._batch_tokens = self._admit(
                 self._batch_queue, self._batch_tokens
             )
-            batch = admitted + b_admitted
+            batch = s_admitted + admitted + b_admitted
             if not batch:
                 continue
             try:
@@ -191,6 +224,7 @@ class GrvProxy:
                 self._tag_tokens = {
                     t: self._tag_tokens.get(t, 0.0) for t in tag_rates
                 }
+                self._have_tag_rates = True
             except Exception:
                 pass  # keep last known rate while ratekeeper is unreachable
             await self.loop.sleep(self.RATE_POLL_INTERVAL)

@@ -48,7 +48,8 @@ class Ratekeeper:
     BATCH_FRACTION = 0.5
 
     def __init__(self, loop: Loop, storage_eps: list, tlog_eps: list | None = None,
-                 proxy_eps: list | None = None, resolver_eps: list | None = None):
+                 proxy_eps: list | None = None, resolver_eps: list | None = None,
+                 tag_quotas: dict[str, float] | None = None):
         self.loop = loop
         self.storages = storage_eps
         self.tlogs = list(tlog_eps or [])
@@ -75,7 +76,14 @@ class Ratekeeper:
         self.limiting_reason = "none"
         # Per-tag tps quotas (reference: TagThrottleApi manual throttles in
         # \xff\x02/throttle/): enforced by the GRV proxies' per-tag buckets.
-        self.tag_quotas: dict[str, float] = {}
+        # The recruiter may pass a SHARED dict so operator quotas survive
+        # recoveries (set_tag_quota mutates it in place; a freshly
+        # recruited ratekeeper then starts with every standing quota —
+        # without this, any kill-triggered recovery silently unthrottled
+        # every quota'd tag; nemesis-campaign find, QuotaAbuseUnderKills).
+        self.tag_quotas: dict[str, float] = (
+            tag_quotas if tag_quotas is not None else {}
+        )
 
     @rpc
     async def set_tag_quota(self, tag: str, tps: float | None) -> None:
@@ -106,8 +114,13 @@ class Ratekeeper:
                     rmetrics = await all_of(
                         [r.get_metrics() for r in self.resolvers]
                     )
+                    # High-water over the resolver's rolling window, not
+                    # the instantaneous depth: a spike that builds and
+                    # drains between two 0.1s polls must still engage the
+                    # backpressure loop (nemesis-campaign find).
                     self.worst_resolver_queue = max(
-                        (m.get("queue_depth", 0) for m in rmetrics), default=0
+                        (m.get("queue_depth_hw", m.get("queue_depth", 0))
+                         for m in rmetrics), default=0
                     )
                     self.worst_resolver_occupancy = max(
                         ((m.get("queue") or {}).get("dispatch_occupancy", 0.0)

@@ -35,9 +35,17 @@ class Resolver:
     REPLY_CACHE_SIZE = 256  # recent batches kept for retransmit replay
 
     def __init__(self, loop: Loop, conflict_set, init_version: int = 0,
-                 scheduler: ResolveScheduler | None = None):
+                 scheduler: ResolveScheduler | None = None,
+                 budget_s: float | None = None,
+                 dispatch_cost_s: float = 0.0):
         self.loop = loop
         self.cs = conflict_set
+        # Modeled per-batch device-execution cost (virtual seconds).
+        # Default 0 keeps dispatch instantaneous (the pre-existing sim
+        # behavior); campaigns set it so the dispatch queue accumulates
+        # real depth and the ratekeeper's resolver_queue backpressure
+        # loop is exercisable end-to-end under simulation.
+        self.dispatch_cost_s = dispatch_cost_s
         self._version = init_version  # end of the ADMITTED version chain
         self._waiters: dict[int, Promise] = {}  # prev_version -> wakeup
         self._replies: dict[int, list[Verdict]] = {}  # version -> verdicts
@@ -49,6 +57,8 @@ class Resolver:
         # depth/occupancy for ratekeeper backpressure (sched subsystem).
         # Default budget 0 = immediate dispatch, semantics identical to the
         # unscheduled resolver.
+        if scheduler is None and budget_s:
+            scheduler = ResolveScheduler(loop, budget_s=budget_s)
         self.sched = scheduler or ResolveScheduler(loop)
         self.sched.attach(self._dispatch_group)
         self.batches_resolved = 0
@@ -150,6 +160,11 @@ class Resolver:
         successors resolving without them is exact (a partial paint from
         a mid-batch engine error only ADDS spurious conflicts, never
         misses one)."""
+        if self.dispatch_cost_s:
+            # Modeled device execution time for this window (sim-only;
+            # see __init__) — spent BEFORE the verdicts resolve, like the
+            # real kernel's dispatch wall time.
+            await self.loop.sleep(self.dispatch_cost_s * len(group))
         for entry in group:
             try:
                 reply = self._resolve_entry(entry)
@@ -315,5 +330,9 @@ class Resolver:
             # throttles admission on queue_depth before the resolver
             # overflows; status JSON reports the full queue dict.
             "queue_depth": self.sched.queue_depth,
+            # Rolling high-water: what the ratekeeper actually throttles
+            # on — an instantaneous depth misses spikes shorter than its
+            # 0.1s poll (campaign find; see ResolveScheduler._note_depth).
+            "queue_depth_hw": self.sched.depth_high_water(),
             "queue": self.sched.metrics(),
         }

@@ -48,6 +48,14 @@ class ResolveScheduler:
         self._busy_s = 0.0
         self.windows_dispatched = 0
         self.batches_dispatched = 0
+        # Rolling depth high-water (0.1s buckets over HW_WINDOW_S): the
+        # ratekeeper polls at 0.1s, so an instantaneous depth read misses
+        # any spike shorter than its poll interval — the backpressure
+        # loop stayed dark while the queue blew past RQ_SOFT and drained
+        # between two polls (nemesis-campaign find, LaneStarvationHotStorm
+        # seed 0: true depth 25, ratekeeper saw 8). Non-destructive, so
+        # status JSON and the ratekeeper can both read it.
+        self._hw_buckets: deque[tuple[float, int]] = deque()
 
     def attach(self, dispatch_fn: Callable[[list], Awaitable[None]]) -> None:
         """dispatch_fn(entries) resolves a consecutive group in order."""
@@ -55,9 +63,33 @@ class ResolveScheduler:
 
     # -- metrics -------------------------------------------------------------
 
+    HW_WINDOW_S = 1.0
+    HW_BUCKET_S = 0.1
+
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    def _note_depth(self) -> None:
+        now = self.loop.now
+        d = len(self._queue)
+        b = now - (now % self.HW_BUCKET_S)
+        if self._hw_buckets and self._hw_buckets[-1][0] == b:
+            t, m = self._hw_buckets[-1]
+            if d > m:
+                self._hw_buckets[-1] = (t, d)
+        else:
+            self._hw_buckets.append((b, d))
+
+    def depth_high_water(self) -> int:
+        """Max queue depth over the last HW_WINDOW_S (>= current depth)."""
+        horizon = self.loop.now - self.HW_WINDOW_S
+        while self._hw_buckets and self._hw_buckets[0][0] < horizon:
+            self._hw_buckets.popleft()
+        return max(
+            max((m for _t, m in self._hw_buckets), default=0),
+            len(self._queue),
+        )
 
     def oldest_age_s(self) -> float:
         return (self.loop.now - self._queue[0][0]) if self._queue else 0.0
@@ -73,6 +105,7 @@ class ResolveScheduler:
     def metrics(self) -> dict:
         return {
             "depth": self.queue_depth,
+            "depth_hw": self.depth_high_water(),
             "oldest_age_s": round(self.oldest_age_s(), 6),
             "dispatch_occupancy": round(self.dispatch_occupancy(), 4),
             "windows_dispatched": self.windows_dispatched,
@@ -89,6 +122,7 @@ class ResolveScheduler:
         if self._t_first is None:
             self._t_first = now
         self._queue.append((now, entry))
+        self._note_depth()
         self.coalescer.note_arrival(now * 1e3)
         if not self._pumping:
             self._pumping = True

@@ -1,0 +1,363 @@
+"""Nemesis campaigns: TOML-declared cross-subsystem fault schedules with
+exact-oracle acceptance gates.
+
+A campaign composes live workloads (the same registry TOML test specs
+use) with scheduled nemesis actions (sim/nemesis.py) on one seeded
+deterministic loop, then gates the run on EXACT checks — workload
+invariants (cycle permutation, conservation sums), byte parity
+(consistency checker, DR switchover parity), admission bounds (tag
+quotas), and bounded lane latency — never on "it didn't crash". A
+failing (campaign, seed) pair replays bit-identically.
+
+Spec shape (tests/specs/campaigns/*.toml):
+
+    [[campaign]]
+    title = 'ConsistencyVsMovement'
+    budget = 600.0            # virtual-seconds cap (deterministic)
+
+    [campaign.cluster]        # same keys as [test.cluster]
+    storages = 3
+    replicas = 2
+    dataDistribution = true
+
+    [[campaign.workload]]     # same registry as [[test.workload]]
+    testName = 'Cycle'
+    transactionCount = 30
+
+    [[campaign.action]]       # nemesis.NEMESIS_REGISTRY
+    name = 'DataMovementKick'
+    at = 0.3
+    every = 0.4
+    count = 6
+    begin = 'cycle/'
+    end = 'cycle0'
+
+    [campaign.checks]         # cross-cutting exact gates
+    consistency = true
+    movedRescansMin = 1
+
+Run one: ``python -m foundationdb_tpu.sim.run <file> --seeds 1
+--seed-base SEED``; the fast battery: ``python -m foundationdb_tpu.sim.run
+--campaigns fast``.
+"""
+
+from __future__ import annotations
+
+import traceback
+from dataclasses import dataclass, field
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # python 3.10: API-compatible backport
+    import tomli as tomllib
+
+from foundationdb_tpu.runtime.flow import all_of
+from foundationdb_tpu.sim.nemesis import (
+    NEMESIS_REGISTRY,
+    CampaignCheckFailed,
+    NemesisContext,
+)
+from foundationdb_tpu.sim.specs import (
+    WORKLOAD_REGISTRY,
+    cluster_kwargs_from_table,
+)
+
+DEFAULT_BUDGET_S = 600.0  # virtual seconds — deterministic per-spec cap
+
+
+@dataclass
+class CampaignSpec:
+    title: str
+    workloads: list
+    actions: list  # instantiated Nemesis objects
+    cluster_opts: dict = field(default_factory=dict)
+    checks: dict = field(default_factory=dict)
+    dr: bool = False
+    dr_opts: dict = field(default_factory=dict)
+    buggify: bool = False
+    budget_s: float = DEFAULT_BUDGET_S
+
+
+def load_campaigns(source: str | bytes) -> list[CampaignSpec]:
+    """Parse TOML text (or a path ending in .toml) into CampaignSpecs."""
+    if isinstance(source, str) and source.endswith(".toml"):
+        with open(source, "rb") as f:
+            doc = tomllib.load(f)
+    else:
+        text = source.decode() if isinstance(source, bytes) else source
+        doc = tomllib.loads(text)
+    specs: list[CampaignSpec] = []
+    for camp in doc.get("campaign", []):
+        workloads = []
+        for i, w in enumerate(camp.get("workload", [])):
+            name = w["testName"]
+            if name not in WORKLOAD_REGISTRY:
+                raise ValueError(f"unknown workload testName {name!r}")
+            cls, mapping = WORKLOAD_REGISTRY[name]
+            # Strict keys (matching run_checks): a typo'd schedule knob
+            # silently dropped would let the campaign pass while not
+            # testing the composition it exists for.
+            unknown = set(w) - set(mapping) - {"testName", "seed"}
+            if unknown:
+                raise ValueError(
+                    f"unknown keys {sorted(unknown)} in workload {name!r} "
+                    f"(known: {sorted(mapping)})")
+            kwargs = {mapping[k]: v for k, v in w.items() if k in mapping}
+            kwargs["seed"] = w.get("seed", camp.get("seed", i))
+            workloads.append(cls(**kwargs))
+        actions = []
+        for a in camp.get("action", []):
+            name = a["name"]
+            if name not in NEMESIS_REGISTRY:
+                raise ValueError(f"unknown nemesis action {name!r}")
+            cls, mapping = NEMESIS_REGISTRY[name]
+            unknown = set(a) - set(mapping) - {"name"}
+            if unknown:
+                raise ValueError(
+                    f"unknown keys {sorted(unknown)} in action {name!r} "
+                    f"(known: {sorted(mapping)})")
+            kwargs = {mapping[k]: v for k, v in a.items() if k in mapping}
+            actions.append(cls(**kwargs))
+        specs.append(CampaignSpec(
+            title=camp.get("title", "untitled"),
+            workloads=workloads,
+            actions=actions,
+            cluster_opts=cluster_kwargs_from_table(camp.get("cluster", {})),
+            checks=camp.get("checks", {}),
+            dr=camp.get("dr", False),
+            dr_opts=cluster_kwargs_from_table(camp.get("drCluster", {})),
+            buggify=camp.get("buggify", False),
+            budget_s=camp.get("budget", DEFAULT_BUDGET_S),
+        ))
+    if not specs:
+        raise ValueError("no [[campaign]] blocks in spec")
+    return specs
+
+
+# -- cross-cutting checks -----------------------------------------------------
+
+
+def _p99(samples: list[float]) -> float:
+    if not samples:
+        return float("inf")
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+async def _final_consistency(ctx: NemesisContext) -> dict:
+    from foundationdb_tpu.consistency.checker import ConsistencyChecker
+
+    report = await ConsistencyChecker(ctx.cluster, ctx.db).run()
+    ctx.reports.append(report)
+    detail = {k: report[k] for k in
+              ("status", "shards_checked", "bytes_compared", "moved_rescans",
+               "resnapshots")}
+    if report["status"] != "consistent":
+        raise CampaignCheckFailed(
+            f"final audit {report['status']}: "
+            f"divergences={report['divergences'][:2]!r} "
+            f"unreachable={report['unreachable'][:2]!r}")
+    return detail
+
+
+async def run_checks(spec: CampaignSpec, ctx: NemesisContext) -> dict:
+    """Evaluate [campaign.checks]; returns {check: detail}, raising
+    CampaignCheckFailed on the first violated gate."""
+    out: dict = {}
+    checks = dict(spec.checks)
+    if checks.pop("consistency", False):
+        out["consistency"] = await _final_consistency(ctx)
+    moved = sum(r["moved_rescans"] for r in ctx.reports)
+    n = checks.pop("movedRescansMin", None)
+    if n is not None:
+        out["moved_rescans"] = moved
+        if moved < n:
+            raise CampaignCheckFailed(
+                f"audits reported {moved} moved_rescans < required {n} — "
+                "the movement race never happened")
+    n = checks.pop("movesMin", None)
+    if n is not None:
+        dd = getattr(ctx.cluster, "data_distributor", None)
+        moves = dd.moves if dd else 0
+        out["moves"] = moves
+        if moves < n:
+            raise CampaignCheckFailed(f"{moves} shard moves < required {n}")
+    for key, lane in (("systemP99Ms", "system"), ("defaultP99Ms", "default")):
+        bound = checks.pop(key, None)
+        if bound is None:
+            continue
+        lat = ctx.latencies.get(lane, [])
+        p99_ms = _p99(lat) * 1e3
+        out[key] = {"p99_ms": round(p99_ms, 1), "samples": len(lat)}
+        if p99_ms > bound:
+            raise CampaignCheckFailed(
+                f"{lane}-lane p99 {p99_ms:.0f}ms > bound {bound}ms "
+                f"({len(lat)} probes)")
+    for key, counter in (("ackedMin", "acked"), ("probesMin", "probes"),
+                         ("killsMin", "kills"), ("clogsMin", "clogs"),
+                         ("auditsMin", "audits")):
+        n = checks.pop(key, None)
+        if n is None:
+            continue
+        got = ctx.counters.get(counter, 0)
+        out[counter] = got
+        if got < n:
+            raise CampaignCheckFailed(
+                f"counter {counter}={got} < required {n} — the composition "
+                "this campaign exists for never happened")
+    n = checks.pop("repairRoundsMin", None)
+    if n is not None:
+        rounds = sum(
+            (w.metrics.extra.get("repair") or {}).get("repair_rounds", 0)
+            for w in spec.workloads
+        )
+        out["repair_rounds"] = rounds
+        if rounds < n:
+            raise CampaignCheckFailed(
+                f"{rounds} repair rounds < required {n} — the faults never "
+                "raced an in-flight repair")
+    if checks:
+        raise ValueError(f"unknown campaign checks: {sorted(checks)}")
+    return out
+
+
+# -- the runner ---------------------------------------------------------------
+
+
+async def _quiesce(ctx: NemesisContext) -> None:
+    """Heal every injected fault, let recovery settle, and wait for live
+    storages to apply through the last committed version so the final
+    byte-parity audit sees the true end state."""
+    for cluster in (ctx.cluster, ctx.extra.get("dst_cluster")):
+        if cluster is None:
+            continue
+        cluster.net.reset_faults()
+        while cluster.controller._recovering:
+            await ctx.loop.sleep(0.25)
+        target = await cluster.sequencer.get_live_committed_version()
+        deadline = ctx.loop.now + 60
+        dead = cluster.loop.dead_processes
+        live = [
+            s for i, s in enumerate(cluster.storages)
+            if (cluster.process_prefix + cluster.storage_procs()[i])
+            not in dead
+        ]
+        while (any(s._version < target for s in live)
+               and ctx.loop.now < deadline):
+            await ctx.loop.sleep(0.05)
+
+
+async def run_campaign_test(spec: CampaignSpec, cluster, db) -> dict:
+    """setup workloads → (workloads ∥ scheduled nemeses) → heal+quiesce →
+    exact gates. Returns a JSON-able result; ``ok`` is the verdict."""
+    loop = cluster.loop
+    t0 = loop.now
+    ctx = NemesisContext(cluster=cluster, db=db)
+    cluster.nemesis_ctx = ctx
+    result: dict = {"title": spec.title, "failures": [], "checks": {}}
+    if spec.buggify:
+        loop.buggify_enabled = True
+    if spec.dr:
+        from foundationdb_tpu.client.ryw import open_database
+        from foundationdb_tpu.runtime.dr import DRAgent
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        dst_opts = {"n_tlogs": 1, "n_storages": 2, **spec.dr_opts}
+        dst_cluster = SimCluster(loop=loop, seed=loop.rng.randrange(1 << 30),
+                                 process_prefix="dr.", **dst_opts)
+        dst_db = open_database(dst_cluster)
+        agent = DRAgent(cluster, db, dst_db)
+        await agent.start()
+        ctx.extra.update(dr_agent=agent, dst_db=dst_db,
+                         dst_cluster=dst_cluster)
+
+    for w in spec.workloads:
+        await w.setup(db)
+    action_tasks = [
+        loop.spawn(a.run(ctx), name=f"nemesis.{a.name}") for a in spec.actions
+    ]
+    try:
+        await all_of([
+            loop.spawn(w.run(db, cluster), name=f"campaign.{w.name}")
+            for w in spec.workloads
+        ])
+    finally:
+        ctx.stopped = True
+    for a, t in zip(spec.actions, action_tasks):
+        try:
+            await t
+        except Exception:
+            result["failures"].append({
+                "check": f"action:{a.name}",
+                "error": traceback.format_exc(limit=6),
+            })
+    await _quiesce(ctx)
+
+    async def gate(name, coro):
+        try:
+            detail = await coro
+            if detail is not None:
+                result["checks"][name] = detail
+        except Exception:
+            result["failures"].append({
+                "check": name, "error": traceback.format_exc(limit=6),
+            })
+
+    for w in spec.workloads:
+        await gate(f"workload:{w.name}", w.check(db))
+        result.setdefault("workloads", {})[w.name] = {
+            "txns_committed": w.metrics.txns_committed,
+            "txns_retried": w.metrics.txns_retried,
+            "ops": w.metrics.ops,
+            **({"extra": w.metrics.extra} if w.metrics.extra else {}),
+        }
+    for a in spec.actions:
+        await gate(f"verify:{a.name}", a.verify(ctx, db))
+
+    checks_detail = {}
+    try:
+        checks_detail = await run_checks(spec, ctx)
+    except Exception:
+        result["failures"].append({
+            "check": "campaign.checks", "error": traceback.format_exc(limit=6),
+        })
+    result["checks"].update(checks_detail)
+    if ctx.defects:
+        result["failures"].append({"check": "live_defects",
+                                   "error": "\n".join(ctx.defects)})
+    result["counters"] = dict(ctx.counters)
+    if ctx.reports:
+        # Audit telemetry is always reported (the ROADMAP item's
+        # moved_rescans contract), gated or not.
+        result["audits"] = {
+            "runs": len(ctx.reports),
+            "moved_rescans": sum(r["moved_rescans"] for r in ctx.reports),
+            "resnapshots": sum(r["resnapshots"] for r in ctx.reports),
+            "statuses": [r["status"] for r in ctx.reports],
+        }
+    result["events"] = len(ctx.events)
+    result["elapsed_virtual_s"] = round(loop.now - t0, 2)
+    result["ok"] = not result["failures"]
+    return result
+
+
+def run_campaign(source: str | bytes, seed: int = 0) -> list[dict]:
+    """Run every [[campaign]] in the spec, each on a fresh seeded cluster.
+    The budget is a VIRTUAL-time cap — deterministic, so a budget blowout
+    fails identically on replay."""
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.runtime.flow import Loop
+    from foundationdb_tpu.sim.cluster import SimCluster
+
+    out = []
+    for i, spec in enumerate(load_campaigns(source)):
+        loop = Loop(seed=seed)
+        cluster = SimCluster(loop=loop, seed=seed,
+                             **{"n_tlogs": 2, "n_storages": 2,
+                                **spec.cluster_opts})
+        db = open_database(cluster)
+        result = loop.run(run_campaign_test(spec, cluster, db),
+                          timeout=spec.budget_s)
+        result["seed"] = seed
+        out.append(result)
+    return out

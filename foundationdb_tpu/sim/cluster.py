@@ -77,6 +77,8 @@ class SimCluster:
         authz_private_pem: bytes | None = None,
         multi_region: dict | None = None,
         storage_engine: str = "sqlite",
+        resolver_budget_s: float = 0.0,
+        resolver_dispatch_cost_s: float = 0.0,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -137,6 +139,19 @@ class SimCluster:
         self.n_tlogs = n_tlogs
         self.n_replicas = n_replicas
         self.with_ratekeeper = ratekeeper
+        # Resolve-dispatch scheduler knobs (sched subsystem): coalescing
+        # budget + modeled per-batch device-execution cost (virtual time),
+        # applied to every generation's resolvers — nonzero cost is what
+        # makes resolver queue depth (and the ratekeeper's resolver_queue
+        # backpressure loop) observable under simulation.
+        self.resolver_budget_s = resolver_budget_s
+        self.resolver_dispatch_cost_s = resolver_dispatch_cost_s
+        # Operator tag quotas survive recoveries: the dict is SHARED with
+        # each generation's Ratekeeper (set_tag_quota mutates it in
+        # place), so a newly recruited ratekeeper inherits every quota —
+        # campaign-found defect: a kill-triggered recovery silently
+        # unthrottled an abusive tag (QuotaAbuseUnderKills seed 3).
+        self.tag_quotas: dict[str, float] = {}
         self.resolver_map = KeyShardMap.uniform(n_resolvers)
         # k-way ring teams (shared with the deployed storage_shard_map —
         # runtime/shardmap.ring_teams; reference: DDTeamCollection builds
@@ -563,7 +578,10 @@ class SimCluster:
         self.sequencer_ep = host("master" + sfx, "sequencer", self.sequencer)
 
         self.resolvers = [
-            Resolver(self.loop, new_conflict_set(self.engine), init_version=start_version)
+            Resolver(self.loop, new_conflict_set(self.engine),
+                     init_version=start_version,
+                     budget_s=self.resolver_budget_s,
+                     dispatch_cost_s=self.resolver_dispatch_cost_s)
             for _ in range(self.n_resolvers)
         ]
         self.resolver_eps = [
@@ -614,8 +632,11 @@ class SimCluster:
         self.ratekeeper = (
             # resolver_eps: the sched subsystem's backpressure loop —
             # resolver dispatch-queue depth throttles admission.
+            # tag_quotas: the cluster's shared dict, so operator quotas
+            # survive the generation change (see __init__).
             Ratekeeper(self.loop, self.storage_eps, self.tlog_eps,
-                       resolver_eps=self.resolver_eps)
+                       resolver_eps=self.resolver_eps,
+                       tag_quotas=self.tag_quotas)
             if self.with_ratekeeper
             else None
         )

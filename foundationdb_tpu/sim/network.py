@@ -154,8 +154,20 @@ class SimNetwork:
             (self.process_prefix + a, self.process_prefix + b)))
 
     def heal_all(self) -> None:
+        """Clear every link-level fault: pair partitions, clogs, AND
+        region partitions (campaign-found: the quiesce path called this
+        expecting a clean network, but a region partition injected by a
+        nemesis survived it and the post-storm checks ran against a
+        still-severed region). Dead regions are NOT cleared — their
+        processes are dead and need the heal_region reboot path."""
         self._partitions.clear()
         self._clogs.clear()
+        self._partitioned_regions.clear()
+
+    def reset_faults(self) -> None:
+        """Explicit full network-fault reset (alias of heal_all, the
+        campaign runner's quiesce contract)."""
+        self.heal_all()
 
     def clog(self, a: str, b: str, factor: float = 50.0,
              duration: float = 1.0) -> None:

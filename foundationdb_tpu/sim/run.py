@@ -2,16 +2,31 @@
 
 Reference: the TestHarness/Joshua loop around `fdbserver -r simulation` —
 run a spec under many seeds, report the failing (spec, seed) pairs with
-an exact replay command (same seed → same trace, including the fault and
-clog schedules).
+an exact replay command (same seed → same trace, including the fault,
+clog, and nemesis schedules).
+
+Two spec kinds share the loop:
+
+- ``[[test]]`` specs (tests/specs/*.toml): workloads + optional fault
+  injector, run via sim/specs.py.
+- ``[[campaign]]`` specs (tests/specs/campaigns/*.toml): workloads ∥
+  scheduled nemesis actions with exact-oracle gates, run via
+  sim/campaigns.py. Campaign runs additionally write a per-(spec, seed)
+  JSON result artifact under --artifacts (default CAMPAIGN_RESULTS/,
+  gitignored) — the full gate/counter/audit record for forensics.
 
     python -m foundationdb_tpu.sim.run tests/specs --seeds 50
+    python -m foundationdb_tpu.sim.run tests/specs/campaigns --seeds 20
     python -m foundationdb_tpu.sim.run tests/specs/Cycle.toml \
         --seeds 1 --seed-base 1234 --buggify --clog 0.7   # replay one
+    python -m foundationdb_tpu.sim.run --campaigns fast   # CI stage:
+        # fast campaign battery, ONE summary JSON line last on stdout,
+        # exit 0 iff all green (tpuwatch/heal-window contract)
 
 Each (spec-file, seed) runs in a fresh process (seeds fan out over
---jobs workers); --buggify arms the in-role BUGGIFY sites and --clog
-adds slow-but-alive link injection on top of whatever the spec asks for.
+--jobs workers); --buggify arms the in-role BUGGIFY sites, --clog adds
+slow-but-alive link injection on top of whatever the spec asks for, and
+--fail-fast stops the fleet at the first failure (CI).
 """
 
 from __future__ import annotations
@@ -21,24 +36,48 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # campaign never needs a TPU
 
 import argparse
+import json
 import sys
 import traceback
-from concurrent.futures import ProcessPoolExecutor, as_completed
+from concurrent.futures import FIRST_COMPLETED, ProcessPoolExecutor, wait
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # python 3.10: API-compatible backport
+    import tomli as tomllib
+
+CAMPAIGN_SPEC_DIR = os.path.join("tests", "specs", "campaigns")
+DEFAULT_ARTIFACT_DIR = "CAMPAIGN_RESULTS"  # gitignored (CAMPAIGN_*)
+FAST_SEEDS = 3  # --campaigns fast: seeds per spec in the CI battery
+
+
+def is_campaign_spec(path: str) -> bool:
+    """True iff the TOML holds [[campaign]] blocks (vs [[test]])."""
+    with open(path, "rb") as f:
+        return bool(tomllib.load(f).get("campaign"))
 
 
 def run_one(spec_path: str, seed: int, buggify: bool,
             clog: float | None,
             aggressive: bool = False,
-            ) -> tuple[str, int, list[tuple[str, bool, str]]]:
-    """Run every [[test]] of one spec file at one seed in THIS process.
-    Returns (spec_path, seed, [(title, ok, detail), ...])."""
+            ) -> tuple[str, int, list[tuple[str, bool, str, dict | None]],
+                       bool]:
+    """Run every [[test]] / [[campaign]] of one spec file at one seed in
+    THIS process. Returns (spec_path, seed, [(title, ok, detail,
+    result_json_or_None), ...], is_campaign) — the dict is the campaign
+    result record the parent writes as the per-seed artifact; the flag
+    rides along so the parent never has to re-parse (a malformed spec
+    must fail in the worker, not crash the reporting loop)."""
+    if is_campaign_spec(spec_path):
+        return _run_one_campaign(spec_path, seed)
+
     from foundationdb_tpu.client.ryw import open_database
     from foundationdb_tpu.sim.cluster import SimCluster
     from foundationdb_tpu.sim.specs import (
         cluster_kwargs, load_spec, run_spec_test,
     )
 
-    out: list[tuple[str, bool, str]] = []
+    out: list[tuple[str, bool, str, dict | None]] = []
     for spec in load_spec(spec_path):
         if buggify:
             spec.buggify = True
@@ -56,10 +95,73 @@ def run_one(spec_path: str, seed: int, buggify: bool,
             )
             if r.kills:
                 detail += f" kills={r.kills}"
-            out.append((spec.title, True, detail))
+            out.append((spec.title, True, detail, None))
         except Exception:
-            out.append((spec.title, False, traceback.format_exc(limit=8)))
-    return spec_path, seed, out
+            out.append((spec.title, False, traceback.format_exc(limit=8), None))
+    return spec_path, seed, out, False
+
+
+def _run_one_campaign(spec_path: str, seed: int,
+                      ) -> tuple[str, int, list[tuple[str, bool, str, dict]],
+                                 bool]:
+    from foundationdb_tpu.sim.campaigns import run_campaign
+
+    out: list[tuple[str, bool, str, dict]] = []
+    try:
+        results = run_campaign(spec_path, seed=seed)
+    except Exception:
+        # Spec-level blowup (parse error, budget timeout escaping the
+        # runner): every campaign of the file is charged.
+        err = traceback.format_exc(limit=8)
+        return spec_path, seed, [("<campaign>", False, err,
+                                  {"ok": False, "seed": seed, "error": err})
+                                 ], True
+    for r in results:
+        if r["ok"]:
+            counters = r.get("counters", {})
+            detail = (f"acked={counters.get('acked', 0)} "
+                      f"checks={sorted(r.get('checks', {}))} "
+                      f"t={r.get('elapsed_virtual_s')}s")
+            out.append((r["title"], True, detail, r))
+        else:
+            detail = "\n".join(
+                f"[{f['check']}] {f['error'].strip().splitlines()[-1]}"
+                for f in r["failures"])
+            out.append((r["title"], False, detail, r))
+    return spec_path, seed, out, True
+
+
+def write_artifact(art_dir: str, spec_path: str, seed: int,
+                   results: list[tuple[str, bool, str, dict | None]]) -> str:
+    """One JSON file per (campaign spec, seed): the full result records."""
+    os.makedirs(art_dir, exist_ok=True)
+    stem = os.path.splitext(os.path.basename(spec_path))[0]
+    path = os.path.join(art_dir, f"{stem}.seed{seed}.json")
+    with open(path, "w") as f:
+        json.dump({
+            "spec": spec_path,
+            "seed": seed,
+            "ok": all(ok for _t, ok, _d, _r in results),
+            "campaigns": [r for _t, _ok, _d, r in results if r is not None],
+            "replay": replay_line(spec_path, seed),
+        }, f, indent=1, default=str)
+    return path
+
+
+def replay_line(spec_path: str, seed: int, buggify: bool = False,
+                aggressive: bool = False, clog: float | None = None) -> str:
+    """The fully-reproducing one-liner: the seed IS the entire schedule
+    (workload interleaving, fault timing, nemesis draws), so spec+seed+
+    flags replay the failure bit-identically."""
+    flags = ""
+    if buggify:
+        flags += " --buggify"
+    if aggressive:
+        flags += " --buggify-aggressive"
+    if clog is not None:
+        flags += f" --clog {clog}"
+    return (f"python -m foundationdb_tpu.sim.run {spec_path} "
+            f"--seeds 1 --seed-base {seed}{flags}")
 
 
 def collect_specs(paths: list[str]) -> list[str]:
@@ -81,8 +183,16 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m foundationdb_tpu.sim.run",
         description="Run every TOML spec × N seeds (TestHarness analogue).",
     )
-    ap.add_argument("specs", nargs="+", help="spec .toml files or directories")
-    ap.add_argument("--seeds", type=int, default=10)
+    ap.add_argument("specs", nargs="*",
+                    help="spec .toml files or directories ([[test]] or "
+                         "[[campaign]] kind; may be mixed)")
+    ap.add_argument("--campaigns", choices=("fast",), default=None,
+                    help="CI battery preset: run tests/specs/campaigns at "
+                         f"{FAST_SEEDS} seeds, print one summary JSON line "
+                         "last (exit 0 iff all green)")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="seeds per spec (default 10; "
+                         f"{FAST_SEEDS} under --campaigns fast)")
     ap.add_argument("--seed-base", type=int, default=0,
                     help="first seed (failing seeds replay with "
                          "--seeds 1 --seed-base SEED)")
@@ -93,8 +203,24 @@ def main(argv: list[str] | None = None) -> int:
                          "(maximum perturbation; implies --buggify)")
     ap.add_argument("--clog", type=float, default=None, metavar="INTERVAL",
                     help="add slow-link clogging at this mean interval (s)")
+    ap.add_argument("--fail-fast", action="store_true",
+                    help="stop the fleet at the first failing (spec, seed)")
+    ap.add_argument("--artifacts", default=DEFAULT_ARTIFACT_DIR,
+                    metavar="DIR",
+                    help="per-(campaign, seed) JSON result directory "
+                         f"(default {DEFAULT_ARTIFACT_DIR}/; '' disables)")
     ap.add_argument("--jobs", type=int, default=min(8, os.cpu_count() or 1))
     args = ap.parse_args(argv)
+
+    if args.campaigns:
+        if not args.specs:
+            args.specs = [CAMPAIGN_SPEC_DIR]
+        if args.seeds is None:
+            args.seeds = FAST_SEEDS
+    elif not args.specs:
+        ap.error("specs required (or use --campaigns fast)")
+    if args.seeds is None:
+        args.seeds = 10
 
     files = collect_specs(args.specs)
     jobs = [(f, args.seed_base + s) for f in files for s in range(args.seeds)]
@@ -103,42 +229,72 @@ def main(argv: list[str] | None = None) -> int:
 
     failures: list[tuple[str, int, str, str]] = []
     done = 0
+    stopped_early = False
     with ProcessPoolExecutor(max_workers=args.jobs) as pool:
         futs = {
             pool.submit(run_one, f, seed, args.buggify, args.clog,
                         args.buggify_aggressive): (f, seed)
             for f, seed in jobs
         }
-        for fut in as_completed(futs):
-            f, seed = futs[fut]
-            done += 1
-            try:
-                _, _, results = fut.result()
-            except Exception as e:  # worker crash counts as failure
-                results = [("<worker>", False, f"{type(e).__name__}: {e}")]
-            for title, ok, detail in results:
-                if ok:
-                    print(f"[{done}/{len(jobs)}] ok   {f}:{title} "
-                          f"seed={seed} {detail}", flush=True)
-                else:
-                    failures.append((f, seed, title, detail))
-                    print(f"[{done}/{len(jobs)}] FAIL {f}:{title} seed={seed}",
-                          flush=True)
+        pending = set(futs)
+        while pending:
+            finished, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in finished:
+                f, seed = futs[fut]
+                done += 1
+                try:
+                    _, _, results, campaign = fut.result()
+                except Exception as e:  # worker crash counts as failure
+                    results = [("<worker>", False,
+                                f"{type(e).__name__}: {e}", None)]
+                    campaign = False  # kind unknowable: no artifact
+                if args.artifacts and campaign:
+                    write_artifact(args.artifacts, f, seed, results)
+                for title, ok, detail, _r in results:
+                    if ok:
+                        print(f"[{done}/{len(jobs)}] ok   {f}:{title} "
+                              f"seed={seed} {detail}", flush=True)
+                    else:
+                        failures.append((f, seed, title, detail))
+                        print(f"[{done}/{len(jobs)}] FAIL {f}:{title} "
+                              f"seed={seed}", flush=True)
+            if failures and args.fail_fast and pending:
+                stopped_early = True
+                for fut in pending:
+                    fut.cancel()
+                pending = set()
 
     if failures:
-        print(f"\n{len(failures)} FAILURES:", flush=True)
+        print(f"\n{len(failures)} FAILURES"
+              + (" (--fail-fast: fleet stopped early)" if stopped_early
+                 else "") + ":", flush=True)
         for f, seed, title, detail in failures:
-            flags = " --buggify" if args.buggify else ""
-            if args.buggify_aggressive:
-                flags += " --buggify-aggressive"
-            if args.clog is not None:
-                flags += f" --clog {args.clog}"
             print(f"--- {f}:{title} seed={seed}\n{detail}\n"
-                  f"replay: python -m foundationdb_tpu.sim.run {f} "
-                  f"--seeds 1 --seed-base {seed}{flags}", flush=True)
-        return 1
-    print("all green", flush=True)
-    return 0
+                  f"replay: "
+                  + replay_line(f, seed, args.buggify,
+                                args.buggify_aggressive, args.clog),
+                  flush=True)
+    else:
+        print("all green", flush=True)
+    if args.campaigns:
+        # ONE summary line, LAST on stdout — the tpuwatch `have` helper
+        # judges the artifact by its final JSON line.
+        print(json.dumps({
+            "metric": "nemesis_campaigns",
+            "mode": args.campaigns,
+            "specs": len(files),
+            "seeds": args.seeds,
+            "runs": len(jobs),
+            "completed": done,
+            "ok": not failures,
+            "failures": [
+                {"spec": f, "seed": seed, "title": title,
+                 "replay": replay_line(f, seed, args.buggify,
+                                       args.buggify_aggressive, args.clog)}
+                for f, seed, title, _detail in failures[:10]
+            ],
+        }), flush=True)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -37,6 +37,7 @@ from foundationdb_tpu.sim.workloads import (
     ConflictRangeWorkload,
     ConsistencyCheckWorkload,
     CycleWorkload,
+    FailoverZipfRepairWorkload,
     FaultInjector,
     IncrementWorkload,
     MakoWorkload,
@@ -47,6 +48,7 @@ from foundationdb_tpu.sim.workloads import (
     FuzzApiWorkload,
     IndexStressWorkload,
     RegionFailoverWorkload,
+    TaskBucketWorkload,
     TenantWorkload,
     VersionStampWorkload,
     WatchesWorkload,
@@ -118,6 +120,18 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "transactionCount": "n_txns",
         "clientCount": "n_clients",
     }),
+    "FailoverZipfRepair": (FailoverZipfRepairWorkload, {
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "theta": "theta",
+        "readsPerTransaction": "reads_per_txn",
+    }),
+    "TaskBucket": (TaskBucketWorkload, {
+        "taskCount": "n_tasks",
+        "executorCount": "n_executors",
+        "lease": "lease",
+    }),
     "ZipfRepair": (ZipfRepairWorkload, {
         "keyCount": "n_keys",
         "transactionCount": "n_txns",
@@ -180,6 +194,50 @@ def cluster_kwargs(spec: "TestSpec") -> dict:
     return {**BASE_CLUSTER, **spec.cluster_opts}
 
 
+# [test.cluster] / [campaign.cluster] key -> SimCluster kwarg.
+CLUSTER_KEY_MAP = {
+    "storages": "n_storages",
+    "tlogs": "n_tlogs",
+    "replicas": "n_replicas",
+    "proxies": "n_proxies",
+    "resolvers": "n_resolvers",
+    "coordinators": "n_coordinators",
+    "dataDistribution": "data_distribution",
+    "storageEngine": "storage_engine",
+    # Resolve-dispatch scheduler (sched subsystem): a coalescing budget
+    # and a modeled per-batch device-execution cost — nonzero cost makes
+    # dispatch take virtual time, so queue depth (and the ratekeeper's
+    # resolver_queue backpressure) is exercisable in simulation.
+    "resolverBudget": "resolver_budget_s",
+    "resolverDispatchCost": "resolver_dispatch_cost_s",
+}
+
+
+def cluster_kwargs_from_table(tbl: dict) -> dict:
+    """Translate a TOML cluster table into SimCluster kwargs — shared by
+    [[test]] specs and [[campaign]] specs so both drive identical
+    clusters for the same table."""
+    opts = {CLUSTER_KEY_MAP[k]: v for k, v in tbl.items()
+            if k in CLUSTER_KEY_MAP}
+    # Region config (reference: DatabaseConfiguration regions):
+    # `satelliteTlogs = k` turns on the pri/sat/rem multi-region topology.
+    if "satelliteTlogs" in tbl:
+        opts["multi_region"] = {"satellite_tlogs": tbl["satelliteTlogs"]}
+    # `authz = true`: generate an operator keypair for this test cluster —
+    # processes verify with the public key; the private key stays
+    # harness-side (cluster.authz_private_pem) so workloads can mint
+    # tokens, playing the operator.
+    if tbl.get("authz"):
+        from foundationdb_tpu.runtime.authz import generate_keypair, mint_token
+
+        priv, pub = generate_keypair()
+        opts["authz_public_key"] = pub
+        opts["authz_private_pem"] = priv
+        opts["authz_system_token"] = mint_token(
+            priv, [b""], expires_at=1e12, system=True)
+    return opts
+
+
 @dataclass
 class TestSpec:
     title: str
@@ -225,43 +283,7 @@ def load_spec(source: str | bytes) -> list[TestSpec]:
             }
             kwargs["seed"] = w.get("seed", test.get("seed", i))
             workloads.append(cls(**kwargs))
-        cluster_tbl = test.get("cluster", {})
-        cluster_map = {
-            "storages": "n_storages",
-            "tlogs": "n_tlogs",
-            "replicas": "n_replicas",
-            "proxies": "n_proxies",
-            "resolvers": "n_resolvers",
-            "coordinators": "n_coordinators",
-            "dataDistribution": "data_distribution",
-            "storageEngine": "storage_engine",
-        }
-        cluster_opts = {
-            cluster_map[k]: v for k, v in cluster_tbl.items()
-            if k in cluster_map
-        }
-        # Region config (reference: DatabaseConfiguration regions):
-        # `satelliteTlogs = k` in [test.cluster] turns on the pri/sat/rem
-        # multi-region topology with k satellite tlogs.
-        if "satelliteTlogs" in cluster_tbl:
-            cluster_opts["multi_region"] = {
-                "satellite_tlogs": cluster_tbl["satelliteTlogs"]
-            }
-        # `authz = true`: generate an operator keypair for this test
-        # cluster — processes verify with the public key; the private key
-        # stays harness-side (cluster.authz_private_pem) so workloads can
-        # mint tokens, playing the operator.
-        if cluster_tbl.get("authz"):
-            from foundationdb_tpu.runtime.authz import (
-                generate_keypair,
-                mint_token,
-            )
-
-            priv, pub = generate_keypair()
-            cluster_opts["authz_public_key"] = pub
-            cluster_opts["authz_private_pem"] = priv
-            cluster_opts["authz_system_token"] = mint_token(
-                priv, [b""], expires_at=1e12, system=True)
+        cluster_opts = cluster_kwargs_from_table(test.get("cluster", {}))
         specs.append(TestSpec(
             title=test.get("testTitle", "untitled"),
             workloads=workloads,

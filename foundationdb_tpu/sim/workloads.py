@@ -2171,3 +2171,276 @@ class ConsistencyCheckWorkload(Workload):
                 f"{report['divergences'][:3]!r} "
                 f"unreachable={report['unreachable'][:3]!r}"
             )
+
+
+class FailoverZipfRepairWorkload(Workload):
+    """Zipf hot-key RMW contention through the repair engine, surviving a
+    DR failover mid-run — the campaign composition "DR failover
+    mid-repair" (nemesis.DRSwitchover + repair/engine.py).
+
+    Differences from ZipfRepairWorkload, both load-bearing for the
+    exactly-once gate:
+
+    - every transaction carries a unique idempotency marker read in the
+      same transaction as its increment, so a commit_unknown_result retry
+      (or a post-failover retry of a txn that LANDED on the primary and
+      drained to the secondary) can never double-apply: sum(keys) ==
+      acked commits EXACTLY, under any fault schedule;
+    - clients fail over: when the switchover locks the primary
+      (DatabaseLocked is definitive, not retryable) they park until the
+      nemesis raises ctx.flags['failover'], then resume on the secondary
+      — the repaired transaction replays there against the drained
+      stream, and the marker decides landed-vs-lost exactly.
+
+    check() audits the SURVIVING side.
+    """
+
+    name = "failover_zipf_repair"
+
+    # Longest a locked-out client waits (virtual s) for the switchover
+    # to raise the failover flag before re-raising DatabaseLocked — a
+    # switchover that locks the primary then dies must fail the run
+    # crisply, not eat the whole campaign budget.
+    PARK_TIMEOUT_S = 60.0
+
+    def __init__(self, seed: int = 0, n_keys: int = 8, n_txns: int = 60,
+                 n_clients: int = 6, theta: float = 0.99,
+                 reads_per_txn: int = 3):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.theta = theta
+        self.reads_per_txn = reads_per_txn
+        self.repair_stats = None
+        self._ctx_cache = None  # NemesisContext, remembered by run()
+
+    def _key(self, i: int) -> bytes:
+        return b"zipf/%04d" % i
+
+    def _cdf(self) -> list[float]:
+        w = [(r + 1) ** -self.theta for r in range(self.n_keys)]
+        total = sum(w)
+        acc, cdf = 0.0, []
+        for x in w:
+            acc += x
+            cdf.append(acc / total)
+        return cdf
+
+    @staticmethod
+    def _ctx(cluster):
+        return getattr(cluster, "nemesis_ctx", None)
+
+    def _surviving_db(self, db):
+        ctx = self._ctx(getattr(db, "cluster", None)) or self._ctx_cache
+        if ctx is not None and ctx.flags.get("failover"):
+            return ctx.extra["dst_db"]
+        return db
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            tr.clear_range(b"zipf/", b"zipf0")
+            tr.clear_range(b"zmk/", b"zmk0")
+            for i in range(self.n_keys):
+                tr.set(self._key(i), struct.pack("<q", 0))
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        from foundationdb_tpu.core.errors import DatabaseLocked
+        from foundationdb_tpu.repair.engine import RepairStats, run_repairable
+
+        ctx = self._ctx(cluster)
+        self._ctx_cache = ctx
+        rng = cluster.loop.rng
+        cdf = self._cdf()
+
+        def pick() -> int:
+            return min(bisect.bisect_left(cdf, rng.random()), self.n_keys - 1)
+
+        counts = self._split(self.n_txns, self.n_clients)
+        stats = RepairStats()
+        self.repair_stats = stats
+
+        async def client(cid: int):
+            cur_db = db
+            for seq in range(counts[cid]):
+                picks = [pick() for _ in range(self.reads_per_txn)]
+                target = min(picks)
+                marker = b"zmk/%02d/%04d" % (cid, seq)
+
+                async def body(tr, picks=picks, target=target, marker=marker):
+                    if await tr.get(marker) is not None:
+                        return  # an earlier attempt landed: exactly-once
+                    vals = {}
+                    for i in picks:
+                        raw = await tr.get(self._key(i))
+                        vals[i] = struct.unpack("<q", raw)[0]
+                    tr.set(marker, b"")
+                    tr.set(self._key(target),
+                           struct.pack("<q", vals[target] + 1))
+
+                while True:
+                    try:
+                        await run_repairable(cur_db, body, stats=stats)
+                        break
+                    except DatabaseLocked:
+                        # Switchover locked the primary under us: park for
+                        # the nemesis to finish draining + parity, then
+                        # replay on the secondary (the marker read decides
+                        # whether the locked-out attempt already landed).
+                        # Bounded park: if there is no nemesis context
+                        # (plain [[test]] usage) or the switchover action
+                        # died after locking but before raising the flag,
+                        # re-raise so the real failure surfaces instead
+                        # of spinning out the campaign budget.
+                        if ctx is None:
+                            raise
+                        deadline = cluster.loop.now + self.PARK_TIMEOUT_S
+                        while not ctx.flags.get("failover"):
+                            if cluster.loop.now >= deadline:
+                                raise
+                            await cluster.loop.sleep(0.05)
+                        cur_db = ctx.extra["dst_db"]
+                self.metrics.txns_committed += 1
+                self.metrics.ops += 1
+                if ctx is not None:
+                    ctx.bump("acked")
+
+        await all_of([
+            cluster.loop.spawn(client(i), name=f"fzr.client{i}")
+            for i in range(self.n_clients)
+        ])
+        self.metrics.extra["repair"] = {
+            "commits": stats.commits,
+            "repaired_commits": stats.repaired_commits,
+            "repair_rounds": stats.repair_rounds,
+            "full_restarts": stats.full_restarts,
+        }
+
+    async def check(self, db) -> None:
+        db = self._surviving_db(db)
+
+        async def body(tr):
+            rows = await tr.get_range(b"zipf/", b"zipf0")
+            markers = await tr.get_range(b"zmk/", b"zmk0", limit=100_000)
+            return sum(struct.unpack("<q", v)[0] for _k, v in rows), \
+                len(markers)
+
+        total, markers = await self._run_txn(db, body)
+        if total != self.metrics.ops:
+            raise WorkloadFailed(
+                f"failover_zipf_repair: sum {total} != {self.metrics.ops} "
+                f"acked increments on the surviving side — a repaired txn "
+                f"was lost or applied twice across the failover")
+        if markers != self.metrics.ops:
+            raise WorkloadFailed(
+                f"failover_zipf_repair: {markers} idempotency markers != "
+                f"{self.metrics.ops} acked txns on the surviving side")
+
+
+class TaskBucketWorkload(Workload):
+    """TaskBucket work-queue drain under faults (layers/taskbucket.py):
+    setup enqueues ``n_tasks`` tasks; ``n_executors`` concurrent executors
+    claim → execute → finish with short leases, so a claim that stalls
+    across a recovery expires and another executor legally re-runs the
+    task (the bucket's idempotency contract). The work transaction is an
+    idempotent marker + counter ADD, making the final accounting exact:
+
+    - counter == n_tasks (every task executed EXACTLY once in effect —
+      a lease double-run is absorbed by the marker, a lost task breaks it
+      from below, a double-apply from above);
+    - the bucket fully drains (no task stranded in avail/ or leased/).
+
+    On an authz-armed cluster the bucket carries the cluster system token.
+    """
+
+    name = "taskbucket"
+
+    def __init__(self, seed: int = 0, n_tasks: int = 12, n_executors: int = 3,
+                 lease: float = 0.8):
+        super().__init__(seed)
+        self.n_tasks = n_tasks
+        self.n_executors = n_executors
+        self.lease = lease
+        self._tb = None
+
+    COUNTER = b"tbwl-count"
+    MARKERS = b"tbwl-mk/"
+
+    def _bucket(self, db):
+        from foundationdb_tpu.layers.taskbucket import TaskBucket
+        from foundationdb_tpu.layers.tuple_layer import Subspace
+
+        if self._tb is None:
+            token = getattr(db.cluster, "authz_system_token", None)
+            self._tb = TaskBucket(Subspace(("tbwl",)), token=token)
+        return self._tb
+
+    async def setup(self, db) -> None:
+        tb = self._bucket(db)
+
+        async def body(tr):
+            if tb.token:
+                tr.set_option("authorization_token", tb.token)
+            tr.clear_range(b"tbwl", b"tbwm")  # counter + markers
+            tr.clear_range(tb.ss.key(), strinc(tb.ss.key()))  # the bucket
+            tr.set(self.COUNTER, struct.pack("<q", 0))
+
+        await self._run_txn(db, body)
+        for i in range(self.n_tasks):
+            await tb.add(db, {b"n": i})
+            self.metrics.txns_committed += 1
+
+    async def run(self, db, cluster) -> None:
+        tb = self._bucket(db)
+
+        async def executor(eid: int):
+            while True:
+                task = await tb.claim(db, lease=self.lease)
+                if task is None:
+                    avail, leased = await tb.counts(db)
+                    if avail == 0 and leased == 0:
+                        return  # drained
+                    await cluster.loop.sleep(self.lease / 4)
+                    continue
+
+                async def work(tr, task=task):
+                    if tb.token:
+                        tr.set_option("authorization_token", tb.token)
+                    marker = self.MARKERS + task.stamp
+                    if await tr.get(marker) is None:
+                        tr.set(marker, b"")
+                        tr.atomic_op(MutationType.ADD, self.COUNTER,
+                                     struct.pack("<q", 1))
+
+                await self._run_txn(db, work)
+                await tb.finish(db, task)  # False = lease lost: tolerated
+                self.metrics.ops += 1
+
+        await all_of([
+            cluster.loop.spawn(executor(i), name=f"tbwl.exec{i}")
+            for i in range(self.n_executors)
+        ])
+
+    async def check(self, db) -> None:
+        tb = self._bucket(db)
+        avail, leased = await tb.counts(db)
+        if avail or leased:
+            raise WorkloadFailed(
+                f"taskbucket not drained: {avail} available, {leased} leased")
+
+        async def body(tr):
+            if tb.token:
+                tr.set_option("authorization_token", tb.token)
+            raw = await tr.get(self.COUNTER)
+            markers = await tr.get_range(self.MARKERS, b"tbwl-mk0",
+                                         limit=100_000)
+            return (struct.unpack("<q", raw)[0] if raw else 0), len(markers)
+
+        count, markers = await self._run_txn(db, body)
+        if count != self.n_tasks or markers != self.n_tasks:
+            raise WorkloadFailed(
+                f"taskbucket accounting broken: counter {count}, "
+                f"{markers} markers != {self.n_tasks} tasks — a task was "
+                f"lost or double-applied")

@@ -90,6 +90,15 @@ while true; do
     'r.get("metric") == "consistency_check" and r.get("status") == "consistent"' -- \
     env JAX_PLATFORMS=cpu python -m foundationdb_tpu.consistency \
     || { sleep 60; continue; }
+  # Nemesis campaign battery (sim subsystem): the four cross-subsystem
+  # fault campaigns (consistency×resharding, DR×repair, sched×storm,
+  # quota×kills) at the fast seed count — CPU-only sim, validates the
+  # build's failure-composition behavior during the heal window. The
+  # runner prints its summary JSON line LAST (the `have` contract).
+  stage campaigns 900 CAMPAIGNS_r05.json \
+    'r.get("metric") == "nemesis_campaigns" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.sim.run \
+    --campaigns fast || { sleep 60; continue; }
   stage profile 1500 TPU_PROFILE_r05.json \
     "$TPU_OK and (r.get('phase_profile_ms') or {}).get('full_resolve')" -- \
     python bench.py --mode ycsb --profile || { sleep 60; continue; }
