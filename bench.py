@@ -1451,6 +1451,19 @@ def main() -> None:
     ap.add_argument("--repair-txns", type=int, default=240)
     ap.add_argument("--repair-clients", type=int, default=12)
     ap.add_argument("--repair-keys", type=int, default=12)
+    ap.add_argument("--wave-commit", choices=("env", "0", "1"),
+                    default="env",
+                    help="repair-sim resolve mode: reorder-don't-abort "
+                         "wave scheduling (1), sequential-order abort "
+                         "(0), or the FDB_TPU_WAVE_COMMIT env default "
+                         "(scripts/wave_ab.sh fixes the env per arm)")
+    ap.add_argument("--repair-target", choices=("hottest", "coldest"),
+                    default="hottest",
+                    help="repair-sim RMW write target among the Zipf "
+                         "picks: hottest = mutual hot-key RMW (cycle-"
+                         "heavy, wave commit's worst case), coldest = "
+                         "read-hot-write-cold chains (the reorderable "
+                         "shape)")
     args = ap.parse_args()
     if args.repair_sim:
         # Pure simulation (the conflict engine is the python oracle): pin
@@ -1461,6 +1474,9 @@ def main() -> None:
         print(json.dumps(run_repair_goodput(
             n_txns=args.repair_txns, n_clients=args.repair_clients,
             n_keys=args.repair_keys, seed=args.seed,
+            wave_commit=(None if args.wave_commit == "env"
+                         else args.wave_commit == "1"),
+            target_pick=args.repair_target,
         )), flush=True)
         return
     if (os.environ.get("FDB_TPU_FORCE_CPU") == "1"

@@ -38,6 +38,72 @@ class Verdict(enum.IntEnum):
     TOO_OLD = 2
 
 
+# Wave-commit schedule levels (the reorder-don't-abort resolve mode —
+# models/conflict_kernel.py phase 2b, sim/oracle.py): a committed txn's
+# level is its commit wave (>= 0; serialization order = (level, batch
+# index)), LEVEL_NONE marks non-commits for non-cycle reasons (history
+# conflict, TOO_OLD, masked slot), LEVEL_CYCLE marks a true-dependency-
+# cycle abort — the repair subsystem's residue. One definition here so the
+# jax kernel, the pure-python oracle, and the runtime Resolver/commit
+# proxy all agree without the runtime importing device code.
+WAVE_LEVEL_NONE = -1
+WAVE_LEVEL_CYCLE = -2
+
+
+def env_choice(name: str, default: str, allowed: tuple[str, ...]) -> str:
+    """Validated FDB_TPU_* env flag: an unknown value raises with the
+    accepted list instead of silently falling through to the default (a
+    typo'd FDB_TPU_ACCEPT=Seq used to bench the wave design while
+    claiming the seq one). One definition here — importable WITHOUT
+    device code — serves the kernel's import-once flags, the sim/server
+    wave default, and the compile-cache knob alike."""
+    import os
+
+    value = os.environ.get(name, default)
+    if value not in allowed:
+        raise ValueError(
+            f"{name}={value!r} is not a valid setting; accepted values: "
+            f"{', '.join(allowed)}"
+        )
+    return value
+
+
+def wave_commit_env_default() -> bool:
+    """FDB_TPU_WAVE_COMMIT env default — the oracle engine, sim cluster,
+    and deployed server must honor the same A/B env contract as the
+    device kernel."""
+    return env_choice("FDB_TPU_WAVE_COMMIT", "0", ("0", "1")) == "1"
+
+
+def validate_wave_commit(n_resolvers: int = 1,
+                         skiplist_engine: str | None = None) -> None:
+    """Refuse deployments a wave-commit resolver cannot serve (call only
+    when wave commit is ON). One definition of the rules — the sim
+    cluster, its engine factory, and the deployed server must enforce
+    identical refusals or a config drift silently un-serializes.
+
+    - A wave engine reorders within its own view, so it must see EVERY
+      conflict edge of its window: role-level multi-resolver deployments
+      clip ranges per key shard and per-shard wave schedules are not
+      combinable (the mesh ShardedConflictSet shards internally, below
+      the schedule, and stays exact).
+    - The C++ skiplist engines never materialize the conflict graph and
+      implement no wave schedule; ``skiplist_engine`` is the caller's
+      name for the engine ("cpu"/"cpp"), None when the engine supports
+      wave commit."""
+    if n_resolvers > 1:
+        raise ValueError(
+            "wave commit requires a single-resolver deployment: per-shard "
+            "resolvers each see only their clipped conflict edges, so "
+            "per-shard wave schedules are not combinable"
+        )
+    if skiplist_engine is not None:
+        raise ValueError(
+            f"wave commit is not implemented by the {skiplist_engine} "
+            "skiplist engine"
+        )
+
+
 @dataclass(frozen=True)
 class KeyRange:
     """Half-open byte-string key range [begin, end)."""

@@ -36,6 +36,11 @@ import jax
 import jax.numpy as jnp
 
 from foundationdb_tpu.core.keypack import INT32_MAX
+from foundationdb_tpu.core.types import (
+    WAVE_LEVEL_CYCLE as LEVEL_CYCLE,
+    WAVE_LEVEL_NONE as LEVEL_NONE,
+    env_choice as _env_choice,
+)
 from foundationdb_tpu.ops.bitset import (
     or_matvec_u32,
     pack_bits_u32,
@@ -60,9 +65,10 @@ from foundationdb_tpu.ops.rmq import (
 
 NEG_VERSION = -(2**31) + 1
 
+
 # History RMQ implementation: "sparse" (default) | "blocked". Read once at
 # import — flipping it mid-process would silently split jit caches.
-_RMQ_DESIGN = os.environ.get("FDB_TPU_RMQ", "sparse")
+_RMQ_DESIGN = _env_choice("FDB_TPU_RMQ", "sparse", ("sparse", "blocked"))
 
 # Within-block acceptance design: "wave" (default — data-dependent matvec
 # relaxation rounds) | "seq" (a fixed G-step sequential fori_loop over the
@@ -72,7 +78,7 @@ _RMQ_DESIGN = os.environ.get("FDB_TPU_RMQ", "sparse")
 # matvecs per round — there the bounded trivial-step scan may win
 # (VERDICT r3 item 4). Same import-once rule as the RMQ flag; the
 # heal-window auto-bench ranks both at full-kernel level.
-_ACCEPT_DESIGN = os.environ.get("FDB_TPU_ACCEPT", "wave")
+_ACCEPT_DESIGN = _env_choice("FDB_TPU_ACCEPT", "wave", ("wave", "seq"))
 
 # History design: "window" (default — two-level base+delta: the base
 # sparse table is built once per merge epoch, per-batch work touches only
@@ -80,7 +86,7 @@ _ACCEPT_DESIGN = os.environ.get("FDB_TPU_ACCEPT", "wave")
 # sparse table is rebuilt EVERY batch — the O(C·log C)/batch hot-path
 # cost VERDICT r4 item 2 ordered out). Import-once rule as above; the
 # heal-window auto-bench ranks both (BENCH_r05_batchhist A/B).
-_HIST_DESIGN = os.environ.get("FDB_TPU_HISTORY", "window")
+_HIST_DESIGN = _env_choice("FDB_TPU_HISTORY", "window", ("window", "batch"))
 
 # Packed-kernel design: "1" (default) | "0" (the r5 unpacked kernel, kept
 # as the A/B baseline — scripts/kernel_ab.sh). Three stacked HBM-diet
@@ -100,7 +106,15 @@ _HIST_DESIGN = os.environ.get("FDB_TPU_HISTORY", "window")
 #      bitsets (ops/bitset): 8x fewer bytes than bool, 16x fewer than
 #      the bf16 MXU tiles, on the acceptance loop's hottest operands.
 # Same import-once rule as the flags above.
-_PACKED = os.environ.get("FDB_TPU_PACKED", "1") != "0"
+_PACKED = _env_choice("FDB_TPU_PACKED", "1", ("0", "1")) != "0"
+
+# Wave-commit mode: "0" (default — sequential-order acceptance, conflicts
+# abort) | "1" (reorder-don't-abort: the same conflict graph schedules
+# txns into dependency-ordered commit waves; only true cycles abort —
+# see _wave_commit_accept). Selects the ENGINE DEFAULT only: both modes'
+# entry points are separate jitted programs, so hosts can construct
+# engines of either mode in one process (TPUConflictSet(wave_commit=...)).
+_WAVE_COMMIT = _env_choice("FDB_TPU_WAVE_COMMIT", "0", ("0", "1")) == "1"
 
 # Verdict encoding (core.types.Verdict values, as device int8).
 V_COMMITTED = 0
@@ -582,6 +596,188 @@ def _seq_accept_packed(base: jax.Array, p: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Phase 2b: wave commit (FDB_TPU_WAVE_COMMIT=1) — reorder, don't abort
+# ---------------------------------------------------------------------------
+#
+# Sequential acceptance treats batch order as serialization order and
+# aborts every txn whose reads overlap an accepted EARLIER txn's writes —
+# throwing away the conflict graph it just materialized. Wave commit
+# spends it instead (FAFO, arXiv:2507.10757): the constraint "i must
+# serialize BEFORE j" exists exactly when reads(i) ∩ writes(j) ≠ ∅ (i
+# must not observe j's write), which is the untriangled overlap matrix.
+# Topologically leveling that digraph yields commit WAVES: wave 0 txns
+# see only pre-batch state, wave k txns serialize after waves < k, and
+# every write-after-read chain commits in dependency order instead of
+# losing all but its luckiest link. Only txns on TRUE CYCLES (mutual
+# read-write entanglement — e.g. two RMWs of one key) are unschedulable;
+# they abort, one exactly-on-a-cycle victim at a time, and the repair
+# subsystem mops them up.
+#
+# Serializability: the realized order is (wave, batch index). A committed
+# txn j's reads overlap no historical write past its read version (the
+# history gate is unchanged) and no committed peer write EXCEPT those of
+# txns at strictly LATER waves — which serialize after j, so j's
+# pre-batch snapshot is exactly what the order prescribes. All writes
+# still land at the batch commit version: visible read versions are
+# always batch versions (GRV hands out committed batch versions, never
+# intra-batch points), so a single-version paint is byte-equivalent for
+# every future conflict test while the proxy applies same-version
+# mutations in wave order.
+
+#: Wave-level encoding (int32 [B], alongside the verdicts):
+#:   >= 0  committed at this wave (serialization order = (level, index))
+#:   -1    not committed for non-cycle reasons (history conflict,
+#:         TOO_OLD, masked slot)
+#:   -2    aborted on a true cycle (the repair engine's residue)
+#: Canonical values live in core.types (imported at the top) so the
+#: oracle and the runtime share them without importing device code.
+
+
+def _pred_matrix_packed(base, rb, re_, read_live, wb, we, write_live):
+    """uint32 [BP, BP/32] packed predecessor bitsets over rank intervals:
+    bit i of row j ⇔ reads(i) ∩ writes(j) ≠ ∅ (txn i must serialize
+    before txn j), diagonal cleared, restricted to candidate txns.
+
+    Built [G, B]-blockwise with the same _overlap_rows primitive as the
+    acceptance scan (writes of the block's txns as rows, everyone's reads
+    as columns — overlap is symmetric, so the transpose falls out of the
+    argument order) and packed the moment each block materializes. Inputs
+    are padded to a multiple of 32 (BP) by the caller."""
+    bp = base.shape[0]
+    g = min(_ACCEPT_BLOCK, bp)
+    q = wb.shape[1]
+    if bp % g == 0 and bp > g:
+        nblk = bp // g
+        p = jax.lax.map(
+            lambda x: pack_bits_u32(
+                _overlap_rows(x[0], x[1], x[2], rb, re_, read_live)
+            ),
+            (
+                wb.reshape(nblk, g, q),
+                we.reshape(nblk, g, q),
+                write_live.reshape(nblk, g, q),
+            ),
+        ).reshape(bp, bp // 32)
+    else:
+        p = pack_bits_u32(
+            _overlap_rows(wb, we, write_live, rb, re_, read_live)
+        )
+    idx = jnp.arange(bp, dtype=jnp.int32)
+    diag = jnp.where(
+        (idx[:, None] >> 5) == jnp.arange(bp // 32, dtype=jnp.int32)[None, :],
+        (jnp.uint32(1) << (idx & 31).astype(jnp.uint32))[:, None],
+        jnp.uint32(0),
+    )
+    return p & ~diag & pack_bits_u32(base)[None, :]
+
+
+def _min_pred(p, undetp, j):
+    """Lowest-index undetermined predecessor of txn j (packed row scan).
+    Only called on stuck txns, whose undetermined predecessor set is
+    non-empty by construction."""
+    row = p[j] & undetp
+    w = jnp.argmax(row != 0).astype(jnp.int32)
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    bit = jnp.argmax(((row[w] >> lanes) & 1) != 0).astype(jnp.int32)
+    return w * 32 + bit
+
+
+def _cycle_victim(p, undet, undetp):
+    """Deterministic exactly-on-a-cycle victim of a stalled schedule.
+
+    At a stall every undetermined txn has an undetermined predecessor, so
+    the min-predecessor walk is total on the stuck set and — being a
+    deterministic functional graph — terminates on exactly one cycle.
+    Walk BP steps from the lowest stuck txn (guaranteed to have entered
+    the cycle: entry distance < |stuck| <= BP), then walk BP more
+    tracking the minimum index visited — at least one full loop of the
+    cycle, so the result is the cycle's minimum-index member regardless
+    of where the first walk landed. The host oracle replays the identical
+    rule with n steps; both step counts exceed every entry distance and
+    cycle length, so the victims agree byte-for-byte."""
+    bp = undet.shape[0]
+    j0 = jnp.argmax(undet).astype(jnp.int32)
+    j = jax.lax.fori_loop(0, bp, lambda _, j: _min_pred(p, undetp, j), j0)
+
+    def track(_, carry):
+        j, m = carry
+        j = _min_pred(p, undetp, j)
+        return j, jnp.minimum(m, j)
+
+    _, victim = jax.lax.fori_loop(0, bp, track, (j, j))
+    return victim
+
+
+def _wave_commit_accept(
+    base: jax.Array, ranks: tuple[jax.Array, ...]
+) -> tuple[jax.Array, jax.Array]:
+    """(accepted bool [B], level int32 [B]): schedule candidate txns into
+    dependency-ordered commit waves; abort only true-cycle members.
+
+    Fixed point over the packed predecessor bitsets (same operand shape
+    and AND/any-reduce rounds as _wave_accept_packed): each iteration
+    either levels every txn with no undetermined predecessor into the
+    next wave, or — when the remaining subgraph has no source, i.e. every
+    stuck txn sits on or behind a cycle — aborts the one _cycle_victim
+    and continues, so txns merely DOWNSTREAM of a cycle are re-examined
+    once the cycle is broken and still commit. Every iteration determines
+    at least one txn, bounding the loop by the candidate count (the
+    saturation cap makes the worst case explicit, exactly like the wave
+    accept's round cap)."""
+    rb, re_, read_live, wb, we, write_live = ranks
+    b = base.shape[0]
+    bp = ((b + 31) // 32) * 32
+    if bp != b:
+        pad = bp - b
+        base = jnp.pad(base, (0, pad))
+        rb = jnp.pad(rb, ((0, pad), (0, 0)))
+        re_ = jnp.pad(re_, ((0, pad), (0, 0)))
+        read_live = jnp.pad(read_live, ((0, pad), (0, 0)))
+        wb = jnp.pad(wb, ((0, pad), (0, 0)))
+        we = jnp.pad(we, ((0, pad), (0, 0)))
+        write_live = jnp.pad(write_live, ((0, pad), (0, 0)))
+    p = _pred_matrix_packed(base, rb, re_, read_live, wb, we, write_live)
+    idx = jnp.arange(bp, dtype=jnp.int32)
+
+    def cond(carry):
+        undet, _level, _wave, it = carry
+        return jnp.any(undet) & (it < bp + 1)
+
+    def step(carry):
+        undet, level, wave, it = carry
+        undetp = pack_bits_u32(undet)
+        blocked = or_matvec_u32(p, undetp)
+        ready = undet & ~blocked
+        has_ready = jnp.any(ready)
+        victim = jax.lax.cond(
+            has_ready,
+            lambda: jnp.int32(bp),  # out-of-range: no abort this round
+            lambda: _cycle_victim(p, undet, undetp),
+        )
+        vmask = idx == victim
+        level = jnp.where(
+            has_ready & ready,
+            wave,
+            jnp.where(vmask, jnp.int32(LEVEL_CYCLE), level),
+        )
+        undet = undet & ~jnp.where(has_ready, ready, vmask)
+        return undet, level, wave + has_ready.astype(jnp.int32), it + 1
+
+    _, level, _, _ = jax.lax.while_loop(
+        cond,
+        step,
+        (
+            base,
+            jnp.full((bp,), LEVEL_NONE, jnp.int32),
+            jnp.int32(0),
+            jnp.int32(0),
+        ),
+    )
+    level = level[:b]
+    return level >= 0, level
+
+
+# ---------------------------------------------------------------------------
 # Phase 3: paint accepted writes into the step function + compact
 # ---------------------------------------------------------------------------
 
@@ -812,12 +1008,21 @@ def loser_range_mask(
     return (hist_mask | intra) & (verdicts == V_CONFLICT)[:, None]
 
 
+def _accept_or_schedule(base, ranks, wave: bool):
+    """Shared acceptance dispatch: sequential-order block scan (wave=False)
+    or the wave-commit schedule (wave=True — levels ride along)."""
+    if wave:
+        return _wave_commit_accept(base, ranks)
+    return _block_accept_fused(base, *ranks), None
+
+
 def resolve_batch(
     state: ConflictState,
     batch: BatchTensors,
     commit_version: jax.Array,
     new_oldest: jax.Array,
     report: bool = False,
+    wave: bool = False,
 ):
     """Resolve one batch and fold its accepted writes into the history.
 
@@ -826,19 +1031,24 @@ def resolve_batch(
     loser_mask bool [B, R], new_state). Mirrors the reference call
     sequence ConflictBatch::detectConflicts → combineWriteConflictRanges →
     SkipList::addConflictRanges, as one compiled program.
+
+    `wave` (static) switches intra-batch acceptance to the wave-commit
+    schedule and inserts the int32 [B] wave levels right after the
+    verdicts in every return shape.
     """
     floor, too_old = too_old_mask(state, batch, new_oldest)
     hist_mask = _history_conflict_ranges(state, batch)
     hist_conflict = jnp.any(hist_mask, axis=1)
     base = batch.txn_mask & ~too_old & ~hist_conflict
     ranks = endpoint_ranks_live(batch)
-    accepted = _block_accept_fused(base, *ranks)
+    accepted, levels = _accept_or_schedule(base, ranks, wave)
     verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     new_state = _paint_and_compact(state, batch, accepted, commit_version, floor)
+    out = (verdicts, levels) if wave else (verdicts,)
     if report:
         losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
-        return verdicts, losers, new_state
-    return verdicts, new_state
+        return (*out, losers, new_state)
+    return (*out, new_state)
 
 
 def rebase(state: ConflictState, delta: jax.Array) -> ConflictState:
@@ -859,7 +1069,8 @@ def resolve_many(
     batches: BatchTensors,  # leading scan axis [k, ...] on every leaf
     commit_versions: jax.Array,  # int32 [k], strictly increasing
     new_oldests: jax.Array,  # int32 [k], non-decreasing
-) -> tuple[jax.Array, ConflictState]:
+    wave: bool = False,
+):
     """Resolve k batches in ONE compiled program (device-side lax.scan).
 
     Semantically identical to k sequential resolve_batch calls; exists
@@ -867,18 +1078,19 @@ def resolve_many(
     PJRT backend) would otherwise dominate the ~4 ms of real per-batch
     compute. The reference amortizes the same way at a different layer:
     CommitProxy batches many client commits per ResolveTransactionBatch
-    RPC (CommitProxyServer.actor.cpp).
+    RPC (CommitProxyServer.actor.cpp). With `wave` (static) the int32
+    [k, B] wave levels are returned after the verdicts.
     """
 
     def body(st, xs):
         batch, cv, old = xs
-        verdicts, st = resolve_batch(st, batch, cv, old)
-        return st, verdicts
+        out = resolve_batch(st, batch, cv, old, wave=wave)
+        return out[-1], out[:-1]
 
-    state, verdicts = jax.lax.scan(
+    state, stacked = jax.lax.scan(
         body, state, (batches, commit_versions, new_oldests)
     )
-    return verdicts, state
+    return (*stacked, state)
 
 
 # ---------------------------------------------------------------------------
@@ -1053,11 +1265,13 @@ def resolve_batch_hist(
     commit_version: jax.Array,
     new_oldest: jax.Array,
     report: bool = False,
+    wave: bool = False,
 ):
     """resolve_batch over the two-level history. Identical verdicts to
     resolve_batch (oracle-tested); only the history data structure
     differs. `report` (static) additionally returns the loser-range mask
-    bool [B, R] (see loser_range_mask)."""
+    bool [B, R] (see loser_range_mask); `wave` (static) inserts the wave
+    levels after the verdicts."""
     floor, too_old = too_old_mask(hist.delta, batch, new_oldest)
     demand = 2 * jnp.sum(
         (batch.write_mask & lex_lt(batch.write_begin, batch.write_end))
@@ -1069,14 +1283,15 @@ def resolve_batch_hist(
     hist_conflict = jnp.any(hist_mask, axis=1)
     ok = batch.txn_mask & ~too_old & ~hist_conflict
     ranks = endpoint_ranks_live(batch)
-    accepted = _block_accept_fused(ok, *ranks)
+    accepted, levels = _accept_or_schedule(ok, ranks, wave)
     verdicts = assemble_verdicts(too_old, batch.txn_mask, accepted)
     delta = _paint_and_compact(delta, batch, accepted, commit_version, floor)
     new_hist = HistState(base_h, base_st, delta)
+    out = (verdicts, levels) if wave else (verdicts,)
     if report:
         losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
-        return verdicts, losers, new_hist
-    return verdicts, new_hist
+        return (*out, losers, new_hist)
+    return (*out, new_hist)
 
 
 def resolve_many_hist(
@@ -1084,16 +1299,17 @@ def resolve_many_hist(
     batches: BatchTensors,
     commit_versions: jax.Array,
     new_oldests: jax.Array,
-) -> tuple[jax.Array, HistState]:
+    wave: bool = False,
+):
     def body(h, xs):
         batch, cv, old = xs
-        verdicts, h = resolve_batch_hist(h, batch, cv, old)
-        return h, verdicts
+        out = resolve_batch_hist(h, batch, cv, old, wave=wave)
+        return out[-1], out[:-1]
 
-    hist, verdicts = jax.lax.scan(
+    hist, stacked = jax.lax.scan(
         body, hist, (batches, commit_versions, new_oldests)
     )
-    return verdicts, hist
+    return (*stacked, hist)
 
 
 def advance_hist(hist: HistState, commit_version: jax.Array,
@@ -1248,24 +1464,27 @@ def resolve_batch_packed(
     commit_version: jax.Array,
     new_oldest: jax.Array,
     report: bool = False,
+    wave: bool = False,
 ):
     """resolve_batch over a PackedBatch — identical verdicts, rank-space
-    data movement. With `report`, the loser mask returns uint32-packed."""
+    data movement. With `report`, the loser mask returns uint32-packed;
+    with `wave`, the wave levels ride after the verdicts."""
     floor, too_old = too_old_mask_packed(state, pb, new_oldest)
     rs, ls = _dict_history_search(state.keys, pb.dict_keys)
     hist_mask = _history_conflict_ranges_packed(state, pb, rs, ls)
     hist_conflict = jnp.any(hist_mask, axis=1)
     base = pb.txn_mask & ~too_old & ~hist_conflict
     ranks = endpoint_ranks_live_packed(pb)
-    accepted = _block_accept_fused(base, *ranks)
+    accepted, levels = _accept_or_schedule(base, ranks, wave)
     verdicts = assemble_verdicts(too_old, pb.txn_mask, accepted)
     new_state = _paint_and_compact_packed(
         state, pb, accepted, commit_version, floor, rs
     )
+    out = (verdicts, levels) if wave else (verdicts,)
     if report:
         losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
-        return verdicts, pack_loser_mask(losers), new_state
-    return verdicts, new_state
+        return (*out, pack_loser_mask(losers), new_state)
+    return (*out, new_state)
 
 
 def resolve_many_packed(
@@ -1273,16 +1492,17 @@ def resolve_many_packed(
     pbs: PackedBatch,  # leading scan axis [k, ...] on every leaf
     commit_versions: jax.Array,
     new_oldests: jax.Array,
-) -> tuple[jax.Array, ConflictState]:
+    wave: bool = False,
+):
     def body(st, xs):
         pb, cv, old = xs
-        verdicts, st = resolve_batch_packed(st, pb, cv, old)
-        return st, verdicts
+        out = resolve_batch_packed(st, pb, cv, old, wave=wave)
+        return out[-1], out[:-1]
 
-    state, verdicts = jax.lax.scan(
+    state, stacked = jax.lax.scan(
         body, state, (pbs, commit_versions, new_oldests)
     )
-    return verdicts, state
+    return (*stacked, state)
 
 
 def _history_conflict_ranges_hist_packed(
@@ -1328,6 +1548,7 @@ def resolve_batch_hist_packed(
     commit_version: jax.Array,
     new_oldest: jax.Array,
     report: bool = False,
+    wave: bool = False,
 ):
     """resolve_batch_hist over a PackedBatch. The delta's right-side
     dictionary search doubles as the paint pass's cross-rank (both run
@@ -1346,16 +1567,17 @@ def resolve_batch_hist_packed(
     hist_conflict = jnp.any(hist_mask, axis=1)
     ok = pb.txn_mask & ~too_old & ~hist_conflict
     ranks = endpoint_ranks_live_packed(pb)
-    accepted = _block_accept_fused(ok, *ranks)
+    accepted, levels = _accept_or_schedule(ok, ranks, wave)
     verdicts = assemble_verdicts(too_old, pb.txn_mask, accepted)
     delta = _paint_and_compact_packed(
         delta, pb, accepted, commit_version, floor, rs_d
     )
     new_hist = HistState(base_h, base_st, delta)
+    out = (verdicts, levels) if wave else (verdicts,)
     if report:
         losers = loser_range_mask(hist_mask, ranks, accepted, verdicts)
-        return verdicts, pack_loser_mask(losers), new_hist
-    return verdicts, new_hist
+        return (*out, pack_loser_mask(losers), new_hist)
+    return (*out, new_hist)
 
 
 def resolve_many_hist_packed(
@@ -1363,16 +1585,17 @@ def resolve_many_hist_packed(
     pbs: PackedBatch,
     commit_versions: jax.Array,
     new_oldests: jax.Array,
-) -> tuple[jax.Array, HistState]:
+    wave: bool = False,
+):
     def body(h, xs):
         pb, cv, old = xs
-        verdicts, h = resolve_batch_hist_packed(h, pb, cv, old)
-        return h, verdicts
+        out = resolve_batch_hist_packed(h, pb, cv, old, wave=wave)
+        return out[-1], out[:-1]
 
-    hist, verdicts = jax.lax.scan(
+    hist, stacked = jax.lax.scan(
         body, hist, (pbs, commit_versions, new_oldests)
     )
-    return verdicts, hist
+    return (*stacked, hist)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -1457,6 +1680,84 @@ def _resolve_many_jit(state, batches, commit_versions, new_oldests):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _rebase_jit(state, delta):
     return rebase(state, delta)
+
+
+# -- wave-commit entry points (FDB_TPU_WAVE_COMMIT=1 engines) ---------------
+# Same four engine configurations as above; every return shape gains the
+# int32 [B] (or [k, B]) wave levels right after the verdicts.
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_wave_jit(state, batch, commit_version, new_oldest):
+    return resolve_batch(state, batch, commit_version, new_oldest, wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_wave_jit(state, batch, commit_version, new_oldest):
+    return resolve_batch(state, batch, commit_version, new_oldest,
+                         report=True, wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_many_wave_jit(state, batches, commit_versions, new_oldests):
+    return resolve_many(state, batches, commit_versions, new_oldests,
+                        wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_hist_wave_jit(hist, batch, commit_version, new_oldest):
+    return resolve_batch_hist(hist, batch, commit_version, new_oldest,
+                              wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_hist_wave_jit(hist, batch, commit_version, new_oldest):
+    return resolve_batch_hist(hist, batch, commit_version, new_oldest,
+                              report=True, wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_many_hist_wave_jit(hist, batches, commit_versions, new_oldests):
+    return resolve_many_hist(hist, batches, commit_versions, new_oldests,
+                             wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_packed_wave_jit(state, pb, commit_version, new_oldest):
+    return resolve_batch_packed(state, pb, commit_version, new_oldest,
+                                wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_packed_wave_jit(state, pb, commit_version, new_oldest):
+    return resolve_batch_packed(state, pb, commit_version, new_oldest,
+                                report=True, wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_many_packed_wave_jit(state, pbs, commit_versions, new_oldests):
+    return resolve_many_packed(state, pbs, commit_versions, new_oldests,
+                               wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_hist_packed_wave_jit(hist, pb, commit_version, new_oldest):
+    return resolve_batch_hist_packed(hist, pb, commit_version, new_oldest,
+                                     wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_report_hist_packed_wave_jit(hist, pb, commit_version,
+                                         new_oldest):
+    return resolve_batch_hist_packed(hist, pb, commit_version, new_oldest,
+                                     report=True, wave=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resolve_many_hist_packed_wave_jit(hist, pbs, commit_versions,
+                                       new_oldests):
+    return resolve_many_hist_packed(hist, pbs, commit_versions, new_oldests,
+                                    wave=True)
 
 
 # ---------------------------------------------------------------------------

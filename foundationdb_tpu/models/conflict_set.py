@@ -57,8 +57,22 @@ class TPUConflictSet:
         max_key_bytes: int = 32,
         window_versions: int = DEFAULT_WINDOW_VERSIONS,
         delta_capacity: int | None = None,
+        wave_commit: bool | None = None,
     ):
         self.codec = KeyCodec(max_key_bytes)
+        # Wave-commit mode (reorder-don't-abort; conflict_kernel phase 2b):
+        # None = the FDB_TPU_WAVE_COMMIT env default. Both modes' entry
+        # points are distinct compiled programs, so engines of either mode
+        # coexist in one process (the import-once rule only pins the env
+        # DEFAULT). NOTE: a wave engine reorders txns within its own view,
+        # so it must see every conflict range of its batches — one engine
+        # per resolver role, and never more than one wave resolver per
+        # keyspace (the mesh ShardedConflictSet shards internally and
+        # stays exact; role-level multi-resolver deployments must keep
+        # wave commit off — see sim/cluster.new_conflict_set).
+        self.wave_commit = ck._WAVE_COMMIT if wave_commit is None else bool(
+            wave_commit
+        )
         self.capacity = capacity
         self.batch_size = batch_size
         self.max_read_ranges = max_read_ranges
@@ -79,6 +93,21 @@ class TPUConflictSet:
         # surface as the oracle's (reference: conflictingKRIndices); the
         # runtime Resolver reads it for the repair subsystem's reports.
         self.last_conflicting: dict[int, list[KeyRange]] = {}
+        # Wave levels of the LAST resolve() call, by txn index (wave
+        # engines only; None otherwise): >= 0 committed at that wave,
+        # conflict_kernel.LEVEL_CYCLE aborted on a true cycle,
+        # LEVEL_NONE every other non-commit. Chunked resolves offset
+        # later chunks' waves past earlier ones (chunks serialize in
+        # order), so the list is one coherent schedule for the call.
+        self.last_wave: list[int] | None = None
+        # Exact reordered count of the last resolve (wave engines only):
+        # txns committed past their chunk's FIRST wave — the published
+        # cross-chunk offsets deliberately excluded (see _collect_waves).
+        self.last_reordered: int | None = None
+        # Window-path analogue (dispatch_window collectors): int32
+        # [k, count] levels, one independent wave schedule per scanned
+        # batch (batches already serialize by commit version).
+        self.last_wave_window: np.ndarray | None = None
         self._empty_dev_batch = None  # advance()'s constant batch, packed lazily
         self._init_engine()
 
@@ -89,33 +118,29 @@ class TPUConflictSet:
         deduped key dictionary (_pack_dict) and the device runs the
         rank-space kernel entry points."""
         self._dev_batch = self._pack_dict if ck._PACKED else (lambda bt: bt)
-        if ck._HIST_DESIGN == "window":
+        hist = ck._HIST_DESIGN == "window"
+        if hist:
             self.state = ck.init_hist(
                 self.capacity, self.codec.width, self.codec.min_key,
                 self.delta_capacity,
             )
-            if ck._PACKED:
-                self._resolve_fn = ck._resolve_hist_packed_jit
-                self._resolve_report_fn = ck._resolve_report_hist_packed_jit
-                self._resolve_many_fn = ck._resolve_many_hist_packed_jit
-            else:
-                self._resolve_fn = ck._resolve_hist_jit
-                self._resolve_report_fn = ck._resolve_report_hist_jit
-                self._resolve_many_fn = ck._resolve_many_hist_jit
             self._rebase_fn = ck._rebase_hist_jit
         else:
             self.state = ck.init_state(
                 self.capacity, self.codec.width, self.codec.min_key
             )
-            if ck._PACKED:
-                self._resolve_fn = ck._resolve_packed_jit
-                self._resolve_report_fn = ck._resolve_report_packed_jit
-                self._resolve_many_fn = ck._resolve_many_packed_jit
-            else:
-                self._resolve_fn = ck._resolve_jit
-                self._resolve_report_fn = ck._resolve_report_jit
-                self._resolve_many_fn = ck._resolve_many_jit
             self._rebase_fn = ck._rebase_jit
+        # Entry points follow one naming convention —
+        # _resolve{,_report,_many}{_hist}{_packed}{_wave}_jit — so the
+        # (history, packed, wave) design point composes the names instead
+        # of a hand-written 12-way table a mis-paired branch could
+        # silently skew.
+        suffix = (("_hist" if hist else "")
+                  + ("_packed" if ck._PACKED else "")
+                  + ("_wave" if self.wave_commit else "") + "_jit")
+        self._resolve_fn = getattr(ck, "_resolve" + suffix)
+        self._resolve_report_fn = getattr(ck, "_resolve_report" + suffix)
+        self._resolve_many_fn = getattr(ck, "_resolve_many" + suffix)
 
     def _pack_dict(self, bt: ck.BatchTensors) -> ck.PackedBatch:
         """Dedup+sort ALL batch endpoint keys once per dispatch (host
@@ -199,17 +224,25 @@ class TPUConflictSet:
             # pay the report program + host-side range bookkeeping.
             if can_report and any(t.report_conflicting_keys for t in chunk):
                 batch, reads = self._pack(chunk, collect_reads=True)
-                verdicts, losers, self.state = self._resolve_report_fn(
+                out = self._resolve_report_fn(
                     self.state, self._dev_batch(batch), cv, oldest
+                )
+                verdicts, levels, losers, self.state = (
+                    out if self.wave_commit else (out[0], None, *out[1:])
                 )
                 flags = [t.report_conflicting_keys for t in chunk]
-                pending.append((verdicts, len(chunk), losers, reads, flags))
+                pending.append(
+                    (verdicts, len(chunk), losers, reads, flags, levels)
+                )
             else:
                 batch = self._pack(chunk)
-                verdicts, self.state = self._resolve_fn(
+                out = self._resolve_fn(
                     self.state, self._dev_batch(batch), cv, oldest
                 )
-                pending.append((verdicts, len(chunk), None, None, None))
+                verdicts, levels, self.state = (
+                    out if self.wave_commit else (out[0], None, out[1])
+                )
+                pending.append((verdicts, len(chunk), None, None, None, levels))
         return lambda: self._collect(pending)
 
     def resolve_wire(
@@ -252,15 +285,23 @@ class TPUConflictSet:
         while remaining > 0:
             n = min(remaining, self.batch_size)
             batch, offset = self._pack_wire(buf, offset, n)
-            verdicts, self.state = self._resolve_fn(
+            out = self._resolve_fn(
                 self.state, self._dev_batch(batch), cv, oldest
             )
-            pending.append((verdicts, n, None, None, None))
+            verdicts, levels, self.state = (
+                out if self.wave_commit else (out[0], None, out[1])
+            )
+            pending.append((verdicts, n, None, None, None, levels))
             remaining -= n
         if as_array:
-            return lambda: np.concatenate(
-                [np.asarray(v)[:n] for v, n, *_rest in pending]
-            )
+
+            def collect_array():
+                self._collect_waves(pending)
+                return np.concatenate(
+                    [np.asarray(v)[:n] for v, n, *_rest in pending]
+                )
+
+            return collect_array
         return lambda: self._collect(pending)
 
     def resolve_wire_window(
@@ -383,16 +424,56 @@ class TPUConflictSet:
             self.state = self._rebase_fn(
                 self.state, np.int32(min(prepared.rebase_delta, 2**31 - 1))
             )
-        verdicts, self.state = self._resolve_many_fn(
+        out = self._resolve_many_fn(
             self.state, prepared.batch, prepared.cvs_rel, prepared.olds_rel
         )
-        return lambda: np.asarray(verdicts)[:, : prepared.count]
+        verdicts, levels, self.state = (
+            out if self.wave_commit else (out[0], None, out[1])
+        )
+        if not self.wave_commit:
+            return lambda: np.asarray(verdicts)[:, : prepared.count]
+
+        def collect():
+            # Waves are PER BATCH on the window path (batches already
+            # serialize by commit version); publish int32 [k, count].
+            self.last_wave_window = np.asarray(levels)[:, : prepared.count]
+            return np.asarray(verdicts)[:, : prepared.count]
+
+        return collect
+
+    def _collect_waves(self, pending: list[tuple]) -> None:
+        """Publish ``last_wave`` from the pending chunks' level tensors.
+
+        Chunks of one resolve call serialize in submission order (earlier
+        chunks' writes are painted before later chunks resolve), so chunk
+        i+1's wave 0 serializes after ALL of chunk i's waves: offset each
+        chunk's committed levels past the previous chunk's maximum to make
+        the list one coherent schedule for the whole call."""
+        if not self.wave_commit:
+            return
+        waves: list[int] = []
+        offset = 0
+        reordered = 0
+        for verdicts, n, _losers, _reads, _flags, levels in pending:
+            lv = np.asarray(levels)[:n]
+            # Reordered = committed past its CHUNK's first wave (raw
+            # level > 0). The chunk offsets below exist only to make the
+            # published schedule coherent across chunks — a later chunk's
+            # wave-0 txn committed in plain arrival order and must not
+            # count as reordered.
+            reordered += int((lv > 0).sum())
+            waves.extend(int(x) + offset if x >= 0 else int(x) for x in lv)
+            if n and int(lv.max()) >= 0:
+                offset += int(lv.max()) + 1
+        self.last_wave = waves
+        self.last_reordered = reordered
 
     def _collect(self, pending: list[tuple]) -> list[Verdict]:
         out: list[Verdict] = []
         self.last_conflicting = {}
+        self._collect_waves(pending)
         gi = 0
-        for verdicts, n, losers, reads, flags in pending:
+        for verdicts, n, losers, reads, flags, _levels in pending:
             v = np.asarray(verdicts)[:n]
             if losers is not None:
                 m = np.asarray(losers)[:n]
@@ -521,9 +602,9 @@ class TPUConflictSet:
             # all endpoint rows) and advance()'s all-masked batch is a
             # constant — pack it once. The batch argument is never donated.
             self._empty_dev_batch = self._dev_batch(self._empty_batch())
-        _, self.state = self._resolve_fn(
+        self.state = self._resolve_fn(
             self.state, self._empty_dev_batch, cv, oldest
-        )
+        )[-1]
 
     # -- internals ----------------------------------------------------------
 

@@ -25,6 +25,8 @@ from TPUConflictSet; only the device entry points differ (_init_engine).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -96,9 +98,12 @@ def density_splits(n_shards: int, sample_keys: list[bytes]) -> list[bytes]:
 _row_sort_keys = row_sort_keys
 
 
-def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi):
+def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi,
+                     wave=False):
     """Per-device body (runs under shard_map; state/lo/hi are the local shard,
-    batch is replicated)."""
+    batch is replicated). `wave` (static) switches intra-batch acceptance
+    to the wave-commit schedule; the int32 [B] levels ride after the
+    verdicts, replicated like them."""
     state = jax.tree.map(lambda x: x[0], state)  # drop leading device axis
     lo = lo[0]
     hi = hi[0]
@@ -127,11 +132,22 @@ def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi):
     # row-sharded design all-gathered a [B, B] matrix (67 MB at B=8192)
     # over ICI only to run the full-matrix wave on every device anyway.
     base = batch.txn_mask & ~too_old & ~hist_conflict
-    accepted = ck._block_accept_fused(base, *ck.endpoint_ranks_live(batch))
+    # Wave commit composes with the mesh: the schedule is a pure function
+    # of the replicated batch and the all_gathered history bits, so every
+    # device computes the SAME dependency waves (levels survive the packed
+    # all_gather combine exactly because acceptance runs after it) and
+    # paints only its own shard's accepted writes. The mesh engine shards
+    # one keyspace internally — unlike role-level multi-resolver, no
+    # device ever sees a clipped-away edge, so reordering stays exact.
+    accepted, levels = ck._accept_or_schedule(
+        base, ck.endpoint_ranks_live(batch), wave
+    )
     verdicts = ck.assemble_verdicts(too_old, batch.txn_mask, accepted)
 
     new_state = ck._paint_and_compact(state, local, accepted, commit_version, floor)
     new_state = jax.tree.map(lambda x: x[None], new_state)
+    if wave:
+        return verdicts, levels, new_state
     return verdicts, new_state
 
 
@@ -293,11 +309,14 @@ class ShardedConflictSet(TPUConflictSet):
 
         state_specs = ck.ConflictState(*(P(AXIS) for _ in ck.ConflictState._fields))
         batch_specs = ck.BatchTensors(*(P() for _ in ck.BatchTensors._fields))
+        wave = self.wave_commit
+        out_specs = ((P(), P(), state_specs) if wave
+                     else (P(), state_specs))
         body = _shard_map(
-            _sharded_resolve,
+            functools.partial(_sharded_resolve, wave=wave),
             mesh=self.mesh,
             in_specs=(state_specs, batch_specs, P(), P(), P(AXIS), P(AXIS)),
-            out_specs=(P(), state_specs),
+            out_specs=out_specs,
             **_SHARD_MAP_KW,
         )
         jitted = jax.jit(body, donate_argnums=(0,))
@@ -308,11 +327,11 @@ class ShardedConflictSet(TPUConflictSet):
         def many(s, bts, cvs, olds, lo, hi):
             def scan_body(st, xs):
                 bt, cv, old = xs
-                verdicts, st = body(st, bt, cv, old, lo, hi)
-                return st, verdicts
+                out = body(st, bt, cv, old, lo, hi)
+                return out[-1], out[:-1]
 
-            st, verdicts = jax.lax.scan(scan_body, s, (bts, cvs, olds))
-            return verdicts, st
+            st, stacked = jax.lax.scan(scan_body, s, (bts, cvs, olds))
+            return (*stacked, st)
 
         many_jit = jax.jit(many, donate_argnums=(0,))
         self._resolve_many_fn = lambda s, bts, cvs, olds: many_jit(

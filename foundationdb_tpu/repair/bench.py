@@ -5,8 +5,21 @@ Runs the SAME Zipf-0.99 read-modify-write contention stream
 clusters — once through the canonical full-restart loop, once through the
 transaction-repair engine — and reports committed-txns/sec (virtual sim
 time) for both. Serializability is enforced, not assumed: the clusters
-resolve with the brute-force oracle (sim/oracle.py) and the workload's
-RMW-sum invariant fails the run if any repair admitted a stale read.
+resolve with the replay-checked brute-force oracle (sim/oracle.py —
+under wave commit every batch's realized (wave, index) order is replayed
+sequentially inline and must agree byte-for-byte or the resolve raises)
+and the workload's RMW-sum invariant fails the run if any repair
+admitted a stale read.
+
+``wave_commit`` (None = the FDB_TPU_WAVE_COMMIT env default, exactly the
+kernel's A/B contract) switches the clusters' resolvers to the
+reorder-don't-abort schedule: write-after-read chains commit in
+dependency order, only true cycles abort, and repair mops up the cycle
+residue. Each run's record carries the exact attribution counters —
+``conflicts`` (CONFLICT verdicts), ``reordered`` (committed at a
+non-zero wave), ``aborted_cycles`` — so goodput gains are attributable
+to reordering vs residual aborts. scripts/wave_ab.sh runs this harness
+at both flag settings on the same seeds and merges the WAVE_AB record.
 
 Driven by ``python bench.py --repair-sim``; prints one JSON line like the
 TPU bench. Pure simulation: no TPU, no JAX device work.
@@ -23,31 +36,40 @@ def run_repair_goodput(
     theta: float = 0.99,
     reads_per_txn: int = 3,
     timeout: float = 3000.0,
+    wave_commit: bool | None = None,
+    target_pick: str = "hottest",
 ) -> dict:
     from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.core.types import wave_commit_env_default
     from foundationdb_tpu.runtime.status import fetch_status
     from foundationdb_tpu.sim.cluster import SimCluster
     from foundationdb_tpu.sim.workloads import ZipfRepairWorkload, run_workload
 
+    if wave_commit is None:
+        wave_commit = wave_commit_env_default()
     result: dict = {
         "metric": "repair_goodput_txns_per_sec",
         "unit": "committed txns / virtual s",
+        "wave_commit": bool(wave_commit),
         "workload": {
             "theta": theta, "n_keys": n_keys, "n_txns": n_txns,
             "n_clients": n_clients, "reads_per_txn": reads_per_txn,
-            "seed": seed,
+            "seed": seed, "target_pick": target_pick,
         },
         "serializability": (
-            "oracle conflict engine (sim/oracle.py) + RMW-sum invariant "
-            "checked after each run"
+            "replay-checked oracle engine (sim/oracle.ReplayCheckedOracle:"
+            " every wave schedule sequentially replayed inline, byte-for-"
+            "byte) + RMW-sum invariant checked after each run"
         ),
     }
     for label, repair in (("naive_full_restart", False), ("repair", True)):
-        c = SimCluster(seed=seed, engine="oracle")
+        c = SimCluster(seed=seed, engine="oracle-replay",
+                       wave_commit=wave_commit)
         db = open_database(c)
         w = ZipfRepairWorkload(
             seed=seed, n_keys=n_keys, n_txns=n_txns, n_clients=n_clients,
             theta=theta, reads_per_txn=reads_per_txn, repair=repair,
+            target_pick=target_pick,
         )
         metrics = c.loop.run(run_workload(c, db, w), timeout=timeout)
         entry = {
@@ -55,6 +77,13 @@ def run_repair_goodput(
             "elapsed_virtual_s": round(metrics.extra.get("elapsed", 0.0), 3),
             "committed": metrics.ops,
             "serializable": True,  # run_workload raised otherwise
+            # Exact attribution (resolver counters): conflicts is every
+            # CONFLICT verdict; under wave commit the intra-window losers
+            # are cycle aborts ONLY, and reordered counts commits that
+            # sequential order would have raced or aborted.
+            "conflicts": sum(r.txns_conflicted for r in c.resolvers),
+            "reordered": sum(r.txns_reordered for r in c.resolvers),
+            "aborted_cycles": sum(r.txns_cycle_aborted for r in c.resolvers),
         }
         if repair:
             entry["repair"] = metrics.extra.get("repair")

@@ -307,10 +307,10 @@ class CommitProxy:
         version: int,
     ) -> None:
         try:
-            verdicts, conflicting, fail_safe = await self._resolve(
+            verdicts, conflicting, fail_safe, wave = await self._resolve(
                 batch, prev_version, version
             )
-            tagged = self._assemble(batch, verdicts, version)
+            tagged = self._assemble(batch, verdicts, version, wave)
             kc = self._known_committed
             if self.loop.buggify("commit_proxy.slow_push"):
                 # Delayed push: later batches' pushes overtake ours at the
@@ -403,7 +403,10 @@ class CommitProxy:
         batch: list[tuple[CommitRequest, Promise]],
         prev_version: int,
         version: int,
-    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
+    ) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
         """Fan the batch out to every resolver (filtered to its key shard)
         and AND the verdicts. Conflicts are never missed: any read/write
         overlap lands on whichever resolver owns those keys. As in the
@@ -444,9 +447,16 @@ class CommitProxy:
         conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
         # Any shard in fail-safe taints the whole batch's conflict stats:
         # its CONFLICTs are spurious capacity rejections, not contention.
-        fail_safe = any(fs for _v, _c, fs in replies)
+        fail_safe = any(fs for _v, _c, fs, _w in replies)
+        # Wave-commit schedule (reorder-don't-abort resolvers): usable only
+        # from a SINGLE resolver — per-shard schedules of clipped ranges
+        # are not combinable (each resolver misses the others' edges), so
+        # wave engines are forbidden at role-level multi-resolver
+        # (sim/cluster.new_conflict_set enforces it) and the AND path below
+        # keeps the reference abort semantics.
+        wave = replies[0][3] if len(replies) == 1 and not fail_safe else None
         for i in range(len(batch)):
-            vs = [verdicts[i] for verdicts, _conf, _fs in replies]
+            vs = [verdicts[i] for verdicts, _conf, _fs, _w in replies]
             if Verdict.TOO_OLD in vs:
                 combined.append(Verdict.TOO_OLD)
             elif Verdict.CONFLICT in vs:
@@ -454,24 +464,40 @@ class CommitProxy:
                 # Union the per-resolver conflicting ranges (each resolver
                 # reports only its own key shard's clipped subranges).
                 ranges = [
-                    r for _v, conf, _fs in replies for r in conf.get(i, [])
+                    r for _v, conf, _fs, _w in replies for r in conf.get(i, [])
                 ]
                 if ranges:
                     conflicting[i] = ranges
             else:
                 combined.append(Verdict.COMMITTED)
-        return combined, conflicting, fail_safe
+        return combined, conflicting, fail_safe, wave
 
     def _assemble(
         self,
         batch: list[tuple[CommitRequest, Promise]],
         verdicts: list[Verdict],
         version: int,
+        wave: list[int] | None = None,
     ) -> dict[int, list[Mutation]]:
         """Tag committed txns' mutations by storage shard (reference:
-        applyMetadataEffect + tag lookup in commitBatch)."""
+        applyMetadataEffect + tag lookup in commitBatch).
+
+        ``wave`` (a wave-commit resolver's schedule) reorders SAME-VERSION
+        mutation application into the realized serialization order
+        (wave level, then batch index): tlogs and storage servers apply a
+        version's mutation list in order, so two committed blind writes to
+        one key must land last-writer-in-realized-order, not last-writer-
+        by-arrival. Versionstamps keep the BATCH index (uniqueness is
+        per-slot; their ordering guarantee is by (version, index), which
+        clients may only compare across versions they observed commit —
+        and wave order never crosses a version boundary)."""
         tagged: dict[int, list[Mutation]] = {}
-        for i, ((req, _p), v) in enumerate(zip(batch, verdicts)):
+        order = range(len(batch))
+        if wave is not None:
+            order = sorted(order, key=lambda i: (max(wave[i], 0), i))
+        for i in order:
+            req, _p = batch[i]
+            v = verdicts[i]
             if v != Verdict.COMMITTED:
                 continue
             for m in resolve_versionstamps(req.mutations, version, i):

@@ -13,7 +13,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from foundationdb_tpu.core.types import TxnConflictInfo, Verdict
+from foundationdb_tpu.core.types import (
+    WAVE_LEVEL_CYCLE,
+    TxnConflictInfo,
+    Verdict,
+)
 from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
@@ -63,6 +67,19 @@ class Resolver:
         self.sched.attach(self._dispatch_group)
         self.batches_resolved = 0
         self.txns_resolved = 0
+        # Wave-commit accounting (engines publishing last_wave, i.e. the
+        # reorder-don't-abort kernel/oracle): txns committed at a
+        # non-zero wave serialized AFTER at least one same-window
+        # predecessor instead of racing it (the reordered population),
+        # and cycle aborts are the schedule's only intra-window losers —
+        # together they make goodput gains attributable in the bench
+        # records (ISSUE 7 satellite).
+        self.txns_reordered = 0
+        self.txns_cycle_aborted = 0
+        # Exact CONFLICT verdict count (every engine; fail-safe rejections
+        # counted separately above): the bench records' denominator for
+        # attributing goodput gains to reorders vs residual aborts.
+        self.txns_conflicted = 0
         # History-capacity fail-safe (engines exposing headroom(), i.e. the
         # fixed-capacity device kernels). The reference SkipList grows
         # unboundedly within the MVCC window and can never lose history
@@ -100,13 +117,20 @@ class Resolver:
         version: int,
         txns: list[TxnConflictInfo],
         oldest_version: int | None = None,
-    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
-        """→ (verdicts, conflicting, fail_safe): conflicting maps a txn's
-        batch index to its conflicting read ranges, for txns that set
+    ) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
+        """→ (verdicts, conflicting, fail_safe, wave): conflicting maps a
+        txn's batch index to its conflicting read ranges, for txns that set
         report_conflicting_keys and got CONFLICT. fail_safe marks a batch
         rejected wholesale by the capacity fail-safe — its conflicts are
         spurious, so downstream hot-range accounting must skip them (the
         proxy's sketch would otherwise score uncontended ranges hot).
+        wave is the engine's wave-commit schedule per txn index (None for
+        sequential-order engines and fail-safe batches): the commit proxy
+        applies same-version mutations in (wave, index) order so
+        write-after-read chains land in dependency order.
 
         Chain admission is decoupled from engine dispatch: once a batch's
         prev_version matches, it takes its chain position immediately (so
@@ -185,12 +209,16 @@ class Resolver:
 
     def _resolve_entry(
         self, entry: _QueuedBatch
-    ) -> tuple[list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool]:
+    ) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
         version, txns, oldest_version = (
             entry.version, entry.txns, entry.oldest_version,
         )
         if oldest_version is None:
             oldest_version = max(0, version - MVCC_WINDOW_VERSIONS)
+        wave: list[int] | None = None
         fail_safe = self._should_fail_safe(len(txns), version, oldest_version)
         if fail_safe:
             # Conflict-everything: rejected txns paint nothing, so history
@@ -203,6 +231,7 @@ class Resolver:
             self.txns_rejected_fail_safe += len(txns)
         else:
             verdicts = self.cs.resolve(txns, version, oldest_version)
+            wave = getattr(self.cs, "last_wave", None)
             if self._post_resolve_check(version):
                 # True overflow DURING this batch: chunked resolves paint
                 # earlier chunks before later ones resolve, so post-overflow
@@ -210,6 +239,10 @@ class Resolver:
                 verdicts = [Verdict.CONFLICT] * len(txns)
                 self.txns_rejected_fail_safe += len(txns)
                 fail_safe = True
+                # The engine's schedule died with its verdicts: a wave
+                # for a rejected batch would skew the attribution
+                # counters below and invite a caller to reorder it.
+                wave = None
         # Conflicting read ranges for txns that asked (reference: the
         # reply's conflictingKRIndices). Engines that track exact ranges
         # (oracle) report them; others degrade to the conservative
@@ -230,9 +263,27 @@ class Resolver:
                 self.hot_ranges.record(pairs)
             if t.report_conflicting_keys:
                 conflicting[i] = pairs
+        if not fail_safe:
+            self.txns_conflicted += sum(
+                1 for v in verdicts if v == Verdict.CONFLICT
+            )
+        if wave is not None:
+            # Attribution counters (see __init__): a committed txn past
+            # its chunk's first wave was REORDERED behind a same-window
+            # predecessor it would have raced (or lost to) under
+            # sequential order. Engines publishing a wave schedule
+            # publish ``last_reordered`` beside it, counted against RAW
+            # per-chunk levels — recomputing from the published schedule
+            # here would miscount later chunks' wave-0 txns as reordered
+            # (its cross-chunk offsets exist only to keep the schedule
+            # coherent), so a missing counter is an engine bug and loud.
+            self.txns_reordered += self.cs.last_reordered
+            self.txns_cycle_aborted += sum(
+                1 for lv in wave if lv == WAVE_LEVEL_CYCLE
+            )
         self.batches_resolved += 1
         self.txns_resolved += len(txns)
-        return (verdicts, conflicting, fail_safe)
+        return (verdicts, conflicting, fail_safe, wave)
 
     # -- history-capacity fail-safe -----------------------------------------
 
@@ -323,6 +374,12 @@ class Resolver:
             or self._unsafe_until is not None,
             "overflow_events": self.overflow_events,
             "txns_rejected_fail_safe": self.txns_rejected_fail_safe,
+            # Wave-commit attribution (reorder-don't-abort engines; both
+            # zero under sequential-order resolution) + the exact conflict
+            # count they are judged against.
+            "txns_reordered": self.txns_reordered,
+            "txns_cycle_aborted": self.txns_cycle_aborted,
+            "txns_conflicted": self.txns_conflicted,
             "history_headroom": self._headroom,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,

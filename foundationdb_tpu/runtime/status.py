@@ -66,7 +66,12 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         "workload": {
             "transactions": {"committed": 0, "conflicted": 0},
             "grvs_served": 0,
-            "resolver": {"batches": 0, "txns": 0},
+            # reordered/aborted_cycles are the wave-commit attribution
+            # counters (reorder-don't-abort resolve mode; zero under
+            # sequential-order resolution), conflicts the exact CONFLICT
+            # verdict total they are judged against.
+            "resolver": {"batches": 0, "txns": 0, "conflicts": 0,
+                         "reordered": 0, "aborted_cycles": 0},
             # Resolve-dispatch scheduler backpressure (sched subsystem):
             # depth/age are the worst over resolvers (the binding signal
             # for admission), dispatch counts are cluster totals.
@@ -124,6 +129,12 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         if m:
             doc["workload"]["resolver"]["batches"] += m["batches_resolved"]
             doc["workload"]["resolver"]["txns"] += m["txns_resolved"]
+            doc["workload"]["resolver"]["conflicts"] += m.get(
+                "txns_conflicted", 0)
+            doc["workload"]["resolver"]["reordered"] += m.get(
+                "txns_reordered", 0)
+            doc["workload"]["resolver"]["aborted_cycles"] += m.get(
+                "txns_cycle_aborted", 0)
             q = m.get("queue") or {}
             rq["depth"] = max(rq["depth"], q.get("depth", 0))
             rq["oldest_age_s"] = max(

@@ -205,13 +205,31 @@ def tls_config(spec: dict, spec_path: str) -> dict | None:
             for k, v in tls.items()}
 
 
-def make_conflict_set(engine: str):
+def make_conflict_set(engine: str, n_resolvers: int = 1):
     """Resolver engine: 'tpu' is the production kernel; 'cpu' (C++ skiplist)
-    keeps a cluster deployable on hosts with no accelerator."""
+    keeps a cluster deployable on hosts with no accelerator.
+
+    ``n_resolvers`` is the DEPLOYMENT's resolver role count (the spec's
+    resolver list), not this process's: wave commit (FDB_TPU_WAVE_COMMIT=1)
+    reorders within one engine's view, so it must see every conflict edge
+    of its window — per-shard wave schedules over clipped ranges are not
+    combinable, and a multi-resolver deployment with the flag set must
+    refuse recruitment rather than silently un-serialize (the sim cluster
+    enforces the same rule)."""
+    from foundationdb_tpu.core.types import (
+        validate_wave_commit,
+        wave_commit_env_default,
+    )
+
+    wave = wave_commit_env_default()
+    if wave:
+        validate_wave_commit(
+            n_resolvers, "cpu" if engine == "cpu" else None
+        )
     if engine == "tpu":
         from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
-        return TPUConflictSet()
+        return TPUConflictSet(wave_commit=wave)
     if engine == "cpu":
         from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
 
@@ -219,7 +237,7 @@ def make_conflict_set(engine: str):
     if engine == "oracle":
         from foundationdb_tpu.sim.oracle import OracleConflictSet
 
-        return OracleConflictSet()
+        return OracleConflictSet(wave_commit=wave)
     raise ValueError(f"unknown engine {engine!r}")
 
 
@@ -511,7 +529,9 @@ class Worker:
         engine = self.spec.get("engine", "cpu")
         self.t.serve(
             "resolver",
-            Resolver(self.loop, make_conflict_set(engine),
+            Resolver(self.loop,
+                     make_conflict_set(engine,
+                                       len(self.spec["resolver"])),
                      init_version=start_version),
         )
         self.epoch = epoch
@@ -1501,7 +1521,9 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         from foundationdb_tpu.runtime.resolver import Resolver
 
         engine = spec.get("engine", "cpu")
-        t.serve("resolver", Resolver(loop, make_conflict_set(engine)))
+        t.serve("resolver",
+                Resolver(loop, make_conflict_set(engine,
+                                                 len(spec["resolver"]))))
     elif role == "tlog":
         from foundationdb_tpu.runtime.tlog import TLog
 

@@ -32,22 +32,44 @@ from foundationdb_tpu.runtime.sequencer import EPOCH_VERSION_JUMP, Sequencer
 from foundationdb_tpu.runtime.shardmap import KeyShardMap
 from foundationdb_tpu.runtime.storage import StorageServer
 from foundationdb_tpu.runtime.tlog import TLog
+from foundationdb_tpu.core.types import (
+    validate_wave_commit as _validate_wave_commit,
+    wave_commit_env_default as _wave_commit_default,
+)
 from foundationdb_tpu.sim.network import SimNetwork
 
 
-def new_conflict_set(engine: str):
+def new_conflict_set(engine: str, wave_commit: bool | None = None):
+    """Conflict-engine factory (the ``newConflictSet()`` seam).
+
+    ``wave_commit`` selects the reorder-don't-abort resolve mode
+    (conflict-graph wave scheduling; only true cycles abort). None reads
+    the FDB_TPU_WAVE_COMMIT env flag so A/B harnesses can flip whole sim
+    clusters per-subprocess without code changes."""
+    if wave_commit is None:
+        wave_commit = _wave_commit_default()
     if engine == "oracle":
         from foundationdb_tpu.sim.oracle import OracleConflictSet
 
-        return OracleConflictSet()
+        return OracleConflictSet(wave_commit=wave_commit)
+    if engine == "oracle-replay":
+        # Oracle that PROVES each wave schedule by sequential replay inline
+        # (raises on any serializability violation) — the wave-commit A/B's
+        # verification engine; identical to "oracle" when wave_commit off.
+        from foundationdb_tpu.sim.oracle import ReplayCheckedOracle
+
+        return ReplayCheckedOracle(wave_commit=wave_commit)
     if engine == "cpp":
         from foundationdb_tpu.models.cpu_conflict_set import CPUSkipListConflictSet
 
+        if wave_commit:
+            _validate_wave_commit(skiplist_engine="cpp")
         return CPUSkipListConflictSet()
     if engine == "tpu":
         from foundationdb_tpu.models.conflict_set import TPUConflictSet
 
-        return TPUConflictSet(capacity=1 << 14, batch_size=256)
+        return TPUConflictSet(capacity=1 << 14, batch_size=256,
+                              wave_commit=wave_commit)
     raise ValueError(f"unknown conflict engine {engine!r}")
 
 
@@ -79,6 +101,7 @@ class SimCluster:
         storage_engine: str = "sqlite",
         resolver_budget_s: float = 0.0,
         resolver_dispatch_cost_s: float = 0.0,
+        wave_commit: bool | None = None,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -146,6 +169,16 @@ class SimCluster:
         # backpressure loop) observable under simulation.
         self.resolver_budget_s = resolver_budget_s
         self.resolver_dispatch_cost_s = resolver_dispatch_cost_s
+        # Wave-commit resolve mode (reorder-don't-abort; None = the
+        # FDB_TPU_WAVE_COMMIT env default). A wave engine reorders txns
+        # within its own view, so it must see EVERY conflict edge of its
+        # window: role-level multi-resolver deployments clip ranges per
+        # key shard and would reorder against incomplete graphs — refuse
+        # the combination rather than silently un-serialize.
+        self.wave_commit = (_wave_commit_default() if wave_commit is None
+                            else bool(wave_commit))
+        if self.wave_commit:
+            _validate_wave_commit(n_resolvers=n_resolvers)
         # Operator tag quotas survive recoveries: the dict is SHARED with
         # each generation's Ratekeeper (set_tag_quota mutates it in
         # place), so a newly recruited ratekeeper inherits every quota —
@@ -578,7 +611,9 @@ class SimCluster:
         self.sequencer_ep = host("master" + sfx, "sequencer", self.sequencer)
 
         self.resolvers = [
-            Resolver(self.loop, new_conflict_set(self.engine),
+            Resolver(self.loop,
+                     new_conflict_set(self.engine,
+                                      wave_commit=self.wave_commit),
                      init_version=start_version,
                      budget_s=self.resolver_budget_s,
                      dispatch_cost_s=self.resolver_dispatch_cost_s)

@@ -11,21 +11,42 @@ fdbserver/ConflictSet.h exactly:
   with version > read_version, or overlaps a write range of an EARLIER
   ACCEPTED txn in the same batch;
 - accepted txns' write ranges enter the history at the batch commit version.
+
+``wave_commit=True`` replaces the third rule with the reorder-don't-abort
+schedule (conflict_kernel phase 2b): the intra-batch constraint
+"i must serialize before j" exists exactly when reads(i) ∩ writes(j) ≠ ∅,
+the constraint digraph is leveled into commit WAVES, and only txns on true
+cycles abort — one deterministic min-index victim per stall, replaying the
+kernel's ``_cycle_victim`` walk byte-for-byte so engine/oracle parity holds
+on verdicts AND schedules (``last_wave``).
 """
 
 from __future__ import annotations
 
-from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.core.types import (
+    WAVE_LEVEL_CYCLE as LEVEL_CYCLE,
+    WAVE_LEVEL_NONE as LEVEL_NONE,
+    KeyRange,
+    TxnConflictInfo,
+    Verdict,
+)
 
 
 class OracleConflictSet:
-    def __init__(self) -> None:
+    def __init__(self, wave_commit: bool = False) -> None:
         self.history: list[tuple[KeyRange, int]] = []
         self.oldest_version = 0
+        self.wave_commit = wave_commit
         # Exact conflicting read ranges of the LAST resolve call, by txn
         # index — only recorded for txns that asked (report_conflicting_keys;
         # reference: conflictingKRIndices in ResolveTransactionBatchReply).
         self.last_conflicting: dict[int, list[KeyRange]] = {}
+        # Wave levels of the LAST resolve call (wave_commit engines only):
+        # >= 0 committed at that wave, LEVEL_CYCLE aborted on a true
+        # cycle, LEVEL_NONE every other non-commit. last_reordered counts
+        # the commits past wave 0 (same contract as TPUConflictSet).
+        self.last_wave: list[int] | None = None
+        self.last_reordered: int | None = None
 
     def resolve(
         self,
@@ -35,6 +56,8 @@ class OracleConflictSet:
     ) -> list[Verdict]:
         if oldest_version is not None:
             self.oldest_version = max(self.oldest_version, oldest_version)
+        if self.wave_commit:
+            return self._resolve_wave(txns, commit_version)
         verdicts: list[Verdict] = []
         accepted_writes: list[KeyRange] = []
         self.last_conflicting = {}
@@ -64,3 +87,249 @@ class OracleConflictSet:
             (w, v) for (w, v) in self.history if v > self.oldest_version
         ]
         return verdicts
+
+    # -- wave commit (reorder-don't-abort) ----------------------------------
+
+    def _resolve_wave(
+        self, txns: list[TxnConflictInfo], commit_version: int
+    ) -> list[Verdict]:
+        n = len(txns)
+        self.last_conflicting = {}
+        level = [LEVEL_NONE] * n
+        verdicts: list[Verdict | None] = [None] * n
+        reads = [[r for r in t.read_ranges if not r.empty] for t in txns]
+        writes = [[w for w in t.write_ranges if not w.empty] for t in txns]
+
+        # History gate first (unchanged from sequential acceptance): the
+        # wave schedule only reorders txns whose reads are clean against
+        # every PRIOR batch.
+        cand: list[int] = []
+        for i, t in enumerate(txns):
+            if reads[i] and t.read_version < self.oldest_version:
+                verdicts[i] = Verdict.TOO_OLD
+                continue
+            hist = [
+                r for r in reads[i]
+                if any(r.overlaps(w) and v > t.read_version
+                       for (w, v) in self.history)
+            ]
+            if hist:
+                verdicts[i] = Verdict.CONFLICT
+                if t.report_conflicting_keys:
+                    self.last_conflicting[i] = hist
+                continue
+            cand.append(i)
+
+        # pred[j] = {i : reads(i) ∩ writes(j) ≠ ∅} — i must serialize
+        # BEFORE j (i must not observe j's write). Candidates only,
+        # diagonal excluded — exactly _pred_matrix_packed's bitset.
+        pred: dict[int, set[int]] = {
+            j: {
+                i for i in cand
+                if i != j and any(
+                    r.overlaps(w) for r in reads[i] for w in writes[j]
+                )
+            }
+            for j in cand
+        }
+
+        undet = set(cand)
+        wave = 0
+        while undet:
+            ready = sorted(j for j in undet if not (pred[j] & undet))
+            if ready:
+                for j in ready:
+                    level[j] = wave
+                wave += 1
+                undet.difference_update(ready)
+            else:
+                victim = self._cycle_victim(pred, undet, n)
+                level[victim] = LEVEL_CYCLE
+                undet.discard(victim)
+
+        committed_writes = [w for j in cand if level[j] >= 0 for w in writes[j]]
+        for i in cand:
+            if level[i] >= 0:
+                verdicts[i] = Verdict.COMMITTED
+                continue
+            verdicts[i] = Verdict.CONFLICT
+            if txns[i].report_conflicting_keys:
+                # A cycle victim's losers: its reads overlapping same-batch
+                # WINNERS' writes (those land at commit_version; a repair
+                # replay at commit_version-1 re-validates over a window
+                # that includes them — see repair/engine.py's soundness
+                # argument). Degrades to the full read set if the cycle
+                # was broken before its peers committed.
+                lost = [
+                    r for r in reads[i]
+                    if any(r.overlaps(w) for w in committed_writes)
+                ]
+                self.last_conflicting[i] = lost or list(reads[i])
+        self.history.extend(
+            (w, commit_version) for w in committed_writes
+        )
+        self.history = [
+            (w, v) for (w, v) in self.history if v > self.oldest_version
+        ]
+        self.last_wave = level
+        self.last_reordered = sum(1 for lv in level if lv > 0)
+        return verdicts  # type: ignore[return-value]
+
+    @staticmethod
+    def _cycle_victim(pred: dict[int, set[int]], undet: set[int],
+                      n: int) -> int:
+        """The kernel's deterministic exactly-on-a-cycle victim rule
+        (conflict_kernel._cycle_victim), replayed on the host: from the
+        lowest-index stuck txn, follow the minimum-index undetermined
+        predecessor n steps (entering the walk's unique terminal cycle),
+        then n more tracking the minimum index visited — at least one
+        full loop, so the result is that cycle's minimum member. Any step
+        count exceeding every entry distance and cycle length yields the
+        same victim, which is why the kernel's padded-size walk and this
+        n-step walk agree byte-for-byte."""
+        j = min(undet)
+        for _ in range(n):
+            j = min(pred[j] & undet)
+        m = j
+        for _ in range(n):
+            j = min(pred[j] & undet)
+            m = min(m, j)
+        return m
+
+
+class ReplayCheckedOracle(OracleConflictSet):
+    """OracleConflictSet that PROVES each wave verdict by sequential
+    replay, inline, on every resolve call.
+
+    With ``wave_commit=True`` every resolve snapshots the pre-batch
+    history and runs ``replay_wave_schedule`` over the verdicts it is
+    about to return — a sequential executor replaying the realized
+    (wave, index) order must agree byte-for-byte, or the resolve raises
+    instead of answering. This is the engine behind the wave-commit A/B's
+    "oracle-verified serializability" claim (repair/bench.py) and the
+    nemesis campaigns' exactness rule. With ``wave_commit=False`` the
+    sequential oracle's acceptance rule IS sequential replay (each txn is
+    validated against the already-replayed prefix), so the subclass adds
+    nothing beyond the shared entry point for A/B harnesses.
+    """
+
+    def resolve(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> list[Verdict]:
+        if not self.wave_commit:
+            return super().resolve(txns, commit_version, oldest_version)
+        if oldest_version is not None:
+            # Mirror the base class's floor advance BEFORE snapshotting:
+            # replay must judge TOO_OLD against the same floor.
+            self.oldest_version = max(self.oldest_version, oldest_version)
+        history_before = list(self.history)
+        floor_before = self.oldest_version
+        verdicts = super().resolve(txns, commit_version, None)
+        replay_wave_schedule(
+            txns, verdicts, self.last_wave, history_before, floor_before
+        )
+        return verdicts
+
+
+def replay_wave_schedule(
+    txns: list[TxnConflictInfo],
+    verdicts: list[Verdict],
+    levels: list[int],
+    history: list[tuple[KeyRange, int]],
+    oldest_version: int = 0,
+) -> None:
+    """Sequentially replay a wave schedule and raise AssertionError on any
+    serializability violation — the acceptance check behind the wave-commit
+    A/B (ISSUE 7): a sequential executor visiting committed txns in
+    realized order (wave level, then batch index) must reproduce the
+    engine's verdicts byte-for-byte.
+
+    Checks, against ``history`` as it stood BEFORE the batch:
+    - every committed txn's reads overlap no historical write past its
+      read version and no write of a txn EARLIER in the realized order;
+    - every committed txn with reads is within the MVCC window;
+    - every CONFLICT either fails the history gate or sits on a true
+      cycle of the candidate constraint graph (cycle-only aborts);
+    - levels respect the constraint digraph: reads(i) ∩ writes(j) ≠ ∅
+      for committed i, j implies level(i) < level(j).
+    """
+    reads = [[r for r in t.read_ranges if not r.empty] for t in txns]
+    writes = [[w for w in t.write_ranges if not w.empty] for t in txns]
+    order = sorted(
+        (i for i, v in enumerate(verdicts) if v == Verdict.COMMITTED),
+        key=lambda i: (levels[i], i),
+    )
+    replayed: list[KeyRange] = []
+    for i in order:
+        assert levels[i] >= 0, f"txn {i}: committed without a wave level"
+        t = txns[i]
+        if reads[i]:
+            assert t.read_version >= oldest_version, (
+                f"txn {i}: committed outside the MVCC window"
+            )
+        for r in reads[i]:
+            assert not any(
+                r.overlaps(w) and v > t.read_version for (w, v) in history
+            ), f"txn {i}: committed over a history conflict"
+            assert not any(r.overlaps(w) for w in replayed), (
+                f"txn {i}: read overlaps an earlier-ordered write — the "
+                f"realized order is not serial"
+            )
+        replayed.extend(writes[i])
+    # Ordering respects every constraint edge among committed txns.
+    for i in order:
+        for j in order:
+            if i != j and any(
+                r.overlaps(w) for r in reads[i] for w in writes[j]
+            ):
+                assert levels[i] < levels[j], (
+                    f"edge {i}->{j} violated: level {levels[i]} !< {levels[j]}"
+                )
+    # Cycle-only aborts: every intra-batch CONFLICT must lie on a cycle of
+    # the candidate graph (candidates = txns passing the history gate).
+    cand = [
+        i for i, v in enumerate(verdicts)
+        if v != Verdict.TOO_OLD and not (
+            reads[i] and any(
+                r.overlaps(w) and v2 > txns[i].read_version
+                for r in reads[i] for (w, v2) in history
+            )
+        )
+    ]
+    cset = set(cand)
+    pred = {
+        j: {
+            i for i in cand
+            if i != j and any(
+                r.overlaps(w) for r in reads[i] for w in writes[j]
+            )
+        }
+        for j in cand
+    }
+    for i in cand:
+        if verdicts[i] != Verdict.CONFLICT:
+            continue
+        assert levels[i] == LEVEL_CYCLE, (
+            f"txn {i}: intra-batch abort without the cycle level"
+        )
+        assert _on_cycle(i, pred, cset), (
+            f"txn {i}: aborted but lies on no cycle of the constraint graph"
+        )
+
+
+def _on_cycle(i: int, pred: dict[int, set[int]], nodes: set[int]) -> bool:
+    """Is node i on a directed cycle of the predecessor graph restricted
+    to ``nodes``? (DFS from i through predecessors back to i.)"""
+    stack, seen = [i], set()
+    while stack:
+        j = stack.pop()
+        for k in pred.get(j, ()) & nodes:
+            if k == i:
+                return True
+            if k not in seen:
+                seen.add(k)
+                stack.append(k)
+    return False

@@ -2017,7 +2017,7 @@ class ZipfRepairWorkload(Workload):
     def __init__(self, seed: int = 0, n_keys: int = 16, n_txns: int = 80,
                  n_clients: int = 8, theta: float = 0.99,
                  reads_per_txn: int = 3, repair: bool = True,
-                 repair_config=None):
+                 repair_config=None, target_pick: str = "hottest"):
         super().__init__(seed)
         self.n_keys = n_keys
         self.n_txns = n_txns
@@ -2026,6 +2026,25 @@ class ZipfRepairWorkload(Workload):
         self.reads_per_txn = reads_per_txn
         self.repair = repair
         self.repair_config = repair_config
+        # Which pick gets rewritten. "hottest" (default, the original
+        # harness): every txn RMWs the hottest key it read — concurrent
+        # readers of a hot key are also its writers, so contention is
+        # mutual (true dependency cycles; the wave-commit scheduler's
+        # WORST case — reordering can't untangle two txns that each read
+        # the other's write target). "coldest": read hot, write cold —
+        # contention is read-hot-key-vs-its-writer, which forms
+        # reader-before-writer CHAINS a wave schedule serializes without
+        # aborting (the FAFO sweet spot). The wave-commit A/B records
+        # both shapes to make the gains attributable.
+        if target_pick not in ("hottest", "coldest"):
+            # Hard error, not assert: under python -O a typo'd value would
+            # silently bench the coldest (wave-friendly) arm while the
+            # record claims the hottest — the silent-wrong-arm A/B hazard.
+            raise ValueError(
+                f"target_pick={target_pick!r} is not a valid setting; "
+                f"accepted values: hottest, coldest"
+            )
+        self.target_pick = target_pick
         self.repair_stats = None  # populated by run() when repair=True
 
     def _key(self, i: int) -> bytes:
@@ -2065,7 +2084,9 @@ class ZipfRepairWorkload(Workload):
         async def client(cid: int):
             for _ in range(counts[cid]):
                 picks = [pick() for _ in range(self.reads_per_txn)]
-                target = min(picks)  # hottest pick (rank 0 = hottest key)
+                # rank 0 = hottest key (see target_pick in __init__)
+                target = (min(picks) if self.target_pick == "hottest"
+                          else max(picks))
 
                 async def body(tr, picks=picks, target=target):
                     vals = {}

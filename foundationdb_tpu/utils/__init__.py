@@ -15,30 +15,54 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
     a 30 min run. Safe to call before or after backend init; silently a
     no-op if the running JAX lacks the config knobs.
 
-    CPU guard: jaxlib 0.4.36's CPU executable deserialization is UNSOUND
-    for mesh/shard_map programs — reloading a persisted executable heap-
-    corrupts the process (nondeterministic segfaults/aborts/hangs in any
-    warm-cache run of the 8-virtual-device suite; cold runs pass, and a
-    reload can even hit within ONE process when a second engine instance
-    recompiles the same shapes). Per-call opt-outs don't exist: jax
-    memoizes the cache-enabled check at the first jit. CPU compiles of
-    this repo's shapes cost seconds, so CPU-pinned processes (the test
-    suite, bench's cpu-mesh child, FORCE_CPU fallbacks) simply keep the
-    persistent cache OFF; ``FDB_TPU_CPU_CACHE=1`` re-enables it for
-    debugging the upstream issue.
+    CPU guard (utils/cache_guard): jaxlib 0.4.36's CPU executable
+    deserialization is UNSOUND for mesh/shard_map programs — reloading a
+    persisted executable heap-corrupts the process (nondeterministic
+    segfaults/aborts/hangs in any warm-cache run of the 8-virtual-device
+    suite; cold runs pass, and a reload can even hit within ONE process
+    when a second engine instance recompiles the same shapes). Per-call
+    opt-outs don't exist: jax memoizes the cache-enabled check at the
+    first jit. So on CPU-pinned processes (the test suite, bench's
+    cpu-mesh child, FORCE_CPU fallbacks) the cache-warm deserialization
+    is ISOLATED in guard subprocesses: the persistent cache turns on
+    exactly when the guard's populate + warm-reload probe proves the
+    running jaxlib reloads clean, with the verdict memoized per jaxlib
+    version — the known-bad 0.4.36 pin short-circuits to off, a future
+    jaxlib bump auto-probes once and re-enables. ``FDB_TPU_CPU_CACHE``:
+    ``1`` forces on, ``0`` forces off, ``probe`` re-runs the guard.
     """
     import jax
 
-    if os.environ.get("FDB_TPU_CPU_CACHE") != "1" and (
+    cache_dir = cache_dir or os.path.join(_REPO_ROOT, ".jax_cache")
+    knob = os.environ.get("FDB_TPU_CPU_CACHE")
+    if knob is not None:
+        from foundationdb_tpu.core.types import env_choice
+
+        env_choice("FDB_TPU_CPU_CACHE", knob, ("0", "1", "probe"))
+    if knob != "1" and (
         "cpu" in os.environ.get("JAX_PLATFORMS", "")
         or os.environ.get("FDB_TPU_FORCE_CPU") == "1"
     ):
-        return
+        from foundationdb_tpu.utils import cache_guard
+
+        if knob == "0":
+            return
+        try:
+            if knob == "probe":
+                if not cache_guard.probe(cache_dir).get("safe"):
+                    return
+            elif not cache_guard.cpu_cache_safe(cache_dir,
+                                                probe_missing=False):
+                # No verdict for this jaxlib yet: a background probe was
+                # kicked (memoized for the NEXT process) — this one must
+                # not stall its own import for minutes of guard compiles.
+                return
+        except OSError:
+            # Verdict bookkeeping touches <cache_dir> — on a read-only
+            # mount startup must degrade to cache-off, not crash.
+            return
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"),
-        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:

@@ -1,0 +1,304 @@
+"""Guard-subprocess isolation for JAX's persistent compile cache on CPU.
+
+jaxlib 0.4.36's CPU deserialization of persisted mesh/shard_map
+executables is UNSOUND: reloading them heap-corrupts the process
+(nondeterministic segfaults/aborts/hangs — reproduced 2026-08 as a
+SIGSEGV in a warm-cache run of tests/test_sharded_resolver.py; cold runs
+pass). jax memoizes the cache-enabled check at the first jit, so there is
+no per-program opt-out: a process either trusts deserialization or keeps
+the persistent cache off.
+
+This module turns the former blanket disable into a probed, versioned
+verdict: the dangerous cache-warm deserialization runs only in
+SACRIFICIAL GUARD SUBPROCESSES (``python -m
+foundationdb_tpu.utils.cache_guard --cache-dir D``), a populate + N
+warm-reload probe decides whether the RUNNING jaxlib reloads clean, and
+the verdict is memoized in ``<cache_dir>/CPU_GUARD.json`` keyed by the
+jaxlib version. ``enable_compilation_cache`` then re-enables the
+persistent cache on CPU-pinned processes exactly when the verdict is
+safe:
+
+- jaxlib in ``KNOWN_BAD_JAXLIB`` → unsafe without probing (the crash is
+  already on file; the memoized verdict records ``probed: false``);
+- any OTHER jaxlib (i.e. after an upgrade) with no verdict on file →
+  one-time auto-probe, then the memoized answer. Import-time callers
+  (``enable_compilation_cache``) never run the probe on their own
+  critical path: they kick it in a detached background prober
+  (lockfile-deduped) and stay cache-off until its verdict lands;
+- ``FDB_TPU_CPU_CACHE=1`` forces the cache ON (debugging the upstream
+  bug), ``FDB_TPU_CPU_CACHE=0`` forces it OFF, ``FDB_TPU_CPU_CACHE=probe``
+  discards the memoized verdict and re-probes.
+
+The guard workload compiles the corrupting executable class — the
+8-virtual-device shard_map mesh engine plus the packed single-device
+kernels, TWO engine instances each (a reload can hit within one process
+when a second instance recompiles the same shapes) — cold once to
+populate, then warm, where deserialization strikes. A crash, hang, or
+nonzero exit in any warm run marks the jaxlib unsafe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+#: jaxlib versions with the deserialization bug already reproduced — the
+#: probe is skipped and the verdict written unsafe (see module docstring
+#: for the 0.4.36 reproduction).
+KNOWN_BAD_JAXLIB = ("0.4.36",)
+
+VERDICT_FILE = "CPU_GUARD.json"
+
+#: Warm reloads per probe. The failure is nondeterministic, so one clean
+#: reload proves little; each run is a fresh process over the same cache.
+RELOAD_RUNS = 2
+
+_GUARD_TIMEOUT_S = 420.0
+
+
+def _jaxlib_version() -> str:
+    import jaxlib
+
+    return jaxlib.__version__
+
+
+def _workload() -> None:
+    """The cache-warm deserialization victim (runs INSIDE the guard).
+
+    Exercises the executable classes the bug hits: the shard_map mesh
+    engine on 8 virtual devices (resolve + window resolve_many + rebase)
+    and the packed single-device kernels, each from two engine instances
+    so the in-process second-compile reload path runs too.
+    """
+    from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo
+    from foundationdb_tpu.models.conflict_set import TPUConflictSet
+    from foundationdb_tpu.parallel.sharded_resolver import ShardedConflictSet
+
+    def txns(n: int, rv: int):
+        return [
+            TxnConflictInfo(
+                read_ranges=[KeyRange(b"k%04d" % i, b"k%04d\x00" % i)],
+                write_ranges=[KeyRange(b"k%04d" % (i + 1),
+                                       b"k%04d\x00" % (i + 1))],
+                read_version=rv,
+                report_conflicting_keys=(i % 3 == 0),
+            )
+            for i in range(n)
+        ]
+
+    for eng in (
+        lambda: ShardedConflictSet(capacity=1 << 10, batch_size=32),
+        lambda: ShardedConflictSet(capacity=1 << 10, batch_size=32),
+        lambda: TPUConflictSet(capacity=1 << 10, batch_size=32),
+        lambda: TPUConflictSet(capacity=1 << 10, batch_size=32,
+                               wave_commit=True),
+    ):
+        cs = eng()
+        v = 100
+        for _ in range(3):
+            cs.resolve(txns(40, v - 1), v, oldest_version=0)
+            v += 10
+        cs.advance(v, v - 50)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    cache_dir = None
+    it = iter(args)
+    for a in it:
+        if a == "--cache-dir":
+            cache_dir = next(it, None)
+    if not cache_dir:
+        print("usage: cache_guard --cache-dir DIR", file=sys.stderr)
+        return 2
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _workload()
+    print("GUARD_OK")
+    return 0
+
+
+def _guard_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # The guard must make its own cache decision, not inherit a forced one.
+    env.pop("FDB_TPU_CPU_CACHE", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    return env
+
+
+def _run_guard(cache_dir: str) -> tuple[str, str]:
+    """→ (status, detail); status is "ok", "crash" (signal death — a
+    documented corruption mode EVEN on the populate run, whose second
+    engine instance reloads the just-persisted executables in-process),
+    "timeout" (the caller decides: a WARM hang is the documented
+    corruption, a COLD one is just a slow machine — a hung populate
+    compiled slowly BEFORE any second-instance reload could start), or
+    "error" (ordinary nonzero exit — an import error, a stripped env —
+    which says nothing about deserialization soundness)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "foundationdb_tpu.utils.cache_guard",
+             "--cache-dir", cache_dir],
+            env=_guard_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            timeout=_GUARD_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return "timeout", "guard hung (timeout)"
+    if proc.returncode == 0 and b"GUARD_OK" in proc.stdout:
+        return "ok", "clean"
+    detail = (
+        f"guard exited {proc.returncode}: "
+        + proc.stdout[-300:].decode("utf-8", "replace").strip()
+    )
+    return ("crash" if proc.returncode < 0 else "error"), detail
+
+
+def read_verdict(cache_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(cache_dir, VERDICT_FILE)) as f:
+            verdict = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if verdict.get("jaxlib") != _jaxlib_version():
+        return None  # stale: probe again on the new jaxlib
+    return verdict
+
+
+def write_verdict(cache_dir: str, verdict: dict) -> None:
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = os.path.join(cache_dir, VERDICT_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(verdict, f, indent=1)
+    os.replace(tmp, os.path.join(cache_dir, VERDICT_FILE))
+
+
+def probe(cache_dir: str, runs: int = RELOAD_RUNS) -> dict:
+    """Populate + warm-reload the cache in guard subprocesses; memoize.
+
+    Only conclusive outcomes are memoized: every run clean → safe, any
+    run CRASHING (signal death / hang, the corruption's modes) → unsafe.
+    An ordinary guard failure (positive exit — transient machine trouble,
+    not deserialization) answers unsafe for THIS process but writes no
+    verdict, so one CI hiccup can't permanently tax every later process
+    with the recompile cost the cache exists to remove."""
+    version = _jaxlib_version()
+    verdict: dict = {"jaxlib": version, "probed": True, "reload_runs": runs}
+    status, detail = _run_guard(cache_dir)  # populate (cold on first use)
+    if status == "timeout":
+        # A cold populate never deserializes, so a hang here is machine
+        # slowness, not the corruption — inconclusive like "error".
+        status = "error"
+    if status == "ok":
+        for _ in range(runs):  # warm: deserialization is the hazard
+            status, detail = _run_guard(cache_dir)
+            if status != "ok":
+                break
+        if status == "timeout":
+            status = "crash"  # a WARM hang is the documented hang mode
+    verdict["safe"] = status == "ok"
+    verdict["detail"] = detail
+    if status == "error":
+        verdict["transient"] = True
+        return verdict  # inconclusive: re-probe next process
+    write_verdict(cache_dir, verdict)
+    return verdict
+
+
+def cpu_cache_safe(cache_dir: str, probe_missing: bool = True) -> bool:
+    """Is the persistent cache safe on THIS jaxlib's CPU backend?
+
+    Memoized verdict if on file; KNOWN_BAD_JAXLIB short-circuits to
+    unsafe (recorded, never probed); otherwise a one-time probe when
+    ``probe_missing`` — False instead KICKS the probe in a detached
+    background process and reports unsafe for now, for callers that must
+    not block (``enable_compilation_cache`` runs at import; a synchronous
+    first-post-upgrade probe would stall process startup for minutes).
+    The background probe memoizes, so the processes after it read the
+    real verdict — "auto-probes once and re-enables" still holds, just
+    never on a caller's critical path.
+    """
+    verdict = read_verdict(cache_dir)
+    if verdict is not None:
+        return bool(verdict.get("safe"))
+    version = _jaxlib_version()
+    if version in KNOWN_BAD_JAXLIB:
+        write_verdict(cache_dir, {
+            "jaxlib": version, "probed": False, "safe": False,
+            "detail": "known-bad pin: persisted mesh/shard_map executable "
+                      "deserialization heap-corrupts (SIGSEGV reproduced "
+                      "warm-running tests/test_sharded_resolver.py)",
+        })
+        return False
+    if not probe_missing:
+        kick_background_probe(cache_dir)
+        return False
+    return bool(probe(cache_dir).get("safe"))
+
+
+#: One probe at a time: the kicker takes <cache_dir>/CPU_GUARD.json.probing
+#: with O_EXCL; a lock this old belongs to a dead prober (the probe's own
+#: worst case is (1 + RELOAD_RUNS) guard timeouts) and is reclaimed.
+_PROBE_LOCK_STALE_S = (1 + RELOAD_RUNS) * _GUARD_TIMEOUT_S + 120.0
+
+
+def kick_background_probe(cache_dir: str) -> bool:
+    """Start ``probe(cache_dir)`` in a detached child unless a verdict
+    already exists or another prober holds the lock; → True if kicked."""
+    import time
+
+    if read_verdict(cache_dir) is not None:
+        return False
+    os.makedirs(cache_dir, exist_ok=True)
+    lock = os.path.join(cache_dir, VERDICT_FILE + ".probing")
+    try:
+        if time.time() - os.path.getmtime(lock) < _PROBE_LOCK_STALE_S:
+            return False  # a live prober owns it
+        # Atomic reclaim: rename wins for exactly ONE racer — an
+        # unlink-then-create here could delete a RIVAL's fresh lock and
+        # double-spawn, the duplication the lock exists to prevent.
+        claimed = f"{lock}.stale.{os.getpid()}"
+        os.rename(lock, claimed)
+        os.unlink(claimed)
+    except OSError:
+        pass  # no lock, it vanished, or a rival reclaimed first
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except OSError:
+        return False  # raced: the other kicker's child will memoize
+    os.close(fd)
+    subprocess.Popen(
+        [sys.executable, "-c",
+         "import os, sys\n"
+         "cache_dir, lock = sys.argv[1], sys.argv[2]\n"
+         "from foundationdb_tpu.utils import cache_guard\n"
+         "try:\n"
+         "    cache_guard.probe(cache_dir)\n"
+         "finally:\n"
+         "    try:\n"
+         "        os.unlink(lock)\n"
+         "    except OSError:\n"
+         "        pass\n",
+         cache_dir, lock],
+        env=_guard_env(),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    return True
+
+
+if __name__ == "__main__":
+    sys.exit(main())

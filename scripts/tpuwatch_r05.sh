@@ -120,6 +120,14 @@ while true; do
     'r.get("metric") == "sched_ab_fixed_vs_adaptive" and r.get("fixed_windowed_txns_per_sec") and r.get("adaptive_txns_per_sec")' -- \
     env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=SCHED_AB_r05_rec.json \
     bash scripts/sched_ab.sh || { sleep 60; continue; }
+  # Wave-commit A/B (reorder-don't-abort): CPU-only deterministic sim —
+  # FDB_TPU_WAVE_COMMIT=0 vs 1 on the same seeds, replay-checked oracle
+  # serializability, goodput ratio strictly above the repair-only
+  # baseline (the artifact's own `valid` gates all of it).
+  stage ab_wave 1800 WAVE_AB_r05.json \
+    'r.get("metric") == "wave_commit_ab" and r.get("valid")' -- \
+    env OUT=WAVE_AB_r05_rec.json bash scripts/wave_ab.sh \
+    || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"

@@ -32,7 +32,7 @@ def _writer(i: int, rv: int) -> TxnConflictInfo:
 
 
 def _drive(loop, res, prev, version, txns, oldest=None):
-    verdicts, _conflicting, _fail_safe = loop.run(
+    verdicts, _conflicting, _fail_safe, _wave = loop.run(
         res.resolve(prev, version, txns, oldest_version=oldest)
     )
     return verdicts

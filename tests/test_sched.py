@@ -320,7 +320,7 @@ class TestResolverDispatchQueue:
             return [await t for t in tasks]
 
         replies = loop.run(main(), timeout=10)
-        got = [v for verdicts, _c, _fs in replies for v in verdicts]
+        got = [v for verdicts, _c, _fs, _w in replies for v in verdicts]
         want = []
         for i, txns in enumerate(batches):
             want.extend(oracle.resolve(txns, (i + 1) * 10, 0))
