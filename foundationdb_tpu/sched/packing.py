@@ -65,12 +65,38 @@ class PipelinedWindowRunner:
 
     # -- submit / dispatch / collect ------------------------------------------
 
+    def _put_draining(self, item) -> None:
+        """Blocking put on the bounded request queue that can never
+        deadlock with a deferred resident repack: if the pack worker is
+        parked on the mirror gate (a _RepackPlan awaiting dispatch), the
+        queue stops draining — so while the put is full-blocked, keep
+        dispatching ready windows from THIS (the dispatch) thread, which
+        executes the plan, reopens the gate, and unblocks the worker."""
+        while True:
+            mirror = getattr(self._cs, "_mirror", None)
+            if mirror is not None and not mirror.gate.is_set():
+                self.dispatch_ready()
+            try:
+                self._req_q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
     def submit(self, wire, commit_versions, count: int) -> None:
         """Queue a window for packing (call in commit-version order)."""
         self.windows_submitted += 1
         if self._threaded:
-            self._req_q.put((wire, list(commit_versions), count))
+            self._put_draining((wire, list(commit_versions), count))
         else:
+            # A deferred resident-dictionary repack (conflict_set
+            # _RepackPlan) parks the mirror gate until its window
+            # DISPATCHES; packing inline on this same thread would
+            # deadlock on the gate, so drain the ready windows first —
+            # dispatching them is exactly what the threaded mode's main
+            # loop would have done concurrently.
+            mirror = getattr(self._cs, "_mirror", None)
+            if mirror is not None and not mirror.gate.is_set():
+                self.dispatch_ready()
             t0 = time.perf_counter()
             self._ready.append(
                 self._cs.pack_wire_window(wire, list(commit_versions), count)
@@ -121,5 +147,5 @@ class PipelinedWindowRunner:
 
     def close(self) -> None:
         if self._threaded:
-            self._req_q.put(None)
+            self._put_draining(None)
             self._worker.join(timeout=5.0)

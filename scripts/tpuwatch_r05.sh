@@ -120,6 +120,13 @@ while true; do
     'r.get("metric") == "sched_ab_fixed_vs_adaptive" and r.get("fixed_windowed_txns_per_sec") and r.get("adaptive_txns_per_sec")' -- \
     env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=SCHED_AB_r05_rec.json \
     bash scripts/sched_ab.sh || { sleep 60; continue; }
+  # Resident-dictionary A/B (device-resident history + incremental
+  # deltas): FDB_TPU_RESIDENT=1 vs 0, same seeds — host pack ms/window,
+  # dictionary economics, and the modeled roofline bytes cut.
+  stage ab_resident 2000 RESIDENT_AB_r05.json \
+    'r.get("metric") == "resident_ab_dictionary" and r.get("host_pack_ratio")' -- \
+    env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=RESIDENT_AB_r05_rec.json \
+    bash scripts/resident_ab.sh || { sleep 60; continue; }
   # Wave-commit A/B (reorder-don't-abort): CPU-only deterministic sim —
   # FDB_TPU_WAVE_COMMIT=0 vs 1 on the same seeds, replay-checked oracle
   # serializability, goodput ratio strictly above the repair-only

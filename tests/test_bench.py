@@ -62,7 +62,7 @@ def test_bench_stream_three_way_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches
     )
-    _, tpu_conf, overflowed, tpu_lat, _occ = bench.run_tpu_wire(
+    _, tpu_conf, overflowed, tpu_lat, _occ, _x = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1
     )
     assert not overflowed
@@ -103,7 +103,7 @@ def test_mode_streams_three_way_parity():
         assert blob[: int(ends[mode.batch])].tobytes() == \
             encode_resolve_batch(txns), mode_name
 
-        _, tpu_conf, overflow, _lat, _occ = bench.run_tpu_wire(
+        _, tpu_conf, overflow, _lat, _occ, _x = bench.run_tpu_wire(
             n_batches, 1 << 14, blob, ends, repeats=1, mode=mode
         )
         assert not overflow
@@ -129,10 +129,10 @@ def test_sharded_resolver_mode_parity():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, conf1, _, _l1, _o1 = bench.run_tpu_wire(
+    _, conf1, _, _l1, _o1, _x1 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=1
     )
-    _, conf4, _, _l4, occ4 = bench.run_tpu_wire(
+    _, conf4, _, _l4, occ4, _x4 = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, n_resolvers=4
     )
     assert conf1 == conf4
@@ -152,7 +152,7 @@ def test_adaptive_dispatch_parity_and_record_shape():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, fixed_conf, _, _lat, _occ = bench.run_tpu_wire(
+    _, fixed_conf, _, _lat, _occ, _x = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, window=2
     )
     rec = bench.run_tpu_adaptive(
@@ -210,7 +210,7 @@ def test_latency_and_roofline_fields():
     blob, ends = bench.build_wire_stream(
         read_ids, write_ids, write_mask, lag, n_batches, mode
     )
-    _, _, _, lat, _occ = bench.run_tpu_wire(
+    _, _, _, lat, _occ, _x = bench.run_tpu_wire(
         n_batches, 1 << 14, blob, ends, repeats=1, mode=mode, window=1
     )
     assert len(lat) == n_batches and all(v > 0 for v in lat)
@@ -231,11 +231,20 @@ def test_latency_and_roofline_fields():
         # >= 4x vs the unpacked kernel at the same shapes, under both
         # history designs.
         for hist in ("window", "batch"):
+            # resident=False pins the PACKED design point: the packed >=4x
+            # tentpole must keep testing packed even while the resident
+            # env default is on.
             rp = bench.roofline_estimate(m, 1 << 18, packed=True,
-                                         hist_design=hist)
+                                         hist_design=hist, resident=False)
             assert rp["bytes_per_batch_unpacked"] >= 4 * rp["bytes_per_batch"], \
                 (m, hist, rp)
             assert rp["packed_bytes_ratio"] >= 4.0
+            # Resident acceptance (ISSUE 8): the resident counterfactual
+            # cuts modeled bytes >= 1.5x further vs the packed baseline.
+            assert rp["resident_bytes_ratio"] >= 1.5, (m, hist, rp)
+            rr = bench.roofline_estimate(m, 1 << 18, packed=True,
+                                         hist_design=hist, resident=True)
+            assert rr["bytes_per_batch"] == rp["bytes_per_batch_resident"]
         ru = bench.roofline_estimate(m, 1 << 18, packed=False)
         assert ru["packed_bytes_ratio"] == 1.0
         assert ru["mxu_flops_per_batch"] > 0

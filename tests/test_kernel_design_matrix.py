@@ -45,11 +45,16 @@ assert ck._RMQ_DESIGN == os.environ.get("FDB_TPU_RMQ", "sparse")
 assert ck._HIST_DESIGN == os.environ.get("FDB_TPU_HISTORY", "window")
 assert ck._ACCEPT_DESIGN == os.environ.get("FDB_TPU_ACCEPT", "wave")
 assert ck._PACKED == (os.environ.get("FDB_TPU_PACKED", "1") != "0")
+# Resident is inert without the packed kernel (rank space needs it).
+assert ck._RESIDENT == (
+    os.environ.get("FDB_TPU_RESIDENT", "1") != "0" and ck._PACKED
+)
+wave = os.environ.get("FDB_TPU_WAVE_COMMIT", "0") == "1"
 
 rng = np.random.default_rng(29)
 cs = TPUConflictSet(capacity=512, batch_size=32, max_read_ranges=4,
                     max_write_ranges=4, max_key_bytes=8)
-oracle = OracleConflictSet()
+oracle = OracleConflictSet(wave_commit=wave)
 cv = 1000
 for batch_i in range(6):
     cv += int(rng.integers(1, 40))
@@ -57,13 +62,17 @@ for batch_i in range(6):
         rand_txn(rng, read_version=int(rng.integers(max(0, cv - 200), cv)))
         for _ in range(int(rng.integers(8, 32)))
     ]
-    for t in txns[::3]:  # loser-range report path rides along
-        object.__setattr__(t, "report_conflicting_keys", True)
+    if not wave:
+        for t in txns[::3]:  # loser-range report path rides along
+            object.__setattr__(t, "report_conflicting_keys", True)
     oldest = cv - 150
     got = cs.resolve(txns, cv, oldest_version=oldest)
     oracle.oldest_version = max(oracle.oldest_version, oldest)
     want = oracle.resolve(txns, cv)
     assert got == want, f"batch {batch_i}: {got} != {want}"
+    if wave:
+        assert cs.last_wave == oracle.last_wave, f"batch {batch_i} levels"
+        continue
     # Loser-range completeness: every oracle conflicting range must be
     # covered by the kernel's (possibly coalesced-wider) report.
     for i, ranges in oracle.last_conflicting.items():
@@ -81,12 +90,13 @@ _FLAGS = {
     "FDB_TPU_HISTORY": ("window", "batch"),
     "FDB_TPU_ACCEPT": ("wave", "seq"),
     "FDB_TPU_PACKED": ("1", "0"),
+    "FDB_TPU_RESIDENT": ("1", "0"),
 }
 
 
 def _run_combo(env_flags: dict) -> None:
     env = dict(os.environ, JAX_PLATFORMS="cpu", **env_flags)
-    for k in _FLAGS:
+    for k in list(_FLAGS) + ["FDB_TPU_WAVE_COMMIT"]:
         env.pop(k, None)
     env.update(env_flags)
     r = subprocess.run(
@@ -98,14 +108,20 @@ def _run_combo(env_flags: dict) -> None:
 
 
 # Fast tier: each non-default value flipped alone, plus the all-flipped
-# corner (defaults themselves are exercised in-process by the whole suite).
+# corner (defaults themselves are exercised in-process by the whole suite)
+# and the RESIDENT cross cases the ISSUE-8 design matrix names:
+# RESIDENT×PACKED=0 (must be inert) and RESIDENT×WAVE_COMMIT=1.
 _FAST = [
     {"FDB_TPU_PACKED": "0"},
     {"FDB_TPU_RMQ": "blocked"},
     {"FDB_TPU_HISTORY": "batch"},
     {"FDB_TPU_ACCEPT": "seq"},
+    {"FDB_TPU_RESIDENT": "0"},
+    {"FDB_TPU_RESIDENT": "1", "FDB_TPU_PACKED": "0"},
+    {"FDB_TPU_RESIDENT": "1", "FDB_TPU_WAVE_COMMIT": "1"},
     {"FDB_TPU_RMQ": "blocked", "FDB_TPU_HISTORY": "batch",
-     "FDB_TPU_ACCEPT": "seq", "FDB_TPU_PACKED": "0"},
+     "FDB_TPU_ACCEPT": "seq", "FDB_TPU_PACKED": "0",
+     "FDB_TPU_RESIDENT": "0"},
 ]
 
 
