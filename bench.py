@@ -1620,6 +1620,14 @@ def main() -> None:
                          "wave scheduling (1), sequential-order abort "
                          "(0), or the FDB_TPU_WAVE_COMMIT env default "
                          "(scripts/wave_ab.sh fixes the env per arm)")
+    ap.add_argument("--admission-ab", action="store_true",
+                    help="run the admission-subsystem A/B goodput harness "
+                         "(FDB_TPU_ADMISSION off vs on, same seeds, "
+                         "deterministic sim, oracle-verified; no TPU) and "
+                         "print the ADMISSION_AB record")
+    ap.add_argument("--admission-min-ratio", type=float, default=1.2,
+                    help="admission A/B acceptance gate on the mean "
+                         "naive-loop goodput ratio")
     ap.add_argument("--repair-target", choices=("hottest", "coldest"),
                     default="hottest",
                     help="repair-sim RMW write target among the Zipf "
@@ -1628,6 +1636,15 @@ def main() -> None:
                          "read-hot-write-cold chains (the reorderable "
                          "shape)")
     args = ap.parse_args()
+    if args.admission_ab:
+        # Pure simulation (replay-checked oracle engine): pin CPU so
+        # importing the client stack can never touch the TPU tunnel.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from foundationdb_tpu.admission.bench import run_admission_ab
+
+        rec = run_admission_ab(min_ratio=args.admission_min_ratio)
+        print(json.dumps(rec), flush=True)
+        sys.exit(0 if rec.get("valid") else 1)
     if args.repair_sim:
         # Pure simulation (the conflict engine is the python oracle): pin
         # CPU so importing the client stack can never touch the TPU tunnel.

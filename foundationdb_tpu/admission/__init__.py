@@ -1,0 +1,38 @@
+"""Admission-time early conflict detection (ROADMAP tentpole, ISSUE 9).
+
+At Zipf-contention load the cluster pays full resolve + repair cost for
+transactions that are provably doomed on arrival. This subsystem detects
+them AT ADMISSION — a device-residentable recent-writes fingerprint
+filter (filter.py) probed at GRV grant and commit-proxy batch formation
+(policy.py) — and SHAPES the outcome instead of letting the abort storm
+run: likely losers are co-scheduled into one serializing dispatch window
+(wave commit reorders them instead of aborting), proven losers are
+pre-aborted with the repair subsystem's score-scaled jittered backoff,
+and filter saturation feeds the ratekeeper next to resolver_queue.
+
+Knobs (README "Admission control"): FDB_TPU_ADMISSION (default 0),
+FDB_TPU_ADMISSION_SHAPE_RISK, FDB_TPU_ADMISSION_PREABORT,
+FDB_TPU_ADMISSION_BITS_LOG2 / _BANKS / _WINDOW.
+"""
+
+from foundationdb_tpu.admission.filter import (
+    RecentWritesFilter,
+    fingerprints,
+    key_fingerprint,
+    u64_cols_fingerprint,
+)
+from foundationdb_tpu.admission.policy import (
+    AdmissionDecision,
+    AdmissionPolicy,
+    admission_env_default,
+)
+
+__all__ = [
+    "AdmissionDecision",
+    "AdmissionPolicy",
+    "RecentWritesFilter",
+    "admission_env_default",
+    "fingerprints",
+    "key_fingerprint",
+    "u64_cols_fingerprint",
+]

@@ -1,0 +1,175 @@
+"""Admission A/B goodput harness: FDB_TPU_ADMISSION off vs on, same seed.
+
+The acceptance harness of the admission subsystem (ISSUE 9): the SAME
+Zipf-0.99 read-modify-write contention stream (sim/workloads.
+ZipfRepairWorkload) runs on fresh deterministic sim clusters with
+admission OFF and ON — same seed per pair, so the arms differ only in
+the admission subsystem — under both canonical client loops:
+
+- ``naive`` (Database.run full-restart retry): the abort-storm
+  deployment shape the subsystem targets; this is the HEADLINE pair.
+  Multiple seeds are run and the gate is the MEAN goodput ratio (the
+  naive ladder's realization variance is the dominant noise source;
+  per-seed ratios ride along, and every pair must individually favor
+  admission-on for the record to be valid).
+- ``repair`` (run_repairable partial re-execution): recorded alongside
+  at the wave-commit A/B's proven scale — admission must COMPOSE with
+  repair, not cannibalize it (pre-aborted txns degrade to the canonical
+  conflict path past the streak ceiling, so the repair engine still gets
+  its loser reports).
+
+Serializability is enforced, not assumed, on BOTH sides of every pair:
+the clusters resolve with the replay-checked brute-force oracle
+(engine "oracle-replay" — every commit set is validated by sequential
+replay, byte-for-byte) and the workload's RMW-sum invariant fails the
+run if any committed increment was lost or duplicated. Shaping never
+changes verdicts (only scheduling), so every non-shaped AND shaped txn
+alike is oracle-verified through the same resolve path.
+
+Attribution is exact per arm: CONFLICT verdicts (resolver counters),
+shaped / pre-aborted / false-positive counts (admission policy counters
+— ``shaped_committed`` is a shaped txn the engine then committed, the
+measured false-positive), and the preabort honesty invariant
+(``preaborted == len(preabort_log)``: every pre-abort carries its
+confirming committed-write evidence).
+
+Driven by ``python bench.py --admission-ab`` (scripts/admission_ab.sh →
+ADMISSION_AB.json). Pure simulation: no TPU, no JAX device work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def _state_checksum(c, db) -> str:
+    """FNV-style digest of the final key space — the byte-exact end state
+    the oracle-replayed commit set produced (recorded per arm)."""
+
+    async def dump(tr):
+        return await tr.get_range(b"", b"\xff", limit=1_000_000)
+
+    rows = c.loop.run(db.run(dump), timeout=300)
+    h = hashlib.sha256()
+    for k, v in rows:
+        h.update(k)
+        h.update(b"\x00")
+        h.update(v)
+        h.update(b"\x01")
+    return h.hexdigest()[:16]
+
+
+def _one(seed: int, repair: bool, admission: bool, n_keys: int,
+         n_txns: int, n_clients: int, timeout: float) -> dict:
+    from foundationdb_tpu.client.ryw import open_database
+    from foundationdb_tpu.sim.cluster import SimCluster
+    from foundationdb_tpu.sim.workloads import ZipfRepairWorkload, run_workload
+
+    c = SimCluster(seed=seed, engine="oracle-replay", admission=admission)
+    db = open_database(c)
+    w = ZipfRepairWorkload(seed=seed, n_keys=n_keys, n_txns=n_txns,
+                           n_clients=n_clients, repair=repair)
+    metrics = c.loop.run(run_workload(c, db, w), timeout=timeout)
+    entry = {
+        "goodput_txns_per_sec": metrics.extra.get("goodput"),
+        "elapsed_virtual_s": round(metrics.extra.get("elapsed", 0.0), 3),
+        "committed": metrics.ops,
+        "serializable": True,  # run_workload raised otherwise (replay oracle
+        # + RMW-sum conservation: every committed increment byte-accounted)
+        "conflicts": sum(r.txns_conflicted for r in c.resolvers),
+        "state_checksum": _state_checksum(c, db),
+    }
+    if repair:
+        entry["repair"] = metrics.extra.get("repair")
+    else:
+        entry["full_restarts"] = metrics.txns_retried
+    if admission:
+        pols = [p.admission for p in c.commit_proxies if p.admission]
+        counters: dict = {}
+        for pol in pols:
+            for k, v in pol.counters.items():
+                counters[k] = counters.get(k, 0) + v
+        entry["admission"] = counters
+        # Preabort honesty (the exact-attribution contract): every
+        # pre-abort logged its confirming committed-write evidence, up to
+        # the forensics log's cap (counters keep counting past it — a
+        # capped log on a big run is not missing evidence).
+        entry["preabort_evidence_complete"] = all(
+            len(pol.preabort_log)
+            == min(pol.counters["preaborted"], pol.PREABORT_LOG_CAP)
+            for pol in pols
+        )
+        entry["filter"] = pols[0].filter.metrics() if pols else None
+    return entry
+
+
+def run_admission_ab(
+    naive_seeds: tuple = (20260803, 20260804, 99),
+    naive_cfg: dict | None = None,
+    repair_seeds: tuple = (20260803, 20260804),
+    repair_cfg: dict | None = None,
+    min_ratio: float = 1.2,
+    timeout: float = 6000.0,
+) -> dict:
+    naive_cfg = naive_cfg or {"n_keys": 10, "n_txns": 600, "n_clients": 24}
+    repair_cfg = repair_cfg or {"n_keys": 12, "n_txns": 360, "n_clients": 24}
+    result: dict = {
+        "metric": "admission_ab",
+        "flag": "FDB_TPU_ADMISSION",
+        "unit": "committed txns / virtual s",
+        "workload": {"theta": 0.99, "naive": dict(naive_cfg),
+                     "repair": dict(repair_cfg)},
+        "serializability": (
+            "replay-checked oracle engine on BOTH sides of every pair "
+            "(sim/oracle.ReplayCheckedOracle: every commit set validated "
+            "by inline sequential replay, byte-for-byte) + RMW-sum "
+            "conservation checked after each run"
+        ),
+        "min_ratio": min_ratio,
+    }
+    ok = True
+    ratios = []
+    pairs = []
+    for seed in naive_seeds:
+        off = _one(seed, False, False, timeout=timeout, **naive_cfg)
+        on = _one(seed, False, True, timeout=timeout, **naive_cfg)
+        denom = off["goodput_txns_per_sec"] or 1e-9
+        ratio = round((on["goodput_txns_per_sec"] or 0.0) / denom, 3)
+        ratios.append(ratio)
+        ok = ok and ratio > 1.0 and on.get("preabort_evidence_complete", False)
+        pairs.append({"seed": seed, "off": off, "on": on, "ratio": ratio})
+    result["naive_pairs"] = pairs
+    mean = round(sum(ratios) / max(1, len(ratios)), 3)
+    result["value"] = mean
+    result["naive_ratio_mean"] = mean
+    result["naive_ratios"] = ratios
+    ok = ok and mean >= min_ratio
+
+    rpairs = []
+    for seed in repair_seeds:
+        try:
+            off = _one(seed, True, False, timeout=timeout, **repair_cfg)
+            on = _one(seed, True, True, timeout=timeout, **repair_cfg)
+        except Exception as e:  # noqa: BLE001 — the repair loop's known
+            # retry-limit wall at unlucky seeds predates this subsystem;
+            # a failed secondary pair is recorded, never hidden, and
+            # fails the record (gate on reproducible pairs only).
+            rpairs.append({"seed": seed, "error": str(e)[:200]})
+            ok = False
+            continue
+        denom = off["goodput_txns_per_sec"] or 1e-9
+        ratio = round((on["goodput_txns_per_sec"] or 0.0) / denom, 3)
+        ok = ok and ratio > 1.0
+        rpairs.append({"seed": seed, "off": off, "on": on, "ratio": ratio})
+    result["repair_pairs"] = rpairs
+    result["repair_ratios"] = [p.get("ratio") for p in rpairs]
+
+    # Honesty flags (bench record conventions; see scripts/wave_ab.sh):
+    # CPU-only BY DESIGN — cpu_fallback marks an unintended fallback from
+    # a claimed TPU run, which this is not; virtual-time goodput has no
+    # wall-clock latency distribution, so no p99 is quotable.
+    result["cpu_fallback"] = False
+    result["p99_quotable"] = False
+    result["p99_note"] = "virtual-time sim goodput; no wall-clock latencies"
+    result["valid"] = ok
+    return result

@@ -1,0 +1,396 @@
+"""Recent-writes fingerprint filter: the admission subsystem's memory.
+
+A compact banked Bloom-style filter over uint64 key fingerprints — the
+same u64 column encoding the resident dictionary's host mirror uses
+(models/conflict_set._rows_to_u64), so the device-resident integration
+(TPUConflictSet.attach_admission_filter) feeds it straight from the
+endpoint u64 columns each dispatch already computed: no re-hash, no
+re-pack, and with the jax backend the bit banks PERSIST in device memory
+across dispatches — the update ships only the write-set fingerprints that
+ride along with the dispatch anyway.
+
+Aging is by VERSION WINDOW, not decay: the filter holds ``banks`` bit
+banks, each covering a slice of the MVCC window (``window_versions /
+banks`` commit versions). Writes are recorded into the current bank; when
+the version stream advances past the bank's slice the oldest bank is
+cleared and becomes current. A probe for a transaction at read version
+``rv`` consults only banks whose recorded-version range can exceed
+``rv`` — a hit means "some write newer than your snapshot probably
+touched this key", which is exactly the admission-time likely-loser
+signal (arXiv:2301.06181's wasted-work detection, moved before dispatch).
+
+Two truth tiers, deliberately separate:
+
+- The BLOOM banks answer fast and may false-positive — they drive
+  SHAPING (advisory: a shaped txn still resolves normally, so a false
+  positive costs one co-scheduling delay, never a wrong verdict) and the
+  saturation signal the ratekeeper consumes.
+- The EXACT SHADOW (``exact_shadow=True``) keeps per-bank dicts of real
+  key bytes → last write version. PRE-ABORTS are only ever issued from a
+  shadow confirmation (a recorded write at version > rv overlapping the
+  txn's read set), so every pre-aborted transaction is a true conflict
+  loser by construction — the honesty contract
+  tests/test_admission.py asserts against the resolve oracle.
+
+The resolver is the authoritative feeder (every accepted write set passes
+through it); commit proxies ALSO self-feed from their own batches'
+accepted writes (zero lag for single-proxy clusters) and pull cross-proxy
+deltas from the resolvers (``Resolver.admission_delta``). Double-feeding
+is harmless by design: recording (key, version) twice is idempotent for
+both tiers.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
+
+_HASH_C1 = np.uint64(0x9E3779B97F4A7C15)
+_HASH_C2 = np.uint64(0xFF51AFD7ED558CCD)
+
+#: Bounded delta log: a consumer further behind than this re-syncs from
+#: the recent tail only (conservative: it misses OLDER entries, so it can
+#: only under-detect, never wrongly pre-abort — exactness lives in the
+#: shadow CONFIRMATION, not in feed completeness).
+DELTA_LOG_CAP = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    """Loud env parsing (the repo's kernel-flag convention: an unusable
+    value RAISES with what is accepted — a silent default would run the
+    cluster with unintended filter geometry and report nothing)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid setting; expected an integer"
+        ) from None
+
+
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def fingerprints(keys: list[bytes]) -> np.ndarray:
+    """uint64 fingerprints of raw keys (FNV-1a + a splitmix finisher so
+    Bloom index derivation sees well-mixed high bits). Vectorized ACROSS
+    keys — one numpy pass per byte column, masked by key length, so the
+    resolver/proxy feed and probe paths pay array ops, not a Python loop
+    per byte (uint64 arithmetic wraps mod 2^64, exactly FNV's ring)."""
+    n = len(keys)
+    if not n:
+        return np.zeros(0, np.uint64)
+    lens = np.fromiter((len(k) for k in keys), np.int64, count=n)
+    width = int(lens.max(initial=0))
+    buf = np.zeros((n, max(width, 1)), np.uint8)
+    for i, k in enumerate(keys):
+        buf[i, : len(k)] = np.frombuffer(k, np.uint8)
+    h = np.full(n, _FNV_OFFSET, np.uint64)
+    for j in range(width):
+        h = np.where(j < lens, (h ^ buf[:, j]) * _FNV_PRIME, h)
+    h = h * _HASH_C1
+    return h ^ (h >> np.uint64(33))
+
+
+def key_fingerprint(key: bytes) -> np.uint64:
+    return fingerprints([key])[0]
+
+
+def u64_cols_fingerprint(cols: np.ndarray) -> np.ndarray:
+    """Fingerprint [n, C] uint64 key columns (the resident mirror's
+    encoding) into [n] uint64 — the same multiplicative mix the mirror's
+    hash table uses, so the device path never touches key bytes."""
+    cols = np.asarray(cols, np.uint64)
+    h = cols[:, 0] * _HASH_C1
+    for j in range(1, cols.shape[1]):
+        h = (h ^ cols[:, j]) * _HASH_C2
+    return h ^ (h >> np.uint64(33))
+
+
+class _NumpyBanks:
+    """Host backend: bool bit banks in numpy."""
+
+    def __init__(self, banks: int, nbits: int):
+        self.bits = np.zeros((banks, nbits), bool)
+
+    def set(self, bank: int, idx: np.ndarray) -> None:
+        self.bits[bank, idx] = True
+
+    def clear(self, bank: int) -> None:
+        self.bits[bank] = False
+
+    def any_all_hashes(self, idx: np.ndarray, bank_mask: np.ndarray) -> np.ndarray:
+        """[n, k] slot indices → [n] hit (all k bits set in SOME unmasked
+        bank)."""
+        hits = self.bits[:, idx].all(axis=2)  # [banks, n]
+        return (hits & bank_mask[:, None]).any(axis=0)
+
+    def fill(self, bank: int) -> float:
+        return float(self.bits[bank].mean())
+
+    def fill_max(self) -> float:
+        return float(self.bits.mean(axis=1).max())
+
+
+class _JaxBanks:
+    """Device backend: the banks live as a jax device array across calls
+    (device-resident state), with jitted scatter/gather entry points.
+
+    Operand row counts are PADDED to powers of two with a valid mask —
+    jax.jit specializes per shape, and the accepted-write count varies
+    every dispatch, so unpadded operands would retrace + recompile on
+    the hot resolve path (log₂ bucket count bounds the program count,
+    the same discipline as the kernel's quantized window depths)."""
+
+    def __init__(self, banks: int, nbits: int):
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.bits = jnp.zeros((banks, nbits), bool)
+
+        @jax.jit
+        def _set(bits, bank, idx, valid):
+            # Scatter-max of booleans: padded (valid=False) rows write
+            # False, which can never clear an existing bit.
+            return bits.at[bank, idx].max(valid)
+
+        @jax.jit
+        def _clear(bits, bank):
+            return bits.at[bank].set(False)
+
+        @jax.jit
+        def _probe(bits, idx, bank_mask):
+            hits = bits[:, idx].all(axis=2)
+            return (hits & bank_mask[:, None]).any(axis=0)
+
+        self._set_fn, self._clear_fn, self._probe_fn = _set, _clear, _probe
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        return 1 << max(n - 1, 0).bit_length()
+
+    def set(self, bank: int, idx: np.ndarray) -> None:
+        m = len(idx)
+        size = self._pow2(m)
+        pad = np.zeros(size, np.int64)
+        pad[:m] = idx
+        valid = np.zeros(size, bool)
+        valid[:m] = True
+        self.bits = self._set_fn(self.bits, bank, pad, valid)
+
+    def clear(self, bank: int) -> None:
+        self.bits = self._clear_fn(self.bits, bank)
+
+    def any_all_hashes(self, idx: np.ndarray, bank_mask: np.ndarray) -> np.ndarray:
+        n = idx.shape[0]
+        size = self._pow2(n)
+        pad = np.zeros((size, idx.shape[1]), np.int64)
+        pad[:n] = idx
+        return np.asarray(self._probe_fn(self.bits, pad, bank_mask))[:n]
+
+    def fill(self, bank: int) -> float:
+        return float(self._jnp.mean(self.bits[bank]))
+
+    def fill_max(self) -> float:
+        return float(self._jnp.max(self._jnp.mean(self.bits, axis=1)))
+
+
+class RecentWritesFilter:
+    """Banked recent-writes filter with version-window aging.
+
+    ``backend``: "numpy" (host; the runtime roles' default — deterministic
+    and dependency-free) or "jax" (device-resident banks + jitted
+    update/probe; what TPUConflictSet attaches). Both backends are
+    bit-identical in behavior — tests/test_admission.py asserts parity.
+    """
+
+    def __init__(
+        self,
+        bits_log2: int | None = None,
+        banks: int | None = None,
+        hashes: int = 2,
+        window_versions: int | None = None,
+        exact_shadow: bool = True,
+        backend: str = "numpy",
+    ):
+        self.nbits = 1 << (bits_log2
+                           or _env_int("FDB_TPU_ADMISSION_BITS_LOG2", 16))
+        self.banks = banks or _env_int("FDB_TPU_ADMISSION_BANKS", 4)
+        self.hashes = max(1, hashes)
+        self.window_versions = (window_versions
+                                or _env_int("FDB_TPU_ADMISSION_WINDOW",
+                                            MVCC_WINDOW_VERSIONS))
+        self.slice_versions = max(1, self.window_versions // self.banks)
+        self.backend = backend
+        self._bits = (_JaxBanks if backend == "jax"
+                      else _NumpyBanks)(self.banks, self.nbits)
+        self._cur = 0
+        # Per-bank recorded-version bounds: [min, max] per bank, -1 = empty.
+        self.bank_min = np.full(self.banks, -1, np.int64)
+        self.bank_max = np.full(self.banks, -1, np.int64)
+        self._cur_from = -1  # version at which the current bank opened
+        # Exact shadow: per-bank dict of key bytes -> newest write version.
+        self.exact_shadow = exact_shadow
+        self._shadow: list[dict[bytes, int]] = [dict() for _ in range(self.banks)]
+        # Delta log for cross-role feeding: (key, version) ring + seq.
+        self._delta_log: list[tuple[bytes, int]] = []
+        self.delta_seq = 0
+        self.recorded = 0
+        self.rotations = 0
+
+    # -- aging ---------------------------------------------------------------
+
+    def _rotate_to(self, version: int) -> None:
+        if self._cur_from < 0:
+            self._cur_from = version
+            return
+        while version - self._cur_from >= self.slice_versions:
+            self._cur_from += self.slice_versions
+            self._cur = (self._cur + 1) % self.banks
+            self._bits.clear(self._cur)
+            self.bank_min[self._cur] = -1
+            self.bank_max[self._cur] = -1
+            self._shadow[self._cur] = {}
+            self.rotations += 1
+
+    def advance(self, version: int) -> None:
+        """Age banks forward without recording (GC-only dispatches)."""
+        self._rotate_to(version)
+
+    # -- recording -----------------------------------------------------------
+
+    def _idx(self, fps: np.ndarray) -> np.ndarray:
+        """[n] fingerprints → [n, hashes] slot indices (h1 + i·h2 style)."""
+        fps = np.asarray(fps, np.uint64)
+        h2 = (fps >> np.uint64(32)) | np.uint64(1)
+        mult = np.arange(self.hashes, dtype=np.uint64)
+        return ((fps[:, None] + mult[None, :] * h2[:, None])
+                % np.uint64(self.nbits)).astype(np.int64)
+
+    def record_u64(self, fps: np.ndarray, version: int) -> None:
+        """Record write fingerprints at a commit version (Bloom tier only
+        — the device path, where key bytes never exist host-side)."""
+        fps = np.asarray(fps, np.uint64).reshape(-1)
+        self._rotate_to(version)
+        if not fps.size:
+            return
+        b = self._cur
+        self._bits.set(b, self._idx(fps).reshape(-1))
+        self.bank_min[b] = version if self.bank_min[b] < 0 else min(
+            int(self.bank_min[b]), version)
+        self.bank_max[b] = max(int(self.bank_max[b]), version)
+        self.recorded += int(fps.size)
+
+    def record(self, keys: list[bytes], version: int,
+               log_delta: bool = True) -> None:
+        """Record raw write keys at a commit version (both tiers + the
+        delta log feeding downstream filters; ``log_delta=False`` for
+        entries REPLAYED from a peer's delta — a consumer-side filter
+        serves no deltas of its own, so re-logging them is pure churn)."""
+        if not keys:
+            self._rotate_to(version)
+            return
+        self.record_u64(fingerprints(keys), version)
+        if self.exact_shadow:
+            shadow = self._shadow[self._cur]
+            for k in keys:
+                prev = shadow.get(k)
+                if prev is None or prev < version:
+                    shadow[k] = version
+        if not log_delta:
+            return
+        for k in keys:
+            self._delta_log.append((bytes(k), version))
+        self.delta_seq += len(keys)
+        if len(self._delta_log) > DELTA_LOG_CAP:
+            del self._delta_log[: len(self._delta_log) - DELTA_LOG_CAP]
+
+    # -- cross-role delta feed ------------------------------------------------
+
+    def delta_since(self, since_seq: int) -> tuple[int, list[tuple[bytes, int]]]:
+        """Entries recorded after ``since_seq`` (bounded by the log cap —
+        a laggard consumer misses only OLDER entries; see module note)."""
+        behind = self.delta_seq - since_seq
+        if behind <= 0:
+            return self.delta_seq, []
+        return self.delta_seq, list(self._delta_log[-min(behind,
+                                                         len(self._delta_log)):])
+
+    def apply_delta(self, entries: list[tuple[bytes, int]]) -> None:
+        """Merge a peer's delta (idempotent: double-feeding is harmless).
+        Entries arrive in feed order (version runs are contiguous), so
+        each same-version run records in ONE vectorized call, and none of
+        it re-enters this filter's own delta log."""
+        i, n = 0, len(entries)
+        while i < n:
+            version = int(entries[i][1])
+            j = i
+            while j < n and int(entries[j][1]) == version:
+                j += 1
+            self.record([bytes(k) for k, _v in entries[i:j]], version,
+                        log_delta=False)
+            i = j
+
+    # -- probing -------------------------------------------------------------
+
+    def _bank_mask(self, read_version: int) -> np.ndarray:
+        """Banks that can hold a write NEWER than the read version."""
+        return self.bank_max > read_version
+
+    def probe_u64(self, fps: np.ndarray, read_version: int) -> np.ndarray:
+        """[n] fingerprints → [n] bool likely-newer-write hits."""
+        fps = np.asarray(fps, np.uint64).reshape(-1)
+        if not fps.size:
+            return np.zeros(0, bool)
+        mask = self._bank_mask(read_version)
+        if not mask.any():
+            return np.zeros(len(fps), bool)
+        return self._bits.any_all_hashes(self._idx(fps), mask)
+
+    def probe_keys(self, keys: list[bytes], read_version: int) -> np.ndarray:
+        if not keys:
+            return np.zeros(0, bool)
+        return self.probe_u64(fingerprints(keys), read_version)
+
+    def probe_exact(self, key: bytes, read_version: int) -> int | None:
+        """Exact tier: the newest RECORDED write version for ``key`` that
+        is strictly newer than ``read_version`` (None = no confirmation).
+        Only meaningful with exact_shadow; this is the ONLY evidence a
+        pre-abort may be issued on."""
+        best = None
+        for shadow in self._shadow:
+            v = shadow.get(key)
+            if v is not None and v > read_version and (best is None or v > best):
+                best = v
+        return best
+
+    # -- signals -------------------------------------------------------------
+
+    def saturation(self) -> float:
+        """Worst fill fraction over ALL banks — the admission signal the
+        ratekeeper reads next to resolver_queue (a saturated bank means
+        the write rate is outrunning what the filter can discriminate:
+        probes degrade toward all-hit, i.e. shape-everything). Max over
+        banks, not the current bank: probes consult the OLDER banks too,
+        so a freshly-rotated (empty) current bank must not blind the
+        SAT_BLIND guard while saturated elder banks still answer."""
+        return self._bits.fill_max()
+
+    def metrics(self) -> dict:
+        return {
+            "backend": self.backend,
+            "bits": self.nbits,
+            "banks": self.banks,
+            "recorded": self.recorded,
+            "rotations": self.rotations,
+            "saturation": round(self.saturation(), 4),
+            "delta_seq": self.delta_seq,
+            "shadow_entries": sum(len(s) for s in self._shadow),
+        }

@@ -1,0 +1,289 @@
+"""Admission policy: turn recent-writes evidence into admit/shape/preabort.
+
+Probed at two admission points (the subsystem's whole reason to exist —
+detect doomed transactions BEFORE they burn a resolve dispatch and a
+client backoff ladder):
+
+- Commit-proxy batch formation (``CommitProxy.run``): every request's
+  read set is probed against the proxy's RecentWritesFilter.
+
+  * Exact-shadow confirmation of a newer overlapping write → the txn is a
+    PROVEN loser (the recorded write is committed, inside the MVCC
+    window, and newer than the txn's snapshot — resolving it can only
+    return CONFLICT). It is pre-aborted on the spot with
+    ``AdmissionPreAborted`` carrying the hot-range odds, and the client
+    retries after the existing score-scaled jittered backoff (the repair
+    subsystem's formula) instead of riding the resolve pipeline and the
+    blind exponential ladder. This is what converts an abort storm into
+    a paced queue.
+  * Bloom-tier hit without exact confirmation → LIKELY loser: routed to
+    the proxy's serializing shaped lane, where contenders are
+    deliberately co-scheduled into ONE dispatch window (same commit
+    version) so a wave-commit resolver reorders the survivable chains
+    instead of aborting them, and the rest lose at most one window.
+    Shaping is advisory — a false positive costs one co-scheduling
+    delay, never a wrong verdict — and is ACCOUNTED: shaped txns that
+    then commit are the measured false positives
+    (``shaped_committed``, judged against the resolve engine's verdict).
+
+- GRV grant (``GrvProxy``): no read set exists yet, so the GRV gate uses
+  the cluster-wide signal instead — filter saturation (via the
+  ratekeeper's rates poll) defers default/batch read-version grants when
+  the filter says the write rate has outrun its discrimination.
+
+System-priority traffic is NEVER shaped or pre-aborted (the lane
+contract: recovery and system-keyspace txns outrank the storm); the
+campaign gate asserts the counter stays zero.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from foundationdb_tpu.admission.filter import RecentWritesFilter
+
+
+def admission_env_default() -> bool:
+    """FDB_TPU_ADMISSION env default (validated through the kernel
+    flags' shared env_choice — unknown values raise with the accepted
+    list instead of silently picking a mode)."""
+    from foundationdb_tpu.core.types import env_choice
+
+    return env_choice("FDB_TPU_ADMISSION", "0", ("0", "1")) == "1"
+
+
+def _env_float(name: str, default: float) -> float:
+    """Loud env parsing (kernel-flag convention — see filter._env_int)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a valid setting; expected a number"
+        ) from None
+
+
+@dataclass(frozen=True)
+class AdmissionDecision:
+    action: str  # "admit" | "shape" | "preabort"
+    risk: float  # fraction of probed read keys hitting the Bloom tier
+    confirm_version: int | None = None  # exact-shadow proof (preabort only)
+    wide: bool = False  # shape came from the wide-range sketch path
+
+
+class AdmissionPolicy:
+    #: Bloom-tier hit fraction at/above which a txn is shaped. One hot
+    #: read among three (the Zipf RMW shape) must clear it: 1/3 ≥ 0.3.
+    SHAPE_RISK = 0.3
+    #: Filter saturation above which probes are no longer discriminating:
+    #: shaping pauses (everything would shape) and the saturation signal
+    #: alone carries the backpressure (ratekeeper + GRV deferral).
+    SAT_BLIND = 0.98
+    #: Widest read range still probed per-key: a point read's range is
+    #: key..key+\\x00 (len+1); anything wider can't be enumerated into
+    #: fingerprints and falls back to the hot-range sketch for shaping.
+    POINT_SLOP = 2
+    #: Hot-range sketch score at/above which a wide-range read shapes.
+    SKETCH_SHAPE_SCORE = 8.0
+    #: Consecutive pre-aborts (client-reported attempts) at/above which a
+    #: txn is admitted REGARDLESS: the canonical conflict path (loser
+    #: report → repair engine / retry ladder) takes over, so admission
+    #: can never starve a persistent loser.
+    PREABORT_CEILING = 3
+    #: Evidence-log bound (forensics): counters keep counting past it.
+    PREABORT_LOG_CAP = 4096
+
+    def __init__(
+        self,
+        filter: RecentWritesFilter | None = None,
+        hot_ranges=None,
+        enabled: bool | None = None,
+        shape_risk: float | None = None,
+        preabort: bool | None = None,
+    ):
+        self.enabled = admission_env_default() if enabled is None else bool(
+            enabled)
+        self.filter = filter or RecentWritesFilter()
+        self.hot_ranges = hot_ranges  # HotRangeSketch (may be None)
+        self.shape_risk = (shape_risk if shape_risk is not None
+                          else _env_float("FDB_TPU_ADMISSION_SHAPE_RISK",
+                                          self.SHAPE_RISK))
+        if preabort is None:
+            from foundationdb_tpu.core.types import env_choice
+
+            preabort = env_choice(
+                "FDB_TPU_ADMISSION_PREABORT", "1", ("0", "1")) == "1"
+        self.preabort_enabled = bool(preabort)
+        self.counters = {
+            "probes": 0,
+            "admitted": 0,
+            "shaped": 0,
+            "preaborted": 0,
+            "shaped_committed": 0,  # false positives, vs the engine verdict
+            "shaped_conflicted": 0,  # true positives the filter caught
+            "shaped_too_old": 0,  # expired snapshots (prove nothing)
+            "system_bypass": 0,
+            "system_shaped": 0,  # MUST stay 0 (campaign gate)
+            "no_shape_rejects": 0,  # admission_no_shape option fired
+            "wide_range_shaped": 0,  # sketch-driven (not per-key) shapes
+            "saturation_blind": 0,  # probes skipped: filter saturated
+            "preabort_ceiling": 0,  # admitted past the streak ceiling
+        }
+        # Pre-abort evidence log for the honesty tests: every entry is the
+        # (key, confirming write version, txn read version) triple that
+        # justified a pre-abort; tests replay it against the oracle's
+        # write history. Bounded at PREABORT_LOG_CAP (forensics, not
+        # accounting — evidence checks must compare against the cap).
+        self.preabort_log: list[tuple[bytes, int, int]] = []
+
+    # -- the decision ---------------------------------------------------------
+
+    def _point_key(self, r) -> bytes | None:
+        """The key of a point-like read range, None if too wide to probe."""
+        begin, end = bytes(r.begin), bytes(r.end)
+        if len(end) <= len(begin) + self.POINT_SLOP and end[: len(begin)] == begin:
+            return begin
+        return None
+
+    def decide(self, read_ranges, read_version: int,
+               priority: str = "default",
+               attempts: int = 0) -> AdmissionDecision:
+        if not self.enabled:
+            return AdmissionDecision("admit", 0.0)
+        if attempts >= self.PREABORT_CEILING:
+            self.counters["preabort_ceiling"] += 1
+            return AdmissionDecision("admit", 0.0)
+        if priority == "system":
+            # SYSTEM_IMMEDIATE bypasses admission wholesale (lane
+            # contract); counted so the campaign gate can prove both that
+            # system traffic flowed AND that none of it was shaped.
+            self.counters["system_bypass"] += 1
+            return AdmissionDecision("admit", 0.0)
+        reads = [r for r in read_ranges if not r.empty]
+        if not reads:
+            # Blind writes conflict with nothing — always admit.
+            self.counters["admitted"] += 1
+            return AdmissionDecision("admit", 0.0)
+        self.counters["probes"] += 1
+        keys, wide = [], []
+        for r in reads:
+            k = self._point_key(r)
+            (keys if k is not None else wide).append(k if k is not None else r)
+        # Exact tier first: one confirmed newer write = proven loser.
+        if self.preabort_enabled:
+            for k in keys:
+                v = self.filter.probe_exact(k, read_version)
+                if v is not None:
+                    self.counters["preaborted"] += 1
+                    if len(self.preabort_log) < self.PREABORT_LOG_CAP:
+                        self.preabort_log.append((k, v, read_version))
+                    return AdmissionDecision("preabort", 1.0,
+                                             confirm_version=v)
+        # Bloom tier: likely losers shape (unless the filter is saturated
+        # past discriminating — then probes are all-hit noise and the
+        # saturation SIGNAL carries the load shedding instead).
+        risk = 0.0
+        if keys:
+            sat = self.filter.saturation()
+            if sat >= self.SAT_BLIND:
+                self.counters["saturation_blind"] += 1
+            else:
+                hits = self.filter.probe_keys(keys, read_version)
+                risk = float(hits.sum()) / len(keys)
+                if risk >= self.shape_risk:
+                    self.counters["shaped"] += 1
+                    return AdmissionDecision("shape", risk)
+        if wide and self.hot_ranges is not None:
+            score = max(
+                (self.hot_ranges.score(bytes(r.begin), bytes(r.end))
+                 for r in wide), default=0.0)
+            if score >= self.SKETCH_SHAPE_SCORE:
+                self.counters["shaped"] += 1
+                self.counters["wide_range_shaped"] += 1
+                return AdmissionDecision("shape", risk, wide=True)
+        self.counters["admitted"] += 1
+        return AdmissionDecision("admit", risk)
+
+    def reclassify_no_shape(self, decision: AdmissionDecision) -> None:
+        """A shape decision the client's admission_no_shape option turned
+        into a rejection: the txn never rode the lane, so the shape
+        counters (including the wide-range detail) are reversed and the
+        reject counted instead — "shaped" stays exactly the population
+        the false-positive rate and campaign gates are computed over."""
+        self.counters["shaped"] -= 1
+        if decision.wide:
+            self.counters["wide_range_shaped"] -= 1
+        self.counters["no_shape_rejects"] += 1
+
+    def recheck_preabort(self, read_ranges, read_version: int) -> int | None:
+        """Exact-tier-only recheck for a SHAPED txn at its flush ride: a
+        loss that became provable while it parked (a contender committed
+        into its read set) pre-aborts now instead of burning the
+        dispatch. Returns the confirming write version or None. Never
+        consults the Bloom tier — a recheck must not re-shape (park
+        forever) or act on unconfirmed evidence."""
+        if not (self.enabled and self.preabort_enabled):
+            return None
+        for r in read_ranges:
+            if r.empty:
+                continue
+            k = self._point_key(r)
+            if k is None:
+                continue
+            v = self.filter.probe_exact(k, read_version)
+            if v is not None:
+                self.counters["preaborted"] += 1
+                if len(self.preabort_log) < self.PREABORT_LOG_CAP:
+                    self.preabort_log.append((k, v, read_version))
+                return v
+        return None
+
+    # -- outcome accounting ---------------------------------------------------
+
+    def note_shaped_outcome(self, verdict) -> None:
+        """Called by the commit proxy when a SHAPED txn's verdict lands:
+        a shaped txn that committed is a measured false positive (it
+        would have committed without shaping too — shaping never changes
+        verdicts, only scheduling), judged against the resolve engine.
+        TOO_OLD is tallied apart: an expired snapshot proves nothing
+        about the filter's call, so folding it into shaped_conflicted
+        would inflate the quoted true-positive count."""
+        from foundationdb_tpu.core.types import Verdict
+
+        if verdict == Verdict.COMMITTED:
+            self.counters["shaped_committed"] += 1
+        elif verdict == Verdict.TOO_OLD:
+            self.counters["shaped_too_old"] += 1
+        else:
+            self.counters["shaped_conflicted"] += 1
+
+    def note_system_shaped(self) -> None:  # pragma: no cover - must not fire
+        self.counters["system_shaped"] += 1
+
+    # -- feeding --------------------------------------------------------------
+
+    def feed_accepted(self, write_ranges, version: int) -> None:
+        """Record an accepted txn's write set (begin keys; wide ranges
+        degrade to their begin key — under-detection only, see filter)."""
+        keys = [bytes(w.begin) for w in write_ranges if not w.empty]
+        # log_delta=False: this is the CONSUMER side (a proxy's probe
+        # filter) — only resolver filters serve admission_delta, so
+        # logging here would be pure hot-path churn. Empty sets still
+        # age the banks.
+        self.filter.record(keys, version, log_delta=False)
+
+    # -- signals --------------------------------------------------------------
+
+    def saturation(self) -> float:
+        return self.filter.saturation() if self.enabled else 0.0
+
+    def metrics(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            **self.counters,
+            "saturation": round(self.saturation(), 4),
+            "filter": self.filter.metrics(),
+        }

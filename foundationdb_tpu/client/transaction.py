@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from foundationdb_tpu.core.errors import (
+    AdmissionPreAborted,
     CommitUnknownResult,
     FdbError,
     ProcessKilled,
@@ -363,6 +364,15 @@ class Transaction:
     on top (and is what Database.run hands out in practice via layers)."""
 
     MAX_BACKOFF = 1.0
+    # Admission pre-abort pacing (the repair engine's score-scaled
+    # jittered formula, starting far below the blind ladder): delay =
+    # min(cap, base · odds · 2^streak) · jitter(0.5..1.5), where streak
+    # counts CONSECUTIVE pre-aborts of this transaction — first retries
+    # are near-immediate (the pre-abort cost the cluster almost nothing),
+    # but a txn losing over and over escalates toward the cap so hot-key
+    # storms cannot starve a client into its retry limit.
+    PREABORT_BACKOFF_BASE = 0.0005
+    PREABORT_BACKOFF_CAP = 0.1
 
     def __init__(self, db: Database):
         self.db = db
@@ -380,7 +390,13 @@ class Transaction:
         # PRIORITY_BATCH option 201): shapes both the GRV lane and the
         # commit proxy's batch formation (sched/lanes.py).
         self.priority = "default"
+        # Admission-control opt-out (admission subsystem): fail with
+        # AdmissionShaped (retryable) instead of riding the serializing
+        # shaped lane — for latency-sensitive clients that prefer an
+        # immediate error to a queue position.
+        self.admission_no_shape = False
         self._retries = 0  # attempts consumed by on_error (for retry_limit)
+        self._preabort_streak = 0  # consecutive pre-aborts (pacing)
         self._reset()
 
     def set_option(self, name: str, value=None) -> None:
@@ -416,6 +432,8 @@ class Transaction:
             self.priority = "system"
         elif name == "priority_batch":
             self.priority = "batch"
+        elif name == "admission_no_shape":
+            self.admission_no_shape = True
         elif name == "authorization_token":
             if not value:
                 raise FdbError("authorization_token requires a value",
@@ -822,6 +840,8 @@ class Transaction:
             lock_aware=self.lock_aware,
             token=self.authorization_token,
             priority=self.priority,
+            admission_no_shape=self.admission_no_shape,
+            admission_attempts=self._preabort_streak,
         )
         commit_ep = self.db._pick(self.db.commit_proxies)
         try:
@@ -873,6 +893,26 @@ class Transaction:
         self._retries += 1
         if self.retry_limit is not None and self._retries > self.retry_limit:
             raise e  # option 501: give up after N retries (reference)
+        if isinstance(e, AdmissionPreAborted):
+            # Admission pre-abort: a PROVEN loss detected before dispatch.
+            # The blind exponential ladder is the wrong pacing here — the
+            # proxy attached its hot-range odds, so apply the repair
+            # subsystem's score-scaled jittered backoff instead and do
+            # NOT consume the ladder (the next real conflict still starts
+            # from the small backoff). This is what turns the abort storm
+            # into a paced queue instead of a sleep pile-up; the streak
+            # escalation bounds how long a persistent loser spins.
+            self._reset()
+            odds = max((s for _b, _e2, s in (e.hot_ranges or [])),
+                       default=0.0)
+            delay = min(self.PREABORT_BACKOFF_CAP,
+                        self.PREABORT_BACKOFF_BASE * max(odds, 1.0)
+                        * (1 << min(self._preabort_streak, 16)))
+            self._preabort_streak += 1
+            await self.db.loop.sleep(
+                delay * (0.5 + self.db.loop.rng.random()))
+            return
+        self._preabort_streak = 0
         backoff = self._backoff
         self._backoff = min(self.MAX_BACKOFF, self._backoff * 2)
         self._reset()

@@ -84,6 +84,57 @@ class NotCommitted(FdbError):
         return None
 
 
+class AdmissionShaped(FdbError):
+    """Admission control routed this commit into the serializing shaped
+    lane, but the transaction set the ``admission_no_shape`` option —
+    latency-sensitive clients that prefer an immediate retryable failure
+    to an unbounded queue position get this instead of the silent delay.
+    Repo-specific code (no reference analogue; the reference has no
+    admission-time conflict filter). Retryable: a fresh attempt reads a
+    newer snapshot and usually passes the probe."""
+
+    code = 1060
+
+
+class AdmissionPreAborted(FdbError):
+    """Admission control PROVED this transaction a conflict loser before
+    dispatch (a recorded committed write newer than its read version
+    overlaps its read set) and aborted it at the commit proxy — the
+    wasted-work cut of arXiv:2301.06181 applied at admission. Carries the
+    same hot-range odds payload as NotCommitted so the client applies the
+    repair subsystem's score-scaled jittered backoff instead of the blind
+    exponential ladder (see Transaction.on_error). Repo-specific code."""
+
+    code = 1061
+
+    def __init__(self, message: str = "",
+                 hot_ranges: "list[tuple[bytes, bytes, float]] | None" = None,
+                 confirm_version: int | None = None,
+                 code: int | None = None):
+        super().__init__(message, code)
+        extra: dict = {}
+        if hot_ranges is not None:
+            extra["h"] = [tuple(h) for h in hot_ranges]
+        if confirm_version is not None:
+            extra["v"] = int(confirm_version)
+        if extra:
+            self.wire_extra = extra
+
+    @property
+    def hot_ranges(self) -> "list[tuple[bytes, bytes, float]] | None":
+        if isinstance(self.wire_extra, dict):
+            return self.wire_extra.get("h")
+        return None
+
+    @property
+    def confirm_version(self) -> "int | None":
+        """Version of the committed write that proved the loss (the
+        admission honesty tests replay it against the oracle history)."""
+        if isinstance(self.wire_extra, dict):
+            return self.wire_extra.get("v")
+        return None
+
+
 class TransactionTooOld(FdbError):
     """Read version is older than the MVCC window (error 1007)."""
 
@@ -184,7 +235,7 @@ class ProcessKilled(FdbError):
     code = 1211  # cluster_version_changed stand-in for injected kills
 
 
-_RETRYABLE = {1001, 1007, 1009, 1020, 1021, 1211}
+_RETRYABLE = {1001, 1007, 1009, 1020, 1021, 1060, 1061, 1211}
 
 
 def _code_registry() -> dict[int, type[FdbError]]:

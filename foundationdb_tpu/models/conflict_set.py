@@ -406,7 +406,25 @@ class TPUConflictSet:
         # batch (batches already serialize by commit version).
         self.last_wave_window: np.ndarray | None = None
         self._empty_dev_batch = None  # advance()'s constant batch, packed lazily
+        # Admission subsystem (attach_admission_filter): a RecentWritesFilter
+        # fed from each dispatch's ACCEPTED write sets using the endpoint
+        # u64 columns the resident pack already computed — no re-hash, no
+        # extra host→device key bytes (the filter's jax banks persist on
+        # device; the update operand is the write-fingerprint row the
+        # dispatch shipped anyway).
+        self.admission_filter = None
+        self._adm_stash = None  # (write fps [b, q], valid [b, q]) per pack
         self._init_engine()
+
+    def attach_admission_filter(self, f) -> None:
+        """Attach a RecentWritesFilter to the resident engine: every
+        resolve feeds the accepted write-set fingerprints (resident mode
+        only — the fingerprints ARE the mirror's u64 key columns)."""
+        if not self.resident:
+            raise ValueError(
+                "admission filter attaches to the resident engine only "
+                "(FDB_TPU_RESIDENT=1 / resident=True)")
+        self.admission_filter = f
 
     def _init_engine(self) -> None:
         """Build device state + entry points. Subclasses (the mesh-sharded
@@ -559,6 +577,29 @@ class TPUConflictSet:
             ),
         )
 
+    def _note_write_fps(self, qu: np.ndarray, is_pad: np.ndarray,
+                        dims) -> None:
+        """Stash the pack's write-begin fingerprints for the admission
+        filter feed (_collect records the ACCEPTED rows once verdicts
+        land). The fingerprint IS admission.filter.u64_cols_fingerprint
+        over the endpoint u64 columns — one shared definition, because
+        the no-re-hash feed contract depends on record and probe staying
+        bit-identical — so the feed costs a vectorized mix over rows
+        already computed, never a key re-hash. Window-path packs ([k]-leading) skip the stash: the
+        runtime role feed goes through Resolver.admission_filter there."""
+        if self.admission_filter is None:
+            return
+        lead, b, r, q, _w = dims
+        if lead:
+            self._adm_stash = None
+            return
+        from foundationdb_tpu.admission.filter import u64_cols_fingerprint
+
+        n_r, n_q = b * r, b * q
+        sect = slice(2 * n_r, 2 * n_r + n_q)
+        fps = u64_cols_fingerprint(qu[sect])
+        self._adm_stash = (fps.reshape(b, q), (~is_pad[sect]).reshape(b, q))
+
     def _pack_resident(self, bt: ck.BatchTensors, defer_repack: bool = False):
         """Rank-space pack against the resident mirror: classify every
         endpoint as hit (already resident) or miss, emit the sorted-unique
@@ -627,6 +668,7 @@ class TPUConflictSet:
             )
             st["unique_keys"] += m + uniq_found
             st["delta_new_keys"] += m
+        self._note_write_fps(qu, is_pad, dims)
         return self._ranks_to_batch(bt, ranks, dims, new_rows)
 
     def _device_live_ranks(self) -> np.ndarray:
@@ -729,6 +771,7 @@ class TPUConflictSet:
                 ranks[plan.is_pad] = INT32_MAX
             finally:
                 mir.gate.set()
+        self._note_write_fps(plan.qu, plan.is_pad, plan.dims)
         return self._ranks_to_batch(
             plan.bt, ranks, plan.dims,
             np.zeros((0, plan.dims[-1]), np.int32),
@@ -798,7 +841,8 @@ class TPUConflictSet:
                 )
                 flags = [t.report_conflicting_keys for t in chunk]
                 pending.append(
-                    (verdicts, len(chunk), losers, reads, flags, levels)
+                    (verdicts, len(chunk), losers, reads, flags, levels,
+                     self._take_adm(commit_version))
                 )
             else:
                 batch = self._pack(chunk)
@@ -807,8 +851,18 @@ class TPUConflictSet:
                 verdicts, levels, self.state = (
                     out if self.wave_commit else (out[0], None, out[1])
                 )
-                pending.append((verdicts, len(chunk), None, None, None, levels))
+                pending.append((verdicts, len(chunk), None, None, None,
+                                levels, self._take_adm(commit_version)))
         return lambda: self._collect(pending)
+
+    def _take_adm(self, commit_version: int):
+        """Claim the last pack's admission write-fingerprint stash, BOUND
+        to its resolve's commit version (None when no filter is attached /
+        window-path pack). The version rides in the pending tuple — NOT
+        instance state — because deferred collectors pipeline: a later
+        dispatch must not relabel an earlier dispatch's write versions."""
+        stash, self._adm_stash = self._adm_stash, None
+        return None if stash is None else (stash, commit_version)
 
     def resolve_wire(
         self,
@@ -855,12 +909,14 @@ class TPUConflictSet:
             verdicts, levels, self.state = (
                 out if self.wave_commit else (out[0], None, out[1])
             )
-            pending.append((verdicts, n, None, None, None, levels))
+            pending.append((verdicts, n, None, None, None, levels,
+                            self._take_adm(commit_version)))
             remaining -= n
         if as_array:
 
             def collect_array():
                 self._collect_waves(pending)
+                self._feed_admission(pending)
                 return np.concatenate(
                     [np.asarray(v)[:n] for v, n, *_rest in pending]
                 )
@@ -1029,7 +1085,7 @@ class TPUConflictSet:
         waves: list[int] = []
         offset = 0
         reordered = 0
-        for verdicts, n, _losers, _reads, _flags, levels in pending:
+        for verdicts, n, _losers, _reads, _flags, levels, _adm in pending:
             lv = np.asarray(levels)[:n]
             # Reordered = committed past its CHUNK's first wave (raw
             # level > 0). The chunk offsets below exist only to make the
@@ -1043,12 +1099,31 @@ class TPUConflictSet:
         self.last_wave = waves
         self.last_reordered = reordered
 
+    def _feed_admission(self, pending: list[tuple]) -> None:
+        """Record ACCEPTED write fingerprints into the attached admission
+        filter at this resolve's commit version (no-op when detached).
+        Runs at collect time — verdicts are already materialized, so the
+        mask costs one vectorized compare per chunk."""
+        if self.admission_filter is None:
+            return
+        for verdicts, n, _l, _r, _f, _lv, adm in pending:
+            if adm is None:
+                continue
+            (fps, valid), cv = adm
+            v = np.asarray(verdicts)[:n]
+            sel = valid[:n] & (v == Verdict.COMMITTED)[:, None]
+            if sel.any():
+                self.admission_filter.record_u64(fps[:n][sel], cv)
+            else:
+                self.admission_filter.advance(cv)
+
     def _collect(self, pending: list[tuple]) -> list[Verdict]:
         out: list[Verdict] = []
         self.last_conflicting = {}
         self._collect_waves(pending)
+        self._feed_admission(pending)
         gi = 0
-        for verdicts, n, losers, reads, flags, _levels in pending:
+        for verdicts, n, losers, reads, flags, _levels, _adm in pending:
             v = np.asarray(verdicts)[:n]
             if losers is not None:
                 m = np.asarray(losers)[:n]
@@ -1180,6 +1255,8 @@ class TPUConflictSet:
         engine forces a merge here (the lazy base would otherwise hold
         expired segments until the next organic merge)."""
         self._begin_resolve(commit_version, oldest_version)
+        if self.admission_filter is not None:
+            self.admission_filter.advance(commit_version)  # age the banks
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
         if self._is_hist:

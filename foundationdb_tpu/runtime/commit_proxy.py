@@ -15,6 +15,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from foundationdb_tpu.core.errors import (
+    AdmissionPreAborted,
+    AdmissionShaped,
     CommitUnknownResult,
     DatabaseLocked,
     NotCommitted,
@@ -54,6 +56,16 @@ class CommitRequest:
     # batched ahead of everything, "batch" bulk load is batched last with
     # starvation-free aging (sched/lanes.py).
     priority: str = "default"
+    # Admission-control opt-out (admission subsystem; client option
+    # admission_no_shape): fail with AdmissionShaped instead of queueing
+    # this commit into the serializing shaped lane.
+    admission_no_shape: bool = False
+    # Consecutive pre-aborts this logical transaction has already eaten
+    # (client-reported): at/above the policy's ceiling the proxy admits
+    # the txn anyway, so a persistent loser degrades to the CANONICAL
+    # conflict path (resolver loser report → repair engine / retry
+    # ladder) instead of spinning on cheap rejections forever.
+    admission_attempts: int = 0
 
 
 @dataclass(frozen=True)
@@ -88,6 +100,7 @@ class CommitProxy:
         epoch: int = 1,
         authz=None,
         tenant_mirror=None,
+        admission=None,
     ):
         assert resolver_map.n_shards == len(resolver_eps)
         self.loop = loop
@@ -129,6 +142,20 @@ class CommitProxy:
         # already being formed; aged batch entries promote to default
         # (starvation-free).
         self._queue: LaneQueue = LaneQueue(lambda: loop.now)
+        # Admission policy (admission subsystem; None = admission off):
+        # probes every request's read set at batch formation. Proven
+        # losers pre-abort on the spot; likely losers park in the
+        # serializing shaped lane below and are CO-SCHEDULED into one
+        # dispatch window (same commit version) when the shape window
+        # elapses — contenders land where a wave-commit resolver reorders
+        # them instead of aborting, and the rest lose at most one window.
+        self.admission = admission
+        if admission is not None and admission.hot_ranges is None:
+            # Wide-range shaping consults the proxy's own aggregated
+            # hot-range sketch (the repair subsystem's loss odds).
+            admission.hot_ranges = self.hot_ranges
+        self._shaped: list[tuple[CommitRequest, Promise]] = []
+        self._shaped_since = loop.now  # head-of-lane arrival (flush clock)
         self._inflight: set[int] = set()  # batch versions being processed
         # Batches popped from _queue but not yet in _inflight (awaiting
         # their commit version): quiesce() must see them or a batch could
@@ -181,6 +208,15 @@ class CommitProxy:
             "lane_promotions": self._queue.promoted,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,
+            # Admission subsystem (None = off): probe/shape/preabort
+            # counters, false-positive accounting, lane occupancy, and
+            # the filter saturation signal the ratekeeper polls.
+            # getattr: metric-harness stubs build proxies piecemeal.
+            "admission": (
+                {**self.admission.metrics(),
+                 "shaped_depth": len(getattr(self, "_shaped", ()))}
+                if getattr(self, "admission", None) is not None else None
+            ),
         }
 
     # -- batch engine ---------------------------------------------------------
@@ -189,11 +225,38 @@ class CommitProxy:
     def live_tenants(self):
         return self.tenant_mirror.view if self.tenant_mirror else None
 
+    # Serializing shaped lane: likely losers park here until the window
+    # elapses (or the lane is deep), then ALL of them ride one batch —
+    # deliberate co-scheduling (see __init__). The window bounds shaping
+    # delay to a few batch ticks.
+    SHAPE_WINDOW_S = 0.004
+    SHAPE_MAX = 64
+    # Cross-proxy filter feed: poll each resolver's admission_delta so
+    # this proxy's probe filter also sees writes committed through PEER
+    # proxies (its own batches self-feed with zero lag in _process_inner).
+    ADMISSION_POLL_INTERVAL = 0.05
+
+    def _admission_on(self) -> bool:
+        return self.admission is not None and self.admission.enabled
+
+    def _shape_flush_due(self) -> bool:
+        """The lane flushes when its HEAD has parked a full shape window
+        (so the first shaped txn of a burst always waits out the
+        co-scheduling window collecting its contenders — the clock is
+        the head's arrival, not the last flush) or the lane is deep."""
+        return bool(self._shaped) and (
+            self.loop.now - self._shaped_since >= self.SHAPE_WINDOW_S
+            or len(self._shaped) >= self.SHAPE_MAX
+        )
+
     async def run(self) -> None:
         last_batch = self.loop.now
+        if self._admission_on():
+            self.loop.spawn(self._admission_poller(),
+                            name="commit_proxy.admission_poller")
         while True:
             await self.loop.sleep(self.BATCH_INTERVAL)
-            if not len(self._queue):
+            if not len(self._queue) and not self._shape_flush_due():
                 if self.loop.now - last_batch < self.IDLE_BATCH_INTERVAL:
                     continue
                 batch = []  # idle: empty batch keeps the version chain hot
@@ -232,6 +295,11 @@ class CommitProxy:
                     except Exception as e:  # PermissionDenied
                         p.fail(e)
                 batch = passed
+            if self._admission_on():
+                # After lock/authz (a denied commit must not burn a probe)
+                # and BEFORE the sequencer trip: pre-aborted txns never
+                # consume a version or a resolver slot.
+                batch = self._admission_gate(batch)
             last_batch = self.loop.now
             # One version per batch; fetched in the batcher (not the spawned
             # worker) so batches acquire chain positions in queue order.
@@ -251,6 +319,105 @@ class CommitProxy:
                 self._process(batch, prev_version, version),
                 name=f"commit_batch@{version}",
             )
+
+    def _admission_gate(
+        self, batch: list[tuple[CommitRequest, Promise]]
+    ) -> list[tuple[CommitRequest, Promise]]:
+        """Probe each request at admission; returns the batch to dispatch
+        (admitted + any shaped-lane flush, shaped block CONTIGUOUS at the
+        end so the whole contention neighborhood shares one window)."""
+        passed: list[tuple[CommitRequest, Promise]] = []
+        for req, p in batch:
+            if getattr(req, "_admission_shaped", False):
+                # Already shaped once (this is its flush ride): admit.
+                passed.append((req, p))
+                continue
+            d = self.admission.decide(
+                req.read_ranges, req.read_version,
+                getattr(req, "priority", "default"),
+                attempts=getattr(req, "admission_attempts", 0),
+            )
+            if d.action == "preabort":
+                feed = [(r.begin, r.end)
+                        for r in req.read_ranges if not r.empty]
+                # A proven loss is real contention evidence: feed the
+                # sketch so backoff odds keep flowing even when
+                # pre-aborts replace resolver-reported conflicts.
+                self.hot_ranges.record(feed)
+                p.fail(AdmissionPreAborted(
+                    "admission: read set overlaps a newer committed write",
+                    hot_ranges=self.hot_ranges.scores(feed),
+                    confirm_version=d.confirm_version,
+                ))
+                continue
+            if d.action == "shape":
+                if getattr(req, "admission_no_shape", False):
+                    # Never parked: reverse the shape counters — "shaped"
+                    # counts txns that actually rode the lane, or the
+                    # false-positive denominator (and the campaign's
+                    # shaped gate) would count rejections that shaped
+                    # nothing.
+                    self.admission.reclassify_no_shape(d)
+                    p.fail(AdmissionShaped(
+                        "admission: likely loser; shaped lane refused by "
+                        "admission_no_shape"))
+                    continue
+                req._admission_shaped = True
+                if not self._shaped:
+                    self._shaped_since = self.loop.now  # new lane head
+                self._shaped.append((req, p))
+                continue
+            passed.append((req, p))
+        if self._shape_flush_due():
+            flush, self._shaped = self._shaped, []
+            for req, p in flush:
+                # Exact-tier recheck at the flush ride: a loss that became
+                # provable while the txn parked pre-aborts here instead of
+                # burning its dispatch (sound — shadow-confirmed only).
+                cv = self.admission.recheck_preabort(
+                    req.read_ranges, req.read_version)
+                if cv is not None:
+                    feed = [(r.begin, r.end)
+                            for r in req.read_ranges if not r.empty]
+                    self.hot_ranges.record(feed)
+                    p.fail(AdmissionPreAborted(
+                        "admission: loss proven while shaped",
+                        hot_ranges=self.hot_ranges.scores(feed),
+                        confirm_version=cv,
+                    ))
+                    continue
+                passed.append((req, p))
+        return passed
+
+    async def _admission_poller(self) -> None:
+        """Pull resolver recent-writes deltas into the local probe filter
+        (idempotent with the proxy's own-batch self-feed by design).
+
+        Transient unreachability is retried silently; a resolver that
+        answers "admission filter not enabled" is MISCONFIGURED (this
+        proxy is armed, that resolver is not — per-process env drift in
+        a deployment) and is reported loudly once, then dropped from the
+        poll set: its feed can never materialize, and an eternal silent
+        retry would quietly reduce pre-abort/shape coverage."""
+        seqs = {i: 0 for i in range(len(self.resolvers))}
+        dead: set[int] = set()
+        while True:
+            await self.loop.sleep(self.ADMISSION_POLL_INTERVAL)
+            for i, r in enumerate(self.resolvers):
+                if i in dead:
+                    continue
+                try:
+                    seqs[i], entries = await r.admission_delta(seqs[i])
+                except Exception as e:
+                    if "admission filter not enabled" in str(e):
+                        dead.add(i)
+                        trace(self.loop).event(
+                            "AdmissionDeltaMisconfigured",
+                            Severity.WARN_ALWAYS, resolver=i,
+                        )
+                    continue  # unreachable: next poll
+                if entries:
+                    self.admission.filter.apply_delta(entries)
 
     # A batch stuck this long means the version chain is wedged (a gap from
     # lost pushes, or a peer's batch never arriving) — a state heartbeats
@@ -284,7 +451,8 @@ class CommitProxy:
         after locking: a batch that passed the lock check pre-lock is
         still entitled to its backup tagging, so dual-tagging must stay
         on until nothing admitted remains in flight."""
-        while len(self._queue) or self._inflight or self._admitting:
+        while (len(self._queue) or self._shaped or self._inflight
+               or self._admitting):
             await self.loop.sleep(self.BATCH_INTERVAL)
 
     async def _wedge_watchdog(self, version: int) -> None:
@@ -351,6 +519,22 @@ class CommitProxy:
                     name=f"request_recovery@{version}",
                 )
             return
+        if self._admission_on() and not fail_safe:
+            # Zero-lag local filter feed: this proxy's own accepted write
+            # sets enter its probe filter at the batch version the moment
+            # the verdicts land (peer proxies' writes arrive via the
+            # resolver delta poll). Shaped outcome accounting rides the
+            # same pass: a shaped txn that committed is a measured false
+            # positive (shaping never changes verdicts, only scheduling).
+            # Fail-safe batches are skipped on both counts — their
+            # verdicts are spurious capacity rejections.
+            accepted = []
+            for (req, _p), v in zip(batch, verdicts):
+                if getattr(req, "_admission_shaped", False):
+                    self.admission.note_shaped_outcome(v)
+                if v == Verdict.COMMITTED:
+                    accepted.extend(req.write_ranges)
+            self.admission.feed_accepted(accepted, version)
         for i, ((req, p), v) in enumerate(zip(batch, verdicts)):
             if v == Verdict.COMMITTED:
                 self.txns_committed += 1

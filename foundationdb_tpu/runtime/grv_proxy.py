@@ -33,6 +33,16 @@ class GrvProxy:
 
     MAX_TAG_TOKENS = 100.0
 
+    # Admission subsystem, GRV-side gate: no read set exists at GRV time,
+    # so the probe signal here is the cluster-wide recent-writes FILTER
+    # SATURATION (polled off the ratekeeper's rates next to the tps
+    # budgets). At/above this saturation the filter can no longer
+    # discriminate likely losers — shaping degrades to shape-everything —
+    # so the GRV gate paces the intake instead: default/batch grants are
+    # deferred every other interval (half-rate), while the system lane
+    # stays unconditionally admitted (the lane contract).
+    ADMISSION_DEFER_SAT = 0.75
+
     def __init__(self, loop: Loop, sequencer_ep, ratekeeper_ep=None,
                  tlog_eps: list | None = None, epoch: int = 0):
         self.loop = loop
@@ -76,6 +86,10 @@ class GrvProxy:
         self._tag_tokens: dict[str, float] = {}
         self.grvs_served = 0
         self.tag_throttled = 0  # admissions deferred by a tag bucket
+        # Admission-saturation deferral (see ADMISSION_DEFER_SAT).
+        self._admission_sat = 0.0
+        self._defer_flip = False
+        self.admission_defer_ticks = 0
 
     @rpc
     async def get_read_version(self, priority: str = PRIORITY_DEFAULT,
@@ -97,6 +111,9 @@ class GrvProxy:
             "queued": len(self._queue),
             "batch_queued": len(self._batch_queue),
             "tag_throttled": self.tag_throttled,
+            # Intervals on which default/batch grants were deferred by
+            # admission-filter saturation (admission subsystem).
+            "admission_defer_ticks": self.admission_defer_ticks,
         }
 
     def _admit(self, queue: list, tokens: float) -> tuple[list, list, float]:
@@ -137,7 +154,17 @@ class GrvProxy:
         self.loop.spawn(self._rate_poller(), name="grv.rate_poller")
         while True:
             await self.loop.sleep(self.BATCH_INTERVAL)
-            if self._tokens != float("inf"):
+            # Saturation deferral (admission subsystem): on deferred
+            # intervals default/batch buckets DO NOT refill — skipping
+            # only the admission pass would let the skipped interval's
+            # tokens accrue and double-spend next interval, leaving
+            # long-run throughput untouched (the whole point is a real
+            # half-rate intake; the bucket cap still allows bursts).
+            defer = self._admission_sat >= self.ADMISSION_DEFER_SAT
+            if defer:
+                self._defer_flip = not self._defer_flip
+            defer_now = defer and self._defer_flip
+            if self._tokens != float("inf") and not defer_now:
                 self._tokens = min(
                     self.MAX_TOKENS, self._tokens + self._rate * self.BATCH_INTERVAL
                 )
@@ -158,12 +185,19 @@ class GrvProxy:
             # is admitted this interval regardless of buckets.
             s_admitted = [p for p, _tags in self._system_queue]
             self._system_queue = []
-            admitted, self._queue, self._tokens = self._admit(
-                self._queue, self._tokens
-            )
-            b_admitted, self._batch_queue, self._batch_tokens = self._admit(
-                self._batch_queue, self._batch_tokens
-            )
+            if defer_now:
+                # Deferred interval: default and batch grants sit out
+                # (no admission, no refill — see above); waiters stay
+                # queued in order, exactly like an empty token bucket.
+                self.admission_defer_ticks += 1
+                admitted, b_admitted = [], []
+            else:
+                admitted, self._queue, self._tokens = self._admit(
+                    self._queue, self._tokens
+                )
+                b_admitted, self._batch_queue, self._batch_tokens = (
+                    self._admit(self._batch_queue, self._batch_tokens)
+                )
             batch = s_admitted + admitted + b_admitted
             if not batch:
                 continue
@@ -225,6 +259,11 @@ class GrvProxy:
                     t: self._tag_tokens.get(t, 0.0) for t in tag_rates
                 }
                 self._have_tag_rates = True
+                # Admission-filter saturation rides the same poll
+                # (admission subsystem; absent = admission off = 0).
+                self._admission_sat = float(
+                    rates.get("admission_saturation", 0.0) or 0.0
+                )
             except Exception:
                 pass  # keep last known rate while ratekeeper is unreachable
             await self.loop.sleep(self.RATE_POLL_INTERVAL)

@@ -44,6 +44,14 @@ class Ratekeeper:
     # resolver's queue, and ultimately its history capacity, overflows.
     RQ_SOFT = 16
     RQ_HARD = 128
+    # Admission-filter saturation (admission subsystem): the commit
+    # proxies' recent-writes filter fill fraction. A saturating filter
+    # means the write rate is outrunning what admission can discriminate
+    # — probes degrade toward all-hit — so the cluster throttles intake
+    # BEFORE shaping collapses into shape-everything (the signal sits
+    # next to resolver_queue, exactly as the ROADMAP item prescribed).
+    AS_SOFT = 0.60
+    AS_HARD = 0.99
     # Batch lane throttles at this fraction of every threshold.
     BATCH_FRACTION = 0.5
 
@@ -73,6 +81,7 @@ class Ratekeeper:
         self.worst_tlog_queue = 0
         self.worst_resolver_queue = 0
         self.worst_resolver_occupancy = 0.0
+        self.worst_admission_saturation = 0.0
         self.limiting_reason = "none"
         # Per-tag tps quotas (reference: TagThrottleApi manual throttles in
         # \xff\x02/throttle/): enforced by the GRV proxies' per-tag buckets.
@@ -165,6 +174,12 @@ class Ratekeeper:
             except Exception:
                 self._last_committed = None  # membership degraded: re-baseline
                 return
+        # Admission-filter saturation rides the same proxy metrics poll
+        # (admission subsystem; proxies without a policy report None).
+        self.worst_admission_saturation = max(
+            ((m.get("admission") or {}).get("saturation", 0.0) for m in ms),
+            default=0.0,
+        )
         committed = sum(m.get("txns_committed", 0) for m in ms)
         # Backlog = admission-limited evidence: commits queued at the
         # proxies PLUS batches parked in resolver dispatch queues (the
@@ -200,6 +215,8 @@ class Ratekeeper:
             ("tlog_queue", self.worst_tlog_queue, self.TQ_SOFT, self.TQ_HARD),
             ("resolver_queue", self.worst_resolver_queue,
              self.RQ_SOFT, self.RQ_HARD),
+            ("admission_filter", self.worst_admission_saturation,
+             self.AS_SOFT, self.AS_HARD),
         ]
         worst, reason = 1.0, "none"
         for name, value, soft, hard in signals:
@@ -240,6 +257,7 @@ class Ratekeeper:
             "worst_tlog_queue_bytes": self.worst_tlog_queue,
             "worst_resolver_queue": self.worst_resolver_queue,
             "resolver_dispatch_occupancy": self.worst_resolver_occupancy,
+            "admission_saturation": self.worst_admission_saturation,
             "tag_rates": dict(self.tag_quotas),
             "base_tps": self.base_tps,
             "measured_tps": self.measured_tps,

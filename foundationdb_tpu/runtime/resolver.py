@@ -41,7 +41,8 @@ class Resolver:
     def __init__(self, loop: Loop, conflict_set, init_version: int = 0,
                  scheduler: ResolveScheduler | None = None,
                  budget_s: float | None = None,
-                 dispatch_cost_s: float = 0.0):
+                 dispatch_cost_s: float = 0.0,
+                 admission_filter=None):
         self.loop = loop
         self.cs = conflict_set
         # Modeled per-batch device-execution cost (virtual seconds).
@@ -97,6 +98,13 @@ class Resolver:
         # exported via get_metrics and aggregated at the commit proxy
         # (repair subsystem — repair/hotrange.py).
         self.hot_ranges = HotRangeSketch(lambda: loop.now)
+        # Recent-writes filter feed (admission subsystem): the resolver is
+        # the AUTHORITATIVE feeder — every accepted write set of every
+        # proxy passes through here, so its filter sees the union. Commit
+        # proxies pull deltas (admission_delta) into their local probe
+        # filters; fail-safe batches never feed (their rejections are
+        # spurious and their "accepted" set is empty by construction).
+        self.admission_filter = admission_filter
 
     @rpc
     async def begin_epoch(self, start_version: int) -> int:
@@ -267,6 +275,18 @@ class Resolver:
             self.txns_conflicted += sum(
                 1 for v in verdicts if v == Verdict.CONFLICT
             )
+            if self.admission_filter is not None:
+                # Accepted write sets feed the recent-writes filter at
+                # THIS batch's commit version (begin keys; wide ranges
+                # degrade to their begin key — under-detection only, the
+                # admission tiers tolerate it by construction).
+                keys = [
+                    bytes(w.begin)
+                    for t, v in zip(txns, verdicts)
+                    if v == Verdict.COMMITTED
+                    for w in t.write_ranges if not w.empty
+                ]
+                self.admission_filter.record(keys, version)
         if wave is not None:
             # Attribution counters (see __init__): a committed txn past
             # its chunk's first wave was REORDERED behind a same-window
@@ -359,6 +379,20 @@ class Resolver:
         )
         return True
 
+    @rpc
+    async def admission_delta(
+        self, since_seq: int = 0
+    ) -> tuple[int, list[tuple[bytes, int]]]:
+        """Recent-writes filter delta feed (admission subsystem): (new
+        seq, [(write key, commit version), ...]) recorded since the
+        caller's last seq. Commit proxies poll this into their local
+        probe filters; an empty reply is the steady state. Raises when
+        the resolver runs without a filter (admission off) so a
+        misconfigured poller fails loudly instead of probing nothing."""
+        if self.admission_filter is None:
+            raise ValueError("admission filter not enabled on this resolver")
+        return self.admission_filter.delta_since(since_seq)
+
     @property
     def version(self) -> int:
         return self._version
@@ -392,4 +426,10 @@ class Resolver:
             # 0.1s poll (campaign find; see ResolveScheduler._note_depth).
             "queue_depth_hw": self.sched.depth_high_water(),
             "queue": self.sched.metrics(),
+            # Recent-writes filter (admission subsystem; None = admission
+            # off): recorded counts, rotation, saturation, delta seq.
+            "admission_filter": (
+                self.admission_filter.metrics()
+                if self.admission_filter is not None else None
+            ),
         }

@@ -87,6 +87,29 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
             # proxies' aggregated decayed loss sketches, hottest first.
             "hot_ranges": [],
             "conflict_losses": 0,
+            # Admission-time early conflict detection (admission
+            # subsystem): probe/shape/preabort counters summed over the
+            # commit proxies, false-positive accounting (shaped txns the
+            # engine then committed), shaped-lane occupancy, the filter
+            # saturation signal (worst proxy), GRV-side deferral ticks,
+            # and the resolvers' filter feed totals.
+            "admission": {
+                "enabled": False,
+                "probes": 0,
+                "admitted": 0,
+                "shaped": 0,
+                "preaborted": 0,
+                "shaped_committed": 0,
+                "shaped_conflicted": 0,
+                "shaped_too_old": 0,
+                "system_bypass": 0,
+                "system_shaped": 0,
+                "no_shape_rejects": 0,
+                "shaped_depth": 0,
+                "saturation": 0.0,
+                "grv_defer_ticks": 0,
+                "filter_recorded": 0,
+            },
             # Replica byte-parity audit (consistency subsystem): summary
             # of the most recent ConsistencyChecker run against this
             # cluster, or never_run.
@@ -99,10 +122,12 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         "processes": {},
     }
 
+    adm = doc["workload"]["admission"]
     for ep, mt in zip(grv_eps, grv_ms):
         m = await mt
         doc["processes"][ep.process] = {"role": "grv_proxy", "reachable": m is not None}
         doc["workload"]["grvs_served"] += m["grvs_served"] if m else 0
+        adm["grv_defer_ticks"] += m.get("admission_defer_ticks", 0) if m else 0
 
     # Same range recorded at several proxies = one global hot range: merge
     # by (begin, end), summing the decayed loss mass, before ranking.
@@ -117,6 +142,19 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
                 k = (h["begin"], h["end"])
                 hot[k] = hot.get(k, 0.0) + h["score"]
             doc["workload"]["conflict_losses"] += m.get("conflict_losses", 0)
+            a = m.get("admission")
+            if a:
+                adm["enabled"] = adm["enabled"] or bool(a.get("enabled"))
+                for key in ("probes", "admitted", "shaped", "preaborted",
+                            "shaped_committed", "shaped_conflicted",
+                            "shaped_too_old",
+                            "system_bypass", "system_shaped",
+                            "no_shape_rejects"):
+                    adm[key] += a.get(key, 0)
+                adm["shaped_depth"] = max(
+                    adm["shaped_depth"], a.get("shaped_depth", 0))
+                adm["saturation"] = max(
+                    adm["saturation"], a.get("saturation", 0.0))
     doc["workload"]["hot_ranges"] = [
         {"begin": b, "end": e, "score": round(s, 3)}
         for (b, e), s in sorted(hot.items(), key=lambda kv: -kv[1])[:16]
@@ -148,6 +186,9 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
             )
             rq["windows_dispatched"] += q.get("windows_dispatched", 0)
             rq["batches_dispatched"] += q.get("batches_dispatched", 0)
+            f = m.get("admission_filter")
+            if f:
+                adm["filter_recorded"] += f.get("recorded", 0)
 
     for ep, vt in zip(tlog_eps, tlog_vers):
         ver = await vt

@@ -239,15 +239,18 @@ def _register_runtime_types() -> None:
         lambda r: (
             r.read_version, list(r.mutations), list(r.read_ranges),
             list(r.write_ranges), r.report_conflicting_keys, r.lock_aware,
-            r.token, r.priority,
+            r.token, r.priority, r.admission_no_shape, r.admission_attempts,
         ),
         lambda f: CommitRequest(
             read_version=f[0], mutations=f[1], read_ranges=f[2],
             write_ranges=f[3], report_conflicting_keys=f[4],
-            # Shorter forms: peers predating lock_aware/token/priority.
+            # Shorter forms: peers predating lock_aware/token/priority/
+            # the admission fields.
             lock_aware=f[5] if len(f) > 5 else False,
             token=f[6] if len(f) > 6 else None,
             priority=f[7] if len(f) > 7 else "default",
+            admission_no_shape=f[8] if len(f) > 8 else False,
+            admission_attempts=f[9] if len(f) > 9 else 0,
         ),
     )
     register_struct(

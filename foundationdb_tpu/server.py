@@ -179,6 +179,31 @@ def parse_addr(s: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def _make_admission_filter():
+    """Recent-writes filter for a deployed resolver when the admission
+    subsystem is armed (FDB_TPU_ADMISSION=1; admission/__init__.py)."""
+    from foundationdb_tpu.admission import (
+        RecentWritesFilter,
+        admission_env_default,
+    )
+
+    return RecentWritesFilter() if admission_env_default() else None
+
+
+def _make_admission_policy():
+    """AdmissionPolicy for a deployed commit proxy (env-armed, like the
+    sim recruiter's new_admission_policy)."""
+    from foundationdb_tpu.admission import (
+        AdmissionPolicy,
+        RecentWritesFilter,
+        admission_env_default,
+    )
+
+    if not admission_env_default():
+        return None
+    return AdmissionPolicy(filter=RecentWritesFilter(), enabled=True)
+
+
 def _make_authz(spec: dict):
     """Tenant authz verifier from the spec's `authz_public_key` (a PEM
     path — main() resolves it against the cluster file's directory before
@@ -532,7 +557,8 @@ class Worker:
             Resolver(self.loop,
                      make_conflict_set(engine,
                                        len(self.spec["resolver"])),
-                     init_version=start_version),
+                     init_version=start_version,
+                     admission_filter=_make_admission_filter()),
         )
         self.epoch = epoch
         return start_version
@@ -578,6 +604,7 @@ class Worker:
             authz=_make_authz(self.spec),
             tenant_mirror=_make_tenant_mirror(
                 self.loop, self.t, self.spec, storage_map, self._spawn),
+            admission=_make_admission_policy(),
         )
         proxy.backup_enabled = backup_enabled
         proxy.locked = locked
@@ -1523,7 +1550,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         engine = spec.get("engine", "cpu")
         t.serve("resolver",
                 Resolver(loop, make_conflict_set(engine,
-                                                 len(spec["resolver"]))))
+                                                 len(spec["resolver"])),
+                         admission_filter=_make_admission_filter()))
     elif role == "tlog":
         from foundationdb_tpu.runtime.tlog import TLog
 
@@ -1585,6 +1613,7 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
             tenant_mirror=_make_tenant_mirror(
                 loop, t, spec, storage_map,
                 lambda name, mk: _supervise(loop, name, mk)),
+            admission=_make_admission_policy(),
         )
         # Static wiring: epoch 0 = unfenced (no recruitment protocol).
         # GrvProxy skips the per-batch confirm_epoch fan-out at epoch 0 —

@@ -205,6 +205,46 @@ async def run_checks(spec: CampaignSpec, ctx: NemesisContext) -> dict:
             raise CampaignCheckFailed(
                 f"counter {counter}={got} < required {n} — the composition "
                 "this campaign exists for never happened")
+    # Admission-subsystem exact gates (admission subsystem): counters read
+    # off the CURRENT generation's commit-proxy policies — campaigns using
+    # them must not kill proxies (per-generation counters, like every
+    # other role counter).
+    def _adm_totals() -> dict:
+        totals: dict = {}
+        for p in getattr(ctx.cluster, "commit_proxies", []):
+            pol = getattr(p, "admission", None)
+            if pol is None:
+                continue
+            for k, v in pol.counters.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
+    for key, counter in (("admissionShapedMin", "shaped"),
+                         ("admissionPreabortedMin", "preaborted"),
+                         ("admissionProbesMin", "probes")):
+        n = checks.pop(key, None)
+        if n is None:
+            continue
+        got = _adm_totals().get(counter, 0)
+        out[f"admission_{counter}"] = got
+        if got < n:
+            raise CampaignCheckFailed(
+                f"admission counter {counter}={got} < required {n} — the "
+                "admission composition this campaign exists for never "
+                "happened")
+    if checks.pop("admissionSystemZeroShaped", False):
+        t = _adm_totals()
+        out["admission_system"] = {
+            "bypass": t.get("system_bypass", 0),
+            "shaped": t.get("system_shaped", 0),
+        }
+        if t.get("system_bypass", 0) <= 0:
+            raise CampaignCheckFailed(
+                "no system-priority txn ever reached admission — the "
+                "zero-shaping gate is vacuous")
+        if t.get("system_shaped", 0):
+            raise CampaignCheckFailed(
+                f"system-priority txns were shaped: {t}")
     n = checks.pop("repairRoundsMin", None)
     if n is not None:
         rounds = sum(

@@ -102,6 +102,8 @@ class SimCluster:
         resolver_budget_s: float = 0.0,
         resolver_dispatch_cost_s: float = 0.0,
         wave_commit: bool | None = None,
+        admission: bool | None = None,
+        admission_opts: dict | None = None,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -179,6 +181,18 @@ class SimCluster:
                             else bool(wave_commit))
         if self.wave_commit:
             _validate_wave_commit(n_resolvers=n_resolvers)
+        # Admission-time early conflict detection (admission subsystem;
+        # None = the FDB_TPU_ADMISSION env default, off by default): each
+        # generation's resolvers get a recent-writes filter (the
+        # authoritative feed), each commit proxy an AdmissionPolicy over
+        # its own probe filter (self-fed from its batches + resolver
+        # deltas), and the GRV proxies defer on the saturation signal the
+        # ratekeeper aggregates.
+        from foundationdb_tpu.admission import admission_env_default
+
+        self.admission = (admission_env_default() if admission is None
+                          else bool(admission))
+        self.admission_opts = dict(admission_opts or {})
         # Operator tag quotas survive recoveries: the dict is SHARED with
         # each generation's Ratekeeper (set_tag_quota mutates it in
         # place), so a newly recruited ratekeeper inherits every quota —
@@ -610,13 +624,23 @@ class SimCluster:
         assert self.sequencer.last_handed_out == start_version
         self.sequencer_ep = host("master" + sfx, "sequencer", self.sequencer)
 
+        def new_admission_filter():
+            if not self.admission:
+                return None
+            from foundationdb_tpu.admission import RecentWritesFilter
+
+            return RecentWritesFilter(
+                **{k: v for k, v in self.admission_opts.items()
+                   if k in ("bits_log2", "banks", "window_versions")})
+
         self.resolvers = [
             Resolver(self.loop,
                      new_conflict_set(self.engine,
                                       wave_commit=self.wave_commit),
                      init_version=start_version,
                      budget_s=self.resolver_budget_s,
-                     dispatch_cost_s=self.resolver_dispatch_cost_s)
+                     dispatch_cost_s=self.resolver_dispatch_cost_s,
+                     admission_filter=new_admission_filter())
             for _ in range(self.n_resolvers)
         ]
         self.resolver_eps = [
@@ -693,6 +717,17 @@ class SimCluster:
             for i, g in enumerate(self.grv_proxies)
         ]
 
+        def new_admission_policy():
+            if not self.admission:
+                return None
+            from foundationdb_tpu.admission import AdmissionPolicy
+
+            return AdmissionPolicy(
+                filter=new_admission_filter(), enabled=True,
+                shape_risk=self.admission_opts.get("shape_risk"),
+                preabort=self.admission_opts.get("preabort"),
+            )
+
         self.commit_proxies = [
             CommitProxy(
                 self.loop,
@@ -705,6 +740,7 @@ class SimCluster:
                 epoch=epoch,
                 authz=self.authz,
                 tenant_mirror=self.tenant_mirror,
+                admission=new_admission_policy(),
             )
             for _ in range(self.n_proxies)
         ]

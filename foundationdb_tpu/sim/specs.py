@@ -210,6 +210,10 @@ CLUSTER_KEY_MAP = {
     # resolver_queue backpressure) is exercisable in simulation.
     "resolverBudget": "resolver_budget_s",
     "resolverDispatchCost": "resolver_dispatch_cost_s",
+    # Admission-time early conflict detection (admission subsystem):
+    # `admission = true` arms the recent-writes filter + policy on every
+    # generation's proxies/resolvers.
+    "admission": "admission",
 }
 
 
@@ -219,6 +223,15 @@ def cluster_kwargs_from_table(tbl: dict) -> dict:
     clusters for the same table."""
     opts = {CLUSTER_KEY_MAP[k]: v for k, v in tbl.items()
             if k in CLUSTER_KEY_MAP}
+    # Admission knobs (admission subsystem): threshold/feature overrides
+    # collected into SimCluster's admission_opts.
+    adm_opts = {}
+    if "admissionShapeRisk" in tbl:
+        adm_opts["shape_risk"] = float(tbl["admissionShapeRisk"])
+    if "admissionPreabort" in tbl:
+        adm_opts["preabort"] = bool(tbl["admissionPreabort"])
+    if adm_opts:
+        opts["admission_opts"] = adm_opts
     # Region config (reference: DatabaseConfiguration regions):
     # `satelliteTlogs = k` turns on the pri/sat/rem multi-region topology.
     if "satelliteTlogs" in tbl:

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Admission-subsystem A/B (ISSUE 9 acceptance harness): runs the
+# bench.py --admission-ab Zipf-0.99 RMW goodput harness — admission OFF
+# vs ON on the SAME seeds (the arms differ only in FDB_TPU_ADMISSION),
+# under both canonical client loops:
+#
+#   naive  (full-restart retry)  — HEADLINE: mean goodput ratio over the
+#                                  seed set must be >= 1.2 with every
+#                                  per-seed pair individually > 1.0;
+#   repair (partial re-execution) — recorded at the wave-commit A/B's
+#                                  proven scale: admission must compose
+#                                  with repair, never cannibalize it.
+#
+# Serializability is oracle-verified on BOTH sides of every pair (the
+# clusters resolve with the replay-checked oracle: every commit set is
+# validated by inline sequential replay, byte-for-byte) and each arm's
+# record carries exact conflict/shaped/preaborted/false-positive
+# attribution plus the preabort-evidence-complete honesty invariant.
+#
+# Unlike the kernel A/Bs there is no per-process env contract here (the
+# admission flag is a per-cluster constructor argument), so one bench
+# invocation runs every arm deterministically.
+#
+# Pure simulation (virtual-time goodput, CPU by design, no TPU): the
+# honesty flags record that — cpu_fallback is false because no TPU run
+# was attempted and none is claimed; p99_quotable is false because a
+# virtual-time sim has no wall-clock latency distribution to quote.
+#
+#   MIN_RATIO=1.2 OUT=ADMISSION_AB.json scripts/admission_ab.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-ADMISSION_AB.json}
+LOG=${LOG:-admission_ab.log}
+MIN_RATIO=${MIN_RATIO:-1.2}
+
+SCRATCH=$(mktemp -d /tmp/_admission_ab.XXXXXX)
+trap 'rm -rf "$SCRATCH"' EXIT
+env JAX_PLATFORMS=cpu python bench.py --admission-ab \
+    --admission-min-ratio "$MIN_RATIO" \
+    > "$SCRATCH/rec.json" 2>> "$LOG"
+rc=$?
+if [ ! -s "$SCRATCH/rec.json" ]; then
+  # A crashed harness must not ship a vacuous artifact a done-check
+  # could mistake for the acceptance record.
+  echo "admission_ab: bench.py --admission-ab produced no record" \
+       "rc=$rc (see $LOG)" >&2
+  exit 1
+fi
+tail -n 1 "$SCRATCH/rec.json" > "$OUT"
+cat "$OUT"
+# rc mirrors the record's own valid gate (bench exits nonzero when the
+# mean ratio misses MIN_RATIO or any pair fails/unserializes).
+exit $rc

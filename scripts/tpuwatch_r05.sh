@@ -135,6 +135,16 @@ while true; do
     'r.get("metric") == "wave_commit_ab" and r.get("valid")' -- \
     env OUT=WAVE_AB_r05_rec.json bash scripts/wave_ab.sh \
     || { sleep 60; continue; }
+  # Admission A/B (admission-time early conflict detection): CPU-only
+  # deterministic sim — FDB_TPU_ADMISSION off vs on on the same seeds,
+  # replay-checked oracle serializability both sides, mean naive-loop
+  # goodput ratio >= 1.2 with exact shaped/preaborted/false-positive
+  # attribution (the artifact's own `valid` gates all of it; standard
+  # honesty flags: valid / cpu_fallback / p99_quotable).
+  stage ab_admission 1800 ADMISSION_AB_r05.json \
+    'r.get("metric") == "admission_ab" and r.get("valid")' -- \
+    env OUT=ADMISSION_AB_r05_rec.json bash scripts/admission_ab.sh \
+    || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"
