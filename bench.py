@@ -1380,10 +1380,18 @@ def pct(lat_ms: list[float], q: float) -> float:
 MIN_LATENCY_SAMPLES = 32
 
 
-def annotate_latency(rec: dict, n_samples: int) -> dict:
+def annotate_latency(rec: dict, n_samples: int,
+                     co_corrected: bool = False) -> dict:
     """Stamp a record with its timed-dispatch count and whether its p99 is
-    quotable. Mutates and returns `rec`."""
+    quotable. Mutates and returns `rec`.
+
+    `co_corrected`: True only when latencies were measured from each
+    request's SCHEDULED arrival time under open-loop load (the loadgen
+    harness) — i.e. free of coordinated omission. Closed-loop records
+    (everything else in this file) are stamped False so the two latency
+    regimes can never be quoted interchangeably."""
     rec["latency_samples"] = int(n_samples)
+    rec["co_corrected"] = bool(co_corrected)
     rec["p99_quotable"] = n_samples >= MIN_LATENCY_SAMPLES
     if not rec["p99_quotable"]:
         rec["latency_flag"] = f"latency_samples < {MIN_LATENCY_SAMPLES}"
@@ -1620,6 +1628,31 @@ def main() -> None:
                          "wave scheduling (1), sequential-order abort "
                          "(0), or the FDB_TPU_WAVE_COMMIT env default "
                          "(scripts/wave_ab.sh fixes the env per arm)")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="open-loop scale-out harness: boot a REAL "
+                         "multi-process cluster over TCP per proxy count, "
+                         "drive it with out-of-process Poisson generators "
+                         "(coordinated-omission-correct latencies), and "
+                         "print the open_loop_scaleout record — txns/s vs "
+                         "proxy count, p99 vs offered load through/past "
+                         "saturation, and the ratekeeper "
+                         "overload-engage/recover run")
+    ap.add_argument("--ol-proxies", default="1,2",
+                    help="comma list of proxy-process counts to sweep")
+    ap.add_argument("--ol-duration", type=float, default=4.0,
+                    help="seconds of offered load per ladder point")
+    ap.add_argument("--ol-generators", type=int, default=1,
+                    help="open-loop generator processes per run")
+    ap.add_argument("--ol-clients", type=int, default=512,
+                    help="virtual client slots per generator")
+    ap.add_argument("--ol-calib-rate", type=float, default=2500.0,
+                    help="past-saturation capacity-probe offered rate")
+    ap.add_argument("--ol-p99-bound-ms", type=float, default=750.0,
+                    help="bounded-p99 clause for a sustainable point")
+    ap.add_argument("--ol-min-scaling", type=float, default=1.15,
+                    help="required sustainable-tps ratio across counts")
+    ap.add_argument("--ol-no-overload", action="store_true",
+                    help="skip the ratekeeper overload/recovery run")
     ap.add_argument("--admission-ab", action="store_true",
                     help="run the admission-subsystem A/B goodput harness "
                          "(FDB_TPU_ADMISSION off vs on, same seeds, "
@@ -1636,6 +1669,31 @@ def main() -> None:
                          "read-hot-write-cold chains (the reorderable "
                          "shape)")
     args = ap.parse_args()
+    if args.open_loop:
+        # Real-socket control-plane harness: subprocess cluster + CPU
+        # resolve engine by design — pin CPU so importing the client
+        # stack here can never touch the TPU tunnel (the server/loadgen
+        # subprocesses pin themselves).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from foundationdb_tpu.loadgen.bench import run_open_loop_bench
+
+        rec = run_open_loop_bench(
+            proxy_counts=[int(p) for p in args.ol_proxies.split(",")],
+            duration_s=args.ol_duration,
+            generators=args.ol_generators,
+            clients=args.ol_clients,
+            seed=args.seed,
+            calib_rate=args.ol_calib_rate,
+            p99_bound_ms=args.ol_p99_bound_ms,
+            min_scaling=args.ol_min_scaling,
+            overload=not args.ol_no_overload,
+            annotate=annotate_latency,  # one quotability rule, co_corrected
+        )
+        print(json.dumps(rec), flush=True)
+        # rc-0 even when valid:false (e.g. a single-core host cannot show
+        # proxy scaling): the record's own flags are the evidence; nonzero
+        # rc stays reserved for harness errors (cpu_fallback precedent).
+        sys.exit(0)
     if args.admission_ab:
         # Pure simulation (replay-checked oracle engine): pin CPU so
         # importing the client stack can never touch the TPU tunnel.

@@ -1,0 +1,38 @@
+"""Open-loop traffic generation against real multi-process deployments.
+
+Every headline number before this subsystem came from closed-loop drivers:
+the next request waits for the previous response, so when the cluster
+slows, the offered load politely slows with it — queueing collapse is
+structurally invisible and sustainable throughput is overstated (FAFO,
+arxiv 2507.10757, demonstrates exactly this failure of single-node TPS
+claims). The paper's own target metric — resolved txns/sec at 1M in-flight
+clients at equal p99 commit latency — is an OPEN-LOOP statement: arrivals
+come from independent clients on their own schedule, whether or not the
+cluster is keeping up.
+
+This package makes that measurable honestly:
+
+- arrivals.py  — Poisson and trace-shaped interarrival schedules modelling
+  millions of independent clients with bounded per-client concurrency.
+- harness.py   — the open-loop runner: dispatches transactions at their
+  SCHEDULED times, measures latency from the scheduled arrival (coordinated-
+  omission correct), counts shed load explicitly, aggregates into mergeable
+  log-binned histograms.
+- deploy.py    — SocketCluster: spawn/teardown of a real multi-process
+  cluster (python -m foundationdb_tpu.server per role) over TCP.
+- __main__.py  — one generator process (several are aggregated by bench).
+- bench.py     — the published curves: txns/s vs proxy-process count and
+  p99 commit latency vs offered load through and past saturation, plus the
+  overload/recovery run that shows ratekeeper clamps engaging and
+  releasing (bench.py --open-loop).
+"""
+
+from foundationdb_tpu.loadgen.arrivals import (  # noqa: F401
+    poisson_schedule,
+    trace_schedule,
+)
+from foundationdb_tpu.loadgen.harness import (  # noqa: F401
+    LatencyHistogram,
+    OpenLoopResult,
+    run_open_loop,
+)

@@ -1,0 +1,150 @@
+"""One open-loop generator process: drive a deployed cluster, print JSON.
+
+    python -m foundationdb_tpu.loadgen --cluster cluster.json \
+        --rate 800 --duration 10 --clients 512 --seed 7
+
+Several of these run side by side against the same cluster (each is one
+OS process with its own RealLoop and sockets — the generator scales
+horizontally exactly like the clients it simulates); bench.py --open-loop
+merges their JSON lines (OpenLoopResult.merge_dicts). `--start-at` is an
+epoch timestamp every generator sleeps until, so schedules across
+processes share one t0; a generator that boots late fast-forwards through
+its missed arrivals (the CO-correct accounting charges the delay to those
+arrivals' latencies rather than quietly re-anchoring the schedule).
+
+The default transaction is a single-key blind write into a seed-disjoint
+keyspace (`--keys` distinct keys); `--reads N` prepends N point reads of
+the same keyspace, making each txn a read-write conflict candidate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from foundationdb_tpu.loadgen.arrivals import (
+    parse_profile,
+    poisson_schedule,
+    trace_schedule,
+)
+from foundationdb_tpu.loadgen.harness import run_open_loop
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m foundationdb_tpu.loadgen")
+    ap.add_argument("--cluster", required=True, help="cluster spec JSON")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="offered load, txns/sec (Poisson)")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--profile", default=None,
+                    help="trace-shaped load 'dur:rate,dur:rate,...' "
+                         "(overrides --rate/--duration)")
+    ap.add_argument("--points", default=None,
+                    help="rate LADDER 'dur:rate,dur:rate,...': run each "
+                         "point as a SEPARATE Poisson run (own keyspace, "
+                         "own JSON line with per-point accounting), "
+                         "--point-gap-s apart. Cross-process sync: every "
+                         "generator derives each point's start from "
+                         "--start-at + the shared durations. This is how "
+                         "bench.py sweeps offered load without paying a "
+                         "process boot per point.")
+    ap.add_argument("--point-gap-s", type=float, default=4.0,
+                    help="settle/drain gap between ladder points")
+    ap.add_argument("--clients", type=int, default=256,
+                    help="virtual client slots (per-client concurrency 1)")
+    ap.add_argument("--client-queue-cap", type=int, default=64)
+    ap.add_argument("--max-inflight", type=int, default=4096)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keys", type=int, default=4096,
+                    help="distinct keys per generator (seed-disjoint)")
+    ap.add_argument("--reads", type=int, default=0,
+                    help="point reads per txn before the write")
+    ap.add_argument("--value-bytes", type=int, default=16)
+    ap.add_argument("--timeout-ms", type=int, default=5000)
+    ap.add_argument("--retry-limit", type=int, default=8)
+    ap.add_argument("--drain-s", type=float, default=15.0)
+    ap.add_argument("--start-at", type=float, default=None,
+                    help="epoch seconds to anchor t0 (cross-process sync)")
+    args = ap.parse_args(argv)
+
+    from foundationdb_tpu.cli import open_cluster
+
+    loop, t, db = open_cluster(args.cluster)
+    from foundationdb_tpu.client.transaction import Transaction
+
+    db.transaction_class = Transaction  # raw txns: RYW adds no load here
+
+    value = b"v" * max(1, args.value_bytes)
+    n_keys, n_reads = args.keys, args.reads
+
+    def make_txn_fn(prefix: bytes):
+        async def txn_fn(tr, k: int) -> None:
+            key = prefix + b"%d" % (k % n_keys)
+            for r in range(n_reads):
+                await tr.get(prefix + b"%d" % ((k + r + 1) % n_keys))
+            tr.set(key, value)
+
+        return txn_fn
+
+    def wait_until(wall: "float | None") -> float:
+        if wall is None:
+            return 0.0
+        lag = max(0.0, time.time() - wall)
+        while time.time() < wall:
+            time.sleep(min(0.05, wall - time.time()))
+        return lag
+
+    def one_run(schedule, txn_fn, drain_s: float):
+        async def main_coro():
+            return await run_open_loop(
+                loop, db, schedule, txn_fn,
+                n_clients=args.clients,
+                client_queue_cap=args.client_queue_cap,
+                max_inflight=args.max_inflight,
+                timeout_ms=args.timeout_ms,
+                retry_limit=args.retry_limit,
+                drain_s=drain_s,
+            )
+
+        span = float(schedule[-1]) if schedule.size else 0.0
+        return loop.run(main_coro(), timeout=span + drain_s + 120.0)
+
+    if args.points:
+        points = parse_profile(args.points)
+        at = args.start_at if args.start_at is not None else time.time()
+        for i, (dur, rate) in enumerate(points):
+            start_lag = wait_until(at)
+            at += dur + args.point_gap_s
+            schedule = poisson_schedule(rate, dur,
+                                        seed=args.seed + 7919 * i)
+            res = one_run(schedule,
+                          make_txn_fn(b"ol/%d/%d/" % (args.seed, i)),
+                          drain_s=max(1.0, args.point_gap_s - 1.0))
+            rec = res.to_dict()
+            rec.update(point=i, offered_tps=rate, duration_s=dur,
+                       start_lag_s=round(start_lag, 3), seed=args.seed)
+            print(json.dumps(rec), flush=True)
+        t.close()
+        return 0
+
+    if args.profile:
+        schedule = trace_schedule(parse_profile(args.profile),
+                                  seed=args.seed)
+    else:
+        schedule = poisson_schedule(args.rate, args.duration,
+                                    seed=args.seed)
+    start_lag = wait_until(args.start_at)
+    res = one_run(schedule, make_txn_fn(b"ol/%d/" % args.seed),
+                  drain_s=args.drain_s)
+    t.close()
+    rec = res.to_dict()
+    rec["start_lag_s"] = round(start_lag, 3)
+    rec["seed"] = args.seed
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

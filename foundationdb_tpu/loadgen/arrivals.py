@@ -1,0 +1,66 @@
+"""Open-loop arrival schedules: Poisson and trace-shaped interarrivals.
+
+An open-loop schedule is decided BEFORE the run: arrival k happens at
+schedule[k] seconds after t0 no matter how the cluster is doing. The
+generator never waits for a response before the next arrival — that
+dependency is exactly what makes closed-loop numbers lie past saturation.
+
+Schedules model a large population of independent clients: the aggregate
+of N independent sparse arrival processes converges on a Poisson process
+(Palm–Khintchine), so a single exponential-gap stream stands in for
+"millions of clients" faithfully as long as no single virtual client is
+asked to pipeline against itself — the harness enforces that with bounded
+per-client concurrency (each arrival is assigned to a virtual client slot;
+a busy slot queues the arrival, and the queue wait is PART of the measured
+latency, never silently skipped).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def poisson_schedule(rate: float, duration_s: float,
+                     seed: int = 0) -> np.ndarray:
+    """Arrival offsets (seconds, ascending, float64) for a homogeneous
+    Poisson process of `rate` arrivals/sec over `duration_s`."""
+    if rate <= 0 or duration_s <= 0:
+        return np.zeros(0, np.float64)
+    rng = np.random.default_rng(seed)
+    # Draw with 3-sigma headroom, then trim to the window: one allocation,
+    # no incremental growth, exact Poisson gaps.
+    n = int(rate * duration_s + 4 * np.sqrt(rate * duration_s) + 16)
+    t = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    out = t[t < duration_s]
+    while n and out.size == n:  # headroom was not enough (tiny rates)
+        n *= 2
+        t = np.cumsum(rng.exponential(1.0 / rate, size=n))
+        out = t[t < duration_s]
+    return out
+
+
+def trace_schedule(profile: "list[tuple[float, float]]",
+                   seed: int = 0) -> np.ndarray:
+    """Trace-shaped arrivals: `profile` is a list of (duration_s, rate)
+    segments played back to back — a piecewise-constant rate function
+    (diurnal curves, bursts, the overload→recovery shape the bench's
+    ratekeeper run uses). Each segment is Poisson at its own rate."""
+    out: list[np.ndarray] = []
+    t0 = 0.0
+    for i, (dur, rate) in enumerate(profile):
+        seg = poisson_schedule(rate, dur, seed=seed + 1000003 * i)
+        out.append(seg + t0)
+        t0 += dur
+    if not out:
+        return np.zeros(0, np.float64)
+    return np.concatenate(out)
+
+
+def parse_profile(spec: str) -> "list[tuple[float, float]]":
+    """Parse "dur:rate,dur:rate,..." (seconds:txns-per-sec) into a
+    trace_schedule profile — the CLI surface of trace-shaped load."""
+    profile = []
+    for part in spec.split(","):
+        dur, rate = part.split(":")
+        profile.append((float(dur), float(rate)))
+    return profile

@@ -1,0 +1,408 @@
+"""bench.py --open-loop: the published open-loop scale-out record.
+
+Produces ONE JSON record (metric ``open_loop_scaleout``) holding the two
+curves the ROADMAP item names, measured against a REAL multi-process
+cluster over TCP sockets (loadgen.deploy.SocketCluster), driven by
+out-of-process open-loop generators (python -m foundationdb_tpu.loadgen)
+whose latencies are coordinated-omission correct (measured from scheduled
+arrival — harness.py):
+
+1. ``scaling_curve`` — sustainable txns/s vs proxy-process count: for each
+   count, a past-saturation capacity probe then a rate ladder; the
+   sustainable point is the highest offered load the cluster completes
+   (>= SUSTAIN_FRAC) at bounded CO-corrected p99.
+2. ``latency_curve`` — CO-corrected p99 commit latency vs offered load on
+   the largest proxy count, through and PAST saturation (the region
+   closed-loop harnesses structurally cannot see).
+
+Plus the ``overload`` run: offered load far past capacity on a cluster
+whose resolver models real dispatch cost, while the ratekeeper is polled
+from the side — the record shows its clamps engaging
+(``resolver_queue``/``admission_filter`` limiting reasons, the signals
+built for exactly this), shed/timed-out load counted explicitly, and the
+cluster recovering (limiting reason back to ``none``, bounded p99) once
+offered load drops.
+
+Honesty flags ride along as established: ``valid`` gates on the full
+acceptance (both curves, scaling at bounded p99, overload engage+recover),
+``cpu_fallback`` is false because no TPU run is attempted or claimed (the
+resolve engine is the C++ skiplist — this record is about the network
+stack and the control plane, and says so in ``engine``), ``p99_quotable``
+carries the sample-count rule, and every latency record is marked
+``co_corrected``. A single-core host is recorded (``host.cores``) and
+fails ``valid`` with its own reason: N proxy processes on one core cannot
+add CPU, so a flat curve there is the host's fault, not evidence about
+the architecture — exactly the cpu_fallback precedent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+from foundationdb_tpu.loadgen.deploy import REPO, SocketCluster
+from foundationdb_tpu.loadgen.harness import OpenLoopResult
+
+#: a point "sustains" its offered load when this fraction completes...
+SUSTAIN_FRAC = 0.92
+#: ...at a CO-corrected p99 at or under this bound (ms).
+P99_BOUND_MS = 750.0
+MIN_SCALING = 1.15  # sustainable-tps ratio best-proxy-count / 1-proxy
+#: Fallback quotability rule for library callers; `bench.py --open-loop`
+#: injects the authoritative bench.annotate_latency instead (run_
+#: open_loop_bench's `annotate`), so the 32-sample rule is not forked.
+MIN_LATENCY_SAMPLES = 32
+
+
+def _stamp_latency(rec: dict, n_samples: int, annotate) -> dict:
+    if annotate is not None:
+        return annotate(rec, n_samples, co_corrected=True)
+    rec["latency_samples"] = int(n_samples)
+    rec["co_corrected"] = True
+    rec["p99_quotable"] = n_samples >= MIN_LATENCY_SAMPLES
+    return rec
+
+#: overload-cluster resolver knobs: model 50ms of engine time per batch —
+#: a ~20 batches/s service ceiling, far below the batch-formation rate
+#: the commit proxies reach under load (they pipeline a batch per 2ms
+#: tick; even CPU-starved they form well over 20/s), so offered load
+#: past the ceiling parks batches in the resolver dispatch queue and the
+#: ratekeeper's resolver_queue signal engages the way it was designed
+#: to. The ceiling must sit BELOW what the host lets proxies form —
+#: otherwise the pipeline self-clocks through CPU scheduling and the
+#: queue never materializes (single-core find). The recovery rate is
+#: chosen below the ceiling even in the sparse one-txn-per-batch
+#: regime, so the clamp provably releases.
+OVERLOAD_SPEC = {"resolver_budget_s": 0.05, "resolver_dispatch_cost_s": 0.05}
+
+
+def _log(msg: str) -> None:
+    print(f"[openloop] {msg}", file=sys.stderr, flush=True)
+
+
+def _run_generators(spec_path: str, workdir: str, points, generators: int,
+                    clients: int, seed: int, keys: int, gap_s: float,
+                    timeout_ms: int, lead_s: float = 6.0,
+                    rk_poll=None,
+                    annotate=None) -> "tuple[list[dict], list[dict]]":
+    """Run `generators` loadgen processes through the shared rate ladder
+    `points` = [(dur_s, total_rate), ...]; returns (per-point merged
+    records, ratekeeper samples). Each generator offers rate/generators
+    on its own seed-disjoint keyspace; per-point records merge by
+    histogram/count sum (OpenLoopResult.merge_dicts)."""
+    start_at = time.time() + lead_s
+    procs = []
+    for g in range(generators):
+        err_path = os.path.join(workdir, f"loadgen{seed}_{g}.err")
+        with open(err_path, "w") as err_f:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "foundationdb_tpu.loadgen",
+                 "--cluster", spec_path,
+                 "--points",
+                 ",".join(f"{d}:{r / generators}" for d, r in points),
+                 "--point-gap-s", str(gap_s),
+                 "--clients", str(clients),
+                 "--seed", str(seed + g),
+                 "--keys", str(keys),
+                 "--timeout-ms", str(timeout_ms),
+                 "--start-at", str(start_at)],
+                cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                stdout=subprocess.PIPE, stderr=err_f, text=True,
+            ))
+    budget = (lead_s + sum(d for d, _r in points)
+              + gap_s * len(points) + 180.0)
+    rk_samples = rk_poll(procs, budget) if rk_poll is not None else []
+    outs = []
+    deadline = time.monotonic() + budget
+    for g, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            raise RuntimeError(
+                f"loadgen generator {g} exceeded its budget "
+                f"(see {workdir}/loadgen{seed}_{g}.err)")
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"loadgen generator {g} rc={p.returncode} "
+                f"(see {workdir}/loadgen{seed}_{g}.err)")
+        outs.append(out)
+    merged = []
+    for i, (dur, rate) in enumerate(points):
+        recs = []
+        for out in outs:
+            for line in out.splitlines():
+                r = json.loads(line)
+                if r.get("point") == i:
+                    recs.append(r)
+        m = OpenLoopResult.merge_dicts(recs)
+        m.update(point=i, offered_tps=rate, duration_s=dur,
+                 start_lag_s=max(r.get("start_lag_s", 0.0) for r in recs))
+        # Quotability is judged on the histogram the p99 is READ from:
+        # the CO histogram holds every non-shed arrival (committed +
+        # timed_out + failed + abandoned), not just commits.
+        _stamp_latency(m, m["offered"] - m["shed"], annotate)
+        merged.append(m)
+    return merged, rk_samples
+
+
+def _sustained(point: dict, p99_bound_ms: float) -> bool:
+    return (point["offered"] > 0
+            and point["committed"] / point["offered"] >= SUSTAIN_FRAC
+            and point["co_p99_ms"] <= p99_bound_ms)
+
+
+def _rk_poller(cluster: SocketCluster, interval_s: float = 0.5):
+    """A rk_poll callable for _run_generators: samples the deployed
+    ratekeeper's get_rates (no poller id — observation must not join the
+    budget-share lease) until every generator exits."""
+
+    def poll(procs, budget: float) -> list[dict]:
+        from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+
+        loop = RealLoop()
+        t = NetTransport(loop)
+        ep = cluster.ratekeeper_ep(t)
+        samples: list[dict] = []
+        t0 = time.monotonic()
+
+        async def poller():
+            while (any(p.poll() is None for p in procs)
+                   and time.monotonic() - t0 < budget):
+                try:
+                    r = await ep.get_rates()
+                    samples.append({
+                        "t_s": round(time.monotonic() - t0, 2),
+                        "limiting_reason": r["limiting_reason"],
+                        "resolver_queue": r["worst_resolver_queue"],
+                        "admission_saturation": round(
+                            r.get("admission_saturation", 0.0), 3),
+                        "tps_limit": round(r["tps_limit"], 1),
+                        "grv_pollers": r.get("grv_pollers"),
+                    })
+                except Exception:
+                    pass
+                await loop.sleep(interval_s)
+
+        try:
+            loop.run(poller(), timeout=budget + 60.0)
+        finally:
+            t.close()
+        return samples
+
+    return poll
+
+
+def _ladder_on_cluster(workdir: str, proxies: int, duration_s: float,
+                       gap_s: float, generators: int, clients: int,
+                       keys: int, seed: int, calib_rate: float,
+                       p99_bound_ms: float, timeout_ms: int,
+                       annotate=None) -> dict:
+    """Boot a cluster with `proxies` proxy processes, probe capacity at a
+    past-saturation rate, then run a rate ladder around it. Returns the
+    per-proxy-count record: every ladder point + the sustainable pick."""
+    _log(f"cluster proxies={proxies}: booting")
+    with SocketCluster(os.path.join(workdir, f"p{proxies}"),
+                       proxies=proxies) as cluster:
+        _log(f"cluster proxies={proxies}: capacity probe @ "
+             f"{calib_rate:.0f} tps")
+        calib, _ = _run_generators(
+            cluster.spec_path, workdir, [(duration_s, calib_rate)],
+            generators, clients, seed, keys, gap_s, timeout_ms,
+            annotate=annotate)
+        capacity = max(calib[0]["throughput_txns_per_sec"], 1.0)
+        _log(f"cluster proxies={proxies}: probe completed "
+             f"{capacity:.0f} tps (offered {calib_rate:.0f})")
+        fracs = (0.5, 0.75, 0.95, 1.2, 1.6)
+        ladder = [(duration_s, round(capacity * f, 1)) for f in fracs]
+        points, _ = _run_generators(
+            cluster.spec_path, workdir, ladder, generators, clients,
+            seed + 100, keys, gap_s, timeout_ms, annotate=annotate)
+    sustained = [p for p in points if _sustained(p, p99_bound_ms)]
+    best = max(sustained, key=lambda p: p["offered_tps"], default=None)
+    return {
+        "proxies": proxies,
+        "capacity_probe_tps": capacity,
+        "capacity_probe_offered_tps": calib_rate,
+        "sustainable_tps": best["offered_tps"] if best else 0.0,
+        "sustainable_completed_tps": (
+            best["throughput_txns_per_sec"] if best else 0.0),
+        "p99_ms_at_sustainable": best["co_p99_ms"] if best else None,
+        "p99_quotable": bool(best and best["p99_quotable"]),
+        "points": points,
+    }
+
+
+def run_open_loop_bench(
+    proxy_counts=(1, 2),
+    duration_s: float = 4.0,
+    gap_s: float = 4.0,
+    generators: int = 1,
+    clients: int = 512,
+    keys: int = 4096,
+    seed: int = 20260804,
+    calib_rate: float = 2500.0,
+    p99_bound_ms: float = P99_BOUND_MS,
+    min_scaling: float = MIN_SCALING,
+    timeout_ms: int = 5000,
+    overload: bool = True,
+    workdir: "str | None" = None,
+    annotate=None,
+) -> dict:
+    proxy_counts = sorted(set(int(p) for p in proxy_counts))
+    workdir = workdir or tempfile.mkdtemp(prefix="openloop_")
+    cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    rec: dict = {
+        "metric": "open_loop_scaleout",
+        "engine": "cpu-skiplist resolve over real TCP (no TPU claimed)",
+        "arrivals": "poisson (open loop)",
+        "co_corrected": True,
+        "cpu_fallback": False,
+        "host": {"cores": cores, "loadavg_1m": round(os.getloadavg()[0], 2)},
+        "generators": generators,
+        "clients_per_generator": clients,
+        "duration_s_per_point": duration_s,
+        "p99_bound_ms": p99_bound_ms,
+        "sustain_frac": SUSTAIN_FRAC,
+        "workdir": workdir,
+    }
+    # -- curve 1: sustainable txns/s vs proxy-process count ---------------
+    scaling = []
+    for i, p in enumerate(proxy_counts):
+        scaling.append(_ladder_on_cluster(
+            workdir, p, duration_s, gap_s, generators, clients, keys,
+            seed + 1000 * i, calib_rate, p99_bound_ms, timeout_ms,
+            annotate=annotate))
+    rec["scaling_curve"] = scaling
+    base = next((s for s in scaling if s["proxies"] == proxy_counts[0]),
+                None)
+    best = max(scaling, key=lambda s: s["sustainable_tps"])
+    ratio = (best["sustainable_tps"] / base["sustainable_tps"]
+             if base and base["sustainable_tps"] else None)
+    rec["throughput_scaling"] = {
+        "from_proxies": proxy_counts[0],
+        "to_proxies": best["proxies"],
+        "ratio": round(ratio, 3) if ratio else None,
+    }
+    # -- curve 2: CO-corrected p99 vs offered load, through saturation ----
+    maxp = next(s for s in scaling if s["proxies"] == max(proxy_counts))
+    rec["latency_curve"] = [
+        {k: p[k] for k in ("offered_tps", "throughput_txns_per_sec",
+                           "co_p50_ms", "co_p99_ms", "service_p99_ms",
+                           "shed", "timed_out", "failed", "committed",
+                           "offered", "p99_quotable", "co_corrected",
+                           "latency_samples", "max_dispatch_lag_s")
+         if k in p}
+        for p in maxp["points"]
+    ]
+    past_saturation = any(not _sustained(p, p99_bound_ms)
+                          for p in maxp["points"])
+
+    # -- overload: ratekeeper engagement + recovery -----------------------
+    overload_rec = None
+    if overload:
+        s_tps = (maxp["sustainable_tps"]
+                 or maxp["capacity_probe_tps"])
+        overload_rec = _overload_run(
+            workdir, max(proxy_counts), s_tps, duration_s, gap_s,
+            generators, clients, keys, seed + 9000, p99_bound_ms,
+            timeout_ms, annotate=annotate)
+        rec["overload"] = overload_rec
+
+    scaling_ok = bool(
+        len(proxy_counts) >= 2 and ratio is not None
+        and ratio >= min_scaling
+        and all(s["sustainable_tps"] > 0 for s in scaling))
+    reasons = []
+    if not scaling_ok:
+        reasons.append(
+            f"no throughput scaling >= {min_scaling} across proxy counts"
+            + (" (single-core host: N proxy processes cannot add CPU)"
+               if cores <= 1 else ""))
+    if not past_saturation:
+        reasons.append("latency curve never crossed saturation")
+    if overload and not (overload_rec and overload_rec["engaged"]
+                         and overload_rec["recovered"]):
+        reasons.append("overload run missing engagement or recovery")
+    rec["p99_quotable"] = all(s["p99_quotable"] for s in scaling)
+    rec["past_saturation_observed"] = past_saturation
+    rec["valid"] = not reasons
+    if reasons:
+        rec["invalid_reasons"] = reasons
+    return rec
+
+
+def _overload_run(workdir: str, proxies: int, sustainable_tps: float,
+                  duration_s: float, gap_s: float, generators: int,
+                  clients: int, keys: int, seed: int,
+                  p99_bound_ms: float, timeout_ms: int,
+                  annotate=None) -> dict:
+    """Drive far past capacity against a cluster whose resolver models
+    dispatch cost (OVERLOAD_SPEC) with the admission subsystem armed,
+    polling the ratekeeper from the side; then drop to well under
+    capacity and require the clamps to release."""
+    batch_ceiling = 1.0 / OVERLOAD_SPEC["resolver_dispatch_cost_s"]
+    hi = round(max(sustainable_tps * 2.2, batch_ceiling * 6), 1)
+    # Recovery offered load sits under the resolver's batch-rate ceiling
+    # even in the sparse one-txn-per-batch regime, so the dispatch queue
+    # drains and the clamp release is observable.
+    lo = round(min(max(sustainable_tps * 0.25, 20.0),
+                   0.4 * batch_ceiling), 1)
+    hi_dur = max(duration_s * 2, 8.0)
+    lo_dur = max(duration_s * 2.5, 10.0)
+    _log(f"overload: booting {proxies}-proxy cluster with resolver "
+         f"dispatch-cost knobs {OVERLOAD_SPEC}")
+    with SocketCluster(os.path.join(workdir, "overload"), proxies=proxies,
+                       spec_extra=dict(OVERLOAD_SPEC),
+                       env={"FDB_TPU_ADMISSION": "1"}) as cluster:
+        _log(f"overload: offering {hi} tps for {hi_dur}s, then {lo} tps "
+             "(transition + steady recovery windows)")
+        # Three windows: overload, the recovery TRANSITION (absorbs the
+        # backlog the overload left behind), and the steady recovered
+        # state the recovery claim is judged on — separate accounting
+        # each, so backlog drain cannot blur the recovered p99.
+        points, rk = _run_generators(
+            cluster.spec_path, workdir,
+            [(hi_dur, hi), (lo_dur, lo), (lo_dur, lo)],
+            generators, clients, seed, keys, gap_s, timeout_ms,
+            rk_poll=_rk_poller(cluster), annotate=annotate)
+    over, transition, rest = points[0], points[1], points[2]
+    # "Engaged" means the ratekeeper itself REPORTED one of the two
+    # admission signals as its limiting reason — raw queue depth alone
+    # is reported next to it but must not satisfy the claim.
+    engaged_signals = sorted({
+        s["limiting_reason"] for s in rk
+        if s["limiting_reason"] in ("resolver_queue", "admission_filter")
+    })
+    engaged = bool(engaged_signals)
+    max_rq = max((s["resolver_queue"] for s in rk), default=0)
+    tail = [s for s in rk if s["t_s"] >= rk[-1]["t_s"] - max(lo_dur / 2, 2.0)] \
+        if rk else []
+    released = bool(tail) and all(
+        s["limiting_reason"] == "none" for s in tail)
+    recovered = (released and _sustained(rest, p99_bound_ms))
+    shed_total = over["shed"] + over["timed_out"] + over["failed"]
+    return {
+        "offered_tps_overload": hi,
+        "offered_tps_recovery": lo,
+        "resolver_knobs": dict(OVERLOAD_SPEC),
+        "overload_point": over,
+        "recovery_transition_point": transition,
+        "recovery_point": rest,
+        "shed_plus_timed_out_plus_failed": shed_total,
+        "shed_frac_of_offered": (
+            round(shed_total / over["offered"], 4) if over["offered"] else 0.0),
+        "signals_observed": engaged_signals,
+        "max_resolver_queue": max_rq,
+        "engaged": engaged,
+        "clamps_released": released,
+        "recovered": recovered,
+        "rk_timeline": rk,
+    }

@@ -1,0 +1,199 @@
+"""SocketCluster: spawn a real multi-process cluster over TCP.
+
+One helper shared by the open-loop bench, the fast-battery smoke test and
+scripts: builds a cluster spec (N proxy processes — the horizontal
+scale-out axis — plus sequencer/resolver/tlog/storage/ratekeeper), boots
+one OS process per role instance (`python -m foundationdb_tpu.server`),
+waits for every readiness line, and tears down gracefully (admin shutdown
+RPC, SIGKILL only as a last resort) with an explicit leak check: every
+process reaped, every listening port released.
+
+Process stdout/stderr go to per-process log files in the work dir (never a
+pipe: a chatty supervisor under overload would fill a 64 KiB pipe buffer
+and deadlock the role behind its own logging).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_spec(proxies: int = 2, tlogs: int = 1, storages: int = 1,
+               resolvers: int = 1, ratekeeper: bool = True,
+               engine: str = "cpu", extra: "dict | None" = None) -> dict:
+    """A cluster spec dict with fresh localhost ports (server.py shape)."""
+    n = 1 + resolvers + tlogs + storages + proxies + (1 if ratekeeper else 0)
+    ports = iter(free_ports(n))
+    spec = {
+        "sequencer": [f"127.0.0.1:{next(ports)}"],
+        "resolver": [f"127.0.0.1:{next(ports)}" for _ in range(resolvers)],
+        "tlog": [f"127.0.0.1:{next(ports)}" for _ in range(tlogs)],
+        "storage": [f"127.0.0.1:{next(ports)}" for _ in range(storages)],
+        "proxy": [f"127.0.0.1:{next(ports)}" for _ in range(proxies)],
+        "ratekeeper": ([f"127.0.0.1:{next(ports)}"] if ratekeeper else []),
+        "engine": engine,
+    }
+    if extra:
+        spec.update(extra)
+    return spec
+
+
+class SocketCluster:
+    """Context manager around one deployed cluster's OS processes."""
+
+    BOOT_DEADLINE_S = 180.0
+
+    def __init__(self, workdir: str, proxies: int = 2, tlogs: int = 1,
+                 storages: int = 1, resolvers: int = 1,
+                 ratekeeper: bool = True, engine: str = "cpu",
+                 spec_extra: "dict | None" = None,
+                 env: "dict | None" = None):
+        os.makedirs(workdir, exist_ok=True)
+        self.workdir = workdir
+        self.spec = build_spec(proxies, tlogs, storages, resolvers,
+                               ratekeeper, engine, spec_extra)
+        self.spec_path = os.path.join(workdir, "cluster.json")
+        with open(self.spec_path, "w") as f:
+            json.dump(self.spec, f)
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu", **(env or {}))
+        self.procs: list[tuple[str, tuple[str, int], subprocess.Popen]] = []
+        self.logs: list[str] = []
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "SocketCluster":
+        from foundationdb_tpu.server import ROLES, parse_addr
+
+        for role in ROLES:
+            for i, addr in enumerate(self.spec.get(role) or []):
+                log_path = os.path.join(self.workdir, f"{role}{i}.log")
+                self.logs.append(log_path)
+                log_f = open(log_path, "w")
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "foundationdb_tpu.server",
+                     "--cluster", self.spec_path, "--role", role,
+                     "--index", str(i)],
+                    cwd=REPO, env=self.env,
+                    stdout=log_f, stderr=subprocess.STDOUT,
+                )
+                log_f.close()  # the child holds the fd
+                self.procs.append((f"{role}{i}", parse_addr(addr), p))
+        deadline = time.monotonic() + self.BOOT_DEADLINE_S
+        for (name, _addr, p), log_path in zip(self.procs, self.logs):
+            while True:
+                try:
+                    with open(log_path) as f:
+                        if "ready" in f.read():
+                            break
+                except OSError:
+                    pass
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"{name} exited rc={p.returncode} during boot "
+                        f"(see {log_path})")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"cluster boot timed out waiting for {name}")
+                time.sleep(0.05)
+        return self
+
+    def shutdown(self, timeout_s: float = 15.0) -> dict:
+        """Graceful stop: admin shutdown RPC to every process, reap, then
+        verify nothing leaked (all processes exited, all ports released).
+        Returns {"exit_codes": {...}, "killed": [...]}."""
+        from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+
+        killed: list[str] = []
+        if self.procs:
+            loop = RealLoop()
+            t = NetTransport(loop)
+            for name, addr, p in self.procs:
+                if p.poll() is not None:
+                    continue
+                try:
+                    loop.run_until(
+                        t.endpoint(addr, "admin").shutdown(), timeout=5.0)
+                except Exception:
+                    pass  # dead/wedged: the SIGKILL pass below reaps it
+            t.close()
+        deadline = time.monotonic() + timeout_s
+        for name, _addr, p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                killed.append(name)
+                p.kill()
+                p.wait()
+        codes = {name: p.returncode for name, _a, p in self.procs}
+        leaked = self._listening_ports()
+        self.procs = []
+        if leaked:
+            raise RuntimeError(f"cluster ports still listening: {leaked}")
+        return {"exit_codes": codes, "killed": killed}
+
+    def _listening_ports(self) -> list[int]:
+        out = []
+        for _name, (host, port), _p in self.procs:
+            s = socket.socket()
+            s.settimeout(0.2)
+            try:
+                s.connect((host, port))
+                out.append(port)
+            except OSError:
+                pass
+            finally:
+                s.close()
+        return out
+
+    def kill(self) -> None:
+        for _name, _addr, p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for _name, _addr, p in self.procs:
+            p.wait()
+        self.procs = []
+
+    def __enter__(self) -> "SocketCluster":
+        return self.start()
+
+    def __exit__(self, exc_type, _exc, _tb) -> None:
+        if exc_type is None:
+            self.shutdown()
+        else:
+            self.kill()
+
+    # -- client surfaces --------------------------------------------------
+
+    def open_client(self):
+        """(loop, transport, db) against this cluster — the Python client
+        stack over real sockets (cli.open_cluster)."""
+        from foundationdb_tpu.cli import open_cluster
+
+        return open_cluster(self.spec_path)
+
+    def ratekeeper_ep(self, t):
+        """Ratekeeper endpoint on transport `t` (None when not deployed)."""
+        from foundationdb_tpu.server import parse_addr
+
+        rk = self.spec.get("ratekeeper") or []
+        return t.endpoint(parse_addr(rk[0]), "ratekeeper") if rk else None

@@ -1,0 +1,334 @@
+"""Open-loop runner: scheduled dispatch, CO-correct latency, shed counting.
+
+Coordinated omission, and why latency is measured from the SCHEDULED
+arrival: a generator that timestamps from the moment it actually sent a
+request silently excludes the time the request spent waiting for the
+generator itself to get around to it — precisely the time that explodes
+when the system saturates. Every latency this harness records for an
+open-loop run is (completion − scheduled arrival), so queueing anywhere
+(harness client slot, GRV proxy queue, commit batch, resolver dispatch
+queue) lands in the histogram instead of vanishing. Records produced here
+carry ``co_corrected: true``; the closed-loop bench records keep
+``co_corrected: false`` so the two latency regimes can never be confused
+(bench.annotate_latency).
+
+Load is never silently dropped either: an arrival that cannot even be
+queued (global in-flight cap, per-client queue cap) increments ``shed``;
+a transaction that exhausts its timeout or retry budget increments
+``timed_out``/``failed``; in-flight work the drain deadline abandons
+increments ``abandoned``. offered == committed + shed + timed_out +
+failed + abandoned, always.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from foundationdb_tpu.core.errors import (
+    FdbError,
+    NotCommitted,
+    TransactionTimedOut,
+)
+
+
+class LatencyHistogram:
+    """Log-binned latency histogram (ms), mergeable across processes.
+
+    ~4.9% bin width (48 bins/decade) from 10µs to 600s: accurate enough
+    to quote a p99, small enough to ship as one JSON line per generator
+    process and SUM across generators (the only aggregation percentile
+    sketches allow honestly)."""
+
+    LO_MS = 1e-2
+    HI_MS = 6e5
+    BINS_PER_DECADE = 48
+    _EDGES = np.logspace(
+        np.log10(LO_MS), np.log10(HI_MS),
+        int(np.log10(HI_MS / LO_MS) * BINS_PER_DECADE) + 1,
+    )
+
+    def __init__(self) -> None:
+        # counts[i] = samples in (_EDGES[i-1], _EDGES[i]]; [0] underflow,
+        # [-1] overflow.
+        self.counts = np.zeros(len(self._EDGES) + 1, np.int64)
+        self.max_ms = 0.0
+        self.sum_ms = 0.0
+
+    @property
+    def count(self) -> int:
+        return int(self.counts.sum())
+
+    def record(self, ms: float) -> None:
+        self.counts[int(np.searchsorted(self._EDGES, ms))] += 1
+        self.max_ms = max(self.max_ms, float(ms))
+        self.sum_ms += float(ms)
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        self.counts += other.counts
+        self.max_ms = max(self.max_ms, other.max_ms)
+        self.sum_ms += other.sum_ms
+        return self
+
+    def percentile(self, q: float) -> float:
+        """Upper edge of the bin holding the q-th percentile sample —
+        CONSERVATIVE (never under-reports a latency). 0.0 when empty."""
+        total = self.count
+        if total == 0:
+            return 0.0
+        target = int(np.ceil(total * q / 100.0))
+        cum = np.cumsum(self.counts)
+        i = int(np.searchsorted(cum, target))
+        if i >= len(self._EDGES):
+            return float(self.max_ms)  # overflow bin: the max is exact
+        return round(float(self._EDGES[i]), 4)
+
+    def mean(self) -> float:
+        return self.sum_ms / self.count if self.count else 0.0
+
+    def to_dict(self) -> dict:
+        nz = np.nonzero(self.counts)[0]
+        return {
+            "bins": [[int(i), int(self.counts[i])] for i in nz],
+            "max_ms": round(self.max_ms, 3),
+            "sum_ms": round(self.sum_ms, 3),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencyHistogram":
+        h = cls()
+        for i, n in d.get("bins", []):
+            h.counts[int(i)] = int(n)
+        h.max_ms = float(d.get("max_ms", 0.0))
+        h.sum_ms = float(d.get("sum_ms", 0.0))
+        return h
+
+
+@dataclass
+class OpenLoopResult:
+    """One generator's accounting. offered == committed + shed +
+    timed_out + failed + abandoned (asserted by the runner)."""
+
+    offered: int = 0
+    committed: int = 0
+    shed: int = 0  # never even queued (in-flight / queue caps)
+    timed_out: int = 0  # exhausted the transaction timeout
+    failed: int = 0  # non-retryable error or retry limit
+    abandoned: int = 0  # still in flight at the drain deadline
+    conflict_retries: int = 0  # NotCommitted retries absorbed en route
+    schedule_span_s: float = 0.0
+    run_span_s: float = 0.0
+    # Worst dispatcher lateness (s): how far behind its own schedule the
+    # GENERATOR fell. Large values mean the generator, not the cluster,
+    # bounded the offered load — the co-latency tail then includes
+    # generator-side queueing and says so (single-core honesty).
+    max_dispatch_lag_s: float = 0.0
+    co_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
+    service_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
+
+    @property
+    def throughput(self) -> float:
+        return self.committed / self.run_span_s if self.run_span_s else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "offered": self.offered,
+            "committed": self.committed,
+            "shed": self.shed,
+            "timed_out": self.timed_out,
+            "failed": self.failed,
+            "abandoned": self.abandoned,
+            "conflict_retries": self.conflict_retries,
+            "schedule_span_s": round(self.schedule_span_s, 3),
+            "run_span_s": round(self.run_span_s, 3),
+            "max_dispatch_lag_s": round(self.max_dispatch_lag_s, 3),
+            "throughput_txns_per_sec": round(self.throughput, 1),
+            # Latency from SCHEDULED arrival (coordinated-omission
+            # correct) vs from actual send — shipping both keeps the gap
+            # between them visible (it IS the omission a naive harness
+            # hides). co_latency covers EVERY non-shed arrival:
+            # timed-out/failed at their elapsed time, abandoned at their
+            # censored lower bound — no survivorship; service_latency is
+            # committed txns only.
+            "co_latency": self.co_hist.to_dict(),
+            "service_latency": self.service_hist.to_dict(),
+            "co_p50_ms": self.co_hist.percentile(50),
+            "co_p99_ms": self.co_hist.percentile(99),
+            "service_p99_ms": self.service_hist.percentile(99),
+        }
+
+    @classmethod
+    def merge_dicts(cls, dicts: "list[dict]") -> dict:
+        """Aggregate several generators' to_dict() lines into one record
+        (counts sum, histograms sum, spans max)."""
+        out = cls()
+        for d in dicts:
+            out.offered += d["offered"]
+            out.committed += d["committed"]
+            out.shed += d["shed"]
+            out.timed_out += d["timed_out"]
+            out.failed += d["failed"]
+            out.abandoned += d["abandoned"]
+            out.conflict_retries += d["conflict_retries"]
+            out.schedule_span_s = max(out.schedule_span_s,
+                                      d["schedule_span_s"])
+            out.run_span_s = max(out.run_span_s, d["run_span_s"])
+            out.max_dispatch_lag_s = max(out.max_dispatch_lag_s,
+                                         d.get("max_dispatch_lag_s", 0.0))
+            out.co_hist.merge(LatencyHistogram.from_dict(d["co_latency"]))
+            out.service_hist.merge(
+                LatencyHistogram.from_dict(d["service_latency"]))
+        merged = out.to_dict()
+        # Throughput sums across generators (each measured its own span
+        # against the same wall clock; max-span division under-reports
+        # when spans differ — sum the per-process rates instead).
+        merged["throughput_txns_per_sec"] = round(
+            sum(d["throughput_txns_per_sec"] for d in dicts), 1)
+        return merged
+
+
+async def run_open_loop(
+    loop,
+    db,
+    schedule,
+    txn_fn,
+    n_clients: int = 256,
+    client_queue_cap: int = 64,
+    max_inflight: int = 4096,
+    timeout_ms: "int | None" = 5000,
+    retry_limit: "int | None" = 8,
+    drain_s: float = 15.0,
+) -> OpenLoopResult:
+    """Drive `db` with transactions at the scheduled offsets (seconds from
+    now). Works on any flow Loop — the RealLoop against a socket cluster
+    (the honest configuration) or the sim loop for deterministic tests of
+    the harness itself.
+
+    `txn_fn(tr, k)` is an async callable that stages arrival k's
+    reads/writes on `tr`; the runner commits, retries through the
+    standard on_error contract (bounded by `retry_limit`), and does the
+    accounting. Arrivals round-robin onto `n_clients` virtual client
+    slots with concurrency 1 each — the bounded-per-client-concurrency
+    model of a large independent population; a busy slot QUEUES the
+    arrival and the wait is measured, not skipped."""
+    res = OpenLoopResult()
+    schedule = np.asarray(schedule, np.float64)
+    res.offered = int(schedule.size)
+    res.schedule_span_s = float(schedule[-1]) if schedule.size else 0.0
+    if hasattr(loop, "resync"):
+        loop.resync()  # wall-clock loops: t0 must be NOW, not the last
+        # pump iteration (a stale clock fakes schedule-wide lateness)
+    t0 = loop.now
+    slots: list[deque] = [deque() for _ in range(n_clients)]
+    state = {"outstanding": 0, "done_at": t0}
+
+    async def one_txn(k: int, sched_abs: float) -> None:
+        tr = db.transaction()
+        if timeout_ms is not None:
+            tr.set_option("timeout", timeout_ms)
+        if retry_limit is not None:
+            tr.set_option("retry_limit", retry_limit)
+        start = loop.now
+        try:
+            while True:
+                try:
+                    await txn_fn(tr, k)
+                    await tr.commit()
+                    break
+                except FdbError as e:
+                    if isinstance(e, NotCommitted):
+                        res.conflict_retries += 1
+                    await tr.on_error(e)  # raises when out of budget
+        except TransactionTimedOut:
+            res.timed_out += 1
+            # Unsuccessful arrivals still took this long: censoring them
+            # out of the CO histogram would re-introduce the exact
+            # survivorship omission this harness exists to kill — the
+            # past-saturation p99 must include the arrivals that never
+            # made it.
+            res.co_hist.record((loop.now - sched_abs) * 1000.0)
+            return
+        except FdbError:
+            res.failed += 1
+            res.co_hist.record((loop.now - sched_abs) * 1000.0)
+            return
+        end = loop.now
+        res.committed += 1
+        res.co_hist.record((end - sched_abs) * 1000.0)
+        res.service_hist.record((end - start) * 1000.0)
+
+    busy = [False] * n_clients
+    running: dict[int, float] = {}  # k -> scheduled time, while in flight
+    workers: set = set()
+
+    async def worker(c: int) -> None:
+        try:
+            while slots[c]:
+                k, sched_abs = slots[c].popleft()
+                running[k] = sched_abs
+                try:
+                    await one_txn(k, sched_abs)
+                finally:
+                    running.pop(k, None)
+                    state["outstanding"] -= 1
+                    state["done_at"] = loop.now
+        finally:
+            busy[c] = False
+
+    behind = 0
+    for k in range(res.offered):
+        target = t0 + float(schedule[k])
+        dt = target - loop.now
+        if dt > 0:
+            await loop.sleep(dt)
+            behind = 0
+        else:
+            res.max_dispatch_lag_s = max(res.max_dispatch_lag_s, -dt)
+            # Catching up after falling behind: yield every few dispatches
+            # so workers drain while the burst floods in (otherwise the
+            # dispatcher monopolizes the loop and sheds work the cluster
+            # could have absorbed).
+            behind += 1
+            if behind % 64 == 0:
+                await loop.sleep(0)
+        c = k % n_clients
+        if (state["outstanding"] >= max_inflight
+                or len(slots[c]) >= client_queue_cap):
+            res.shed += 1
+            continue
+        slots[c].append((k, target))
+        state["outstanding"] += 1
+        if not busy[c]:
+            busy[c] = True
+            task = loop.spawn(worker(c), name=f"loadgen.client{c}")
+            workers.add(task)
+            task.add_done_callback(lambda _f, t=task: workers.discard(t))
+
+    deadline = loop.now + drain_s
+    while state["outstanding"] > 0 and loop.now < deadline:
+        await loop.sleep(0.05)
+    if state["outstanding"] > 0:
+        res.abandoned = state["outstanding"]
+        # Abandoned arrivals are censored observations: record each at
+        # its elapsed-so-far latency (a LOWER bound on its truth) so the
+        # CO histogram never quietly drops the slowest tail.
+        now = loop.now
+        for s in slots:
+            for _k, sched_abs in s:
+                res.co_hist.record((now - sched_abs) * 1000.0)
+            s.clear()
+        for sched_abs in running.values():
+            res.co_hist.record((now - sched_abs) * 1000.0)
+        # Cancel the workers outright: on a reused loop (ladder points),
+        # parked coroutines would otherwise resume DURING the next
+        # point's run — consuming cluster capacity inside its window and
+        # mutating this already-finalized result.
+        for t in list(workers):
+            t.cancel()
+    res.run_span_s = max(res.schedule_span_s,
+                         state["done_at"] - t0)
+    assert (res.committed + res.shed + res.timed_out + res.failed
+            + res.abandoned == res.offered)
+    return res

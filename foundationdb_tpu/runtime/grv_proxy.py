@@ -13,7 +13,14 @@ admitted default-priority request.
 
 from __future__ import annotations
 
+import itertools
+import os
+
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
+
+#: Unique-per-process GRV poller ids (pid + counter: deterministic in the
+#: single-process sim, collision-free across deployed proxy processes).
+_poller_seq = itertools.count()
 
 PRIORITY_DEFAULT = "default"
 PRIORITY_BATCH = "batch"
@@ -79,6 +86,10 @@ class GrvProxy:
         # tag a free burst per kill). Queuing is the conservative choice;
         # untagged traffic is unaffected.
         self._have_tag_rates = ratekeeper_ep is None
+        # Identify this proxy to the ratekeeper so the cluster budget is
+        # leased in per-proxy SHARES (Ratekeeper._grv_pollers): with N
+        # proxies each draws tps_limit/N — the scale-out contract.
+        self.poller_id = f"grv-{os.getpid()}-{next(_poller_seq)}"
         unlimited = float("inf") if ratekeeper_ep is None else 0.0
         self._rate = unlimited
         self._batch_rate = unlimited
@@ -248,10 +259,15 @@ class GrvProxy:
             return
         while True:
             try:
-                rates = await self.ratekeeper.get_rates()
-                self._rate = rates["tps_limit"]
-                self._batch_rate = rates["batch_tps_limit"]
-                tag_rates = rates.get("tag_rates", {})
+                rates = await self.ratekeeper.get_rates(self.poller_id)
+                # Per-proxy share when the ratekeeper leases one (older
+                # ratekeepers hand back only the cluster totals).
+                self._rate = rates.get("tps_limit_share",
+                                       rates["tps_limit"])
+                self._batch_rate = rates.get("batch_tps_limit_share",
+                                             rates["batch_tps_limit"])
+                tag_rates = rates.get("tag_rates_share",
+                                      rates.get("tag_rates", {}))
                 # Drop buckets for cleared quotas so those tags go back
                 # to unlimited.
                 self._tag_rates = dict(tag_rates)

@@ -74,6 +74,15 @@ class RealLoop(Loop):
         super().__init__(seed=seed, start_time=time.monotonic())
         self.selector = selectors.DefaultSelector()
 
+    def resync(self) -> None:
+        """Snap `now` to the current monotonic clock. The pump refreshes
+        `_now` as it iterates, but code that blocks OUTSIDE the loop
+        (e.g. a wall-clock synchronization sleep before loop.run) leaves
+        it stale — anything anchoring timestamps to `loop.now` before
+        the first pump iteration would then measure phantom lateness
+        equal to the blocked interval (loadgen start-at find)."""
+        self._now = time.monotonic()
+
     @property
     def wall_now(self) -> float:
         """Epoch seconds: operator-minted expiries (authz tokens) compare
@@ -120,6 +129,17 @@ class RealLoop(Loop):
 class _Conn:
     """One TCP connection (either side): frame reassembly + buffered writes.
 
+    Small frames COALESCE per flush: send_frame appends to the write
+    buffer and raises EVENT_WRITE interest instead of hitting the socket
+    per frame — every frame queued in one scheduler burst (a GRV batch's
+    replies, a pipelined client's requests) drains in ONE send() on the
+    next selector round. With TCP_NODELAY set (it is, on both accepted
+    and connecting sockets) each send() is one segment, so without
+    coalescing a burst of length-prefixed small RPC frames becomes a
+    segment per frame; with Nagle instead it becomes a 40ms
+    delayed-ACK stall per round trip. Buffers past COALESCE_BYTES flush
+    eagerly so a bulk stream never accumulates unbounded.
+
     With a TLS-configured transport (reference: flow/TLSConfig.actor.cpp —
     mutual TLS between every pair of processes), the framing rides an
     ``ssl.SSLObject`` over memory BIOs: raw socket bytes feed the incoming
@@ -127,6 +147,8 @@ class _Conn:
     outgoing handshake/application bytes drain from the outgoing BIO into
     the ordinary nonblocking write buffer. Frames queued before the
     handshake completes are buffered and sent on completion."""
+
+    COALESCE_BYTES = 64 << 10  # past this, flush eagerly (bounded buffer)
 
     def __init__(self, transport: "NetTransport", sock: socket.socket,
                  server_side: bool = True):
@@ -136,6 +158,8 @@ class _Conn:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rbuf = bytearray()
         self.wbuf = bytearray()
+        self.frames_queued = 0  # coalescing ratio = frames_queued/flushes
+        self.flushes = 0
         self.pending: dict[int, Promise] = {}  # requests sent on this conn
         self.closed = False
         self.tls = None
@@ -241,6 +265,7 @@ class _Conn:
                 f"frame of {len(payload)} bytes exceeds {MAX_FRAME}"
             )
         framed = _LEN.pack(len(payload)) + payload
+        self.frames_queued += 1
         if self.tls is not None:
             if not self._hs_done:
                 self._pre_hs.append(framed)
@@ -249,12 +274,21 @@ class _Conn:
             self._drain_out_bio()
             return
         self.wbuf += framed
-        self._flush()
+        if len(self.wbuf) >= self.COALESCE_BYTES:
+            self._flush()
+        elif len(self.wbuf) == len(framed):
+            # Buffer was empty: raise write interest ONCE per burst and
+            # let the next selector round drain everything queued in the
+            # burst in one send(). Later frames skip the selector call —
+            # interest is already up (_flush re-registers after drains).
+            self.t.loop.register(self.sock, self._events(), self._on_ready)
 
     def _flush(self) -> None:
         try:
             n = self.sock.send(self.wbuf)
             del self.wbuf[:n]
+            if n:
+                self.flushes += 1
         except (BlockingIOError, InterruptedError):
             pass
         except OSError as e:

@@ -93,6 +93,28 @@ class Ratekeeper:
         self.tag_quotas: dict[str, float] = (
             tag_quotas if tag_quotas is not None else {}
         )
+        # Live GRV-proxy pollers (poller_id -> last get_rates time): the
+        # cluster tps budget is LEASED in per-proxy shares (reference:
+        # Ratekeeper::updateRate divides tpsLimit across proxies by their
+        # reported request fractions; we split evenly). Without this,
+        # every proxy refilled its bucket from the WHOLE cluster budget,
+        # so an N-proxy scale-out silently multiplied admission by N and
+        # the clamps this role exists for never engaged (open-loop
+        # scale-out find). A poller that stops polling (retired
+        # generation, dead process) ages out after POLLER_TTL and its
+        # share returns to the survivors.
+        self._pollers: dict[str, float] = {}
+
+    POLLER_TTL = 1.0
+
+    def _grv_pollers(self, poller_id: "str | None") -> int:
+        now = self.loop.now
+        if poller_id is not None:
+            self._pollers[poller_id] = now
+        for pid, seen in list(self._pollers.items()):
+            if now - seen > self.POLLER_TTL:
+                del self._pollers[pid]
+        return max(1, len(self._pollers))
 
     @rpc
     async def set_tag_quota(self, tag: str, tps: float | None) -> None:
@@ -245,11 +267,21 @@ class Ratekeeper:
         return self.tps_limit
 
     @rpc
-    async def get_rates(self) -> dict:
-        """Both lanes + the governing signal (status json reports these)."""
+    async def get_rates(self, poller_id: "str | None" = None) -> dict:
+        """Both lanes + the governing signal (status json reports these).
+
+        `poller_id`: a GRV proxy identifying itself — counted into the
+        live-poller set and handed its even SHARE of each lane budget
+        (`tps_limit_share` / `batch_tps_limit_share`). The cluster-wide
+        totals stay in `tps_limit`/`batch_tps_limit` for status and for
+        callers that don't identify themselves."""
+        n_pollers = self._grv_pollers(poller_id)
         return {
             "tps_limit": self.tps_limit,
             "batch_tps_limit": self.batch_tps_limit,
+            "grv_pollers": n_pollers,
+            "tps_limit_share": self.tps_limit / n_pollers,
+            "batch_tps_limit_share": self.batch_tps_limit / n_pollers,
             "limiting_reason": self.limiting_reason,
             "worst_storage_lag": self.worst_lag,
             "worst_durability_lag": self.worst_durability_lag,
@@ -259,6 +291,12 @@ class Ratekeeper:
             "resolver_dispatch_occupancy": self.worst_resolver_occupancy,
             "admission_saturation": self.worst_admission_saturation,
             "tag_rates": dict(self.tag_quotas),
+            # Tag quotas split the same way: a quota is a CLUSTER bound,
+            # not a per-proxy one (N proxies each refilling the full
+            # quota would hand an abusive tag N× its budget).
+            "tag_rates_share": {
+                t: q / n_pollers for t, q in self.tag_quotas.items()
+            },
             "base_tps": self.base_tps,
             "measured_tps": self.measured_tps,
         }

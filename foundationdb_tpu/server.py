@@ -179,6 +179,22 @@ def parse_addr(s: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def _resolver_knobs(spec: dict) -> dict:
+    """Optional deployed-resolver scheduler knobs from the cluster spec
+    (the TCP twins of the sim campaign table's resolverBudget /
+    resolverDispatchCost): `resolver_budget_s` arms the dispatch-queue
+    scheduler (sched/resolver_queue.py) so batches park behind the
+    engine and the ratekeeper's resolver_queue signal is exercisable on
+    a real deployment; `resolver_dispatch_cost_s` models per-batch
+    engine time. Both default off (immediate dispatch)."""
+    out: dict = {}
+    if spec.get("resolver_budget_s"):
+        out["budget_s"] = float(spec["resolver_budget_s"])
+    if spec.get("resolver_dispatch_cost_s"):
+        out["dispatch_cost_s"] = float(spec["resolver_dispatch_cost_s"])
+    return out
+
+
 def _make_admission_filter():
     """Recent-writes filter for a deployed resolver when the admission
     subsystem is armed (FDB_TPU_ADMISSION=1; admission/__init__.py)."""
@@ -558,7 +574,8 @@ class Worker:
                      make_conflict_set(engine,
                                        len(self.spec["resolver"])),
                      init_version=start_version,
-                     admission_filter=_make_admission_filter()),
+                     admission_filter=_make_admission_filter(),
+                     **_resolver_knobs(self.spec)),
         )
         self.epoch = epoch
         return start_version
@@ -1551,7 +1568,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         t.serve("resolver",
                 Resolver(loop, make_conflict_set(engine,
                                                  len(spec["resolver"])),
-                         admission_filter=_make_admission_filter()))
+                         admission_filter=_make_admission_filter(),
+                         **_resolver_knobs(spec)))
     elif role == "tlog":
         from foundationdb_tpu.runtime.tlog import TLog
 

@@ -145,6 +145,17 @@ while true; do
     'r.get("metric") == "admission_ab" and r.get("valid")' -- \
     env OUT=ADMISSION_AB_r05_rec.json bash scripts/admission_ab.sh \
     || { sleep 60; continue; }
+  # Open-loop scale-out harness (loadgen subsystem): real multi-process
+  # cluster over TCP per proxy count, CO-correct open-loop generators —
+  # both published curves + the ratekeeper overload-engage/recover run.
+  # CPU-only by design (no TPU claimed); the done-check gates on the
+  # record being STRUCTURALLY complete (curves + overload engage/recover)
+  # rather than `valid`, which additionally demands throughput scaling a
+  # single-core host cannot physically show (host.cores recorded).
+  stage ab_openloop 1800 OPENLOOP_AB_r05.json \
+    'r.get("metric") == "open_loop_scaleout" and r.get("scaling_curve") and r.get("latency_curve") and r.get("past_saturation_observed") and (r.get("overload") or {}).get("engaged") and (r.get("overload") or {}).get("recovered")' -- \
+    env OUT=OPENLOOP_AB_r05_rec.json bash scripts/openloop_ab.sh \
+    || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"

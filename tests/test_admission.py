@@ -417,7 +417,7 @@ class _SatRk:
         self.sat = sat
         self.tps = tps
 
-    async def get_rates(self):
+    async def get_rates(self, poller_id=None):
         return {"tps_limit": self.tps, "batch_tps_limit": self.tps,
                 "admission_saturation": self.sat}
 

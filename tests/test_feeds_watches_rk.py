@@ -238,7 +238,7 @@ class FakeRatekeeper:
     def __init__(self, tps, batch_tps):
         self.tps, self.batch_tps = tps, batch_tps
 
-    async def get_rates(self):
+    async def get_rates(self, poller_id=None):
         return {"tps_limit": self.tps, "batch_tps_limit": self.batch_tps}
 
 
@@ -273,7 +273,7 @@ class TestTagThrottling:
         loop = Loop(seed=0)
 
         class RkWithTags(FakeRatekeeper):
-            async def get_rates(self):
+            async def get_rates(self, poller_id=None):
                 r = await super().get_rates()
                 r["tag_rates"] = {"hot": 10.0}
                 return r
@@ -312,7 +312,7 @@ class TestTagThrottling:
         class ToggleRk(FakeRatekeeper):
             tag_rates = {"hot": 5.0}
 
-            async def get_rates(self):
+            async def get_rates(self, poller_id=None):
                 r = await super().get_rates()
                 r["tag_rates"] = dict(self.tag_rates)
                 return r

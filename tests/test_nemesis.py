@@ -248,7 +248,7 @@ class TestFreshProxyTagDeferral:
         state = {"ready": False}
 
         class LateRk:
-            async def get_rates(self):
+            async def get_rates(self, poller_id=None):
                 if not state["ready"]:
                     raise RuntimeError("ratekeeper unreachable (recovery)")
                 return {"tps_limit": 1e6, "batch_tps_limit": 1e6,
@@ -289,7 +289,7 @@ class TestSystemLaneBypass:
         loop = Loop(seed=0)
 
         class ZeroRk:  # backpressure clamped everything
-            async def get_rates(self):
+            async def get_rates(self, poller_id=None):
                 return {"tps_limit": 0.0, "batch_tps_limit": 0.0}
 
         from foundationdb_tpu.runtime.grv_proxy import GrvProxy

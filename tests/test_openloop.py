@@ -1,0 +1,398 @@
+"""Open-loop loadgen subsystem: arrivals, CO-correct harness, histogram
+aggregation, NetTransport frame coalescing, per-proxy ratekeeper budget
+shares, and the multi-process socket-cluster smoke (ISSUE 11).
+
+The harness logic is validated on the deterministic sim loop (virtual
+time: exact latency assertions); the smoke test then boots a REAL
+>= 3-process cluster over TCP, streams read-modify-write transactions
+through it open-loop, proves serializability with an exact increment
+oracle (sum of counters == committed increments — a lost update breaks
+the identity), and tears down cleanly (every process exits 0, every
+port released)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.loadgen.arrivals import (
+    parse_profile,
+    poisson_schedule,
+    trace_schedule,
+)
+from foundationdb_tpu.loadgen.harness import (
+    LatencyHistogram,
+    OpenLoopResult,
+    run_open_loop,
+)
+from foundationdb_tpu.runtime.flow import Loop
+
+
+class TestArrivals:
+    def test_poisson_rate_and_determinism(self):
+        s = poisson_schedule(500.0, 10.0, seed=7)
+        assert s.size == pytest.approx(5000, rel=0.1)
+        assert np.all(np.diff(s) >= 0) and s[-1] < 10.0
+        assert np.array_equal(s, poisson_schedule(500.0, 10.0, seed=7))
+        assert not np.array_equal(
+            s[:100], poisson_schedule(500.0, 10.0, seed=8)[:100])
+
+    def test_poisson_tiny_rate_headroom(self):
+        # Rates so low the first draw overshoots the window must still
+        # terminate and stay inside it.
+        s = poisson_schedule(0.5, 4.0, seed=1)
+        assert np.all(s < 4.0)
+
+    def test_trace_profile_segments(self):
+        prof = parse_profile("2:100,2:1000")
+        assert prof == [(2.0, 100.0), (2.0, 1000.0)]
+        s = trace_schedule(prof, seed=3)
+        lo = int(np.sum(s < 2.0))
+        hi = int(np.sum(s >= 2.0))
+        assert lo == pytest.approx(200, rel=0.35)
+        assert hi == pytest.approx(2000, rel=0.15)
+        assert np.all(np.diff(s) >= 0)
+
+
+class TestLatencyHistogram:
+    def test_percentile_conservative_within_bin(self):
+        h = LatencyHistogram()
+        vals = np.random.default_rng(0).lognormal(3.0, 1.0, 5000)
+        for v in vals:
+            h.record(float(v))
+        for q in (50, 99):
+            true = float(np.percentile(vals, q))
+            est = h.percentile(q)
+            assert est >= true * 0.999  # never under-reports
+            assert est <= true * 1.06  # within ~one 4.9% bin
+        assert h.count == 5000
+
+    def test_merge_equals_union_and_roundtrip(self):
+        a, b, u = (LatencyHistogram() for _ in range(3))
+        for v in (0.5, 3.0, 700.0):
+            a.record(v)
+            u.record(v)
+        for v in (1e9, 12.0):  # 1e9 lands in the overflow bin
+            b.record(v)
+            u.record(v)
+        m = LatencyHistogram.from_dict(a.to_dict()).merge(
+            LatencyHistogram.from_dict(b.to_dict()))
+        assert np.array_equal(m.counts, u.counts)
+        assert m.percentile(99) == u.percentile(99)
+        assert m.max_ms == 1e9  # overflow percentile falls back to max
+        assert m.percentile(99.999) == 1e9
+
+
+class _FakeDb:
+    """Minimal Database stand-in for harness-only tests: transactions
+    whose commit sleeps a scripted per-txn duration on the sim loop."""
+
+    class _Tr:
+        def __init__(self, db):
+            self.db = db
+
+        def set_option(self, *_a, **_k):
+            pass
+
+        async def commit(self):
+            await self.db.loop.sleep(self.db.service_s)
+
+        async def on_error(self, e):
+            raise e
+
+    def __init__(self, loop, service_s: float):
+        self.loop = loop
+        self.service_s = service_s
+
+    def transaction(self):
+        return self._Tr(self)
+
+
+class TestOpenLoopHarness:
+    def test_co_latency_measured_from_scheduled_arrival(self):
+        """One client slot, 200ms service, two arrivals 10ms apart: the
+        second txn's latency must include the 190ms it waited for the
+        slot (coordinated omission), while its service latency is just
+        the 200ms commit."""
+        loop = Loop(seed=0)
+        db = _FakeDb(loop, service_s=0.2)
+
+        async def txn_fn(_tr, _k):
+            pass
+
+        async def main():
+            return await run_open_loop(
+                loop, db, [0.0, 0.01], txn_fn, n_clients=1,
+                timeout_ms=None, retry_limit=None)
+
+        res = loop.run(main(), timeout=60)
+        assert res.committed == 2 and res.offered == 2
+        # Second txn: scheduled t=10ms, started t=200ms, done t=400ms.
+        assert res.co_hist.percentile(99) >= 385.0
+        assert res.service_hist.percentile(99) <= 220.0
+
+    def test_shed_and_accounting_identity(self):
+        loop = Loop(seed=0)
+        db = _FakeDb(loop, service_s=1.0)
+
+        async def txn_fn(_tr, _k):
+            pass
+
+        async def main():
+            # 8 simultaneous arrivals onto ONE slot with queue cap 2:
+            # the burst dispatches synchronously (the worker hasn't
+            # popped yet), so 2 queue and 6 shed, deterministically.
+            return await run_open_loop(
+                loop, db, [0.0] * 8, txn_fn, n_clients=1,
+                client_queue_cap=2, timeout_ms=None, retry_limit=None,
+                drain_s=30.0)
+
+        res = loop.run(main(), timeout=120)
+        assert res.shed == 6 and res.committed == 2
+        assert (res.committed + res.shed + res.timed_out + res.failed
+                + res.abandoned == res.offered)
+
+    def test_abandoned_counted_at_drain_deadline(self):
+        loop = Loop(seed=0)
+        db = _FakeDb(loop, service_s=50.0)
+
+        async def txn_fn(_tr, _k):
+            pass
+
+        async def main():
+            return await run_open_loop(
+                loop, db, [0.0, 0.0], txn_fn, n_clients=2,
+                timeout_ms=None, retry_limit=None, drain_s=1.0)
+
+        res = loop.run(main(), timeout=120)
+        assert res.abandoned == 2 and res.committed == 0
+        # Censored observations: abandoned arrivals enter the CO
+        # histogram at their elapsed-so-far lower bound (~1s), never
+        # silently dropped from the tail.
+        assert res.co_hist.count == 2
+        assert res.co_hist.percentile(50) >= 990.0
+
+    def test_timed_out_arrivals_counted_in_co_histogram(self):
+        from foundationdb_tpu.core.errors import TransactionTimedOut
+
+        loop = Loop(seed=0)
+
+        class _TimeoutDb(_FakeDb):
+            class _Tr(_FakeDb._Tr):
+                async def commit(self):
+                    await self.db.loop.sleep(self.db.service_s)
+                    raise TransactionTimedOut("scripted")
+
+            def transaction(self):
+                return self._Tr(self)
+
+        db = _TimeoutDb(loop, service_s=0.5)
+
+        async def txn_fn(_tr, _k):
+            pass
+
+        async def main():
+            return await run_open_loop(
+                loop, db, [0.0], txn_fn, n_clients=1,
+                timeout_ms=None, retry_limit=None)
+
+        res = loop.run(main(), timeout=60)
+        assert res.timed_out == 1 and res.committed == 0
+        # The failed arrival's full elapsed time is IN the CO tail —
+        # censoring it out would be survivorship bias.
+        assert res.co_hist.count == 1
+        assert res.co_hist.percentile(99) >= 495.0
+        assert res.service_hist.count == 0
+
+    def test_sim_cluster_end_to_end(self):
+        from foundationdb_tpu.client.ryw import open_database
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        c = SimCluster(seed=11)
+        db = open_database(c)
+        sched = poisson_schedule(150.0, 2.0, seed=5)
+
+        async def txn_fn(tr, k):
+            tr.set(b"ol/%d" % (k % 32), b"v")
+
+        async def main():
+            return await run_open_loop(c.loop, db, sched, txn_fn,
+                                       n_clients=16, timeout_ms=None)
+
+        res = c.loop.run(main(), timeout=600)
+        assert res.committed == res.offered and res.failed == 0
+        assert res.co_hist.count == res.committed
+
+    def test_merge_dicts_sums_counts_and_histograms(self):
+        a = OpenLoopResult(offered=10, committed=8, shed=2,
+                           schedule_span_s=2.0, run_span_s=2.5)
+        a.co_hist.record(5.0)
+        b = OpenLoopResult(offered=4, committed=3, failed=1,
+                           schedule_span_s=2.0, run_span_s=2.0)
+        b.co_hist.record(50.0)
+        m = OpenLoopResult.merge_dicts([a.to_dict(), b.to_dict()])
+        assert m["offered"] == 14 and m["committed"] == 11
+        assert m["shed"] == 2 and m["failed"] == 1
+        assert m["run_span_s"] == 2.5
+        assert LatencyHistogram.from_dict(m["co_latency"]).count == 2
+        # Throughput sums across generators, not committed/max-span.
+        assert m["throughput_txns_per_sec"] == pytest.approx(
+            8 / 2.5 + 3 / 2.0, rel=0.05)
+
+
+class TestFrameCoalescing:
+    def test_burst_of_small_frames_coalesces_per_flush(self):
+        """64 RPCs issued in one scheduler burst must reach the wire in
+        far fewer send() calls than frames (TCP_NODELAY + per-frame
+        flushes would emit a segment per frame; Nagle instead would
+        stall — coalescing is the fix for both)."""
+        from foundationdb_tpu.runtime.net import (
+            NetTransport,
+            RealLoop,
+            rpc,
+        )
+
+        class Echo:
+            @rpc
+            async def echo(self, x):
+                return x
+
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("echo", Echo())
+        ep = client.endpoint(server.addr, "echo")
+
+        async def main():
+            tasks = [loop.spawn(ep.echo(i), name=f"e{i}")
+                     for i in range(64)]
+            out = []
+            for t in tasks:
+                out.append(await t)
+            return out
+
+        try:
+            assert loop.run(main(), timeout=30) == list(range(64))
+            conn = next(iter(client._conns.values()))
+            assert conn.frames_queued >= 64
+            assert conn.flushes <= conn.frames_queued // 4
+        finally:
+            client.close()
+            server.close()
+
+
+class TestRatekeeperShares:
+    def _rk(self, loop):
+        from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
+
+        return Ratekeeper(loop, storage_eps=[])
+
+    def test_budget_split_across_live_pollers(self):
+        loop = Loop(seed=0)
+        rk = self._rk(loop)
+
+        async def main():
+            r1 = await rk.get_rates("grv-a")
+            r2 = await rk.get_rates("grv-b")
+            anon = await rk.get_rates()
+            return r1, r2, anon
+
+        r1, r2, anon = loop.run(main())
+        assert r1["grv_pollers"] == 1
+        assert r1["tps_limit_share"] == r1["tps_limit"]
+        assert r2["grv_pollers"] == 2
+        assert r2["tps_limit_share"] == pytest.approx(
+            r2["tps_limit"] / 2)
+        # Observers without an id never join the lease.
+        assert anon["grv_pollers"] == 2
+
+    def test_dead_poller_share_returns_to_survivors(self):
+        loop = Loop(seed=0)
+        rk = self._rk(loop)
+
+        async def main():
+            await rk.get_rates("grv-a")
+            await rk.get_rates("grv-b")
+            await loop.sleep(rk.POLLER_TTL + 0.1)
+            return await rk.get_rates("grv-a")  # b went silent
+
+        r = loop.run(main())
+        assert r["grv_pollers"] == 1
+        assert r["tps_limit_share"] == r["tps_limit"]
+
+    def test_tag_quota_is_a_cluster_bound(self):
+        loop = Loop(seed=0)
+        rk = self._rk(loop)
+
+        async def main():
+            await rk.set_tag_quota("hot", 100.0)
+            await rk.get_rates("grv-a")
+            return await rk.get_rates("grv-b")
+
+        r = loop.run(main())
+        assert r["tag_rates"]["hot"] == 100.0
+        assert r["tag_rates_share"]["hot"] == pytest.approx(50.0)
+
+
+class TestSocketClusterSmoke:
+    """The ISSUE 11 satellite: >= 3 OS processes over real TCP, an
+    open-loop txn stream, an exact serializability oracle, and a clean
+    teardown with no leaked processes or sockets."""
+
+    def test_multiprocess_stream_serializable_and_clean_teardown(
+            self, tmp_path):
+        from foundationdb_tpu.loadgen.deploy import SocketCluster
+
+        n_counters = 8
+        cluster = SocketCluster(str(tmp_path), proxies=2, ratekeeper=False)
+        cluster.start()
+        assert len(cluster.procs) >= 3  # 6: seq/res/tlog/storage/proxy*2
+        try:
+            loop, t, db = cluster.open_client()
+            from foundationdb_tpu.client.transaction import Transaction
+
+            db.transaction_class = Transaction
+
+            async def txn_fn(tr, k):
+                key = b"ctr/%d" % (k % n_counters)
+                cur = await tr.get(key)
+                tr.set(key, b"%d" % (int(cur or b"0") + 1))
+
+            sched = poisson_schedule(120.0, 2.0, seed=9)
+
+            async def main():
+                return await run_open_loop(
+                    loop, db, sched, txn_fn, n_clients=24,
+                    timeout_ms=20000, retry_limit=None, drain_s=30.0)
+
+            res = loop.run(main(), timeout=120)
+            assert res.offered > 100
+            assert res.failed == 0 and res.timed_out == 0
+            assert res.abandoned == 0 and res.shed == 0
+
+            # Exact serializability oracle: every committed txn
+            # incremented exactly one counter by exactly 1, so the sum
+            # of final counters must equal the committed count — a lost
+            # update (two RMWs from one snapshot both committing) breaks
+            # this identity immediately.
+            async def readback():
+                tr = db.transaction()
+                total = 0
+                for i in range(n_counters):
+                    v = await tr.get(b"ctr/%d" % i)
+                    total += int(v or b"0")
+                return total
+
+            assert loop.run_until(loop.spawn(readback(), name="rb"),
+                                  timeout=60) == res.committed
+            assert res.conflict_retries > 0 or res.committed > 0
+            t.close()
+        except BaseException:
+            cluster.kill()
+            raise
+        # Clean teardown: graceful shutdown RPC, every process exits 0,
+        # every port released (shutdown() raises on leaks).
+        report = cluster.shutdown()
+        assert report["killed"] == []
+        assert all(rc == 0 for rc in report["exit_codes"].values()), \
+            report
