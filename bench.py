@@ -1826,6 +1826,24 @@ def main() -> None:
         if args.smoke:
             result["cpu_baseline_pinned"] = {
                 "skipped": "smoke run", "config": dict(CPU_BASELINE_PIN)}
+            # Obs reconciliation identity (observability subsystem): a
+            # short traced sim run must show complete span trees whose
+            # per-stage sums reconcile against end-to-end latency with
+            # the residue reported as `unattributed` — asserted here so
+            # a stage-stamping regression fails the smoke gate, not a
+            # reader of the next round's artifact.
+            from foundationdb_tpu.obs import run_selfcheck
+
+            obs_rec = run_selfcheck(txns=96)
+            result["latency_breakdown_selfcheck"] = {
+                k: obs_rec[k] for k in
+                ("ok", "span_trees_checked", "unattributed_frac",
+                 "problems")
+            }
+            if not obs_rec["ok"]:
+                raise RuntimeError(
+                    f"obs breakdown reconciliation failed: "
+                    f"{obs_rec['problems'][:3]}")
         else:
             try:
                 log("[cpu] pinned cross-round baseline "

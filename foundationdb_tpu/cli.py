@@ -128,6 +128,18 @@ consistencycheck [T]    walk every shard team at one snapshot version and
                         serve path; prints the divergence report (JSON).
                         T = wait budget in seconds (default 120; the audit
                         paces itself, so big datasets need more)
+latency [N]             active commit-path latency probe: run N (default
+                        48) traced transactions and print the per-stage
+                        breakdown (grv wait, proxy admit, batch form,
+                        resolve wait, tlog durable, ...) with the
+                        residue reported as `unattributed`. Full stage
+                        attribution needs the SERVER processes started
+                        with FDB_TPU_OBS=1; against an untraced cluster
+                        the probe reports client-side stages only and
+                        says so
+metrics [prom]          unified metrics scrape of every role (obs
+                        registry): one JSON line, or Prometheus text
+                        exposition with `prom`
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -342,6 +354,32 @@ class Shell:
                 timeout=timeout_s,
             )
             return json.dumps(report, indent=1, sort_keys=True)
+        if cmd == "latency":
+            # Commit-path stage attribution (obs subsystem): an ACTIVE
+            # probe — N small transactions, every one traced client-side
+            # (no pre-armed client sampling needed). Proxy-side stages
+            # ride the commit replies only from FDB_TPU_OBS=1 servers;
+            # against an untraced cluster the report carries a warning
+            # and the round trip lands in `unattributed`. The per-stage
+            # sums reconcile against e2e either way.
+            if len(args) > 1:
+                return "usage: latency [N_TXNS]"
+            n = int(args[0]) if args else 48
+            from foundationdb_tpu.obs import latency_probe
+
+            report = self._await(latency_probe(self.db, self.loop, n=n),
+                                 timeout=120.0)
+            return json.dumps(report, indent=1, sort_keys=True)
+        if cmd == "metrics":
+            # Unified metrics scrape (obs registry): every role's
+            # counters in one namespaced snapshot.
+            if args not in ([], ["prom"]):
+                return "usage: metrics [prom]"
+            from foundationdb_tpu.obs import scrape_deployed
+
+            reg = scrape_deployed(self.loop, self.t, self.spec)
+            return (reg.to_prometheus() if args == ["prom"]
+                    else reg.to_json_line())
         if cmd == "status":
             return json.dumps(self._status(), indent=1, sort_keys=True)
         return f"ERROR: unknown command `{cmd}' (try help)"

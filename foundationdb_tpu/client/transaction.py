@@ -51,6 +51,7 @@ from foundationdb_tpu.core.errors import (
     ValueTooLarge,
     WrongShardServer,
 )
+from foundationdb_tpu.obs.span import span_sink
 from foundationdb_tpu.runtime.commit_proxy import CommitRequest
 from foundationdb_tpu.runtime.shardmap import MAX_KEY, KeyShardMap
 
@@ -397,6 +398,12 @@ class Transaction:
         self.admission_no_shape = False
         self._retries = 0  # attempts consumed by on_error (for retry_limit)
         self._preabort_streak = 0  # consecutive pre-aborts (pacing)
+        # Commit-path tracing (obs subsystem): None = sampling undecided,
+        # False = not sampled, TraceContext = sampled. Decided once per
+        # transaction LIFETIME (at the first GRV) so a retried txn keeps
+        # its trace id; per-attempt stamps live in _obs_grv (reset-able).
+        self._obs = None
+        self._obs_grv: "tuple[float, float] | None" = None
         self._reset()
 
     def set_option(self, name: str, value=None) -> None:
@@ -462,12 +469,22 @@ class Transaction:
         self._pending_watches: list[tuple[bytes, bytes | None]] = []
         self._watch_futures: list = []
         self._conflicting_ranges: list[tuple[bytes, bytes]] = []
+        self._obs_grv = None  # per-attempt GRV stamp (obs subsystem)
 
     # -- versions -------------------------------------------------------------
 
     async def get_read_version(self) -> int:
         self._check_timeout()
         if self._read_version is None:
+            if self._obs is None:
+                # Sampling decision (obs subsystem): once per txn, at the
+                # first GRV — counter-based, so it never perturbs the
+                # loop's seeded RNG stream. None (no sink / not sampled)
+                # collapses to False: decided, unsampled.
+                sink = span_sink(self.db.loop)
+                self._obs = (sink.sample() if sink is not None
+                             else None) or False
+            t_grv = self.db.loop.now if self._obs else 0.0
             ep = self.db._pick(self.db.grv_proxies)
             try:
                 self._read_version = await ep.get_read_version(
@@ -501,6 +518,9 @@ class Transaction:
                     self.db.note_proxy_failed(ep)
                     raise ProcessKilled(str(e)) from e
                 raise
+            if self._obs:
+                # grv_wait stage: request -> grant, queue/deferral incl.
+                self._obs_grv = (t_grv, self.db.loop.now - t_grv)
         return self._read_version
 
     def set_read_version(self, version: int) -> None:
@@ -842,8 +862,12 @@ class Transaction:
             priority=self.priority,
             admission_no_shape=self.admission_no_shape,
             admission_attempts=self._preabort_streak,
+            # Sampled txns carry their trace id so the proxy stamps
+            # stage spans onto the reply (obs subsystem).
+            trace=self._obs.tid if self._obs else None,
         )
         commit_ep = self.db._pick(self.db.commit_proxies)
+        t_commit = self.db.loop.now if self._obs else 0.0
         try:
             res = await commit_ep.commit(req)
         except NotCommitted as e:
@@ -869,8 +893,57 @@ class Transaction:
                 raise ProcessKilled(str(e)) from e
             raise
         self._committed = (res.version, res.batch_order)
+        if self._obs:
+            try:
+                self._obs_record_commit(getattr(res, "spans", None),
+                                        t_commit, self.db.loop.now)
+            except Exception:
+                # Tracing bookkeeping must never fail a transaction that
+                # IS durably committed (a malformed spans tuple from a
+                # buggy/older proxy would otherwise raise out of commit()
+                # and skip arming the watches below).
+                pass
         self._arm_watches()
         return res.version
+
+    def _obs_record_commit(self, proxy_spans, t0: float, t1: float) -> None:
+        """Assemble this sampled txn's exact commit-path breakdown from
+        the client-measured GRV/commit envelopes plus the proxy's
+        piggybacked stage spans, and record it (span tree + per-stage
+        histograms + the arithmetic residue as `unattributed`). e2e is
+        the COMMIT PATH only — grv_wait + the commit round trip — so the
+        identity e2e == sum(stages) + unattributed is exact and app
+        think-time between reads never pollutes it.
+
+        A sampled commit answered WITHOUT spans (the proxy process runs
+        untraced — e.g. servers started without FDB_TPU_OBS=1, or an
+        older peer) still records: grv_wait plus the whole commit round
+        trip as `unattributed`, so the report says loudly that the
+        server side is dark instead of silently showing nothing."""
+        sink = span_sink(self.db.loop)
+        if sink is None:
+            return
+        commit_dur = t1 - t0
+        e2e = commit_dur
+        stages: list[tuple[str, float, float]] = []
+        if self._obs_grv is not None:
+            g0, g_dur = self._obs_grv
+            stages.append(("grv_wait", g0, g_dur))
+            e2e += g_dur
+        if proxy_spans:
+            proxy_total = 0.0
+            for name, start, dur in proxy_spans:
+                if name == "proxy_total":
+                    proxy_total = dur
+                else:
+                    stages.append((name, start, dur))
+            # The transport residue: commit round trip minus the proxy's
+            # envelope (request + reply legs, client/proxy queueing
+            # outside the stamped stages). Clamped at 0 against
+            # cross-process clock skew; the exact residue still lands in
+            # `unattributed`.
+            stages.append(("reply", t0, max(0.0, commit_dur - proxy_total)))
+        sink.record_txn(self._obs.tid, e2e, stages)
 
     def _arm_watches(self) -> None:
         for (key, value), slot in zip(self._pending_watches, self._watch_futures):

@@ -76,6 +76,14 @@ def main(argv: "list[str] | None" = None) -> int:
 
     db.transaction_class = Transaction  # raw txns: RYW adds no load here
 
+    from foundationdb_tpu.obs.span import SpanSink, obs_env_default
+
+    if obs_env_default():
+        # Commit-path tracing (FDB_TPU_OBS=1): sampled txns' per-stage
+        # breakdown rides each run's JSON line as `obs` (mergeable
+        # histograms; bench.py --open-loop merges across generators).
+        SpanSink(loop)
+
     value = b"v" * max(1, args.value_bytes)
     n_keys, n_reads = args.keys, args.reads
 

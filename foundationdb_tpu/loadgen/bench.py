@@ -88,7 +88,8 @@ def _run_generators(spec_path: str, workdir: str, points, generators: int,
                     clients: int, seed: int, keys: int, gap_s: float,
                     timeout_ms: int, lead_s: float = 6.0,
                     rk_poll=None,
-                    annotate=None) -> "tuple[list[dict], list[dict]]":
+                    annotate=None,
+                    env: "dict | None" = None) -> "tuple[list[dict], list[dict]]":
     """Run `generators` loadgen processes through the shared rate ladder
     `points` = [(dur_s, total_rate), ...]; returns (per-point merged
     records, ratekeeper samples). Each generator offers rate/generators
@@ -110,7 +111,8 @@ def _run_generators(spec_path: str, workdir: str, points, generators: int,
                  "--keys", str(keys),
                  "--timeout-ms", str(timeout_ms),
                  "--start-at", str(start_at)],
-                cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                cwd=REPO,
+                env=dict(os.environ, JAX_PLATFORMS="cpu", **(env or {})),
                 stdout=subprocess.PIPE, stderr=err_f, text=True,
             ))
     budget = (lead_s + sum(d for d, _r in points)
@@ -144,6 +146,15 @@ def _run_generators(spec_path: str, workdir: str, points, generators: int,
         m = OpenLoopResult.merge_dicts(recs)
         m.update(point=i, offered_tps=rate, duration_s=dur,
                  start_lag_s=max(r.get("start_lag_s", 0.0) for r in recs))
+        dumps = [r.get("obs") for r in recs if r.get("obs")]
+        if dumps:
+            # Per-stage commit-path breakdown (obs subsystem), merged by
+            # histogram sum across generators — the record's answer to
+            # WHERE this point's latency went, residue reported as
+            # `unattributed`.
+            from foundationdb_tpu.obs.span import SpanSink
+
+            m["latency_breakdown"] = SpanSink.merge_dumps(dumps)
         # Quotability is judged on the histogram the p99 is READ from:
         # the CO histogram holds every non-shed arrival (committed +
         # timed_out + failed + abandoned), not just commits.
@@ -203,19 +214,19 @@ def _ladder_on_cluster(workdir: str, proxies: int, duration_s: float,
                        gap_s: float, generators: int, clients: int,
                        keys: int, seed: int, calib_rate: float,
                        p99_bound_ms: float, timeout_ms: int,
-                       annotate=None) -> dict:
+                       annotate=None, env: "dict | None" = None) -> dict:
     """Boot a cluster with `proxies` proxy processes, probe capacity at a
     past-saturation rate, then run a rate ladder around it. Returns the
     per-proxy-count record: every ladder point + the sustainable pick."""
     _log(f"cluster proxies={proxies}: booting")
     with SocketCluster(os.path.join(workdir, f"p{proxies}"),
-                       proxies=proxies) as cluster:
+                       proxies=proxies, env=env) as cluster:
         _log(f"cluster proxies={proxies}: capacity probe @ "
              f"{calib_rate:.0f} tps")
         calib, _ = _run_generators(
             cluster.spec_path, workdir, [(duration_s, calib_rate)],
             generators, clients, seed, keys, gap_s, timeout_ms,
-            annotate=annotate)
+            annotate=annotate, env=env)
         capacity = max(calib[0]["throughput_txns_per_sec"], 1.0)
         _log(f"cluster proxies={proxies}: probe completed "
              f"{capacity:.0f} tps (offered {calib_rate:.0f})")
@@ -223,7 +234,8 @@ def _ladder_on_cluster(workdir: str, proxies: int, duration_s: float,
         ladder = [(duration_s, round(capacity * f, 1)) for f in fracs]
         points, _ = _run_generators(
             cluster.spec_path, workdir, ladder, generators, clients,
-            seed + 100, keys, gap_s, timeout_ms, annotate=annotate)
+            seed + 100, keys, gap_s, timeout_ms, annotate=annotate,
+            env=env)
     sustained = [p for p in points if _sustained(p, p99_bound_ms)]
     best = max(sustained, key=lambda p: p["offered_tps"], default=None)
     return {
@@ -257,6 +269,13 @@ def run_open_loop_bench(
 ) -> dict:
     proxy_counts = sorted(set(int(p) for p in proxy_counts))
     workdir = workdir or tempfile.mkdtemp(prefix="openloop_")
+    # Arm commit-path tracing in the generator/cluster SUBPROCESSES at
+    # the default 1-in-64 sampling (never by mutating this process's
+    # environment): every ladder point's record then embeds the
+    # per-stage latency breakdown (obs subsystem; the sampling-overhead
+    # gate for this is OBS_AB.json). FDB_TPU_OBS=0 in the caller's env
+    # still disables it end to end.
+    obs_env = {"FDB_TPU_OBS": os.environ.get("FDB_TPU_OBS", "1")}
     cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
         else (os.cpu_count() or 1)
     rec: dict = {
@@ -279,7 +298,7 @@ def run_open_loop_bench(
         scaling.append(_ladder_on_cluster(
             workdir, p, duration_s, gap_s, generators, clients, keys,
             seed + 1000 * i, calib_rate, p99_bound_ms, timeout_ms,
-            annotate=annotate))
+            annotate=annotate, env=obs_env))
     rec["scaling_curve"] = scaling
     base = next((s for s in scaling if s["proxies"] == proxy_counts[0]),
                 None)
@@ -304,6 +323,15 @@ def run_open_loop_bench(
     ]
     past_saturation = any(not _sustained(p, p99_bound_ms)
                           for p in maxp["points"])
+    # Headline per-stage breakdown: the max-proxy cluster's best
+    # sustained point (fallback: its first point) — the record-level
+    # answer to where a sustained txn's time went.
+    for p in sorted(maxp["points"],
+                    key=lambda p: (not _sustained(p, p99_bound_ms),
+                                   -p["offered_tps"])):
+        if p.get("latency_breakdown"):
+            rec["latency_breakdown"] = p["latency_breakdown"]
+            break
 
     # -- overload: ratekeeper engagement + recovery -----------------------
     overload_rec = None
@@ -313,7 +341,7 @@ def run_open_loop_bench(
         overload_rec = _overload_run(
             workdir, max(proxy_counts), s_tps, duration_s, gap_s,
             generators, clients, keys, seed + 9000, p99_bound_ms,
-            timeout_ms, annotate=annotate)
+            timeout_ms, annotate=annotate, env=obs_env)
         rec["overload"] = overload_rec
 
     scaling_ok = bool(
@@ -343,7 +371,7 @@ def _overload_run(workdir: str, proxies: int, sustainable_tps: float,
                   duration_s: float, gap_s: float, generators: int,
                   clients: int, keys: int, seed: int,
                   p99_bound_ms: float, timeout_ms: int,
-                  annotate=None) -> dict:
+                  annotate=None, env: "dict | None" = None) -> dict:
     """Drive far past capacity against a cluster whose resolver models
     dispatch cost (OVERLOAD_SPEC) with the admission subsystem armed,
     polling the ratekeeper from the side; then drop to well under
@@ -361,7 +389,8 @@ def _overload_run(workdir: str, proxies: int, sustainable_tps: float,
          f"dispatch-cost knobs {OVERLOAD_SPEC}")
     with SocketCluster(os.path.join(workdir, "overload"), proxies=proxies,
                        spec_extra=dict(OVERLOAD_SPEC),
-                       env={"FDB_TPU_ADMISSION": "1"}) as cluster:
+                       env={"FDB_TPU_ADMISSION": "1",
+                            **(env or {})}) as cluster:
         _log(f"overload: offering {hi} tps for {hi_dur}s, then {lo} tps "
              "(transition + steady recovery windows)")
         # Three windows: overload, the recovery TRANSITION (absorbs the
@@ -372,7 +401,7 @@ def _overload_run(workdir: str, proxies: int, sustainable_tps: float,
             cluster.spec_path, workdir,
             [(hi_dur, hi), (lo_dur, lo), (lo_dur, lo)],
             generators, clients, seed, keys, gap_s, timeout_ms,
-            rk_poll=_rk_poller(cluster), annotate=annotate)
+            rk_poll=_rk_poller(cluster), annotate=annotate, env=env)
     over, transition, rest = points[0], points[1], points[2]
     # "Engaged" means the ratekeeper itself REPORTED one of the two
     # admission signals as its limiting reason — raw queue depth alone

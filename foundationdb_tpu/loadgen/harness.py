@@ -22,6 +22,7 @@ failed + abandoned, always.
 
 from __future__ import annotations
 
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -49,6 +50,10 @@ class LatencyHistogram:
         np.log10(LO_MS), np.log10(HI_MS),
         int(np.log10(HI_MS / LO_MS) * BINS_PER_DECADE) + 1,
     )
+    # Plain-python edge list for the record() hot path: bisect on a list
+    # is several times cheaper than a numpy scalar searchsorted, and the
+    # obs subsystem's stage stamps sit on the commit path.
+    _EDGE_LIST = _EDGES.tolist()
 
     def __init__(self) -> None:
         # counts[i] = samples in (_EDGES[i-1], _EDGES[i]]; [0] underflow,
@@ -62,9 +67,19 @@ class LatencyHistogram:
         return int(self.counts.sum())
 
     def record(self, ms: float) -> None:
-        self.counts[int(np.searchsorted(self._EDGES, ms))] += 1
-        self.max_ms = max(self.max_ms, float(ms))
+        self.counts[bisect_left(self._EDGE_LIST, ms)] += 1
+        if ms > self.max_ms:
+            self.max_ms = float(ms)
         self.sum_ms += float(ms)
+
+    def record_n(self, ms: float, n: int) -> None:
+        """`n` samples at one value — batch-level stage stamps (obs
+        subsystem) weight a per-batch duration by the batch's txn count
+        without paying a record() per txn."""
+        self.counts[bisect_left(self._EDGE_LIST, ms)] += n
+        if ms > self.max_ms:
+            self.max_ms = float(ms)
+        self.sum_ms += float(ms) * n
 
     def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
         self.counts += other.counts
@@ -127,6 +142,11 @@ class OpenLoopResult:
     max_dispatch_lag_s: float = 0.0
     co_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
     service_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
+    # Commit-path stage attribution (obs subsystem): the generator
+    # loop's span-sink dump for this run's window, when tracing is
+    # armed (FDB_TPU_OBS=1). Raw mergeable histograms — bench merges
+    # across generators into the record's `latency_breakdown`.
+    obs_dump: "dict | None" = None
 
     @property
     def throughput(self) -> float:
@@ -157,6 +177,7 @@ class OpenLoopResult:
             "co_p50_ms": self.co_hist.percentile(50),
             "co_p99_ms": self.co_hist.percentile(99),
             "service_p99_ms": self.service_hist.percentile(99),
+            **({"obs": self.obs_dump} if self.obs_dump else {}),
         }
 
     @classmethod
@@ -331,4 +352,11 @@ async def run_open_loop(
                          state["done_at"] - t0)
     assert (res.committed + res.shed + res.timed_out + res.failed
             + res.abandoned == res.offered)
+    sink = getattr(loop, "span_sink", None)
+    if sink is not None and sink.enabled:
+        # Per-stage commit-path attribution for THIS run's window; the
+        # sink resets so ladder points on a reused loop never bleed
+        # samples into each other's records.
+        res.obs_dump = sink.dump()
+        sink.reset()
     return res

@@ -18,6 +18,7 @@ import ctypes
 import os
 import struct
 import threading
+from time import perf_counter as _perf_counter
 from typing import Callable, NamedTuple
 
 import numpy as np
@@ -1343,6 +1344,10 @@ class TPUConflictSet:
         return bt, int(new_off)
 
     def _pack(self, txns: list[TxnConflictInfo], collect_reads: bool = False):
+        # Host-pack stage stamp (obs subsystem): wall seconds of the last
+        # host-side pack, read by the resolver's span sink right after a
+        # resolve — a stored float, never entering kernel state.
+        _t_pack0 = _perf_counter()
         bt = self._empty_batch()
         read_begin, read_end, read_mask = bt.read_begin, bt.read_end, bt.read_mask
         write_begin, write_end, write_mask = bt.write_begin, bt.write_end, bt.write_mask
@@ -1381,6 +1386,12 @@ class TPUConflictSet:
             write_end[w_rows, w_cols] = we
             write_mask[w_rows, w_cols] = True
 
+        # ACCUMULATE across chunks (a capacity-chunked resolve packs once
+        # per chunk; the reader — the resolver's span sink — clears the
+        # stamp to None per dispatched batch, so the sum is per-batch).
+        self.last_host_pack_s = (
+            (getattr(self, "last_host_pack_s", None) or 0.0)
+            + (_perf_counter() - _t_pack0))
         if collect_reads:
             return bt, reads_per_txn
         return bt

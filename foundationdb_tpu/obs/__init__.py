@@ -1,0 +1,62 @@
+"""End-to-end commit-path observability (ISSUE 12 tentpole).
+
+Three pieces, one subsystem:
+
+- ``span``: per-transaction commit-path tracing — sampled txns carry a
+  trace context through the wire structs, every role stamps span
+  boundaries, the client assembles the exact per-stage breakdown and the
+  residue is reported as ``unattributed`` (never silently dropped).
+- ``registry``: the unified metrics scrape — every role's counters plus
+  tracer/span tallies in one namespaced snapshot, emitted as Prometheus
+  text, one JSON line, or a periodic JSONL time-series.
+- ``selfcheck``: the CI face — ``python -m foundationdb_tpu.obs`` runs a
+  short sim and verifies span completeness, the reconciliation identity,
+  and the scrape audit in one JSON line; ``--ab`` measures the 1-in-64
+  sampling overhead against the <=2% gate (scripts/obs_ab.sh ->
+  OBS_AB.json).
+
+Knobs (README "Observability"): FDB_TPU_OBS (default 0),
+FDB_TPU_OBS_SAMPLE (default 64 — sample 1-in-N transactions).
+"""
+
+from foundationdb_tpu.obs.registry import (
+    DOCUMENTED_COUNTERS,
+    MetricsPoller,
+    MetricsRegistry,
+    scrape_deployed,
+    scrape_sim,
+)
+from foundationdb_tpu.obs.selfcheck import (
+    latency_probe,
+    run_overhead_ab,
+    run_selfcheck,
+)
+from foundationdb_tpu.obs.span import (
+    SUB_STAGES,
+    TXN_STAGES,
+    SpanSink,
+    TraceContext,
+    check_txn_tree,
+    obs_env_default,
+    obs_sample_default,
+    span_sink,
+)
+
+__all__ = [
+    "DOCUMENTED_COUNTERS",
+    "MetricsPoller",
+    "MetricsRegistry",
+    "SUB_STAGES",
+    "SpanSink",
+    "TXN_STAGES",
+    "TraceContext",
+    "check_txn_tree",
+    "latency_probe",
+    "obs_env_default",
+    "obs_sample_default",
+    "run_overhead_ab",
+    "run_selfcheck",
+    "scrape_deployed",
+    "scrape_sim",
+    "span_sink",
+]

@@ -1,0 +1,94 @@
+"""CI entry point: one-JSON-line obs self-check / sampling-overhead A/B.
+
+    python -m foundationdb_tpu.obs                   # selfcheck, rc 0/1
+    python -m foundationdb_tpu.obs --ab              # OBS_AB.json record
+    python -m foundationdb_tpu.obs --export-trace f  # Perfetto timeline
+    python -m foundationdb_tpu.obs --poll cluster.json --poll-out m.jsonl
+
+The selfcheck (scrape + span reconciliation on a short sim run) is wired
+as the `obs` stage of scripts/tpuwatch_r05.sh; the A/B is
+scripts/obs_ab.sh -> OBS_AB.json. `--poll` is the deployed-cluster
+time-series scraper: one aggregated JSONL snapshot per interval, over
+the cluster spec's TCP endpoints, until interrupted (or --poll-count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # pure sim: no TPU touch
+    ap = argparse.ArgumentParser(prog="python -m foundationdb_tpu.obs")
+    ap.add_argument("--ab", action="store_true",
+                    help="sampling-overhead A/B (tracing off vs 1-in-N) "
+                         "instead of the selfcheck")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--txns", type=int, default=None)
+    ap.add_argument("--sample-every", type=int, default=None)
+    ap.add_argument("--export-trace", default=None, metavar="PATH",
+                    help="also write the selfcheck run's sampled window "
+                         "as a Chrome-trace/Perfetto JSON timeline")
+    ap.add_argument("--poll", default=None, metavar="CLUSTER_JSON",
+                    help="poll a DEPLOYED cluster's metrics into a JSONL "
+                         "time-series instead of running the selfcheck")
+    ap.add_argument("--poll-out", default="obs_metrics.jsonl")
+    ap.add_argument("--poll-interval", type=float, default=5.0)
+    ap.add_argument("--poll-count", type=int, default=0,
+                    help="snapshots to take (0 = until interrupted)")
+    args = ap.parse_args(argv)
+
+    from foundationdb_tpu.obs.selfcheck import run_overhead_ab, run_selfcheck
+
+    if args.poll:
+        import time
+
+        from foundationdb_tpu.obs.registry import scrape_deployed
+        from foundationdb_tpu.runtime.net import NetTransport, RealLoop
+        from foundationdb_tpu.server import load_spec
+
+        spec = load_spec(args.poll)
+        loop = RealLoop()
+        t = NetTransport(loop)
+        taken = 0
+        try:
+            while not args.poll_count or taken < args.poll_count:
+                reg = scrape_deployed(loop, t, spec)
+                with open(args.poll_out, "a", encoding="utf-8") as f:
+                    f.write(reg.to_json_line(
+                        t=round(time.time(), 3), seq=taken) + "\n")
+                taken += 1
+                if not args.poll_count or taken < args.poll_count:
+                    time.sleep(args.poll_interval)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            t.close()
+        print(json.dumps({"metric": "obs_poll_done", "snapshots": taken,
+                          "out": args.poll_out}), flush=True)
+        return 0
+
+    if args.ab:
+        kw = {k: v for k, v in (
+            ("seed", args.seed), ("txns", args.txns),
+            ("sample_every", args.sample_every),
+        ) if v is not None}
+        rec = run_overhead_ab(**kw)
+        print(json.dumps(rec), flush=True)
+        return 0 if rec["valid"] else 1
+
+    kw = {k: v for k, v in (
+        ("seed", args.seed), ("txns", args.txns),
+        ("sample_every", args.sample_every),
+        ("export_trace", args.export_trace),
+    ) if v is not None}
+    rec = run_selfcheck(**kw)
+    print(json.dumps(rec), flush=True)
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

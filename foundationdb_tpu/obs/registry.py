@@ -1,0 +1,266 @@
+"""MetricsRegistry: one namespaced scrape of every role's counters.
+
+Every role already exports counters (``get_metrics`` / ``metrics`` /
+``get_rates``), but each surface had its own consumer — status JSON reads
+a hand-picked subset, the ratekeeper another, the benches a third. The
+registry is the single scrape: every role instance's metrics flattened
+into ``<role>.<instance>.<metric>`` keys (numbers and booleans only — the
+scrape is a metrics plane, not an object dump), plus the tracer's event
+counts and the span sink's tallies, emitted as
+
+- Prometheus text exposition (``to_prometheus``): one gauge per metric,
+  ``process`` label per instance, ``fdb_tpu_`` prefix;
+- one JSON line (``to_json_line``): the CI/tooling form every A/B script
+  in this repo already parses;
+- a periodic JSONL time-series (``MetricsPoller``): deployed clusters
+  append one snapshot per interval for offline dashboards.
+
+The name audit (``audit``) is the registry's hygiene contract, pinned by
+tests: every metric leaf is snake_case, and no two sources collide on a
+full namespaced key (a collision would silently overwrite one role's
+truth with another's).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: status-JSON / README counters that MUST exist in a full-cluster scrape
+#: (the metrics-name audit pins these: a rename that orphans a documented
+#: counter fails the battery, not a user's dashboard).
+DOCUMENTED_COUNTERS = (
+    "grv_proxy.grvs_served",
+    "grv_proxy.queued",
+    "grv_proxy.tag_throttled",
+    "grv_proxy.admission_defer_ticks",
+    "commit_proxy.txns_committed",
+    "commit_proxy.txns_conflicted",
+    "commit_proxy.conflict_losses",
+    "resolver.batches_resolved",
+    "resolver.txns_resolved",
+    "resolver.txns_conflicted",
+    "resolver.txns_reordered",
+    "resolver.txns_cycle_aborted",
+    "resolver.txns_rejected_fail_safe",
+    "resolver.overflow_events",
+    "resolver.queue.depth",
+    "tlog.queue_bytes",
+    "tlog.queue_entries",
+    "storage.version_lag",
+    "ratekeeper.tps_limit",
+)
+
+
+def _flatten(out: dict, prefix: str, value: Any) -> None:
+    """Numbers and booleans keep their key; dicts recurse with dots;
+    everything else (strings, lists — e.g. hot_ranges tables) is not a
+    metric and is dropped from the scrape."""
+    if isinstance(value, bool):
+        out[prefix] = int(value)
+    elif isinstance(value, (int, float)):
+        out[prefix] = value
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(out, f"{prefix}.{k}", v)
+
+
+class MetricsRegistry:
+    """Collects (role, instance, metrics-dict) tuples into one snapshot."""
+
+    def __init__(self) -> None:
+        # full key -> value; plus the collision log the audit reports.
+        self.values: dict[str, float] = {}
+        self.collisions: list[str] = []
+        self._sources: dict[str, int] = {}  # full key -> add() call seq
+        self._add_seq = 0
+
+    def add(self, role: str, instance: str, metrics: "dict | None") -> None:
+        if not metrics:
+            return
+        self._add_seq += 1
+        flat: dict[str, float] = {}
+        _flatten(flat, role, metrics)
+        for key, v in flat.items():
+            full = f"{key}#{instance}" if instance else key
+            if full in self.values and self._sources[full] != self._add_seq:
+                # Two distinct sources produced the SAME namespaced key —
+                # one role's truth silently overwrote another's (e.g. two
+                # endpoints scraped under one instance name).
+                self.collisions.append(full)
+            self.values[full] = v
+            self._sources[full] = self._add_seq
+
+    def snapshot(self) -> dict:
+        """{namespaced key (instance suffix stripped where unique) ->
+        value} with per-instance values under ``key#instance``."""
+        return dict(sorted(self.values.items()))
+
+    def aggregated(self) -> dict:
+        """Instance-summed view ``<role>.<metric> -> value`` (counters
+        sum across instances — the status-JSON convention)."""
+        agg: dict[str, float] = {}
+        for full, v in self.values.items():
+            key = full.split("#", 1)[0]
+            agg[key] = agg.get(key, 0) + v
+        return dict(sorted(agg.items()))
+
+    # -- hygiene -------------------------------------------------------------
+
+    def audit(self) -> list[str]:
+        """Name-hygiene problems: non-snake_case leaves, and full-key
+        collisions between distinct sources. Empty == clean.
+
+        The ``trace.events.*`` namespace is exempt from the snake_case
+        rule: its leaves are TraceEvent TYPE names, which are CamelCase
+        by the reference's convention (MasterRecoveryTriggered, ...) —
+        they are labels riding the scrape, not metric names."""
+        problems = [f"collision: {k}" for k in self.collisions]
+        for full in self.values:
+            key = full.split("#", 1)[0]
+            if key.startswith("trace.events."):
+                continue
+            for leaf in key.split("."):
+                if not _SNAKE.match(leaf):
+                    problems.append(f"not snake_case: {full} (leaf {leaf!r})")
+                    break
+        return problems
+
+    def missing_documented(self) -> list[str]:
+        """Documented counters absent from this scrape (prefix match on
+        the aggregated keys)."""
+        agg = self.aggregated()
+        return [c for c in DOCUMENTED_COUNTERS if c not in agg]
+
+    # -- emission ------------------------------------------------------------
+
+    @staticmethod
+    def _prom_name(key: str) -> str:
+        return "fdb_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", key)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition: one gauge per metric key, the
+        instance as a ``process`` label."""
+        by_name: dict[str, list[tuple[str, float]]] = {}
+        for full, v in self.values.items():
+            key, _, inst = full.partition("#")
+            by_name.setdefault(self._prom_name(key), []).append((inst, v))
+        lines = []
+        for name in sorted(by_name):
+            lines.append(f"# TYPE {name} gauge")
+            for inst, v in sorted(by_name[name]):
+                label = f'{{process="{inst}"}}' if inst else ""
+                lines.append(f"{name}{label} {v}")
+        return "\n".join(lines) + "\n"
+
+    def to_json_line(self, **extra) -> str:
+        doc = {"metric": "obs_scrape", **extra,
+               "metrics": self.aggregated()}
+        return json.dumps(doc, sort_keys=True)
+
+
+async def scrape_sim(cluster) -> MetricsRegistry:
+    """Scrape every role of a SimCluster over its simulated network (the
+    status-JSON discipline: an unreachable role's counters are genuinely
+    invisible, never read in-process), plus tracer event counts and the
+    span sink's tallies."""
+    reg = MetricsRegistry()
+    spawn = cluster.loop.spawn
+
+    async def safe(fut):
+        try:
+            return await fut
+        except Exception:
+            return None
+
+    probes: list[tuple[str, str, Any]] = []
+
+    def probe(role: str, ep, coro) -> None:
+        probes.append((role, ep.process,
+                       spawn(safe(coro), name=f"obs.scrape.{ep.process}")))
+
+    for ep in cluster.grv_proxy_eps:
+        probe("grv_proxy", ep, ep.get_metrics())
+    for ep in cluster.commit_proxy_eps:
+        probe("commit_proxy", ep, ep.get_metrics())
+    for ep in cluster.resolver_eps:
+        probe("resolver", ep, ep.get_metrics())
+    for ep in cluster.tlog_eps:
+        probe("tlog", ep, ep.metrics())
+    for ep in cluster.storage_eps:
+        probe("storage", ep, ep.metrics())
+    if cluster.ratekeeper_ep is not None:
+        probe("ratekeeper", cluster.ratekeeper_ep,
+              cluster.ratekeeper_ep.get_rates())
+    for role, inst, task in probes:
+        reg.add(role, inst, await task)
+
+    tracer = getattr(cluster.loop, "tracer", None)
+    if tracer is not None:
+        reg.add("trace", "", {"events": dict(tracer.counts)})
+    sink = getattr(cluster.loop, "span_sink", None)
+    if sink is not None:
+        b = sink.breakdown()
+        reg.add("obs", "", {
+            "txns_seen": b["txns_seen"],
+            "txns_sampled": b["txns_sampled"],
+            "spans": len(sink.spans),
+            "unattributed_ms": b["unattributed_ms"],
+        })
+    return reg
+
+
+def scrape_deployed(loop, t, spec: dict) -> MetricsRegistry:
+    """Scrape a deployed cluster over its TCP endpoints (the cli
+    ``status`` role table, registry-shaped). Synchronous driver: pumps
+    the caller's RealLoop per probe like cli.Shell does."""
+    from foundationdb_tpu.server import parse_addr
+
+    reg = MetricsRegistry()
+    plans: list[tuple[str, str, str, str]] = []
+    for role, service, method in (
+        ("proxy", "grv_proxy", "get_metrics"),
+        ("proxy", "commit_proxy", "get_metrics"),
+        ("resolver", "resolver", "get_metrics"),
+        ("tlog", "tlog", "metrics"),
+        ("storage", "storage", "metrics"),
+        ("ratekeeper", "ratekeeper", "get_rates"),
+    ):
+        for i, addr in enumerate(spec.get(role) or []):
+            plans.append((service, f"{service}{i}", addr, method))
+    for service, inst, addr, method in plans:
+        ep = t.endpoint(parse_addr(addr), service)
+        try:
+            m = loop.run(getattr(ep, method)(), timeout=5.0)
+        except Exception:
+            m = None
+        reg.add(service, inst, m)
+    return reg
+
+
+class MetricsPoller:
+    """Periodic JSONL time-series: append one aggregated snapshot per
+    interval — the deployed-cluster "scrape loop" (point Prometheus at
+    to_prometheus for pull; this is the push/file form for hosts without
+    a scraper)."""
+
+    def __init__(self, loop, scrape: Callable, path: str,
+                 interval_s: float = 5.0):
+        self.loop = loop
+        self.scrape = scrape  # async () -> MetricsRegistry
+        self.path = path
+        self.interval_s = interval_s
+        self.snapshots_written = 0
+
+    async def run(self) -> None:
+        while True:
+            await self.loop.sleep(self.interval_s)
+            reg = await self.scrape()
+            line = reg.to_json_line(
+                t=round(self.loop.now, 3), seq=self.snapshots_written)
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+            self.snapshots_written += 1

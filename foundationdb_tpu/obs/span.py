@@ -1,0 +1,470 @@
+"""Commit-lifecycle tracing: per-transaction spans + stage histograms.
+
+Every perf record so far measured the commit path at its edges — p99
+moved, but WHERE a transaction spent its time was invisible (the kernel
+profiler's own ``unattributed_ms`` admits the gap). This module is the
+runtime-side answer: a sampled transaction carries a trace context
+(txn trace id) through the wire structs, every role stamps span
+boundaries, and the CLIENT assembles the exact per-transaction breakdown
+from the proxy's piggybacked stage spans (CommitResult.spans), so the
+identity
+
+    e2e == sum(stage durations) + unattributed
+
+holds by ARITHMETIC per sampled transaction — the residue is reported,
+never silently dropped. The reference's TraceEvent backbone stops at
+per-role events; this is the FAFO-style exact per-stage cost attribution
+(arxiv 2507.10757) the multi-core open-loop re-run needs to be
+diagnosable.
+
+Design rules:
+
+- **Off by default, cheap when on.** No sink attached → role code takes
+  one ``getattr`` and moves on. With a sink, only 1-in-N transactions
+  (``sample_every``, default 64) pay the per-txn work; per-batch stamps
+  (coalescer queue, tlog fsync) are amortized over the whole batch.
+- **Deterministic in sim.** Sampling is counter-based (never RNG — it
+  must not perturb the loop's seeded stream), trace ids are sequential,
+  and all stamps come off the loop's virtual clock, so the same seed
+  yields byte-identical span records. On a RealLoop, trace ids carry the
+  pid so records from parallel generator processes never collide, and
+  synchronous engine work is measured with ``time.perf_counter`` (the
+  virtual clock cannot advance inside one task step there).
+- **One histogram machinery.** Per-stage distributions reuse loadgen's
+  mergeable log-binned ``LatencyHistogram`` — scrape lines from many
+  processes SUM into one honest population percentile.
+
+Stage vocabulary (``TXN_STAGES`` is an exclusive partition of a sampled
+transaction's commit-path time; ``SUB_STAGES`` attribute the interior of
+``resolve_wait``/``grv_wait`` at batch granularity and never enter the
+reconciliation identity):
+
+    grv_wait      client: read-version request -> grant (includes the GRV
+                  proxy queue and any admission-saturation deferral)
+    proxy_admit   proxy: commit arrival -> popped by batch formation
+                  (lane queue; includes the admission probe)
+    shaped_park   proxy: time parked in the admission shaped lane (0
+                  unless shaped)
+    batch_form    proxy: popped -> commit version acquired
+    resolve_wait  proxy: version -> resolver verdicts (network + the
+                  resolver sub-stages below)
+    wave_apply    proxy: verdicts -> mutations assembled in (wave, index)
+                  order
+    tlog_durable  proxy: assemble -> every tlog acked the push fsync'd
+    commit_publish proxy: durable -> reply send (sequencer committed-
+                  version report, admission filter feed)
+    reply         client: commit RPC round trip minus the proxy's total
+                  (request + reply transport legs)
+
+    grv_proxy_queue   GRV proxy: request arrival -> batch admit
+    coalesce_queue    resolver: chain admission -> dispatch group start
+    host_pack         resolver: engine host-side pack (engines that
+                      publish ``last_host_pack_s``)
+    device_dispatch   resolver: modeled dispatch cost + engine execution
+    tlog_fsync        tlog: chain-ordered push -> durable ack
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from foundationdb_tpu.loadgen.harness import LatencyHistogram
+
+#: Exclusive partition of a sampled txn's commit-path time: the
+#: reconciliation identity is  e2e == sum(TXN_STAGES) + unattributed.
+TXN_STAGES = (
+    "grv_wait",
+    "proxy_admit",
+    "shaped_park",
+    "batch_form",
+    "resolve_wait",
+    "wave_apply",
+    "tlog_durable",
+    "commit_publish",
+    "reply",
+)
+
+#: Batch/role-level attribution INSIDE the txn stages (never summed into
+#: the identity — they live within grv_wait / resolve_wait / tlog_durable).
+SUB_STAGES = (
+    "grv_proxy_queue",
+    "coalesce_queue",
+    "host_pack",
+    "device_dispatch",
+    "tlog_fsync",
+)
+
+
+def obs_env_default() -> bool:
+    """FDB_TPU_OBS env default (validated via the kernel flags' shared
+    env_choice: unknown values raise with the accepted list)."""
+    from foundationdb_tpu.core.types import env_choice
+
+    return env_choice("FDB_TPU_OBS", "0", ("0", "1")) == "1"
+
+
+def obs_sample_default() -> int:
+    """FDB_TPU_OBS_SAMPLE: sample 1-in-N transactions (default 64)."""
+    raw = os.environ.get("FDB_TPU_OBS_SAMPLE", "64")
+    try:
+        n = int(raw)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"FDB_TPU_OBS_SAMPLE={raw!r} invalid: want an integer >= 1"
+        ) from None
+    return n
+
+
+class TraceContext:
+    """A sampled transaction's trace identity, propagated through the
+    wire structs (CommitRequest.trace). Existence == sampled: unsampled
+    transactions carry None and cost nothing downstream."""
+
+    __slots__ = ("tid",)
+
+    def __init__(self, tid: int):
+        self.tid = tid
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.tid:#x})"
+
+
+class SpanSink:
+    """Per-loop span collector: ring of span records + per-stage mergeable
+    histograms. Attaches as ``loop.span_sink`` (the Tracer convention) so
+    role code reaches it ambiently.
+
+    Span records are plain dicts ``{tid, name, start, dur, process}``
+    (``version`` for batch-level records); ``start``/``dur`` are seconds
+    on the emitting process's loop clock, rounded to 9 decimals so sim
+    records are byte-identical under a seed."""
+
+    def __init__(self, loop, sample_every: int | None = None,
+                 ring_size: int = 8192, enabled: bool = True):
+        self.loop = loop
+        self.sample_every = (obs_sample_default() if sample_every is None
+                             else max(1, int(sample_every)))
+        self.enabled = enabled
+        self.spans: deque[dict] = deque(maxlen=ring_size)
+        self.stage_hists: dict[str, LatencyHistogram] = {}
+        self.e2e_hist = LatencyHistogram()
+        self.unattributed_hist = LatencyHistogram()
+        self._sample_counter = 0
+        self._stage_ticks: dict[str, int] = {}
+        self._spans_dropped = 0  # ring evictions (maxlen overflow)
+        self._next_tid = 0
+        # RealLoop (deployed / loadgen generator): pid-salted trace ids so
+        # parallel processes never collide. Never in sim — determinism.
+        self._tid_base = (
+            (os.getpid() & 0xFFFF) << 40
+            if getattr(loop, "WALL_TIME", False) else 0
+        )
+        self.txns_sampled = 0
+        self.txns_seen = 0
+        loop.span_sink = self
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self) -> TraceContext | None:
+        """1-in-N counter-based sampling decision (deterministic: never
+        draws from the loop RNG). Returns a TraceContext or None."""
+        if not self.enabled:
+            return None
+        self.txns_seen += 1
+        self._sample_counter += 1
+        if self._sample_counter < self.sample_every:
+            return None
+        self._sample_counter = 0
+        self._next_tid += 1
+        self.txns_sampled += 1
+        return TraceContext(self._tid_base | self._next_tid)
+
+    # -- recording -----------------------------------------------------------
+
+    def _hist(self, name: str) -> LatencyHistogram:
+        h = self.stage_hists.get(name)
+        if h is None:
+            h = self.stage_hists[name] = LatencyHistogram()
+        return h
+
+    def record_stage(self, name: str, dur_s: float, n: int = 1) -> None:
+        """Histogram-only stage sample (batch-level sub-stages)."""
+        self._hist(name).record_n(dur_s * 1e3, n)
+
+    def stage_tick(self, name: str, dur_s: float, n: int = 1) -> None:
+        """Sampled sub-stage record: 1-in-sample_every per stage NAME,
+        counter-based (deterministic). The population sub-stages
+        (grv_proxy_queue, tlog_fsync, per-batch resolver stages) ride the
+        commit hot path on EVERY request while tracing is armed — at full
+        recording they alone cost ~10% throughput, which would fail the
+        subsystem's own overhead gate. They are distribution detail, not
+        part of the reconciliation identity, so sampling them like the
+        txn spans keeps the gate honest and the histograms statistical."""
+        c = self._stage_ticks.get(name, 0) + 1
+        if c >= self.sample_every:
+            self._stage_ticks[name] = 0
+            self.record_stage(name, dur_s, n)
+        else:
+            self._stage_ticks[name] = c
+
+    def add_span(self, tid: "int | None", name: str, start: float,
+                 dur: float, process: str | None = None,
+                 version: "int | None" = None) -> None:
+        """One span record for the tree/timeline (ring-buffered)."""
+        if process is None:
+            cur = getattr(self.loop, "_current", None)
+            process = cur.process if cur is not None else "<main>"
+        rec = {
+            "tid": tid,
+            "name": name,
+            "start": round(start, 9),
+            "dur": round(dur, 9),
+            "process": process,
+        }
+        if version is not None:
+            rec["version"] = version
+        if len(self.spans) == self.spans.maxlen:
+            self._spans_dropped += 1  # eviction truncates the OLDEST tid
+        self.spans.append(rec)
+
+    def record_txn(self, tid: int, e2e_s: float,
+                   stages: "list[tuple[str, float, float]]") -> float:
+        """One sampled transaction's assembled breakdown: ``stages`` is
+        [(stage name, absolute start, duration), ...] in TXN_STAGES
+        vocabulary. Records the span tree, the per-stage histograms, the
+        end-to-end histogram, and the arithmetic residue; returns the
+        residue (seconds). Negative residue is clamped to 0 for the
+        histogram but preserved in the span record — a negative value
+        would mean double-counted stages and must stay visible."""
+        attributed = 0.0
+        for name, start, dur in stages:
+            self.add_span(tid, name, start, dur)
+            self._hist(name).record(dur * 1e3)
+            attributed += dur
+        unattributed = e2e_s - attributed
+        start0 = min((start for _n, start, _d in stages), default=0.0)
+        self.add_span(tid, "e2e", start0, e2e_s)
+        self.add_span(tid, "unattributed", 0.0, round(unattributed, 9))
+        self.e2e_hist.record(e2e_s * 1e3)
+        self.unattributed_hist.record(max(0.0, unattributed) * 1e3)
+        return unattributed
+
+    # -- query ---------------------------------------------------------------
+
+    def spans_for(self, tid: int) -> list[dict]:
+        return [s for s in self.spans if s["tid"] == tid]
+
+    def sampled_tids(self, complete_only: bool = False) -> list[int]:
+        """Distinct tids in the ring, oldest first. ``complete_only``
+        drops the OLDEST tid whenever the ring has evicted records: a
+        txn's spans are appended as one contiguous block (record_txn),
+        so front-eviction can truncate only the oldest surviving tid —
+        completeness gates must not read that truncation as a missing
+        stage (a false alarm that would only fire at scale)."""
+        seen: dict[int, None] = {}
+        for s in self.spans:
+            if s["tid"] is not None:
+                seen.setdefault(s["tid"])
+        tids = list(seen)
+        if complete_only and self._spans_dropped and tids:
+            tids = tids[1:]
+        return tids
+
+    def breakdown(self) -> dict:
+        """The latency_breakdown document (status JSON / cli latency):
+        per-stage count/mean/p50/p99 plus the reconciliation block. The
+        identity is judged on SUMS (exact arithmetic), not percentiles:
+        attributed_ms + unattributed_ms == e2e_ms up to float rounding,
+        with unattributed_frac the honesty headline."""
+        stages = {
+            name: {
+                "count": h.count,
+                "mean_ms": round(h.mean(), 4),
+                "p50_ms": h.percentile(50),
+                "p99_ms": h.percentile(99),
+                "sum_ms": round(h.sum_ms, 4),
+            }
+            for name, h in sorted(self.stage_hists.items())
+        }
+        attributed_ms = sum(
+            h.sum_ms for name, h in self.stage_hists.items()
+            if name in TXN_STAGES
+        )
+        e2e_ms = self.e2e_hist.sum_ms
+        unattributed_ms = e2e_ms - attributed_ms
+        return {
+            "enabled": self.enabled,
+            "sample_every": self.sample_every,
+            "txns_seen": self.txns_seen,
+            "txns_sampled": self.txns_sampled,
+            "stages": stages,
+            "e2e": {
+                "count": self.e2e_hist.count,
+                "mean_ms": round(self.e2e_hist.mean(), 4),
+                "p50_ms": self.e2e_hist.percentile(50),
+                "p99_ms": self.e2e_hist.percentile(99),
+                "sum_ms": round(e2e_ms, 4),
+            },
+            "attributed_ms": round(attributed_ms, 4),
+            "unattributed_ms": round(unattributed_ms, 4),
+            "unattributed_frac": (
+                round(max(0.0, unattributed_ms) / e2e_ms, 4)
+                if e2e_ms > 0 else 0.0
+            ),
+        }
+
+    def dump(self) -> dict:
+        """Mergeable raw form (histograms as bin lists): what crosses
+        process boundaries — loadgen generators emit this next to their
+        open-loop accounting and bench merges by histogram sum."""
+        return {
+            "sample_every": self.sample_every,
+            "txns_seen": self.txns_seen,
+            "txns_sampled": self.txns_sampled,
+            "stages": {n: h.to_dict()
+                       for n, h in sorted(self.stage_hists.items())},
+            "e2e": self.e2e_hist.to_dict(),
+            "unattributed": self.unattributed_hist.to_dict(),
+        }
+
+    @classmethod
+    def merge_dumps(cls, dumps: "list[dict]") -> dict:
+        """Sum several dump() documents (cross-process aggregation) and
+        return a breakdown-shaped report over the merged population."""
+        dumps = [d for d in dumps if d]
+        stage_hists: dict[str, LatencyHistogram] = {}
+        e2e = LatencyHistogram()
+        seen = sampled = 0
+        sample_every = 0
+        for d in dumps:
+            seen += d.get("txns_seen", 0)
+            sampled += d.get("txns_sampled", 0)
+            sample_every = max(sample_every, d.get("sample_every", 0))
+            e2e.merge(LatencyHistogram.from_dict(d.get("e2e", {})))
+            for name, hd in (d.get("stages") or {}).items():
+                h = stage_hists.setdefault(name, LatencyHistogram())
+                h.merge(LatencyHistogram.from_dict(hd))
+        attributed_ms = sum(
+            h.sum_ms for n, h in stage_hists.items() if n in TXN_STAGES
+        )
+        e2e_ms = e2e.sum_ms
+        return {
+            "merged_from": len(dumps),
+            "sample_every": sample_every,
+            "txns_seen": seen,
+            "txns_sampled": sampled,
+            "stages": {
+                n: {"count": h.count, "mean_ms": round(h.mean(), 4),
+                    "p50_ms": h.percentile(50), "p99_ms": h.percentile(99),
+                    "sum_ms": round(h.sum_ms, 4)}
+                for n, h in sorted(stage_hists.items())
+            },
+            "e2e": {"count": e2e.count, "mean_ms": round(e2e.mean(), 4),
+                    "p50_ms": e2e.percentile(50),
+                    "p99_ms": e2e.percentile(99),
+                    "sum_ms": round(e2e_ms, 4)},
+            "attributed_ms": round(attributed_ms, 4),
+            "unattributed_ms": round(e2e_ms - attributed_ms, 4),
+            "unattributed_frac": (
+                round(max(0.0, e2e_ms - attributed_ms) / e2e_ms, 4)
+                if e2e_ms > 0 else 0.0
+            ),
+        }
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome-trace/Perfetto timeline of the sampled window: complete
+        ("X") events, one track per emitting process, span name + trace
+        id in args. Load via chrome://tracing or ui.perfetto.dev."""
+        events = []
+        pids: dict[str, int] = {}
+        for s in self.spans:
+            pid = pids.setdefault(s["process"], len(pids) + 1)
+            events.append({
+                "name": s["name"],
+                "ph": "X",
+                "pid": pid,
+                "tid": (s["tid"] or 0) & 0xFFFFFFFF,
+                "ts": round(s["start"] * 1e6, 3),
+                "dur": round(s["dur"] * 1e6, 3),
+                "args": {k: v for k, v in s.items()
+                         if k in ("tid", "version", "process")},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "source": "foundationdb_tpu.obs",
+                "processes": {str(v): k for k, v in pids.items()},
+            },
+        }
+
+    def reset(self) -> None:
+        """Clear collected spans/histograms (ladder points reuse one
+        sink); the sampling counter and tid sequence keep running."""
+        self.spans.clear()
+        self._spans_dropped = 0
+        self.stage_hists = {}
+        self.e2e_hist = LatencyHistogram()
+        self.unattributed_hist = LatencyHistogram()
+        self.txns_sampled = 0
+        self.txns_seen = 0
+
+
+#: A committed sampled txn's tree must contain ALL of these (shaped_park
+#: only when the txn rode the shaped lane).
+REQUIRED_TREE = frozenset(
+    s for s in TXN_STAGES if s != "shaped_park"
+) | {"e2e", "unattributed"}
+
+#: The proxy-side stages that must PARTITION [arrival, reply send]
+#: contiguously — a gap here is a stage the proxy forgot to stamp.
+_PROXY_CHAIN = ("proxy_admit", "shaped_park", "batch_form", "resolve_wait",
+                "wave_apply", "tlog_durable", "commit_publish")
+
+
+def check_txn_tree(spans: "list[dict]", tol: float = 1e-6) -> list[str]:
+    """Completeness check for ONE sampled transaction's span records:
+    every commit-path stage present, and the proxy chain contiguous (no
+    stage gaps). Returns problems; empty == complete."""
+    names = {s["name"] for s in spans}
+    problems = [f"missing stage: {n}" for n in sorted(REQUIRED_TREE - names)]
+    chain = sorted((s for s in spans if s["name"] in _PROXY_CHAIN),
+                   key=lambda s: s["start"])
+    for prev, nxt in zip(chain, chain[1:]):
+        gap = nxt["start"] - (prev["start"] + prev["dur"])
+        if abs(gap) > tol:
+            problems.append(
+                f"gap {gap:.9f}s between {prev['name']} and {nxt['name']}")
+    # Per-txn reconciliation identity, straight off the records.
+    e2e = sum(s["dur"] for s in spans if s["name"] == "e2e")
+    attributed = sum(s["dur"] for s in spans if s["name"] in TXN_STAGES)
+    resid = sum(s["dur"] for s in spans if s["name"] == "unattributed")
+    if abs(e2e - attributed - resid) > tol:
+        problems.append(
+            f"identity broken: e2e {e2e:.9f} != attributed {attributed:.9f}"
+            f" + unattributed {resid:.9f}")
+    return problems
+
+
+def span_sink(loop) -> "SpanSink | None":
+    """The loop's span sink when tracing is armed and enabled, else None.
+    THE hot-path gate: every role call site is
+    ``sink = span_sink(loop)`` + ``if sink is not None`` — one getattr
+    when tracing is off."""
+    s = getattr(loop, "span_sink", None)
+    return s if s is not None and s.enabled else None
+
+
+def stage_clock(loop):
+    """Clock for SYNCHRONOUS work (engine resolve, host pack): the loop
+    clock cannot advance inside one task step on a RealLoop, so deployed
+    processes measure with perf_counter; sim keeps the virtual clock so
+    records stay seed-deterministic (synchronous work is 0 virtual
+    seconds there, honestly reported as such)."""
+    if getattr(loop, "WALL_TIME", False):
+        return time.perf_counter
+    return lambda: loop.now

@@ -28,6 +28,7 @@ from foundationdb_tpu.core.mutations import (
     resolve_versionstamps,
 )
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.obs.span import span_sink
 from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.backup import BACKUP_TAG
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, all_of, rpc
@@ -66,12 +67,21 @@ class CommitRequest:
     # conflict path (resolver loser report → repair engine / retry
     # ladder) instead of spinning on cheap rejections forever.
     admission_attempts: int = 0
+    # Trace context (obs subsystem): the sampled txn's trace id, or None
+    # (unsampled — the overwhelming default). Presence asks the proxy to
+    # stamp its commit-path stage spans onto the reply.
+    trace: "int | None" = None
 
 
 @dataclass(frozen=True)
 class CommitResult:
     version: int
     batch_order: int  # with `version`, determines the txn's versionstamp
+    # Stage spans for a SAMPLED commit (obs subsystem): tuple of
+    # (stage, start, dur) in proxy-clock seconds, plus the proxy_total
+    # envelope — the client assembles its exact per-txn breakdown from
+    # these. None (and absent on the wire) for unsampled txns.
+    spans: "tuple | None" = None
 
 
 class CommitProxy:
@@ -172,6 +182,12 @@ class CommitProxy:
 
     @rpc
     async def commit(self, req: CommitRequest) -> CommitResult:
+        if span_sink(self.loop) is not None:
+            # Commit-path tracing (obs subsystem): stamp the arrival so
+            # lane-queue time is attributable. Stamped for EVERY request
+            # while tracing is armed (one attr write); all heavier work
+            # is gated on req.trace (sampled txns only).
+            req._obs_arrival = self.loop.now
         p = Promise()
         self._queue.push((req, p), getattr(req, "priority", "default"))
         return await p.future
@@ -270,6 +286,15 @@ class CommitProxy:
                 # batch (with aging) — a system txn is never queued behind
                 # more than the window already forming.
                 batch = self._queue.pop(max_batch)
+            if batch and span_sink(self.loop) is not None:
+                # Stage stamp: batch formation popped these requests NOW.
+                # Shaped requests keep their FIRST pop (the admission
+                # gate re-stamps at flush so the park window is never
+                # double-counted into batch_form).
+                t_pop = self.loop.now
+                for req, _p in batch:
+                    if not hasattr(req, "_obs_pop"):
+                        req._obs_pop = t_pop
             if self.locked and batch:
                 # Database locked (reference error 1038, checked at the
                 # proxy): reject non-lock-aware commits; DR/operator txns
@@ -363,6 +388,8 @@ class CommitProxy:
                         "admission_no_shape"))
                     continue
                 req._admission_shaped = True
+                if hasattr(req, "_obs_arrival"):
+                    req._obs_park0 = self.loop.now  # traced: park begins
                 if not self._shaped:
                     self._shaped_since = self.loop.now  # new lane head
                 self._shaped.append((req, p))
@@ -386,6 +413,13 @@ class CommitProxy:
                         confirm_version=cv,
                     ))
                     continue
+                if hasattr(req, "_obs_park0"):
+                    # Stage stamp: the park window closes here, and the
+                    # pop is re-anchored to the flush so batch_form
+                    # measures flush->version, not park-inclusive.
+                    now = self.loop.now
+                    req._obs_park = now - req._obs_park0
+                    req._obs_pop = now
                 passed.append((req, p))
         return passed
 
@@ -474,11 +508,16 @@ class CommitProxy:
         prev_version: int,
         version: int,
     ) -> None:
+        sink = span_sink(self.loop)
+        t_version = self.loop.now  # commit version in hand as of entry
+        t_resolved = t_assembled = t_pushed = t_version
         try:
             verdicts, conflicting, fail_safe, wave = await self._resolve(
                 batch, prev_version, version
             )
+            t_resolved = self.loop.now
             tagged = self._assemble(batch, verdicts, version, wave)
+            t_assembled = self.loop.now
             kc = self._known_committed
             if self.loop.buggify("commit_proxy.slow_push"):
                 # Delayed push: later batches' pushes overtake ours at the
@@ -500,6 +539,7 @@ class CommitProxy:
                     for t in self.tlogs
                 ]
             )
+            t_pushed = self.loop.now  # every tlog acked its fsync
             self._known_committed = max(self._known_committed, version)
             await self.sequencer.report_committed(version)
         except Exception:
@@ -535,10 +575,17 @@ class CommitProxy:
                 if v == Verdict.COMMITTED:
                     accepted.extend(req.write_ranges)
             self.admission.feed_accepted(accepted, version)
+        t_reply = self.loop.now
         for i, ((req, p), v) in enumerate(zip(batch, verdicts)):
             if v == Verdict.COMMITTED:
                 self.txns_committed += 1
-                p.send(CommitResult(version, i))
+                spans = None
+                if (sink is not None and req.trace is not None
+                        and hasattr(req, "_obs_arrival")):
+                    spans = self._obs_spans(
+                        req, t_version, t_resolved, t_assembled, t_pushed,
+                        t_reply)
+                p.send(CommitResult(version, i, spans))
             elif v == Verdict.TOO_OLD:
                 p.fail(TransactionTooOld())
             else:
@@ -566,6 +613,36 @@ class CommitProxy:
                     hot_ranges=(None if fail_safe
                                 else self.hot_ranges.scores(feed)),
                 ))
+
+    @staticmethod
+    def _obs_spans(req, t_version, t_resolved, t_assembled, t_pushed,
+                   t_reply) -> tuple:
+        """A sampled txn's proxy-side stage spans, piggybacked on its
+        CommitResult: ((stage, start, dur), ...) in proxy-clock seconds.
+        The stages PARTITION [arrival, version/resolve/.../push] exactly,
+        and proxy_total carries the full envelope so the client's residue
+        arithmetic (e2e == sum(stages) + unattributed) is exact. The park
+        window (shaped lane) is carved out of the pop->version segment by
+        the flush-time pop re-anchor in _admission_gate."""
+        arrival = req._obs_arrival
+        pop = getattr(req, "_obs_pop", arrival)
+        spans = [("proxy_admit", arrival,
+                  getattr(req, "_obs_park0", pop) - arrival)]
+        park = getattr(req, "_obs_park", None)
+        if park is not None:
+            spans.append(("shaped_park", req._obs_park0, park))
+        spans += [
+            ("batch_form", pop, t_version - pop),
+            ("resolve_wait", t_version, t_resolved - t_version),
+            ("wave_apply", t_resolved, t_assembled - t_resolved),
+            ("tlog_durable", t_assembled, t_pushed - t_assembled),
+            # Durable -> reply send: the sequencer committed-version
+            # report + admission filter feed. Attributed, not dumped
+            # into the residue — the residue must mean "unknown".
+            ("commit_publish", t_pushed, t_reply - t_pushed),
+            ("proxy_total", arrival, t_reply - arrival),
+        ]
+        return tuple(spans)
 
     RPC_RETRIES = 4  # worst case ~4.4s — must finish under WEDGE_TIMEOUT
 

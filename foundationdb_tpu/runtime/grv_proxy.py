@@ -16,6 +16,7 @@ from __future__ import annotations
 import itertools
 import os
 
+from foundationdb_tpu.obs.span import span_sink
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 
 #: Unique-per-process GRV poller ids (pid + counter: deterministic in the
@@ -112,7 +113,17 @@ class GrvProxy:
             PRIORITY_SYSTEM: self._system_queue,
         }.get(priority, self._queue)
         queue.append(entry)
-        return await p.future
+        sink = span_sink(self.loop)
+        if sink is None:
+            return await p.future
+        # Sub-stage attribution (obs subsystem): time from arrival to the
+        # batched grant — token-bucket waits, tag throttling, and the
+        # admission-saturation deferral all land here (the interior of
+        # the client-measured grv_wait stage).
+        t0 = self.loop.now
+        version = await p.future
+        sink.stage_tick("grv_proxy_queue", self.loop.now - t0)
+        return version
 
     @rpc
     async def get_metrics(self) -> dict:

@@ -18,6 +18,7 @@ from foundationdb_tpu.core.types import (
     TxnConflictInfo,
     Verdict,
 )
+from foundationdb_tpu.obs.span import span_sink, stage_clock
 from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
@@ -33,6 +34,7 @@ class _QueuedBatch:
     txns: list
     oldest_version: int | None
     reply: Promise
+    t_enq: float = 0.0  # chain-admission time (obs coalesce_queue stage)
 
 
 class Resolver:
@@ -172,7 +174,8 @@ class Resolver:
         reply = Promise()
         self._pending[version] = reply
         self.sched.enqueue(
-            _QueuedBatch(version, txns, oldest_version, reply)
+            _QueuedBatch(version, txns, oldest_version, reply,
+                         t_enq=self.loop.now)
         )
         w = self._waiters.pop(version, None)
         if w is not None:
@@ -192,12 +195,30 @@ class Resolver:
         successors resolving without them is exact (a partial paint from
         a mid-batch engine error only ADDS spurious conflicts, never
         misses one)."""
+        sink = span_sink(self.loop)
+        if sink is not None:
+            # Sub-stage attribution (obs subsystem), interior of the
+            # proxy-measured resolve_wait: chain admission -> dispatch
+            # start per batch, txn-weighted so the histograms reconcile
+            # against per-txn populations.
+            t0 = self.loop.now
+            for entry in group:
+                sink.stage_tick("coalesce_queue", t0 - entry.t_enq,
+                                n=max(1, len(entry.txns)))
         if self.dispatch_cost_s:
             # Modeled device execution time for this window (sim-only;
             # see __init__) — spent BEFORE the verdicts resolve, like the
             # real kernel's dispatch wall time.
             await self.loop.sleep(self.dispatch_cost_s * len(group))
+        clock = stage_clock(self.loop) if sink is not None else None
         for entry in group:
+            t_eng = clock() if sink is not None else 0.0
+            if sink is not None and hasattr(self.cs, "last_host_pack_s"):
+                # Clear the stamp so a batch that never packs (fail-safe
+                # rejection, overflow) can't re-record the PREVIOUS
+                # batch's pack time — fail-safe engages exactly under
+                # overload, when the attribution is being read.
+                self.cs.last_host_pack_s = None
             try:
                 reply = self._resolve_entry(entry)
             except BaseException as e:  # noqa: BLE001 — fail the RPC waiter
@@ -206,6 +227,21 @@ class Resolver:
                 self._pending.pop(entry.version, None)
                 entry.reply.fail(e)
                 continue
+            if sink is not None:
+                n = max(1, len(entry.txns))
+                eng_s = (clock() - t_eng) + self.dispatch_cost_s
+                pack_s = getattr(self.cs, "last_host_pack_s", None)
+                if pack_s is not None:
+                    # DISJOINT attribution: the engine bracket above
+                    # includes the synchronous host pack — carve it out
+                    # so host_pack + device_dispatch sums to the
+                    # interior, never above it.
+                    sink.stage_tick("host_pack", pack_s, n=n)
+                    eng_s = max(0.0, eng_s - pack_s)
+                # Engine execution (synchronous: perf-clocked on real
+                # loops, 0 virtual seconds in sim by construction) plus
+                # the modeled dispatch cost this batch's share paid.
+                sink.stage_tick("device_dispatch", eng_s, n=n)
             self._replies[entry.version] = reply
             self._trim_replies()
             self._pending.pop(entry.version, None)

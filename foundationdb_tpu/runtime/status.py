@@ -227,6 +227,15 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
     }
     doc["cluster"]["committed_version"] = seq_ver
 
+    # Commit-path latency attribution (obs subsystem): the loop's span
+    # sink's per-stage breakdown — sampled-txn stage histograms plus the
+    # e2e-vs-sum reconciliation with the residue reported as
+    # `unattributed`, never silently dropped.
+    sink = getattr(cluster.loop, "span_sink", None)
+    doc["workload"]["latency_breakdown"] = (
+        sink.breakdown() if sink is not None else {"enabled": False}
+    )
+
     # Trace rollup (reference: status surfaces recent TraceEvent errors and
     # event counts from the cluster's trace logs).
     tracer = getattr(cluster.loop, "tracer", None)

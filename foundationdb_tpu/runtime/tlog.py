@@ -15,6 +15,7 @@ import bisect
 from dataclasses import dataclass
 
 from foundationdb_tpu.core.mutations import Mutation
+from foundationdb_tpu.obs.span import span_sink
 from foundationdb_tpu.runtime.flow import Loop, Promise, rpc
 
 
@@ -264,6 +265,8 @@ class TLog:
             await p.future
         if self.locked:
             raise TLogLocked(f"push v{version} after lock at v{self._version}")
+        sink = span_sink(self.loop)
+        t_fsync = self.loop.now if sink is not None else 0.0
         await self.loop.sleep(self.FSYNC_SECONDS)
         if self.locked:  # lock won the race while we were "fsyncing"
             raise TLogLocked(f"push v{version} after lock at v{self._version}")
@@ -289,6 +292,11 @@ class TLog:
             version if known_committed is None else known_committed,
         )
         self._maybe_spill()
+        if sink is not None:
+            # Sub-stage attribution (obs subsystem), interior of the
+            # proxy-measured tlog_durable: chain-ordered append ->
+            # durable (fsync sleep + disk write), per push.
+            sink.stage_tick("tlog_fsync", self.loop.now - t_fsync)
         w = self._waiters.pop(version, None)
         if w is not None:
             w.send(None)

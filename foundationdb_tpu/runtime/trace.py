@@ -99,12 +99,20 @@ class Tracer:
         roll_bytes: int = 10 << 20,
         ring_size: int = 2048,
         min_severity: int = Severity.DEBUG,
+        max_files: int | None = None,
     ):
         self.loop = loop
         self.trace_dir = trace_dir
         self.process_override = process
         self.roll_bytes = roll_bytes
         self.min_severity = min_severity
+        # Rolled-file retention (reference: TRACE_LOG_MAX_ROTATED_FILES):
+        # keep at most this many trace.<process>.*.jsonl files — a long
+        # soak rolls forever, and without a cap the trace dir eventually
+        # fills the disk. Oldest files (any run id, so a restarted role's
+        # predecessors count too) are deleted past the knob; None =
+        # unlimited (the historical behavior).
+        self.max_files = max_files
         self.ring: deque[dict] = deque(maxlen=ring_size)
         self.counts: Counter[str] = Counter()
         self._file: TextIO | None = None
@@ -182,6 +190,39 @@ class Tracer:
         )
         self._file = open(path, "w", encoding="utf-8", buffering=1)
         self._file_bytes = 0
+        self._prune(keep=path)
+
+    def _prune(self, keep: str) -> None:
+        """Delete this process's oldest rolled files beyond max_files.
+        Age order is (mtime, name) — mtime for cross-run ordering, name
+        as the deterministic tie-break within one second. The active
+        file is never deleted."""
+        if self.max_files is None:
+            return
+        prefix = f"trace.{(self.process_override or 'proc').replace('/', '_')}."
+        try:
+            files = [
+                os.path.join(self.trace_dir, f)
+                for f in os.listdir(self.trace_dir)
+                if f.startswith(prefix) and f.endswith(".jsonl")
+            ]
+        except OSError:
+            return
+        files = [f for f in files if f != keep]
+        if len(files) + 1 <= self.max_files:
+            return
+        aged = []
+        for f in files:
+            try:
+                aged.append((os.path.getmtime(f), f))
+            except OSError:
+                continue  # concurrently removed (shared dir): not ours
+        aged.sort()
+        for _m, f in aged[: len(files) + 1 - self.max_files]:
+            try:
+                os.remove(f)
+            except OSError:
+                pass  # concurrently removed / permissions: never fatal
 
     def flush(self) -> None:
         if self._file is not None:

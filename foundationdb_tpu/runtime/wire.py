@@ -236,27 +236,39 @@ def _register_runtime_types() -> None:
 
     register_struct(
         5, CommitRequest,
+        # Trace context (obs subsystem) packs as a TRAILING field only
+        # when set: unsampled commits keep the 10-field form, so peers
+        # predating the field parse the common case cleanly (a sampled
+        # commit reaching an old peer is a new-client choice, not a
+        # default behavior change).
         lambda r: (
             r.read_version, list(r.mutations), list(r.read_ranges),
             list(r.write_ranges), r.report_conflicting_keys, r.lock_aware,
             r.token, r.priority, r.admission_no_shape, r.admission_attempts,
-        ),
+        ) + ((r.trace,) if r.trace is not None else ()),
         lambda f: CommitRequest(
             read_version=f[0], mutations=f[1], read_ranges=f[2],
             write_ranges=f[3], report_conflicting_keys=f[4],
             # Shorter forms: peers predating lock_aware/token/priority/
-            # the admission fields.
+            # the admission fields/trace.
             lock_aware=f[5] if len(f) > 5 else False,
             token=f[6] if len(f) > 6 else None,
             priority=f[7] if len(f) > 7 else "default",
             admission_no_shape=f[8] if len(f) > 8 else False,
             admission_attempts=f[9] if len(f) > 9 else 0,
+            trace=f[10] if len(f) > 10 else None,
         ),
     )
     register_struct(
         6, CommitResult,
-        lambda r: (r.version, r.batch_order),
-        lambda f: CommitResult(*f),
+        # spans (the proxy's piggybacked stage breakdown for SAMPLED
+        # txns — obs subsystem) rides as a trailing field only when
+        # present: unsampled results keep the 2-field form old peers
+        # parse, and only tracing clients (new by definition) receive
+        # the longer one.
+        lambda r: (r.version, r.batch_order)
+        + ((r.spans,) if r.spans is not None else ()),
+        lambda f: CommitResult(f[0], f[1], f[2] if len(f) > 2 else None),
     )
 
 

@@ -1693,6 +1693,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--trace-dir", default=None,
                     help="write rolling JSONL trace files here "
                          "(reference: fdbserver --logdir)")
+    ap.add_argument("--trace-max-files", type=int, default=16,
+                    help="retention cap on this process's rolled "
+                         "trace.*.jsonl files (oldest deleted beyond it; "
+                         "0 = unlimited)")
     args = ap.parse_args(argv)
 
     spec = load_spec(args.cluster)  # resolves authz_public_key to absolute
@@ -1710,7 +1714,14 @@ def main(argv: list[str] | None = None) -> None:
     from foundationdb_tpu.runtime.trace import Tracer
 
     tracer = Tracer(loop, trace_dir=args.trace_dir,
-                    process=f"{args.role}{args.index}")
+                    process=f"{args.role}{args.index}",
+                    max_files=args.trace_max_files or None)
+    # Commit-path tracing (obs subsystem, FDB_TPU_OBS=1): one span sink
+    # per process; this process's stage histograms are scraped via the
+    # admin obs_snapshot RPC (cli `latency` / metrics tooling).
+    from foundationdb_tpu.obs.span import SpanSink, obs_env_default
+
+    span_sink_obj = (SpanSink(loop) if obs_env_default() else None)
     t = NetTransport(loop, host=host, port=port,
                      tls=tls_config(spec, args.cluster))
     boot = build_role(loop, t, spec, args.role, args.index, args.data_dir)
@@ -1758,6 +1769,18 @@ def main(argv: list[str] | None = None) -> None:
         async def clear_faults(self) -> str:
             t.clear_faults()
             return "faults cleared"
+
+        @rpc
+        async def obs_snapshot(self) -> dict:
+            """This process's span-sink dump (mergeable histograms) +
+            breakdown — the deployed scrape surface for commit-path
+            stage attribution (obs subsystem; None when FDB_TPU_OBS is
+            off)."""
+            if span_sink_obj is None:
+                return {"enabled": False}
+            return {"enabled": True,
+                    "breakdown": span_sink_obj.breakdown(),
+                    "dump": span_sink_obj.dump()}
 
         async def _finish(self):
             await loop.sleep(0)

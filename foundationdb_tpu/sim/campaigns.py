@@ -245,6 +245,44 @@ async def run_checks(spec: CampaignSpec, ctx: NemesisContext) -> dict:
         if t.get("system_shaped", 0):
             raise CampaignCheckFailed(
                 f"system-priority txns were shaped: {t}")
+    # Commit-path tracing gates (obs subsystem): under this campaign's
+    # faults, every sampled COMMITTED txn must still yield a complete
+    # span tree satisfying e2e == sum(stages) + unattributed — kills,
+    # clogs and recoveries must degrade tracing to "txn not sampled",
+    # never to a half-stamped tree that misattributes latency.
+    if checks.pop("obsSpanTreesComplete", False):
+        from foundationdb_tpu.obs.span import check_txn_tree
+
+        sink = getattr(ctx.loop, "span_sink", None)
+        if sink is None:
+            raise CampaignCheckFailed(
+                "obsSpanTreesComplete needs [campaign.cluster] obs = true")
+        trees = bad = 0
+        for tid in sink.sampled_tids(complete_only=True):
+            spans = sink.spans_for(tid)
+            if not any(s["name"] == "e2e" for s in spans):
+                continue  # sampled but never committed (aborted/killed)
+            trees += 1
+            problems = check_txn_tree(spans)
+            if problems:
+                bad += 1
+                if bad == 1:
+                    first = f"tid {tid:#x}: {problems[:2]}"
+        out["obs_span_trees"] = {"complete": trees - bad, "broken": bad,
+                                 "sampled": sink.txns_sampled}
+        if bad:
+            raise CampaignCheckFailed(
+                f"{bad}/{trees} sampled span trees broken under faults — "
+                f"first: {first}")
+    n = checks.pop("obsSampledMin", None)
+    if n is not None:
+        sink = getattr(ctx.loop, "span_sink", None)
+        got = sink.txns_sampled if sink is not None else 0
+        out["obs_sampled"] = got
+        if got < n:
+            raise CampaignCheckFailed(
+                f"only {got} txns sampled < required {n} — the tracing "
+                "composition this campaign gates never happened")
     n = checks.pop("repairRoundsMin", None)
     if n is not None:
         rounds = sum(

@@ -104,6 +104,8 @@ class SimCluster:
         wave_commit: bool | None = None,
         admission: bool | None = None,
         admission_opts: dict | None = None,
+        obs: bool | None = None,
+        obs_sample_every: int | None = None,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -152,6 +154,15 @@ class SimCluster:
 
         if not hasattr(self.loop, "tracer"):
             Tracer(self.loop)
+        # Commit-path tracing (obs subsystem; None = the FDB_TPU_OBS env
+        # default, off by default): one SpanSink per loop — every role and
+        # client on this cluster's loop stamps spans into it, so a sim run
+        # yields complete, seed-deterministic span trees.
+        from foundationdb_tpu.obs.span import SpanSink, obs_env_default
+
+        self.obs = obs_env_default() if obs is None else bool(obs)
+        if self.obs and not hasattr(self.loop, "span_sink"):
+            SpanSink(self.loop, sample_every=obs_sample_every)
         # Namespace for loop-global process names: two clusters on one
         # Loop (a DR pair) must not both own a "tlog0" (kills would
         # cross clusters). Applied by SimNetwork at host()/kill() and

@@ -214,6 +214,11 @@ CLUSTER_KEY_MAP = {
     # `admission = true` arms the recent-writes filter + policy on every
     # generation's proxies/resolvers.
     "admission": "admission",
+    # Commit-path tracing (obs subsystem): `obs = true` attaches a span
+    # sink to the cluster loop; `obsSampleEvery = N` samples 1-in-N
+    # (campaigns gate span-tree completeness under faults with it).
+    "obs": "obs",
+    "obsSampleEvery": "obs_sample_every",
 }
 
 

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Observability sampling-overhead A/B (the obs subsystem's
+# off-by-default-cheap acceptance): the SAME closed-loop sim workload is
+# wall-clocked with tracing disabled vs armed at 1-in-64 sampling
+# (FDB_TPU_OBS_SAMPLE default), alternating arms, best-of-N throughput
+# per arm, and OBS_AB.json records the measured throughput overhead
+# against the <=2% gate.
+#
+# Pure simulation on the CPU by design (no TPU run attempted or
+# claimed — cpu_fallback:false means exactly that, as in every sim A/B
+# artifact here); the measurement is WALL-CLOCK, so the record carries
+# the host's core count and load for the reader. On a busy host the
+# number is noise-dominated — rerun on a quiet one before quoting it.
+#
+#   TXNS=3072 SEED=11 OUT=OBS_AB.json scripts/obs_ab.sh
+set -u
+cd "$(dirname "$0")/.."
+TXNS=${TXNS:-3072}
+SEED=${SEED:-11}
+SAMPLE=${SAMPLE:-64}
+OUT=${OUT:-OBS_AB.json}
+LOG=${LOG:-obs_ab.log}
+
+env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs --ab \
+    --txns "$TXNS" --seed "$SEED" --sample-every "$SAMPLE" \
+    > "$OUT.tmp" 2>> "$LOG"
+rc=$?
+# rc 1 = gate missed (record still printed, valid:false); >1 = harness
+# error, keep the tmp for forensics and fail loudly.
+if [ $rc -gt 1 ] || [ ! -s "$OUT.tmp" ]; then
+  echo "obs_ab: python -m foundationdb_tpu.obs --ab failed rc=$rc" \
+       "(see $LOG)" >&2
+  exit 1
+fi
+mv "$OUT.tmp" "$OUT"
+cat "$OUT"
+exit 0

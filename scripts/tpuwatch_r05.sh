@@ -99,6 +99,21 @@ while true; do
     'r.get("metric") == "nemesis_campaigns" and r.get("ok")' -- \
     env JAX_PLATFORMS=cpu python -m foundationdb_tpu.sim.run \
     --campaigns fast || { sleep 60; continue; }
+  # Observability selfcheck (obs subsystem): one-JSON-line scrape + span
+  # reconciliation on a short sim run — complete span trees, the
+  # e2e == sum(stages) + unattributed identity, and the metrics-name
+  # audit. CPU-only sim; validates the build's attribution plane.
+  stage obs 600 OBS_r05.json \
+    'r.get("metric") == "obs_selfcheck" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs \
+    || { sleep 60; continue; }
+  # Sampling-overhead gate (obs subsystem): tracing off vs 1-in-64 on
+  # the same sim workload, wall-clocked — the <=2% acceptance with the
+  # standard honesty flags.
+  stage ab_obs 900 OBS_AB_r05.json \
+    'r.get("metric") == "obs_sampling_overhead_ab"' -- \
+    env OUT=OBS_AB_r05_rec.json bash scripts/obs_ab.sh \
+    || { sleep 60; continue; }
   stage profile 1500 TPU_PROFILE_r05.json \
     "$TPU_OK and (r.get('phase_profile_ms') or {}).get('full_resolve')" -- \
     python bench.py --mode ycsb --profile || { sleep 60; continue; }

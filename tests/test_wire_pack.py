@@ -117,6 +117,62 @@ class TestWirePackParity:
         assert [c1(), c2()] == [b.resolve(txns1, 10), b.resolve(txns2, 20)]
 
 
+class TestWireStructCrossVersion:
+    """Trace-context fields on the RPC structs (obs subsystem) follow the
+    established shorter-forms convention: peers predating a field parse
+    the shorter tuple cleanly, and the NEW packer emits the short form
+    whenever the field is unset — so an old peer never even sees the
+    longer tuple unless a tracing (new) client asked for it."""
+
+    def _entry(self, sid):
+        from foundationdb_tpu.runtime import wire
+
+        return wire._STRUCTS[sid]
+
+    def test_commit_request_trace_round_trip(self):
+        from foundationdb_tpu.runtime import wire
+        from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+
+        req = CommitRequest(read_version=7, trace=0xBEEF)
+        out = wire.loads(wire.dumps(req))
+        assert out.trace == 0xBEEF and out.read_version == 7
+
+    def test_unsampled_request_packs_the_short_form(self):
+        from foundationdb_tpu.runtime.commit_proxy import CommitRequest
+
+        _cls, to_tuple, from_tuple = self._entry(5)
+        fields = to_tuple(CommitRequest(read_version=7))
+        assert len(fields) == 10  # no trailing trace field on the wire
+        assert from_tuple(fields).trace is None
+
+    def test_old_peer_short_forms_parse_cleanly(self):
+        _cls, _to, from_tuple = self._entry(5)
+        # A peer predating lock_aware/.../trace sent only 5 fields.
+        old = from_tuple((3, [], [], [], False))
+        assert old.trace is None and old.priority == "default"
+        assert old.admission_attempts == 0
+        # A peer predating ONLY trace sent 10.
+        mid = from_tuple((3, [], [], [], False, True, None, "batch",
+                          False, 2))
+        assert mid.trace is None and mid.lock_aware is True
+        assert mid.priority == "batch" and mid.admission_attempts == 2
+
+    def test_commit_result_spans_cross_version(self):
+        from foundationdb_tpu.runtime import wire
+        from foundationdb_tpu.runtime.commit_proxy import CommitResult
+
+        _cls, to_tuple, from_tuple = self._entry(6)
+        # Unsampled: 2-field form on the wire (old peers parse it).
+        assert len(to_tuple(CommitResult(10, 3))) == 2
+        assert from_tuple((10, 3)).spans is None
+        # Sampled: spans round-trip through the full codec.
+        spans = (("proxy_admit", 0.001, 0.002),
+                 ("proxy_total", 0.001, 0.009))
+        out = wire.loads(wire.dumps(CommitResult(10, 3, spans)))
+        assert out.version == 10 and out.batch_order == 3
+        assert out.spans == spans
+
+
 class TestHostileWire:
     """The C parser is the RPC trust boundary: hostile counts/lengths must
     be rejected, never overflow into misparses or out-of-bounds reads."""
