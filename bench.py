@@ -459,6 +459,12 @@ def run_tpu_wire(
                 ),
                 "dictionary": cs.dict_stats,
             }
+            if n_resolvers > 1 and getattr(cs, "wave_commit", False):
+                # Mesh wave commit: the realized-graph exchange account
+                # (occupied predecessor tiles vs the dense all_gather) —
+                # the measured side of the roofline's
+                # exchange_bytes_per_batch term.
+                extras["wave_exchange"] = cs.exchange_stats()
         if n_resolvers > 1:
             occupancy = cs.shard_occupancy()
     if do_reshard and occupancy and occ_uniform:
@@ -1151,7 +1157,9 @@ def _roofline_one(mode: ModeConfig, capacity: int, wave_rounds: int,
 def roofline_estimate(mode: ModeConfig, capacity: int,
                       wave_rounds: int = 4, packed: "bool | None" = None,
                       hist_design: "str | None" = None,
-                      resident: "bool | None" = None) -> dict:
+                      resident: "bool | None" = None,
+                      n_shards: int = 1,
+                      exchange_stats: "dict | None" = None) -> dict:
     """Per-batch work estimate for resolve_batch at this mode's shapes.
 
     Models the kernel under the ACTIVE design flags (FDB_TPU_PACKED /
@@ -1204,6 +1212,29 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
     est["resident_bytes_ratio"] = round(
         pk["bytes_per_batch"] / max(res["bytes_per_batch"], 1), 2
     )
+    if n_shards > 1:
+        # Mesh wave-commit exchange term (ISSUE 13): the predecessor-tile
+        # OR-reduce that rebuilds the global conflict graph across the
+        # resolver shards. Dense = what the packed [BP, BP/32] all_gather
+        # ships per device per batch (every shard's matrix, uint32 words
+        # — already 1/32 of an int32 edge matrix); scoped = a
+        # tile-granular exchange shipping only OCCUPIED 32x32-bit tiles,
+        # so bytes scale with the REALIZED graph, not BP². The scoped
+        # figure is measured by the mesh engine
+        # (ShardedConflictSet.exchange_stats) when the sharded wave run
+        # happened, else None — the model never invents a graph density.
+        bp = ((mode.batch + 31) // 32) * 32
+        dense = n_shards * bp * (bp // 32) * 4
+        term = {
+            "n_shards": n_shards,
+            "dense_all_gather": dense,
+            "scoped_occupied_tiles": (
+                exchange_stats.get("exchange_bytes_per_batch_scoped")
+                if exchange_stats else None
+            ),
+            "measured": exchange_stats or None,
+        }
+        est["exchange_bytes_per_batch"] = term
     est["assumes"] = ("public TPU v5e peaks: 197 TF bf16, 819 GB/s HBM, "
                       "~4e12 VPU int-ops/s")
     return est
@@ -1573,7 +1604,10 @@ def run_config(
         "shard_occupancy": occupancy or None,
         "overflowed": overflowed,
         "phase_profile_ms": phase_profile,
-        "roofline": roofline_estimate(mode, capacity),
+        "roofline": roofline_estimate(
+            mode, capacity, n_shards=n_resolvers,
+            exchange_stats=wire_extras.get("wave_exchange"),
+        ),
         "valid": (not overflowed) and platform not in ("cpu", "none"),
     }, head_samples)
 
@@ -1668,6 +1702,18 @@ def main() -> None:
                          "heavy, wave commit's worst case), coldest = "
                          "read-hot-write-cold chains (the reorderable "
                          "shape)")
+    ap.add_argument("--wave-mesh-ab", action="store_true",
+                    help="run the sharded-resolver wave-commit A/B "
+                         "(repair/wave_mesh.py): deterministic schedule-"
+                         "goodput at n_resolvers in {1,2,4} gated at 5% "
+                         "of the single-resolver ratio, plus variance-"
+                         "documented e2e sim goodputs; one WAVE_MESH_AB "
+                         "JSON line")
+    ap.add_argument("--n-resolvers", type=int, default=1,
+                    help="repair-sim resolver role count: >1 drives the "
+                         "role-level global wave protocol (per-shard "
+                         "edge bitsets OR-reduced at the commit proxy — "
+                         "scripts/wave_mesh_ab.sh sweeps {1,2,4})")
     args = ap.parse_args()
     if args.open_loop:
         # Real-socket control-plane harness: subprocess cluster + CPU
@@ -1703,6 +1749,15 @@ def main() -> None:
         rec = run_admission_ab(min_ratio=args.admission_min_ratio)
         print(json.dumps(rec), flush=True)
         sys.exit(0 if rec.get("valid") else 1)
+    if args.wave_mesh_ab:
+        # Pure simulation + deterministic engine replay: pin CPU so
+        # importing the client stack can never touch the TPU tunnel.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from foundationdb_tpu.repair.wave_mesh import run_wave_mesh_ab
+
+        rec = run_wave_mesh_ab()
+        print(json.dumps(rec), flush=True)
+        sys.exit(0 if rec.get("valid") else 1)
     if args.repair_sim:
         # Pure simulation (the conflict engine is the python oracle): pin
         # CPU so importing the client stack can never touch the TPU tunnel.
@@ -1715,6 +1770,7 @@ def main() -> None:
             wave_commit=(None if args.wave_commit == "env"
                          else args.wave_commit == "1"),
             target_pick=args.repair_target,
+            n_resolvers=args.n_resolvers,
         )), flush=True)
         return
     if (os.environ.get("FDB_TPU_FORCE_CPU") == "1"

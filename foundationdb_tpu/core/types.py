@@ -76,31 +76,38 @@ def wave_commit_env_default() -> bool:
 
 
 def validate_wave_commit(n_resolvers: int = 1,
-                         skiplist_engine: str | None = None) -> None:
+                         skiplist_engine: str | None = None,
+                         wave_global_capable: bool = True) -> None:
     """Refuse deployments a wave-commit resolver cannot serve (call only
     when wave commit is ON). One definition of the rules — the sim
     cluster, its engine factory, and the deployed server must enforce
     identical refusals or a config drift silently un-serializes.
 
-    - A wave engine reorders within its own view, so it must see EVERY
-      conflict edge of its window: role-level multi-resolver deployments
-      clip ranges per key shard and per-shard wave schedules are not
-      combinable (the mesh ShardedConflictSet shards internally, below
-      the schedule, and stays exact).
     - The C++ skiplist engines never materialize the conflict graph and
       implement no wave schedule; ``skiplist_engine`` is the caller's
       name for the engine ("cpu"/"cpp"), None when the engine supports
-      wave commit."""
-    if n_resolvers > 1:
-        raise ValueError(
-            "wave commit requires a single-resolver deployment: per-shard "
-            "resolvers each see only their clipped conflict edges, so "
-            "per-shard wave schedules are not combinable"
-        )
+      wave commit.
+    - Role-level multi-resolver deployments clip ranges per key shard,
+      so a shard alone cannot serializably reorder — the deployment is
+      legal exactly when every resolver's engine implements the GLOBAL
+      wave protocol (resolve_edges/resolve_apply: per-shard clipped
+      predecessor bitsets are OR-reduced into the global graph at the
+      commit proxy and every shard levels that graph identically — see
+      core/wavemesh.py). ``wave_global_capable`` is the caller's
+      capability verdict for its engine; engines without the protocol
+      keep the old single-resolver-only rule."""
     if skiplist_engine is not None:
         raise ValueError(
             f"wave commit is not implemented by the {skiplist_engine} "
             "skiplist engine"
+        )
+    if n_resolvers > 1 and not wave_global_capable:
+        raise ValueError(
+            "wave commit with multiple resolvers requires engines that "
+            "implement the global edge-exchange protocol (resolve_edges/"
+            "resolve_apply): per-shard resolvers each see only their "
+            "clipped conflict edges, and a clipped-graph wave schedule "
+            "is not serializable"
         )
 
 

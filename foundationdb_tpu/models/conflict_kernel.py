@@ -723,22 +723,16 @@ def _cycle_victim(p, undet, undetp):
     return victim
 
 
-def _wave_commit_accept(
+def wave_pred_matrix(
     base: jax.Array, ranks: tuple[jax.Array, ...]
-) -> tuple[jax.Array, jax.Array]:
-    """(accepted bool [B], level int32 [B]): schedule candidate txns into
-    dependency-ordered commit waves; abort only true-cycle members.
-
-    Fixed point over the packed predecessor bitsets (same operand shape
-    and AND/any-reduce rounds as _wave_accept_packed): each iteration
-    either levels every txn with no undetermined predecessor into the
-    next wave, or — when the remaining subgraph has no source, i.e. every
-    stuck txn sits on or behind a cycle — aborts the one _cycle_victim
-    and continues, so txns merely DOWNSTREAM of a cycle are re-examined
-    once the cycle is broken and still commit. Every iteration determines
-    at least one txn, bounding the loop by the candidate count (the
-    saturation cap makes the worst case explicit, exactly like the wave
-    accept's round cap)."""
+) -> jax.Array:
+    """uint32 [BP, BP/32] packed predecessor bitsets over (possibly
+    shard-clipped) rank intervals, padded to BP = ceil32(B). The
+    shard-exchange operand: shards partition the keyspace, so the OR of
+    per-shard clipped matrices IS the global matrix (an edge's overlap
+    region lands in exactly the shards that witness it) — the mesh
+    engine all_gathers and OR-reduces these, and the role-level
+    resolve_edges payload carries them to the commit proxy."""
     rb, re_, read_live, wb, we, write_live = ranks
     b = base.shape[0]
     bp = ((b + 31) // 32) * 32
@@ -751,7 +745,40 @@ def _wave_commit_accept(
         wb = jnp.pad(wb, ((0, pad), (0, 0)))
         we = jnp.pad(we, ((0, pad), (0, 0)))
         write_live = jnp.pad(write_live, ((0, pad), (0, 0)))
-    p = _pred_matrix_packed(base, rb, re_, read_live, wb, we, write_live)
+    return _pred_matrix_packed(base, rb, re_, read_live, wb, we, write_live)
+
+
+def wave_occupied_tiles(p: jax.Array) -> jax.Array:
+    """int32 scalar: non-zero 32x32-bit tiles of a packed predecessor
+    matrix (32 rows x 1 uint32 word). The realized-graph density signal
+    behind the mesh exchange-cost model: a tile-scoped exchange ships
+    only occupied tiles, so its bytes scale with the conflict graph the
+    workload actually produced, not with BP² (bench.py roofline
+    ``exchange_bytes_per_batch``)."""
+    bp, w = p.shape
+    t = p.reshape(bp // 32, 32, w)
+    return jnp.sum(jnp.any(t != 0, axis=1).astype(jnp.int32))
+
+
+def _wave_level_packed(base: jax.Array, p: jax.Array) -> jax.Array:
+    """level int32 [BP] from a packed predecessor matrix: the wave-commit
+    fixed point. ``base`` is the padded candidate mask; ``p`` the packed
+    [BP, BP/32] graph (global or single-shard — the rule is graph-
+    agnostic).
+
+    Fixed point over the packed predecessor bitsets (same operand shape
+    and AND/any-reduce rounds as _wave_accept_packed): each iteration
+    either levels every txn with no undetermined predecessor into the
+    next wave, or — when the remaining subgraph has no source, i.e. every
+    stuck txn sits on or behind a cycle — aborts the one _cycle_victim
+    and continues, so txns merely DOWNSTREAM of a cycle are re-examined
+    once the cycle is broken and still commit. Every iteration determines
+    at least one txn, bounding the loop by the candidate count (the
+    saturation cap makes the worst case explicit, exactly like the wave
+    accept's round cap). Deterministic in the graph alone, so every mesh
+    shard running it on the same OR-reduced matrix reports the identical
+    schedule (core/wavemesh.level_wave_graph is the host replay)."""
+    bp = base.shape[0]
     idx = jnp.arange(bp, dtype=jnp.int32)
 
     def cond(carry):
@@ -788,7 +815,36 @@ def _wave_commit_accept(
             jnp.int32(0),
         ),
     )
-    level = level[:b]
+    return level
+
+
+def wave_level_from_graph(
+    cand: jax.Array, p: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(accepted bool [B], level int32 [B]) from a GLOBAL predecessor
+    matrix + global candidate mask. Columns are re-masked to candidates
+    here: a shard's clipped matrix carries edges from txns that are
+    candidates in its local view but history-gated on another shard, and
+    those edges must not constrain the schedule."""
+    b = cand.shape[0]
+    bp = p.shape[0]
+    candp = jnp.pad(cand, (0, bp - b)) if bp != b else cand
+    p = p & pack_bits_u32(candp)[None, :]
+    level = _wave_level_packed(candp, p)[:b]
+    return level >= 0, level
+
+
+def _wave_commit_accept(
+    base: jax.Array, ranks: tuple[jax.Array, ...]
+) -> tuple[jax.Array, jax.Array]:
+    """(accepted bool [B], level int32 [B]): schedule candidate txns into
+    dependency-ordered commit waves; abort only true-cycle members. The
+    single-shard composition of wave_pred_matrix + _wave_level_packed."""
+    b = base.shape[0]
+    p = wave_pred_matrix(base, ranks)
+    bp = p.shape[0]
+    basep = jnp.pad(base, (0, bp - b)) if bp != b else base
+    level = _wave_level_packed(basep, p)[:b]
     return level >= 0, level
 
 
@@ -2253,6 +2309,184 @@ def _advance_hist_res_jit(res, commit_version, new_oldest):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _repack_res_jit(res, new_dict, new_n, remap):
     return apply_dict_remap(res, new_dict, new_n, remap)
+
+
+# ---------------------------------------------------------------------------
+# Two-phase wave entry points (role-level global wave commit): a sharded
+# resolver deployment splits one resolve into EDGES (history gate + this
+# shard's clipped predecessor bitsets; no paint) and APPLY (level the
+# OR-reduced GLOBAL graph + paint the globally accepted writes). The
+# commit proxy is the reduction point between the phases
+# (core/wavemesh.combine_edges); every shard levels the identical graph,
+# so every shard reports the identical (wave, index) schedule. The mesh
+# ShardedConflictSet performs the same exchange as an on-device
+# all_gather inside one program and never needs these.
+# ---------------------------------------------------------------------------
+
+
+def wave_edges_batch(state: ConflictState, batch: BatchTensors, new_oldest):
+    """(too_old [B], hist_conflict [B], pred uint32 [BP, BP/32]): the
+    phase-1 body — gate verdicts for THIS shard's clipped view plus its
+    clipped predecessor matrix. Reads the history, never paints it."""
+    _floor, too_old = too_old_mask(state, batch, new_oldest)
+    hist_conflict = _history_conflicts(state, batch)
+    base = batch.txn_mask & ~too_old & ~hist_conflict
+    p = wave_pred_matrix(base, endpoint_ranks_live(batch))
+    return too_old, hist_conflict, p
+
+
+def wave_edges_batch_hist(hist: HistState, batch: BatchTensors, new_oldest):
+    """wave_edges_batch over the two-level history. No merge here — the
+    probe against base+delta is merge-invariant (pointwise max), and the
+    capacity merge runs in the apply phase, just before the paint that
+    needs the room."""
+    _floor, too_old = too_old_mask(hist.delta, batch, new_oldest)
+    hist_conflict = _history_conflicts_hist(
+        hist.base, hist.base_st, hist.delta, batch
+    )
+    base = batch.txn_mask & ~too_old & ~hist_conflict
+    p = wave_pred_matrix(base, endpoint_ranks_live(batch))
+    return too_old, hist_conflict, p
+
+
+def wave_edges_batch_packed(state: ConflictState, pb: PackedBatch, new_oldest):
+    _floor, too_old = too_old_mask_packed(state, pb, new_oldest)
+    hist_conflict = _history_conflicts_packed(state, pb)
+    base = pb.txn_mask & ~too_old & ~hist_conflict
+    p = wave_pred_matrix(base, endpoint_ranks_live_packed(pb))
+    return too_old, hist_conflict, p
+
+
+def wave_edges_batch_hist_packed(hist: HistState, pb: PackedBatch, new_oldest):
+    _floor, too_old = too_old_mask_packed(hist.delta, pb, new_oldest)
+    hist_conflict = _history_conflicts_hist_packed(hist, pb)
+    base = pb.txn_mask & ~too_old & ~hist_conflict
+    p = wave_pred_matrix(base, endpoint_ranks_live_packed(pb))
+    return too_old, hist_conflict, p
+
+
+def wave_edges_res(res: ResState, rb: ResidentBatch, new_oldest):
+    """Resident phase-1: the dictionary delta merges HERE (the host
+    packed ranks against the post-merge mirror), so the returned state
+    carries the merged dictionary and the apply phase must not re-merge.
+    History is still unpainted."""
+    res = apply_delta(res, rb.delta_keys)
+    hist = res.hist
+    if isinstance(hist, HistState):
+        _floor, too_old = too_old_mask_packed(hist.delta, rb.ranks, new_oldest)
+        hist_conflict = _history_conflicts_hist_res(hist, rb.ranks)
+    else:
+        _floor, too_old = too_old_mask_packed(hist, rb.ranks, new_oldest)
+        hist_conflict = _history_conflicts_res(hist, rb.ranks)
+    base = rb.ranks.txn_mask & ~too_old & ~hist_conflict
+    p = wave_pred_matrix(base, endpoint_ranks_live_packed(rb.ranks))
+    return too_old, hist_conflict, p, res
+
+
+def wave_apply_batch(
+    state: ConflictState, batch: BatchTensors, cand, p, commit_version,
+    new_oldest,
+):
+    """(levels int32 [B], new_state): level the GLOBAL graph, paint the
+    globally accepted writes. ``cand``/``p`` are the combined candidate
+    mask and OR-reduced predecessor matrix — identical on every shard,
+    so the returned schedule is identical on every shard."""
+    floor = jnp.maximum(state.oldest, new_oldest)
+    accepted, levels = wave_level_from_graph(cand, p)
+    new_state = _paint_and_compact(state, batch, accepted, commit_version,
+                                   floor)
+    return levels, new_state
+
+
+def wave_apply_batch_hist(
+    hist: HistState, batch: BatchTensors, cand, p, commit_version, new_oldest,
+):
+    floor = jnp.maximum(hist.delta.oldest, new_oldest)
+    demand = 2 * jnp.sum(
+        (batch.write_mask & lex_lt(batch.write_begin, batch.write_end))
+        .astype(jnp.int32)
+    )
+    hist = _maybe_merge(hist, demand, floor)
+    base_h, base_st, delta = hist
+    accepted, levels = wave_level_from_graph(cand, p)
+    delta = _paint_and_compact(delta, batch, accepted, commit_version, floor)
+    return levels, HistState(base_h, base_st, delta)
+
+
+def wave_apply_batch_packed(
+    state: ConflictState, pb: PackedBatch, cand, p, commit_version,
+    new_oldest,
+):
+    floor = jnp.maximum(state.oldest, new_oldest)
+    accepted, levels = wave_level_from_graph(cand, p)
+    new_state = _paint_and_compact_packed(
+        state, pb, accepted, commit_version, floor
+    )
+    return levels, new_state
+
+
+def wave_apply_batch_hist_packed(
+    hist: HistState, pb: PackedBatch, cand, p, commit_version, new_oldest,
+):
+    floor = jnp.maximum(hist.delta.oldest, new_oldest)
+    demand = 2 * jnp.sum(
+        (pb.write_mask & (pb.write_begin < pb.write_end)).astype(jnp.int32)
+    )
+    hist = _maybe_merge(hist, demand, floor)
+    base_h, base_st, delta = hist
+    accepted, levels = wave_level_from_graph(cand, p)
+    delta = _paint_and_compact_packed(
+        delta, pb, accepted, commit_version, floor
+    )
+    return levels, HistState(base_h, base_st, delta)
+
+
+def wave_apply_res(
+    res: ResState, rbk: RankBatch, cand, p, commit_version, new_oldest,
+):
+    """Resident apply: the dictionary already merged in wave_edges_res,
+    so this is pure rank-space level + paint."""
+    hist = res.hist
+    accepted, levels = wave_level_from_graph(cand, p)
+    if isinstance(hist, HistState):
+        floor = jnp.maximum(hist.delta.oldest, new_oldest)
+        demand = 2 * jnp.sum(
+            (rbk.write_mask & (rbk.write_begin < rbk.write_end)).astype(
+                jnp.int32
+            )
+        )
+        hist = _maybe_merge(hist, demand, floor)
+        base_h, base_st, delta = hist
+        delta = _paint_and_compact_res(
+            delta, rbk, accepted, commit_version, floor
+        )
+        new_hist: ConflictState | HistState = HistState(base_h, base_st, delta)
+    else:
+        floor = jnp.maximum(hist.oldest, new_oldest)
+        new_hist = _paint_and_compact_res(
+            hist, rbk, accepted, commit_version, floor
+        )
+    return levels, res._replace(hist=new_hist)
+
+
+# Edge entries are NOT donated (the apply phase reuses the same state);
+# the resident edge entry IS donated (the delta merge replaces the
+# state, returned alongside). Apply entries donate like every resolve.
+_wave_edges_jit = jax.jit(wave_edges_batch)
+_wave_edges_hist_jit = jax.jit(wave_edges_batch_hist)
+_wave_edges_packed_jit = jax.jit(wave_edges_batch_packed)
+_wave_edges_hist_packed_jit = jax.jit(wave_edges_batch_hist_packed)
+_wave_edges_res_jit = jax.jit(wave_edges_res, donate_argnums=(0,))
+_wave_edges_hist_res_jit = _wave_edges_res_jit
+
+_wave_apply_jit = jax.jit(wave_apply_batch, donate_argnums=(0,))
+_wave_apply_hist_jit = jax.jit(wave_apply_batch_hist, donate_argnums=(0,))
+_wave_apply_packed_jit = jax.jit(wave_apply_batch_packed, donate_argnums=(0,))
+_wave_apply_hist_packed_jit = jax.jit(
+    wave_apply_batch_hist_packed, donate_argnums=(0,)
+)
+_wave_apply_res_jit = jax.jit(wave_apply_res, donate_argnums=(0,))
+_wave_apply_hist_res_jit = _wave_apply_res_jit
 
 
 # ---------------------------------------------------------------------------

@@ -362,12 +362,13 @@ class TPUConflictSet:
         # None = the FDB_TPU_WAVE_COMMIT env default. Both modes' entry
         # points are distinct compiled programs, so engines of either mode
         # coexist in one process (the import-once rule only pins the env
-        # DEFAULT). NOTE: a wave engine reorders txns within its own view,
-        # so it must see every conflict range of its batches — one engine
-        # per resolver role, and never more than one wave resolver per
-        # keyspace (the mesh ShardedConflictSet shards internally and
-        # stays exact; role-level multi-resolver deployments must keep
-        # wave commit off — see sim/cluster.new_conflict_set).
+        # DEFAULT). NOTE: a wave engine reorders txns against the FULL
+        # conflict graph of its window. Single-resolver roles see it
+        # whole; the mesh ShardedConflictSet OR-reduces per-shard clipped
+        # graphs on-device; role-level multi-resolver deployments run the
+        # two-phase global protocol (resolve_edges/resolve_apply below —
+        # the commit proxy OR-reduces the shards' edge bitsets and every
+        # shard levels the identical global graph).
         self.wave_commit = ck._WAVE_COMMIT if wave_commit is None else bool(
             wave_commit
         )
@@ -415,6 +416,13 @@ class TPUConflictSet:
         # dispatch shipped anyway).
         self.admission_filter = None
         self._adm_stash = None  # (write fps [b, q], valid [b, q]) per pack
+        # Role-level global wave protocol (core/wavemesh): resolve_edges
+        # stashes the packed chunks here until resolve_apply consumes the
+        # combined global graph. None between windows; the mesh-sharded
+        # subclass leaves the entry points unset (it exchanges in-jit).
+        self._wave_pending = None
+        self._wave_edges_fn = None
+        self._wave_apply_fn = None
         self._init_engine()
 
     def attach_admission_filter(self, f) -> None:
@@ -478,6 +486,13 @@ class TPUConflictSet:
         self._resolve_fn = getattr(ck, "_resolve" + suffix)
         self._resolve_report_fn = getattr(ck, "_resolve_report" + suffix)
         self._resolve_many_fn = getattr(ck, "_resolve_many" + suffix)
+        if self.wave_commit:
+            # Two-phase entry points for the role-level global wave
+            # protocol (resolve_edges/resolve_apply) — same suffix
+            # composition as above.
+            two = ("_hist" if hist else "") + fmt + "_jit"
+            self._wave_edges_fn = getattr(ck, "_wave_edges" + two)
+            self._wave_apply_fn = getattr(ck, "_wave_apply" + two)
 
     def _pack_dict(self, bt: ck.BatchTensors) -> ck.PackedBatch:
         """Dedup+sort ALL batch endpoint keys once per dispatch (host
@@ -1072,6 +1087,162 @@ class TPUConflictSet:
             return np.asarray(verdicts)[:, : prepared.count]
 
         return collect
+
+    # -- role-level global wave protocol (core/wavemesh) ----------------------
+
+    @property
+    def wave_global_capable(self) -> bool:
+        """Does this engine implement the two-phase global wave protocol
+        (resolve_edges/resolve_apply)? True for single-chip wave-commit
+        engines; the mesh-sharded subclass exchanges edges on-device
+        inside one program and is a self-contained single resolver from
+        the role's perspective (it reports False — a deployment sharding
+        ABOVE a mesh engine would need edges of edges)."""
+        return self.wave_commit and self._wave_edges_fn is not None
+
+    def resolve_edges(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ):
+        """Phase 1 of the global wave protocol: gate this shard's CLIPPED
+        view of the window (TOO_OLD + history conflicts) and build its
+        clipped predecessor bitsets, WITHOUT painting. The packed device
+        batches stay stashed until resolve_apply consumes the combined
+        graph — one pack serves both phases. Returns a wavemesh.WaveEdges
+        payload (per-chunk packed uint32 matrices) for the commit proxy's
+        OR-reduce."""
+        from foundationdb_tpu.core.wavemesh import WaveEdges
+
+        if not self.wave_global_capable:
+            raise ValueError(
+                "resolve_edges requires a wave-commit engine with the "
+                "two-phase entry points (wave_commit=True)"
+            )
+        if self._wave_pending is not None:
+            raise ValueError(
+                "resolve_edges with an apply outstanding: the previous "
+                "window's resolve_apply must land first (version chain)"
+            )
+        if len(txns) > self.batch_size:
+            # The protocol exchanges ONE schedule domain per window. The
+            # single-engine path chunks oversized windows and serializes
+            # them THROUGH the history (chunk k+1's gate sees chunk k's
+            # paints — cross-chunk read-write pairs abort); a one-shot
+            # edge exchange gates every chunk against the pre-window
+            # history and would silently commit those pairs. Callers
+            # (the commit proxy) must keep wave batches within one
+            # engine chunk.
+            raise ValueError(
+                f"global wave window of {len(txns)} txns exceeds the "
+                f"engine chunk ({self.batch_size}): one exchange carries "
+                "one schedule domain"
+            )
+        self._begin_resolve(commit_version, oldest_version)
+        cv = np.int32(self._rel(commit_version))
+        oldest = np.int32(self._rel(self.oldest_version))
+        # The guard above pins the one-window-one-chunk invariant, so the
+        # payload is exactly one chunk (or none for an empty window).
+        n = len(txns)
+        if not n:
+            self._wave_pending = ([], commit_version)
+            return WaveEdges(
+                count=0, too_old=np.zeros(0, bool),
+                hist_conflict=np.zeros(0, bool), chunks=[],
+            )
+        batch = self._pack(txns)
+        dev = self._dev_batch(batch)
+        if self.resident:
+            too_old, hist_c, p, self.state = self._wave_edges_fn(
+                self.state, dev, oldest
+            )
+        else:
+            too_old, hist_c, p = self._wave_edges_fn(self.state, dev, oldest)
+        self._wave_pending = (
+            [(dev, n, cv, oldest, self._take_adm(commit_version))],
+            commit_version,
+        )
+        return WaveEdges(
+            count=n,
+            too_old=np.asarray(too_old)[:n],
+            hist_conflict=np.asarray(hist_c)[:n],
+            chunks=[(n, np.asarray(p))],
+        )
+
+    def resolve_abandon(self) -> None:
+        """Drop a pending resolve_edges without painting (another shard's
+        capacity fail-safe rejected the whole window). Nothing reached
+        device history in phase 1, so dropping the stash IS the
+        paint-nothing fail-safe contract; version bookkeeping stays
+        advanced (harmless — the device floor catches up on the next
+        dispatch's max())."""
+        self._wave_pending = None
+
+    def resolve_apply(self, graph) -> list[Verdict]:
+        """Phase 2: level the combined GLOBAL graph on-device (identical
+        inputs on every shard → identical schedule on every shard), paint
+        this shard's accepted writes, and publish last_wave /
+        last_reordered exactly like a single-shard wave resolve. The
+        conflicting-keys report degrades to the resolver-side
+        conservative superset on this path (last_conflicting stays
+        empty)."""
+        if self._wave_pending is None:
+            raise ValueError("resolve_apply without a pending resolve_edges")
+        pend, commit_version = self._wave_pending
+        self._wave_pending = None
+        if len(graph.chunks) != len(pend):
+            raise ValueError(
+                f"global graph has {len(graph.chunks)} chunks; this shard "
+                f"packed {len(pend)}"
+            )
+        gi = 0
+        level_parts: list[np.ndarray] = []
+        feed: list[tuple] = []
+        for (dev, n, cv, oldest, adm), (nc, pred) in zip(pend, graph.chunks):
+            if nc != n:
+                raise ValueError(
+                    f"global graph chunk of {nc} txns vs local pack of {n}"
+                )
+            cand = np.zeros(self.batch_size, bool)
+            cand[:n] = graph.cand[gi : gi + n]
+            rbk = dev.ranks if self.resident else dev
+            levels, self.state = self._wave_apply_fn(
+                self.state, rbk, cand, np.ascontiguousarray(pred, np.uint32),
+                cv, oldest,
+            )
+            lv = np.asarray(levels)[:n]
+            level_parts.append(lv)
+            if adm is not None:
+                feed.append((lv, adm))
+            gi += n
+        # Stitch the coherent window schedule (same chunk-offset rule as
+        # _collect_waves) + the attribution counters.
+        waves: list[int] = []
+        offset = 0
+        reordered = 0
+        for lv in level_parts:
+            reordered += int((lv > 0).sum())
+            waves.extend(int(x) + offset if x >= 0 else int(x) for x in lv)
+            if len(lv) and int(lv.max()) >= 0:
+                offset += int(lv.max()) + 1
+        self.last_wave = waves
+        self.last_reordered = reordered
+        self.last_conflicting = {}
+        # Admission feed (engine-attached filters): accepted writes at
+        # this window's commit version, judged on the GLOBAL schedule.
+        if self.admission_filter is not None:
+            for lv, ((fps, valid), adm_cv) in feed:
+                sel = valid[: len(lv)] & (lv >= 0)[:, None]
+                if sel.any():
+                    self.admission_filter.record_u64(
+                        fps[: len(lv)][sel], adm_cv
+                    )
+                else:
+                    self.admission_filter.advance(adm_cv)
+        from foundationdb_tpu.core.wavemesh import verdicts_from_schedule
+
+        return verdicts_from_schedule(graph, waves)
 
     def _collect_waves(self, pending: list[tuple]) -> None:
         """Publish ``last_wave`` from the pending chunks' level tensors.

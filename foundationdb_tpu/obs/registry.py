@@ -45,6 +45,8 @@ DOCUMENTED_COUNTERS = (
     "resolver.txns_conflicted",
     "resolver.txns_reordered",
     "resolver.txns_cycle_aborted",
+    "resolver.wave_batches",
+    "commit_proxy.wave_exchanges",
     "resolver.txns_rejected_fail_safe",
     "resolver.overflow_events",
     "resolver.queue.depth",

@@ -61,6 +61,14 @@ reconciliation identity):
     host_pack         resolver: engine host-side pack (engines that
                       publish ``last_host_pack_s``)
     device_dispatch   resolver: modeled dispatch cost + engine execution
+                      (under the global wave protocol: both phases'
+                      engine work, edges + level/paint)
+    wave_exchange     resolver: global wave commit only — phase-1 reply
+                      to phase-2 arrival (the proxy's OR-reduce of the
+                      shards' edge bitsets plus both network legs), the
+                      comms cost the sharded schedule pays per window
+    wave_level        resolver: global wave commit only — the phase-2
+                      leveling + paint (interior of device_dispatch)
     tlog_fsync        tlog: chain-ordered push -> durable ack
 """
 
@@ -93,6 +101,8 @@ SUB_STAGES = (
     "coalesce_queue",
     "host_pack",
     "device_dispatch",
+    "wave_exchange",
+    "wave_level",
     "tlog_fsync",
 )
 

@@ -138,23 +138,51 @@ def _sharded_resolve(state, batch, commit_version, new_oldest, lo, hi,
     # row-sharded design all-gathered a [B, B] matrix (67 MB at B=8192)
     # over ICI only to run the full-matrix wave on every device anyway.
     base = batch.txn_mask & ~too_old & ~hist_conflict
-    # Wave commit composes with the mesh: the schedule is a pure function
-    # of the replicated batch and the all_gathered history bits, so every
-    # device computes the SAME dependency waves (levels survive the packed
-    # all_gather combine exactly because acceptance runs after it) and
-    # paints only its own shard's accepted writes. The mesh engine shards
-    # one keyspace internally — unlike role-level multi-resolver, no
-    # device ever sees a clipped-away edge, so reordering stays exact.
-    accepted, levels = ck._accept_or_schedule(
-        base, ck.endpoint_ranks_live(batch), wave
-    )
+    if wave:
+        # Global wave commit over per-shard graphs: each shard builds the
+        # predecessor bitsets from its CLIPPED ranges only (edges whose
+        # read∩write overlap falls inside its keyspace slice — shards
+        # partition the keyspace, so the OR across shards IS the exact
+        # global graph), the packed [BP, BP/32] tiles cross ICI in one
+        # all_gather, and every device levels the identical OR-reduced
+        # matrix — byte-identical (wave, index) schedules and min-index
+        # cycle victims on every shard, no device ever trusting an edge
+        # it cannot see. This is the same exchange the role-level
+        # resolve_edges/resolve_apply protocol runs through the commit
+        # proxy (core/wavemesh), here fused into the device program.
+        accepted, levels, stats = _wave_exchange_and_level(
+            base, ck.endpoint_ranks_live(local)
+        )
+    else:
+        accepted, _ = ck._accept_or_schedule(
+            base, ck.endpoint_ranks_live(batch), False
+        )
     verdicts = ck.assemble_verdicts(too_old, batch.txn_mask, accepted)
 
     new_state = ck._paint_and_compact(state, local, accepted, commit_version, floor)
     new_state = jax.tree.map(lambda x: x[None], new_state)
     if wave:
-        return verdicts, levels, new_state
+        return verdicts, levels, stats, new_state
     return verdicts, new_state
+
+
+def _wave_exchange_and_level(base, clipped_ranks):
+    """Shared mesh wave body (runs under shard_map): clipped per-shard
+    predecessor tiles -> packed all_gather -> OR-reduce -> replicated
+    leveling. Returns (accepted [B], levels [B], stats int32 [2]) where
+    stats = (occupied 32x32-bit tiles summed over shards, total tiles
+    shipped by the dense all_gather) — the realized-graph exchange
+    economics surfaced to the host for the roofline's
+    ``exchange_bytes_per_batch`` term."""
+    p_local = ck.wave_pred_matrix(base, clipped_ranks)
+    occ = ck.wave_occupied_tiles(p_local)
+    gathered = jax.lax.all_gather(p_local, AXIS)  # [D, BP, BP/32]
+    d = gathered.shape[0]
+    p = functools.reduce(jnp.bitwise_or, [gathered[i] for i in range(d)])
+    accepted, levels = ck.wave_level_from_graph(base, p)
+    total = jnp.int32(d * (p.shape[0] // 32) * p.shape[1])
+    stats = jnp.stack([jax.lax.psum(occ, AXIS), total])
+    return accepted, levels, stats
 
 
 def _res_shard_step(hist, lo, hi, rbk, commit_version, new_oldest, wave):
@@ -176,14 +204,24 @@ def _res_shard_step(hist, lo, hi, rbk, commit_version, new_oldest, wave):
     else:
         hist_conflict = jax.lax.psum(hist_local.astype(jnp.int32), AXIS) > 0
     base = rbk.txn_mask & ~too_old & ~hist_conflict
-    accepted, levels = ck._accept_or_schedule(
-        base, ck.endpoint_ranks_live_packed(rbk), wave
-    )
+    stats = None
+    if wave:
+        # Same global-graph exchange as the full-key body, in rank space:
+        # the clipped RankBatch's intervals witness exactly this shard's
+        # slice of every edge (clip_ranks is a two-sided clamp on shared
+        # global ranks), so the OR across shards is the exact graph.
+        accepted, levels, stats = _wave_exchange_and_level(
+            base, ck.endpoint_ranks_live_packed(local)
+        )
+    else:
+        accepted, levels = ck._accept_or_schedule(
+            base, ck.endpoint_ranks_live_packed(rbk), False
+        )
     verdicts = ck.assemble_verdicts(too_old, rbk.txn_mask, accepted)
     new_hist = ck._paint_and_compact_res(
         hist, local, accepted, commit_version, floor
     )
-    return verdicts, levels, new_hist
+    return verdicts, levels, stats, new_hist
 
 
 def _sharded_resolve_res(res, rb, commit_version, new_oldest, wave=False):
@@ -198,13 +236,13 @@ def _sharded_resolve_res(res, rb, commit_version, new_oldest, wave=False):
         shard_hi=res.shard_hi,
     )
     local = ck.apply_delta(local, rb.delta_keys)
-    verdicts, levels, new_hist = _res_shard_step(
+    verdicts, levels, stats, new_hist = _res_shard_step(
         local.hist, local.shard_lo[0], local.shard_hi[0], rb.ranks,
         commit_version, new_oldest, wave,
     )
     new_res = local._replace(hist=jax.tree.map(lambda x: x[None], new_hist))
     if wave:
-        return verdicts, levels, new_res
+        return verdicts, levels, stats, new_res
     return verdicts, new_res
 
 
@@ -225,10 +263,10 @@ def _sharded_resolve_res_many(res, rb, commit_versions, new_oldests,
 
     def body(h, xs):
         rbk, cv, old = xs
-        verdicts, levels, new_h = _res_shard_step(
+        verdicts, levels, stats, new_h = _res_shard_step(
             h, lo, hi, rbk, cv, old, wave
         )
-        return new_h, ((verdicts, levels) if wave else (verdicts,))
+        return new_h, ((verdicts, levels, stats) if wave else (verdicts,))
 
     hist, stacked = jax.lax.scan(
         body, local.hist, (rb.ranks, commit_versions, new_oldests)
@@ -291,7 +329,74 @@ class ShardedConflictSet(TPUConflictSet):
         self.reshard_skew = reshard_skew
         self.auto_reshards = 0  # re-splits the default policy performed
         self._dispatches = 0
+        # Wave-exchange economics (wave_commit engines): per-dispatch
+        # (occupied tiles, dense tiles) device scalars, folded lazily by
+        # exchange_stats() so accounting never syncs a dispatch.
+        self._exchange_pending: list = []
+        self._exchange_acc = [0, 0, 0]  # occupied, total, batches
         super().__init__(**kw)
+
+    # -- wave-exchange accounting (roofline exchange_bytes_per_batch) --------
+
+    #: bytes per 32x32-bit predecessor tile (32 rows x 1 uint32 word).
+    EXCHANGE_TILE_BYTES = 128
+
+    #: fold the pending exchange stats into the account past this many
+    #: dispatches — bounds the list (and its live device buffers) on long
+    #: soaks; entries this old are far behind any pipeline depth, so the
+    #: host sync cannot stall an in-flight dispatch.
+    EXCHANGE_FOLD_AT = 256
+
+    def _note_exchange(self, stats) -> None:
+        self._exchange_pending.append(stats)
+        if len(self._exchange_pending) >= self.EXCHANGE_FOLD_AT:
+            self._fold_exchange()
+
+    def _fold_exchange(self) -> None:
+        for s in self._exchange_pending:
+            a = np.asarray(s).reshape(-1, 2)
+            self._exchange_acc[0] += int(a[:, 0].sum())
+            self._exchange_acc[1] += int(a[:, 1].sum())
+            self._exchange_acc[2] += int(a.shape[0])
+        self._exchange_pending.clear()
+
+    def exchange_stats(self) -> dict:
+        """Fold the pending per-dispatch exchange stats (device sync) into
+        the running account and report the wave-exchange economics:
+        ``tiles_occupied`` counts non-zero 32x32-bit predecessor tiles
+        summed over shards (what a tile-scoped exchange would ship — it
+        scales with the REALIZED conflict graph), ``tiles_total`` the
+        dense all_gather's tile count (the transport currently shipped,
+        scaling with BP²·D). Bytes are per batch, averaged over every
+        wave dispatch since construction."""
+        self._fold_exchange()
+        occ, tot, batches = self._exchange_acc
+        per = max(1, batches)
+        return {
+            "wave_batches": batches,
+            "tiles_occupied": occ,
+            "tiles_total": tot,
+            "tile_bytes": self.EXCHANGE_TILE_BYTES,
+            "exchange_bytes_per_batch_scoped": round(
+                occ * self.EXCHANGE_TILE_BYTES / per
+            ),
+            "exchange_bytes_per_batch_dense": round(
+                tot * self.EXCHANGE_TILE_BYTES / per
+            ),
+            "tile_occupancy": round(occ / max(1, tot), 4),
+        }
+
+    def _strip_exchange(self, fn):
+        """Wrap a wave-mode jitted mesh entry: pop the exchange-stats leaf
+        into the pending account and hand the host collectors the same
+        (verdicts, levels, state) shape every engine returns."""
+
+        def run(*args):
+            verdicts, levels, stats, state = fn(*args)
+            self._note_exchange(stats)
+            return verdicts, levels, state
+
+        return run
 
     # -- density resharding as the default policy ----------------------------
 
@@ -428,7 +533,7 @@ class ShardedConflictSet(TPUConflictSet):
         state_specs = ck.ConflictState(*(P(AXIS) for _ in ck.ConflictState._fields))
         batch_specs = ck.BatchTensors(*(P() for _ in ck.BatchTensors._fields))
         wave = self.wave_commit
-        out_specs = ((P(), P(), state_specs) if wave
+        out_specs = ((P(), P(), P(), state_specs) if wave
                      else (P(), state_specs))
         body = _shard_map(
             functools.partial(_sharded_resolve, wave=wave),
@@ -438,9 +543,10 @@ class ShardedConflictSet(TPUConflictSet):
             **_SHARD_MAP_KW,
         )
         jitted = jax.jit(body, donate_argnums=(0,))
-        self._resolve_fn = lambda s, bt, cv, old: jitted(
+        resolve = lambda s, bt, cv, old: jitted(  # noqa: E731
             s, bt, cv, old, self._lo_dev, self._hi_dev
         )
+        self._resolve_fn = self._strip_exchange(resolve) if wave else resolve
 
         def many(s, bts, cvs, olds, lo, hi):
             def scan_body(st, xs):
@@ -452,8 +558,11 @@ class ShardedConflictSet(TPUConflictSet):
             return (*stacked, st)
 
         many_jit = jax.jit(many, donate_argnums=(0,))
-        self._resolve_many_fn = lambda s, bts, cvs, olds: many_jit(
+        resolve_many = lambda s, bts, cvs, olds: many_jit(  # noqa: E731
             s, bts, cvs, olds, self._lo_dev, self._hi_dev
+        )
+        self._resolve_many_fn = (
+            self._strip_exchange(resolve_many) if wave else resolve_many
         )
         self._rebase_fn = jax.jit(
             _shard_map(
@@ -526,7 +635,7 @@ class ShardedConflictSet(TPUConflictSet):
             ranks=ck.RankBatch(*(P() for _ in ck.RankBatch._fields)),
         )
         wave = self.wave_commit
-        out_specs = ((P(), P(), state_specs) if wave
+        out_specs = ((P(), P(), P(), state_specs) if wave
                      else (P(), state_specs))
         body = _shard_map(
             functools.partial(_sharded_resolve_res, wave=wave),
@@ -535,7 +644,8 @@ class ShardedConflictSet(TPUConflictSet):
             out_specs=out_specs,
             **_SHARD_MAP_KW,
         )
-        self._resolve_fn = jax.jit(body, donate_argnums=(0,))
+        resolve = jax.jit(body, donate_argnums=(0,))
+        self._resolve_fn = self._strip_exchange(resolve) if wave else resolve
         many_body = _shard_map(
             functools.partial(_sharded_resolve_res_many, wave=wave),
             mesh=self.mesh,
@@ -543,7 +653,10 @@ class ShardedConflictSet(TPUConflictSet):
             out_specs=out_specs,
             **_SHARD_MAP_KW,
         )
-        self._resolve_many_fn = jax.jit(many_body, donate_argnums=(0,))
+        resolve_many = jax.jit(many_body, donate_argnums=(0,))
+        self._resolve_many_fn = (
+            self._strip_exchange(resolve_many) if wave else resolve_many
+        )
         # Rebase/repack touch versions/ranks elementwise — the plain
         # resident entry points shard transparently under jit.
         self._rebase_fn = ck._rebase_res_jit

@@ -1,0 +1,402 @@
+"""Mesh wave-commit A/B: does sharding the resolvers give the wave win back?
+
+Two instruments, one artifact (WAVE_MESH_AB.json — scripts/wave_mesh_ab.sh,
+``python bench.py --wave-mesh-ab``):
+
+1. **Deterministic schedule-goodput** (the gated comparison): a seeded
+   Zipf RMW stream is replayed as retry-until-commit resolve windows —
+   bounded in-flight set, conflicted txns re-enter with a fresh snapshot
+   — directly against the conflict engines, with NO simulated time, so
+   goodput (txns committed / windows consumed) is an exact integer-count
+   metric, reproducible to the byte. Arms per resolver count
+   n ∈ {1, 2, 4}:
+
+   - *wave*: n = 1 resolves on one wave oracle; n ≥ 2 runs the role-level
+     global protocol (per-shard clipped ``resolve_edges`` → wavemesh
+     OR-reduce → ``resolve_apply`` on every shard) with ReplayCheckedOracle
+     shards, so every window's schedule is sequentially replay-verified
+     AND asserted byte-identical across shards and against the
+     single-resolver schedule for the same window.
+   - *naive*: sequential-order engines, full-restart retry. n ≥ 2 keeps
+     the reference AND-combine semantics (each shard resolves its clipped
+     view independently and paints ITS OWN accepted writes — the known
+     multi-resolver over-abort).
+
+   The acceptance ratio is wave/naive goodput per n; the global protocol
+   reconstructs the exact single-resolver conflict graph (shards
+   partition the keyspace), so the wave arm's schedule — and therefore
+   its goodput — is IDENTICAL at every n on the same stream: scaling out
+   resolvers gives none of the reorder win back. The gate requires
+   ratio(n ≥ 2) within 5% of ratio(1); the over-abort baseline can only
+   make the mesh ratio larger.
+
+2. **End-to-end sim goodput** (recorded, variance-documented): the full
+   SimCluster harness (repair/bench.run_repair_goodput) per n and flag on
+   the same seeds. Virtual-time goodput there is tail-dominated
+   (retry-backoff + randomized RPC latencies; per-run spread of ±30-50%
+   was measured while building this), so these ratios are REPORTED with
+   their per-seed spread rather than gated at 5% — the honesty-flag
+   discipline: the artifact says exactly which instrument supports which
+   claim. Gated from this half instead: replay-checked serializability in
+   every run, wave batches > 0 on every shard, and byte-identical
+   per-shard schedule counters.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+
+def _zipf_cdf(n_keys: int, theta: float) -> list[float]:
+    w = [(r + 1) ** -theta for r in range(n_keys)]
+    total = sum(w)
+    acc, cdf = 0.0, []
+    for x in w:
+        acc += x
+        cdf.append(acc / total)
+    return cdf
+
+
+def _gen_stream(seed: int, n_txns: int, n_keys: int, theta: float,
+                reads_per_txn: int, target_pick: str):
+    """[(read key ids, write key id)] — the ZipfRepairWorkload shape
+    (read ``reads_per_txn`` Zipf picks, RMW one target)."""
+    import bisect
+    import random
+
+    if target_pick not in ("hottest", "coldest"):
+        # Hard error, mirroring ZipfRepairWorkload: a typo'd value would
+        # silently bench the coldest (wave-friendly) arm while the
+        # gated WAVE_MESH_AB record claims otherwise.
+        raise ValueError(
+            f"target_pick={target_pick!r} is not a valid setting; "
+            f"accepted values: hottest, coldest"
+        )
+    rng = random.Random(seed)
+    cdf = _zipf_cdf(n_keys, theta)
+    out = []
+    for _ in range(n_txns):
+        picks = [
+            min(bisect.bisect_left(cdf, rng.random()), n_keys - 1)
+            for _ in range(reads_per_txn)
+        ]
+        target = min(picks) if target_pick == "hottest" else max(picks)
+        out.append((picks, target))
+    return out
+
+
+def _key(i: int) -> bytes:
+    return b"k%04d" % i
+
+
+def _shard_bounds(n_keys: int, n_shards: int):
+    """[(lo, hi)] covering the whole keyspace, interior splits at key
+    quantiles so every shard owns real load."""
+    cuts = [_key((d * n_keys) // n_shards) for d in range(1, n_shards)]
+    los = [b""] + cuts
+    his = cuts + [b"\xff\xff"]
+    return list(zip(los, his))
+
+
+
+
+def run_schedule_goodput(
+    seed: int,
+    n_resolvers: int,
+    wave: bool,
+    n_txns: int = 480,
+    n_keys: int = 12,
+    theta: float = 0.99,
+    reads_per_txn: int = 3,
+    target_pick: str = "coldest",
+    inflight: int = 24,
+    window: int = 24,
+    max_rounds: int = 100_000,
+) -> dict:
+    """One deterministic arm: retry-until-commit windows straight through
+    the engines. Returns goodput (txns/windows) + exact counters, plus
+    the measured per-window exchange bytes and the cross-shard schedule
+    checksum for the wave arms."""
+    import hashlib
+
+    from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+    from foundationdb_tpu.core.wavemesh import (
+        WaveEdges,
+        clip_txns,
+        combine_edges,
+    )
+    from foundationdb_tpu.sim.oracle import (
+        OracleConflictSet,
+        ReplayCheckedOracle,
+    )
+
+    stream = _gen_stream(seed, n_txns, n_keys, theta, reads_per_txn,
+                         target_pick)
+    bounds = _shard_bounds(n_keys, n_resolvers) if n_resolvers > 1 else None
+    if n_resolvers == 1:
+        engines = [ReplayCheckedOracle(wave_commit=wave)]
+    else:
+        engines = [
+            (ReplayCheckedOracle if wave else OracleConflictSet)(
+                wave_commit=wave
+            )
+            for _ in range(n_resolvers)
+        ]
+
+    def txn_info(i: int, read_version: int) -> TxnConflictInfo:
+        picks, target = stream[i]
+        return TxnConflictInfo(
+            read_version=read_version,
+            read_ranges=[
+                KeyRange(_key(k), _key(k) + b"\x00") for k in sorted(set(picks))
+            ],
+            write_ranges=[KeyRange(_key(target), _key(target) + b"\x00")],
+        )
+
+    next_arrival = 0
+    pending: list[tuple[int, int]] = []  # (stream index, read_version)
+    committed = 0
+    conflicts = 0
+    reordered = 0
+    cycle_aborts = 0
+    rounds = 0
+    exchange_bytes = 0
+    sched_hash = hashlib.sha256()
+    cv = 0
+    while committed < n_txns and rounds < max_rounds:
+        while len(pending) < inflight and next_arrival < n_txns:
+            pending.append((next_arrival, cv))
+            next_arrival += 1
+        batch = pending[:window]
+        cv += 1
+        txns = [txn_info(i, rv) for i, rv in batch]
+        if n_resolvers == 1:
+            verdicts = engines[0].resolve(txns, cv)
+            waves = [engines[0].last_wave] if wave else []
+        elif wave:
+            payloads = []
+            for (lo, hi), eng in zip(bounds, engines):
+                w_ = eng.resolve_edges(clip_txns(txns, lo, hi), cv).to_wire()
+                exchange_bytes += _wire_bytes(w_)
+                payloads.append(WaveEdges.from_wire(w_))
+            graph = combine_edges(payloads)
+            exchange_bytes += _wire_bytes(graph.to_wire()) * n_resolvers
+            shard_verdicts = [eng.resolve_apply(graph) for eng in engines]
+            verdicts = shard_verdicts[0]
+            waves = [eng.last_wave for eng in engines]
+            for v in shard_verdicts[1:]:
+                if v != verdicts:
+                    raise AssertionError("shard verdicts diverge")
+            for w_ in waves[1:]:
+                if w_ != waves[0]:
+                    raise AssertionError("shard schedules diverge")
+        else:
+            # Reference AND-combine: each shard resolves its clipped view
+            # independently (and paints its own accepted writes — the
+            # over-abort the sequential multi-resolver path really pays).
+            per_shard = [
+                eng.resolve(clip_txns(txns, lo, hi), cv)
+                for (lo, hi), eng in zip(bounds, engines)
+            ]
+            verdicts = []
+            for k in range(len(txns)):
+                vs = [sv[k] for sv in per_shard]
+                if Verdict.TOO_OLD in vs:
+                    verdicts.append(Verdict.TOO_OLD)
+                elif Verdict.CONFLICT in vs:
+                    verdicts.append(Verdict.CONFLICT)
+                else:
+                    verdicts.append(Verdict.COMMITTED)
+            waves = []
+        if wave and waves:
+            lw = waves[0]
+            sched_hash.update(
+                (",".join(str(x) for x in lw) + ";").encode()
+            )
+            reordered += sum(1 for x in lw if x > 0)
+            cycle_aborts += sum(1 for x in lw if x == -2)
+        survivors = []
+        for (i, _rv), v in zip(batch, verdicts):
+            if v == Verdict.COMMITTED:
+                committed += 1
+            else:
+                conflicts += 1
+                survivors.append((i, cv))  # restart at a fresh snapshot
+        pending = survivors + pending[window:]
+        rounds += 1
+    if committed < n_txns:
+        raise AssertionError(
+            f"schedule-goodput arm did not converge: {committed}/{n_txns} "
+            f"in {rounds} rounds"
+        )
+    return {
+        "goodput_txns_per_window": round(n_txns / rounds, 4),
+        "windows": rounds,
+        "committed": committed,
+        "conflicts": conflicts,
+        "reordered": reordered,
+        "aborted_cycles": cycle_aborts,
+        "schedule_sha256": sched_hash.hexdigest() if wave else None,
+        "exchange_bytes_total": exchange_bytes,
+        "exchange_bytes_per_window": (
+            round(exchange_bytes / rounds) if rounds else 0
+        ),
+    }
+
+
+def _wire_bytes(t) -> int:
+    """Measured payload size of a wavemesh wire tuple (what the tagged
+    transport would carry, minus framing)."""
+    if isinstance(t, (bytes, bytearray)):
+        return len(t)
+    if isinstance(t, (list, tuple)):
+        return sum(_wire_bytes(x) for x in t)
+    return 8  # int/bool/None: one tagged scalar
+
+
+def run_wave_mesh_ab(
+    seeds: "tuple[int, ...]" = (20260803, 20260804, 20260805),
+    resolver_counts: "tuple[int, ...]" = (1, 2, 4),
+    targets: "tuple[str, ...]" = ("coldest", "hottest"),
+    tolerance: float = 0.05,
+    sim_txns: int = 360,
+    sim_clients: int = 24,
+    sim_keys: int = 12,
+) -> dict:
+    """The WAVE_MESH_AB.json record: gated deterministic schedule-goodput
+    ratios + variance-documented e2e sim goodputs, honesty flags."""
+    from foundationdb_tpu.repair.bench import run_repair_goodput
+
+    rec: dict = {
+        "metric": "wave_mesh_ab",
+        "flag": "FDB_TPU_WAVE_COMMIT x n_resolvers",
+        "platform": "sim",
+        # Honesty flags (bench record conventions): CPU by design — no
+        # TPU run attempted or claimed; count-based goodput has no
+        # wall-clock latency distribution to quote.
+        "cpu_fallback": False,
+        "p99_quotable": False,
+        "p99_note": "deterministic window-count + virtual-time sim "
+                    "goodput; no wall-clock latencies",
+        "tolerance": tolerance,
+        "schedule_goodput": {},
+        "sim_e2e": {},
+    }
+    ok = True
+
+    # -- instrument 1: deterministic schedule goodput (gated at 5%) ----------
+    for target in targets:
+        per_n: dict = {}
+        for n in resolver_counts:
+            arms = {}
+            for wave in (False, True):
+                per_seed = [
+                    run_schedule_goodput(s, n, wave, n_keys=sim_keys,
+                                         target_pick=target)
+                    for s in seeds
+                ]
+                arms["wave" if wave else "naive"] = {
+                    "per_seed": per_seed,
+                    "goodput_mean": round(statistics.mean(
+                        r["goodput_txns_per_window"] for r in per_seed
+                    ), 4),
+                }
+            ratio = round(
+                arms["wave"]["goodput_mean"] / arms["naive"]["goodput_mean"],
+                4,
+            )
+            per_n[str(n)] = {**arms, "wave_vs_naive_ratio": ratio}
+        base_ratio = per_n[str(resolver_counts[0])]["wave_vs_naive_ratio"]
+        # The wave schedules are byte-identical across n on the same seed
+        # (the global protocol reconstructs the exact graph): pin it.
+        for s_i, s in enumerate(seeds):
+            hashes = {
+                n: per_n[str(n)]["wave"]["per_seed"][s_i]["schedule_sha256"]
+                for n in resolver_counts
+            }
+            if len(set(hashes.values())) != 1:
+                ok = False
+                per_n.setdefault("schedule_divergence", {})[str(s)] = hashes
+        for n in resolver_counts[1:]:
+            r = per_n[str(n)]["wave_vs_naive_ratio"]
+            within = r >= (1.0 - tolerance) * base_ratio
+            per_n[str(n)]["within_tolerance_of_single"] = within
+            ok = ok and within
+        per_n["single_resolver_ratio"] = base_ratio
+        rec["schedule_goodput"][target] = per_n
+
+    # -- instrument 2: e2e sim goodput (variance-documented, gated on
+    #    serializability + schedule-identity, NOT on the 5% band) ------------
+    for target in targets:
+        per_n = {}
+        for n in resolver_counts:
+            cells: dict = {"naive_seq": [], "wave_repair": [],
+                           "per_shard_identical": True,
+                           "incomplete_cells": []}
+            for s in seeds:
+                try:
+                    seq = run_repair_goodput(
+                        n_txns=sim_txns, n_clients=sim_clients,
+                        n_keys=sim_keys, seed=s, wave_commit=False,
+                        target_pick=target, n_resolvers=n,
+                    )
+                    wav = run_repair_goodput(
+                        n_txns=sim_txns, n_clients=sim_clients,
+                        n_keys=sim_keys, seed=s, wave_commit=True,
+                        target_pick=target, n_resolvers=n,
+                    )
+                except Exception as e:
+                    # A starved client (retry limit under brutal
+                    # contention) is a real workload outcome on some
+                    # seeds, not a serializability event; record the
+                    # cell honestly instead of vacating the artifact.
+                    cells["incomplete_cells"].append(
+                        {"seed": s, "error": f"{type(e).__name__}: {e}"}
+                    )
+                    continue
+                cells["naive_seq"].append(
+                    seq["naive_full_restart"]["goodput_txns_per_sec"])
+                cells["wave_repair"].append(
+                    wav["repair"]["goodput_txns_per_sec"])
+                if n > 1:
+                    cells["per_shard_identical"] &= bool(
+                        wav["repair"].get("wave_schedule_identical", False)
+                    )
+                    shards = wav["repair"]["per_shard"]
+                    ok = ok and all(sh["wave_batches"] > 0 for sh in shards)
+                ok = ok and seq["repair"]["serializable"] \
+                    and wav["repair"]["serializable"]
+            # At least one completed cell per deployment shape — an ALL-
+            # failed column would quietly drop the e2e evidence.
+            ok = ok and bool(cells["wave_repair"])
+            ratios = [
+                w / nv for w, nv in zip(cells["wave_repair"],
+                                        cells["naive_seq"])
+            ]
+            per_n[str(n)] = {
+                **cells,
+                "cross_ratio_per_seed": [round(r, 3) for r in ratios],
+                # Guarded: an ALL-failed column still emits the honest
+                # valid:false record (the bool gate above) instead of a
+                # StatisticsError vacating the whole artifact.
+                "cross_ratio_median": (
+                    round(statistics.median(ratios), 3) if ratios else None
+                ),
+                "cross_ratio_spread": (
+                    round((max(ratios) - min(ratios)) / max(ratios), 3)
+                    if ratios else None
+                ),
+            }
+            ok = ok and per_n[str(n)]["per_shard_identical"]
+        rec["sim_e2e"][target] = {
+            **per_n,
+            "note": (
+                "virtual-time goodput is retry-tail dominated (measured "
+                "per-run spread ±30-50%); the 5% acceptance band is "
+                "judged on the deterministic schedule_goodput instrument "
+                "above, these timing ratios are reported with their "
+                "spread"
+            ),
+        }
+    rec["valid"] = ok
+    return rec

@@ -28,6 +28,7 @@ from foundationdb_tpu.core.mutations import (
     resolve_versionstamps,
 )
 from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo, Verdict
+from foundationdb_tpu.core.wavemesh import clip_ranges
 from foundationdb_tpu.obs.span import span_sink
 from foundationdb_tpu.repair.hotrange import HotRangeSketch
 from foundationdb_tpu.runtime.backup import BACKUP_TAG
@@ -111,6 +112,8 @@ class CommitProxy:
         authz=None,
         tenant_mirror=None,
         admission=None,
+        wave_commit: bool = False,
+        wave_batch_limit: "int | None" = None,
     ):
         assert resolver_map.n_shards == len(resolver_eps)
         self.loop = loop
@@ -173,6 +176,20 @@ class CommitProxy:
         self._admitting = 0
         self.txns_committed = 0
         self.txns_conflicted = 0
+        # Wave commit (reorder-don't-abort resolvers): with ONE resolver
+        # the schedule rides the ordinary resolve reply; with several,
+        # this proxy runs the two-phase global edge exchange
+        # (resolve_edges → OR-reduce → resolve_apply; core/wavemesh) and
+        # cross-checks that every shard reported the byte-identical
+        # schedule. False = sequential AND-combine, wave replies ignored.
+        self.wave_commit = bool(wave_commit)
+        self.wave_exchanges = 0  # batches resolved via the global protocol
+        # One exchange carries ONE schedule domain: an engine chunks
+        # oversized windows and serializes them through the history,
+        # which a one-shot edge exchange cannot reproduce — so wave
+        # batches are capped at the engine chunk (the resolver raises
+        # loudly past it; None = engine unchunked, e.g. the oracle).
+        self.wave_batch_limit = wave_batch_limit
         # Highest batch version this proxy has seen durable on ALL tlogs;
         # piggybacked on pushes so storage can bound its GC floor
         # (reference: knownCommittedVersion).
@@ -219,6 +236,10 @@ class CommitProxy:
         return {
             "txns_committed": self.txns_committed,
             "txns_conflicted": self.txns_conflicted,
+            # Batches resolved through the global wave edge exchange
+            # (multi-resolver wave commit; 0 on every other config).
+            # getattr: metric-harness stubs build proxies piecemeal.
+            "wave_exchanges": getattr(self, "wave_exchanges", 0),
             "queued": len(self._queue),
             "lanes": self._queue.depths(),
             "lane_promotions": self._queue.promoted,
@@ -282,6 +303,9 @@ class CommitProxy:
                 # BUGGIFY'd COMMIT_TRANSACTION_BATCH_COUNT_MAX).
                 max_batch = 1 if self.loop.buggify("commit_proxy.tiny_batch") \
                     else self.MAX_BATCH
+                if (self.wave_commit and len(self.resolvers) > 1
+                        and self.wave_batch_limit):
+                    max_batch = min(max_batch, self.wave_batch_limit)
                 # Lane-ordered drain: system first, then default, then
                 # batch (with aging) — a system txn is never queued behind
                 # more than the window already forming.
@@ -693,6 +717,10 @@ class CommitProxy:
                 for req, _p in batch
             ]
             per_resolver.append(txns)
+        if self.wave_commit and len(self.resolvers) > 1:
+            return await self._resolve_wave_global(
+                per_resolver, prev_version, version
+            )
         replies = await all_of(
             [
                 self.loop.spawn(
@@ -709,12 +737,13 @@ class CommitProxy:
         # Any shard in fail-safe taints the whole batch's conflict stats:
         # its CONFLICTs are spurious capacity rejections, not contention.
         fail_safe = any(fs for _v, _c, fs, _w in replies)
-        # Wave-commit schedule (reorder-don't-abort resolvers): usable only
-        # from a SINGLE resolver — per-shard schedules of clipped ranges
-        # are not combinable (each resolver misses the others' edges), so
-        # wave engines are forbidden at role-level multi-resolver
-        # (sim/cluster.new_conflict_set enforces it) and the AND path below
-        # keeps the reference abort semantics.
+        # Wave-commit schedule on THIS (sequential AND-combine) path:
+        # usable only from a SINGLE resolver — a per-shard schedule of
+        # clipped ranges is not serializable (each resolver misses the
+        # others' edges). Multi-resolver wave deployments never reach
+        # here (the global edge-exchange path above owns them); this
+        # guard is the pinned regression that the clipped-graph path can
+        # NEVER emit a wave schedule, even from a rogue reply.
         wave = replies[0][3] if len(replies) == 1 and not fail_safe else None
         for i in range(len(batch)):
             vs = [verdicts[i] for verdicts, _conf, _fs, _w in replies]
@@ -732,6 +761,86 @@ class CommitProxy:
             else:
                 combined.append(Verdict.COMMITTED)
         return combined, conflicting, fail_safe, wave
+
+    async def _resolve_wave_global(
+        self,
+        per_resolver: list[list[TxnConflictInfo]],
+        prev_version: int,
+        version: int,
+    ) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
+        """Two-phase global wave commit across sharded resolvers: fan out
+        resolve_edges (each shard's clipped gate + packed predecessor
+        bitsets), OR-reduce them into the global conflict graph (exact —
+        shards partition the keyspace), broadcast it, and collect every
+        shard's independently computed schedule. The schedules must be
+        BYTE-IDENTICAL (the leveling is a deterministic function of the
+        shared graph); a divergence means an unserializable apply order
+        is possible, so the batch fails into commit_unknown_result and
+        recovery rather than committing on either schedule."""
+        from foundationdb_tpu.core.wavemesh import WaveEdges, combine_edges
+
+        edge_wires = await all_of(
+            [
+                self.loop.spawn(
+                    self._with_retry(
+                        lambda r=r, txns=txns: r.resolve_edges(
+                            prev_version, version, txns
+                        )
+                    ),
+                    name=f"resolve_edges@{version}",
+                )
+                for r, txns in zip(self.resolvers, per_resolver)
+            ]
+        )
+        if all(t == ("empty",) for t in edge_wires):
+            # Idle heartbeat window: every shard advanced its chain in
+            # phase 1; nothing to level, order, or apply.
+            return [], {}, False, []
+        graph = combine_edges([WaveEdges.from_wire(t) for t in edge_wires])
+        gw = graph.to_wire()
+        replies = await all_of(
+            [
+                self.loop.spawn(
+                    self._with_retry(
+                        lambda r=r: r.resolve_apply(version, gw)
+                    ),
+                    name=f"resolve_apply@{version}",
+                )
+                for r in self.resolvers
+            ]
+        )
+        self.wave_exchanges += 1
+        # Fail-safe FIRST: a shard-local capacity event during apply
+        # (true overflow — _post_resolve_check) legitimately makes that
+        # shard's reply an all-CONFLICT with no schedule, which is a
+        # DESIGNED degraded mode, not a divergence. The batch conflicts
+        # wholesale (no shard's paint became durable for its clients;
+        # partial paints on the healthy shards only add spurious
+        # conflicts later, the standing failure contract) — exactly the
+        # sequential path's fail-safe handling, no recovery.
+        fail_safe = any(fs for _v, _c, fs, _w in replies)
+        if fail_safe:
+            fs_reply = next(r for r in replies if r[2])
+            return list(fs_reply[0]), {}, True, None
+        first = replies[0]
+        for k, rep in enumerate(replies[1:], 1):
+            if rep[3] != first[3] or rep[0] != first[0]:
+                trace(self.loop).event(
+                    "WaveScheduleDivergence", Severity.ERROR,
+                    version=version, shard=k,
+                )
+                raise RuntimeError(
+                    f"wave schedule divergence at batch@{version}: shard "
+                    f"{k} disagrees with shard 0 — refusing to apply"
+                )
+        conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
+        for _v, conf, _fs, _w in replies:
+            for i, ranges in conf.items():
+                conflicting.setdefault(i, []).extend(ranges)
+        return list(first[0]), conflicting, False, first[3]
 
     def _assemble(
         self,
@@ -780,9 +889,9 @@ class CommitProxy:
 
 
 def _clip(ranges: list[KeyRange], shard: KeyRange) -> list[KeyRange]:
-    out = []
-    for r in ranges:
-        lo, hi = max(r.begin, shard.begin), min(r.end, shard.end)
-        if lo < hi:
-            out.append(KeyRange(lo, hi))
-    return out
+    # ONE clip rule (core/wavemesh.clip_ranges, imported at module level —
+    # this runs per txn per resolver on the commit hot path): the wave
+    # protocol's partition identity depends on this exact boundary
+    # handling, so the proxy split, the A/B harness, and the tests share
+    # the definition.
+    return clip_ranges(ranges, shard.begin, shard.end)

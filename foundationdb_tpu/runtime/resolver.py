@@ -107,6 +107,17 @@ class Resolver:
         # filters; fail-safe batches never feed (their rejections are
         # spurious and their "accepted" set is empty by construction).
         self.admission_filter = admission_filter
+        # Role-level global wave protocol (core/wavemesh): per-version
+        # state between resolve_edges (phase 1 — gate + clipped edge
+        # bitsets, nothing painted) and resolve_apply (phase 2 — level
+        # the proxy's OR-reduced global graph, paint, advance the chain).
+        # The chain version advances at APPLY, so a successor's phase 1
+        # parks on the ordinary _waiters machinery until this window's
+        # schedule lands — no scheduler involvement, retransmits replay
+        # from the caches.
+        self._wave_pending_role: dict[int, dict] = {}
+        self._edge_replies: dict[int, tuple] = {}
+        self.wave_batches = 0  # windows resolved via the global protocol
 
     @rpc
     async def begin_epoch(self, start_version: int) -> int:
@@ -181,6 +192,247 @@ class Resolver:
         if w is not None:
             w.send(None)
         return await reply.future
+
+    # -- role-level global wave commit (core/wavemesh) ------------------------
+    #
+    # With wave commit at n_resolvers > 1, a shard's clipped view cannot
+    # be reordered alone — the commit proxy splits each resolve into two
+    # chain-ordered phases: resolve_edges returns this shard's history
+    # gate + clipped predecessor bitsets (nothing painted), the proxy
+    # OR-reduces every shard's bitsets into the GLOBAL conflict graph
+    # (exact: shards partition the keyspace), and resolve_apply levels
+    # that graph identically on every shard (deterministic rule —
+    # byte-identical (wave, index) schedules), paints the shard's
+    # accepted writes, and advances the version chain.
+
+    @rpc
+    async def resolve_edges(
+        self,
+        prev_version: int,
+        version: int,
+        txns: list[TxnConflictInfo],
+        oldest_version: int | None = None,
+    ) -> tuple:
+        """Phase 1: this shard's clipped gate verdicts + packed
+        predecessor bitsets (wavemesh.WaveEdges wire tuple). The chain
+        position is NOT advanced — that happens at resolve_apply, so a
+        successor batch's phase 1 parks until this window's paint lands
+        and probes a history that includes it."""
+        cached = self._edge_replies.get(version)
+        if cached is not None:
+            return cached  # phase-1 retransmit (lost reply / proxy retry)
+        while self._version != prev_version:
+            if prev_version < self._version:
+                cached = self._edge_replies.get(version)
+                if cached is not None:
+                    return cached
+                raise ValueError(
+                    f"stale resolve_edges: prev={prev_version} < "
+                    f"applied={self._version}"
+                )
+            p = self._waiters.setdefault(prev_version, Promise())
+            await p.future
+            cached = self._edge_replies.get(version)
+            if cached is not None:
+                return cached
+        from foundationdb_tpu.core.wavemesh import WaveEdges
+
+        if not getattr(self.cs, "wave_global_capable", False):
+            raise ValueError(
+                "resolve_edges: this resolver's engine does not implement "
+                "the global wave protocol"
+            )
+        if oldest_version is None:
+            oldest_version = max(0, version - MVCC_WINDOW_VERSIONS)
+        if not txns:
+            # Empty window (idle heartbeat batches — the common case on a
+            # quiet chain): there is no graph to exchange, so the chain
+            # advances HERE and the proxy skips phase 2 entirely — one
+            # round trip, same as the sequential path. The engine is not
+            # touched (the classic path dispatches nothing for zero txns
+            # either).
+            reply = ("empty",)
+            self._cache_edge_reply(version, reply)
+            self._replies[version] = ([], {}, False, [])
+            self._trim_replies()
+            self.batches_resolved += 1
+            self._advance_chain(version)
+            return reply
+        sink = span_sink(self.loop)
+        clock = stage_clock(self.loop) if sink is not None else None
+        t0 = clock() if sink is not None else 0.0
+        fail_safe = self._should_fail_safe(len(txns), version, oldest_version)
+        if fail_safe:
+            import numpy as np
+
+            payload = WaveEdges(
+                count=len(txns),
+                too_old=np.zeros(len(txns), bool),
+                hist_conflict=np.zeros(len(txns), bool),
+                chunks=[],
+                fail_safe=True,
+            )
+        else:
+            payload = self.cs.resolve_edges(txns, version, oldest_version)
+        if sink is not None:
+            sink.stage_tick("device_dispatch", clock() - t0,
+                            n=max(1, len(txns)))
+        self._wave_pending_role[version] = {
+            "txns": txns,
+            "oldest": oldest_version,
+            "fail_safe": fail_safe,
+            "t_edges_done": self.loop.now,
+        }
+        reply = payload.to_wire()
+        self._cache_edge_reply(version, reply)
+        return reply
+
+    def _cache_edge_reply(self, version: int, reply: tuple) -> None:
+        """Bounded phase-1 reply cache (retransmit replay) — trimmed on
+        EVERY insert; the empty-heartbeat fast path is the common case on
+        a quiet chain and must not leak an entry per window."""
+        self._edge_replies[version] = reply
+        if len(self._edge_replies) > self.REPLY_CACHE_SIZE:
+            del self._edge_replies[min(self._edge_replies)]
+
+    @rpc
+    async def resolve_apply(self, version: int, graph_wire: tuple) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
+        """Phase 2: level the combined global graph, paint, advance the
+        chain. Reply shape matches resolve() so the proxy's downstream
+        (verdict combine, hot ranges, wave-ordered apply) is unchanged."""
+        if version <= self._version:
+            cached = self._replies.get(version)
+            if cached is not None:
+                if isinstance(cached, BaseException):
+                    raise cached
+                return cached
+            raise ValueError(
+                f"stale resolve_apply: version={version} <= "
+                f"applied={self._version}"
+            )
+        inflight = self._pending.get(version)
+        if inflight is not None:
+            # Retransmit while the first apply is still executing (reply
+            # lost mid-RPC, proxy retried): share the pending reply, the
+            # same idempotent-retry contract resolve() keeps.
+            return await inflight.future
+        pend = self._wave_pending_role.pop(version, None)
+        if pend is None:
+            raise ValueError(
+                f"resolve_apply@{version} without a matching resolve_edges"
+            )
+        self._pending[version] = inflight = Promise()
+        from foundationdb_tpu.core.wavemesh import WaveGraph
+
+        graph = WaveGraph.from_wire(graph_wire)
+        txns = pend["txns"]
+        sink = span_sink(self.loop)
+        if sink is not None:
+            # The inter-phase gap: proxy-side OR-reduce + both network
+            # legs — the global protocol's comms cost, attributed under
+            # the resolver's device_dispatch umbrella (SUB_STAGES).
+            sink.stage_tick("wave_exchange",
+                            self.loop.now - pend["t_edges_done"],
+                            n=max(1, len(txns)))
+        if self.dispatch_cost_s:
+            await self.loop.sleep(self.dispatch_cost_s)
+        clock = stage_clock(self.loop) if sink is not None else None
+        t0 = clock() if sink is not None else 0.0
+        try:
+            reply = self._apply_entry(version, txns, pend, graph)
+        except BaseException as e:  # noqa: BLE001 — fail the RPC waiter
+            self._replies[version] = e
+            self._trim_replies()
+            self._pending.pop(version, None)
+            inflight.fail(e)
+            self._advance_chain(version)
+            raise
+        if sink is not None:
+            dur = clock() - t0 + self.dispatch_cost_s
+            n = max(1, len(txns))
+            sink.stage_tick("wave_level", dur, n=n)
+            sink.stage_tick("device_dispatch", dur, n=n)
+        self._replies[version] = reply
+        self._trim_replies()
+        self._pending.pop(version, None)
+        inflight.send(reply)
+        self._advance_chain(version)
+        return reply
+
+    def _advance_chain(self, version: int) -> None:
+        self._version = version
+        w = self._waiters.pop(version, None)
+        if w is not None:
+            w.send(None)
+
+    def _apply_entry(
+        self, version: int, txns: list[TxnConflictInfo], pend: dict, graph
+    ) -> tuple:
+        """Phase-2 body: verdicts + schedule from the global graph, with
+        the same counter/hot-range/filter bookkeeping as _resolve_entry."""
+        oldest_version = pend["oldest"]
+        fail_safe = bool(pend["fail_safe"] or graph.fail_safe)
+        wave: list[int] | None = None
+        if fail_safe:
+            if pend["fail_safe"]:
+                # Locally engaged: the engine never saw phase 1 — advance
+                # its GC floor exactly like the single-phase fail-safe.
+                if hasattr(self.cs, "advance"):
+                    self.cs.advance(version, oldest_version)
+                    self._headroom = self.cs.headroom()
+            elif getattr(self.cs, "_wave_pending", None) is not None:
+                # Another shard engaged: drop this shard's un-painted
+                # phase-1 state (painting nothing IS the fail-safe
+                # contract; the floor advances with the next window).
+                self.cs.resolve_abandon()
+            verdicts = [Verdict.CONFLICT] * len(txns)
+            self.txns_rejected_fail_safe += len(txns)
+        else:
+            verdicts = self.cs.resolve_apply(graph)
+            wave = getattr(self.cs, "last_wave", None)
+            if self._post_resolve_check(version):
+                verdicts = [Verdict.CONFLICT] * len(txns)
+                self.txns_rejected_fail_safe += len(txns)
+                fail_safe = True
+                wave = None
+        exact = None if fail_safe else getattr(self.cs, "last_conflicting",
+                                               None)
+        conflicting: dict[int, list[tuple[bytes, bytes]]] = {}
+        for i, (t, v) in enumerate(zip(txns, verdicts)):
+            if v != Verdict.CONFLICT:
+                continue
+            ranges = exact.get(i) if exact else None
+            if ranges is None:
+                ranges = [r for r in t.read_ranges if not r.empty]
+            pairs = [(r.begin, r.end) for r in ranges]
+            if not fail_safe and pairs:
+                self.hot_ranges.record(pairs)
+            if t.report_conflicting_keys and pairs:
+                conflicting[i] = pairs
+        if not fail_safe:
+            self.txns_conflicted += sum(
+                1 for v in verdicts if v == Verdict.CONFLICT
+            )
+            if self.admission_filter is not None:
+                keys = [
+                    bytes(w.begin)
+                    for t, v in zip(txns, verdicts)
+                    if v == Verdict.COMMITTED
+                    for w in t.write_ranges if not w.empty
+                ]
+                self.admission_filter.record(keys, version)
+        if wave is not None:
+            self.txns_reordered += self.cs.last_reordered
+            self.txns_cycle_aborted += sum(
+                1 for lv in wave if lv == WAVE_LEVEL_CYCLE
+            )
+            self.wave_batches += 1
+        self.batches_resolved += 1
+        self.txns_resolved += len(txns)
+        return (verdicts, conflicting, fail_safe, wave)
 
     async def _dispatch_group(self, group: list[_QueuedBatch]) -> None:
         """Scheduler dispatch callback: resolve a consecutive run of
@@ -450,6 +702,10 @@ class Resolver:
             "txns_reordered": self.txns_reordered,
             "txns_cycle_aborted": self.txns_cycle_aborted,
             "txns_conflicted": self.txns_conflicted,
+            # Windows resolved through the role-level global wave
+            # protocol (resolve_edges/resolve_apply) — per-shard, so a
+            # sharded deployment's status shows every shard exchanging.
+            "wave_batches": self.wave_batches,
             "history_headroom": self._headroom,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,

@@ -246,17 +246,28 @@ def tls_config(spec: dict, spec_path: str) -> dict | None:
             for k, v in tls.items()}
 
 
+#: One exchange carries ONE schedule domain: the commit proxies cap
+#: multi-resolver wave batches at the deployed engine's chunk.
+#: make_conflict_set builds TPUConflictSet with its DEFAULT batch_size --
+#: this constant mirrors that default in the proxy process (which must
+#: not import the jax engine just to read a number); the resolver's
+#: resolve_edges refuses oversized windows loudly if the two ever drift.
+DEPLOYED_WAVE_BATCH_LIMIT = 512
+
+
 def make_conflict_set(engine: str, n_resolvers: int = 1):
     """Resolver engine: 'tpu' is the production kernel; 'cpu' (C++ skiplist)
     keeps a cluster deployable on hosts with no accelerator.
 
     ``n_resolvers`` is the DEPLOYMENT's resolver role count (the spec's
     resolver list), not this process's: wave commit (FDB_TPU_WAVE_COMMIT=1)
-    reorders within one engine's view, so it must see every conflict edge
-    of its window — per-shard wave schedules over clipped ranges are not
-    combinable, and a multi-resolver deployment with the flag set must
-    refuse recruitment rather than silently un-serialize (the sim cluster
-    enforces the same rule)."""
+    at n_resolvers > 1 is a CAPABILITY check — engines implementing the
+    global edge-exchange protocol (resolve_edges/resolve_apply over
+    core/wavemesh: tpu, oracle) reorder against the OR-reduced global
+    graph the commit proxies assemble, so sharded deployments are legal;
+    the cpu skiplist never materializes the conflict graph and must
+    refuse recruitment rather than silently un-serialize (the sim
+    cluster enforces the same rule)."""
     from foundationdb_tpu.core.types import (
         validate_wave_commit,
         wave_commit_env_default,
@@ -265,7 +276,8 @@ def make_conflict_set(engine: str, n_resolvers: int = 1):
     wave = wave_commit_env_default()
     if wave:
         validate_wave_commit(
-            n_resolvers, "cpu" if engine == "cpu" else None
+            n_resolvers, "cpu" if engine == "cpu" else None,
+            wave_global_capable=engine in ("tpu", "oracle"),
         )
     if engine == "tpu":
         from foundationdb_tpu.models.conflict_set import TPUConflictSet
@@ -613,6 +625,8 @@ class Worker:
         controller_ep = self.t.endpoint(
             parse_addr(self.spec["controller"][0]), "controller")
         storage_map = storage_shard_map(self.spec)
+        from foundationdb_tpu.core.types import wave_commit_env_default
+
         proxy = CommitProxy(
             self.loop, seq_ep, resolver_eps,
             KeyShardMap.uniform(len(resolver_eps)), tlog_eps,
@@ -622,6 +636,8 @@ class Worker:
             tenant_mirror=_make_tenant_mirror(
                 self.loop, self.t, self.spec, storage_map, self._spawn),
             admission=_make_admission_policy(),
+            wave_commit=wave_commit_env_default(),
+            wave_batch_limit=DEPLOYED_WAVE_BATCH_LIMIT,
         )
         proxy.backup_enabled = backup_enabled
         proxy.locked = locked
@@ -1624,6 +1640,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
         seq_ep = t.endpoint(seq_addr, "sequencer")
         rk = spec.get("ratekeeper") or []
         rk_ep = t.endpoint(parse_addr(rk[0]), "ratekeeper") if rk else None
+        from foundationdb_tpu.core.types import wave_commit_env_default
+
         proxy = CommitProxy(
             loop, seq_ep, eps("resolver"), resolver_map,
             eps("tlog"), storage_map,
@@ -1632,6 +1650,8 @@ def build_role(loop: RealLoop, t: NetTransport, spec: dict, role: str,
                 loop, t, spec, storage_map,
                 lambda name, mk: _supervise(loop, name, mk)),
             admission=_make_admission_policy(),
+            wave_commit=wave_commit_env_default(),
+            wave_batch_limit=DEPLOYED_WAVE_BATCH_LIMIT,
         )
         # Static wiring: epoch 0 = unfenced (no recruitment protocol).
         # GrvProxy skips the per-batch confirm_epoch fan-out at epoch 0 —

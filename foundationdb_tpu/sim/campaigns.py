@@ -283,6 +283,49 @@ async def run_checks(spec: CampaignSpec, ctx: NemesisContext) -> dict:
             raise CampaignCheckFailed(
                 f"only {got} txns sampled < required {n} — the tracing "
                 "composition this campaign gates never happened")
+    # Wave-commit gates (ISSUE 13): counters read off the CURRENT
+    # generation's resolvers — after a ResolverKill-driven recovery these
+    # are the POST-RECOVERY shards, so crossing the minimums proves the
+    # re-formed chain kept exchanging and reordering. Under the global
+    # protocol every shard's schedule-derived counters must also AGREE
+    # (byte-identical schedules), gated unconditionally whenever a wave
+    # minimum is requested on a multi-resolver cluster.
+    wave_keys = (("waveReorderedMin", "txns_reordered"),
+                 ("waveCycleAbortedMin", "txns_cycle_aborted"),
+                 ("waveBatchesMin", "wave_batches"))
+    if any(k in checks for k, _ in wave_keys):
+        resolvers = list(getattr(ctx.cluster, "resolvers", []))
+        shard_counts = [
+            {attr: getattr(r, attr) for _k, attr in wave_keys}
+            for r in resolvers
+        ]
+        out["wave_per_shard"] = shard_counts
+        # Counter identity only holds on fail-safe-free runs: a shard-
+        # local capacity fail-safe during apply skips that shard's
+        # counters for the (wholesale-rejected) window by design.
+        fail_safed = any(
+            getattr(r, "txns_rejected_fail_safe", 0) for r in resolvers
+        )
+        if fail_safed:
+            out["wave_counter_identity"] = "skipped: fail-safe engaged"
+        elif len(shard_counts) > 1 and any(
+            s != shard_counts[0] for s in shard_counts[1:]
+        ):
+            raise CampaignCheckFailed(
+                f"per-shard wave counters diverge (schedules were not "
+                f"byte-identical): {shard_counts}"
+            )
+        for key, attr in wave_keys:
+            n = checks.pop(key, None)
+            if n is None:
+                continue
+            got = shard_counts[0][attr] if shard_counts else 0
+            out[attr] = got
+            if got < n:
+                raise CampaignCheckFailed(
+                    f"{attr}={got} < required {n} — the wave composition "
+                    "this campaign gates never happened (post-recovery)"
+                )
     n = checks.pop("repairRoundsMin", None)
     if n is not None:
         rounds = sum(

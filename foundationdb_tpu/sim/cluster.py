@@ -39,6 +39,13 @@ from foundationdb_tpu.core.types import (
 from foundationdb_tpu.sim.network import SimNetwork
 
 
+#: Engines implementing the role-level global wave protocol
+#: (resolve_edges/resolve_apply — core/wavemesh): legal under
+#: wave_commit at ANY resolver count. The cpp skiplist never
+#: materializes the conflict graph and refuses wave commit outright.
+WAVE_GLOBAL_CAPABLE_ENGINES = frozenset({"oracle", "oracle-replay", "tpu"})
+
+
 def new_conflict_set(engine: str, wave_commit: bool | None = None):
     """Conflict-engine factory (the ``newConflictSet()`` seam).
 
@@ -183,15 +190,20 @@ class SimCluster:
         self.resolver_budget_s = resolver_budget_s
         self.resolver_dispatch_cost_s = resolver_dispatch_cost_s
         # Wave-commit resolve mode (reorder-don't-abort; None = the
-        # FDB_TPU_WAVE_COMMIT env default). A wave engine reorders txns
-        # within its own view, so it must see EVERY conflict edge of its
-        # window: role-level multi-resolver deployments clip ranges per
-        # key shard and would reorder against incomplete graphs — refuse
-        # the combination rather than silently un-serialize.
+        # FDB_TPU_WAVE_COMMIT env default). Multi-resolver wave commit is
+        # a CAPABILITY check, not a blanket refusal: engines implementing
+        # the global edge-exchange protocol (resolve_edges/resolve_apply
+        # — oracle, oracle-replay, tpu) reorder against the OR-reduced
+        # GLOBAL graph at any resolver count; the cpp skiplist never
+        # materializes the graph and still refuses.
         self.wave_commit = (_wave_commit_default() if wave_commit is None
                             else bool(wave_commit))
         if self.wave_commit:
-            _validate_wave_commit(n_resolvers=n_resolvers)
+            _validate_wave_commit(
+                n_resolvers=n_resolvers,
+                skiplist_engine="cpp" if engine == "cpp" else None,
+                wave_global_capable=engine in WAVE_GLOBAL_CAPABLE_ENGINES,
+            )
         # Admission-time early conflict detection (admission subsystem;
         # None = the FDB_TPU_ADMISSION env default, off by default): each
         # generation's resolvers get a recent-writes filter (the
@@ -752,6 +764,15 @@ class SimCluster:
                 authz=self.authz,
                 tenant_mirror=self.tenant_mirror,
                 admission=new_admission_policy(),
+                wave_commit=self.wave_commit,
+                # One exchange = one schedule domain: cap wave batches at
+                # the recruited engines' OWN chunk (derived, not
+                # re-stated — a drifted constant would hit resolve_edges'
+                # loud per-window refusal under load); oracle engines are
+                # unchunked (None).
+                wave_batch_limit=getattr(
+                    self.resolvers[0].cs, "batch_size", None
+                ),
             )
             for _ in range(self.n_proxies)
         ]

@@ -138,6 +138,49 @@ class ProcessKiller(Nemesis):
         cluster.net.kill(victim)
 
 
+class ResolverKill(Nemesis):
+    """Kill one RESOLVER of the current generation, anchored mid-traffic.
+
+    The wave-commit composition this exists for (ISSUE 13): under the
+    role-level global wave protocol a resolver dies BETWEEN edge
+    exchanges — in-flight batches lose a shard mid-two-phase, the commit
+    proxy's retries break, the batch fails into commit_unknown_result,
+    and recovery re-forms the chain with fresh resolvers whose NEXT
+    windows must again produce byte-identical global schedules (the
+    campaign gates exact reordered/cycle counters accumulated AFTER the
+    recovery). ``after_acked`` anchors the kill on the workloads' shared
+    acked counter so it provably lands mid-stream."""
+
+    name = "resolver_kill"
+
+    def __init__(self, index: "int | None" = None, after_acked: int = 0,
+                 **kw):
+        kw.setdefault("count", 1)
+        super().__init__(**kw)
+        self.index = index
+        self.after_acked = after_acked
+        self.kills: list[str] = []
+
+    async def fire(self, ctx: NemesisContext):
+        cluster = ctx.cluster
+        while ctx.counters.get("acked", 0) < self.after_acked:
+            if ctx.stopped:
+                return False
+            await ctx.loop.sleep(0.02)
+        gen = cluster.controller.generation
+        victims = sorted(p for p in gen.heartbeat_eps if "resolver" in p)
+        if not victims:
+            return False
+        idx = (self.index if self.index is not None
+               else ctx.loop.rng.randrange(len(victims)))
+        victim = victims[idx % len(victims)]
+        self.kills.append(victim)
+        ctx.bump("kills")
+        ctx.bump("resolver_kills")
+        ctx.record(self.name, victim=victim)
+        cluster.net.kill(victim)
+
+
 class StorageReboot(Nemesis):
     """Kill a random storage server's process, then revive it after
     ``down_s`` and restart its pull loop — the machine-reboot mode where
@@ -891,6 +934,9 @@ NEMESIS_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
     "Kill": (ProcessKiller, {
         **_COMMON, "maxKills": "max_kills",
         "includeController": "include_controller",
+    }),
+    "ResolverKill": (ResolverKill, {
+        **_COMMON, "index": "index", "afterAcked": "after_acked",
     }),
     "StorageReboot": (StorageReboot, {**_COMMON, "downSeconds": "down_s"}),
     "PairPartition": (PairPartition, {**_COMMON, "length": "length"}),

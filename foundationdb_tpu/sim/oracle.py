@@ -30,6 +30,14 @@ from foundationdb_tpu.core.types import (
     TxnConflictInfo,
     Verdict,
 )
+from foundationdb_tpu.core.wavemesh import (
+    WaveEdges,
+    level_wave_graph,
+    pack_pred_rows,
+    schedule_graph,
+    unpack_pred_rows,
+    verdicts_from_schedule,
+)
 
 
 class OracleConflictSet:
@@ -47,6 +55,16 @@ class OracleConflictSet:
         # the commits past wave 0 (same contract as TPUConflictSet).
         self.last_wave: list[int] | None = None
         self.last_reordered: int | None = None
+        # Role-level global wave protocol (core/wavemesh): resolve_edges
+        # stashes the window here until resolve_apply paints it.
+        self._wave_pending: "tuple | None" = None
+
+    @property
+    def wave_global_capable(self) -> bool:
+        """Wave-commit oracles implement the two-phase global protocol
+        (resolve_edges/resolve_apply), so sharded multi-resolver wave
+        deployments are legal with this engine."""
+        return self.wave_commit
 
     def resolve(
         self,
@@ -95,7 +113,6 @@ class OracleConflictSet:
     ) -> list[Verdict]:
         n = len(txns)
         self.last_conflicting = {}
-        level = [LEVEL_NONE] * n
         verdicts: list[Verdict | None] = [None] * n
         reads = [[r for r in t.read_ranges if not r.empty] for t in txns]
         writes = [[w for w in t.write_ranges if not w.empty] for t in txns]
@@ -133,19 +150,10 @@ class OracleConflictSet:
             for j in cand
         }
 
-        undet = set(cand)
-        wave = 0
-        while undet:
-            ready = sorted(j for j in undet if not (pred[j] & undet))
-            if ready:
-                for j in ready:
-                    level[j] = wave
-                wave += 1
-                undet.difference_update(ready)
-            else:
-                victim = self._cycle_victim(pred, undet, n)
-                level[victim] = LEVEL_CYCLE
-                undet.discard(victim)
+        # Deterministic leveling — ONE implementation (core/wavemesh,
+        # replaying the kernel's _wave_level_packed rule byte-for-byte)
+        # shared with the role-level global-graph apply path below.
+        level = level_wave_graph(n, cand, pred)
 
         committed_writes = [w for j in cand if level[j] >= 0 for w in writes[j]]
         for i in cand:
@@ -175,26 +183,115 @@ class OracleConflictSet:
         self.last_reordered = sum(1 for lv in level if lv > 0)
         return verdicts  # type: ignore[return-value]
 
-    @staticmethod
-    def _cycle_victim(pred: dict[int, set[int]], undet: set[int],
-                      n: int) -> int:
-        """The kernel's deterministic exactly-on-a-cycle victim rule
-        (conflict_kernel._cycle_victim), replayed on the host: from the
-        lowest-index stuck txn, follow the minimum-index undetermined
-        predecessor n steps (entering the walk's unique terminal cycle),
-        then n more tracking the minimum index visited — at least one
-        full loop, so the result is that cycle's minimum member. Any step
-        count exceeding every entry distance and cycle length yields the
-        same victim, which is why the kernel's padded-size walk and this
-        n-step walk agree byte-for-byte."""
-        j = min(undet)
-        for _ in range(n):
-            j = min(pred[j] & undet)
-        m = j
-        for _ in range(n):
-            j = min(pred[j] & undet)
-            m = min(m, j)
-        return m
+    # -- role-level global wave protocol (core/wavemesh) ---------------------
+
+    def _gate_and_pred(self, txns: list[TxnConflictInfo]):
+        """(too_old, hist_conflict, hist_losers, pred): this shard's
+        clipped gate + predecessor sets over LOCAL candidates — phase 1's
+        raw material, shared with nothing else so the single-shard
+        _resolve_wave stays byte-identical to its history."""
+        n = len(txns)
+        reads = [[r for r in t.read_ranges if not r.empty] for t in txns]
+        writes = [[w for w in t.write_ranges if not w.empty] for t in txns]
+        too_old = [
+            bool(reads[i] and t.read_version < self.oldest_version)
+            for i, t in enumerate(txns)
+        ]
+        hist_losers = [
+            [] if too_old[i] else [
+                r for r in reads[i]
+                if any(r.overlaps(w) and v > txns[i].read_version
+                       for (w, v) in self.history)
+            ]
+            for i in range(n)
+        ]
+        hist_conflict = [bool(h) for h in hist_losers]
+        cand = [
+            i for i in range(n) if not too_old[i] and not hist_conflict[i]
+        ]
+        pred = {
+            j: {
+                i for i in cand
+                if i != j and any(
+                    r.overlaps(w) for r in reads[i] for w in writes[j]
+                )
+            }
+            for j in cand
+        }
+        return too_old, hist_conflict, hist_losers, pred
+
+    def resolve_edges(
+        self,
+        txns: list[TxnConflictInfo],
+        commit_version: int,
+        oldest_version: int | None = None,
+    ) -> WaveEdges:
+        """Phase 1: gate this shard's clipped view and pack its clipped
+        predecessor bitsets; nothing is painted until resolve_apply."""
+        if not self.wave_global_capable:
+            raise ValueError("resolve_edges requires a wave-commit oracle")
+        if self._wave_pending is not None:
+            raise ValueError(
+                "resolve_edges with an apply outstanding (version chain)"
+            )
+        import numpy as np
+
+        if oldest_version is not None:
+            self.oldest_version = max(self.oldest_version, oldest_version)
+        too_old, hist_conflict, hist_losers, pred = self._gate_and_pred(txns)
+        n = len(txns)
+        self._wave_pending = (txns, commit_version, hist_losers)
+        return WaveEdges(
+            count=n,
+            too_old=np.asarray(too_old, bool),
+            hist_conflict=np.asarray(hist_conflict, bool),
+            chunks=[(n, pack_pred_rows(pred, n))] if n else [],
+        )
+
+    def resolve_abandon(self) -> None:
+        """Drop a pending resolve_edges without painting (fail-safe
+        elsewhere in the deployment rejected the window)."""
+        self._wave_pending = None
+
+    def resolve_apply(self, graph) -> list[Verdict]:
+        """Phase 2: level the combined GLOBAL graph with the shared
+        deterministic rule, paint this shard's clipped accepted writes,
+        publish last_wave/last_reordered. Every shard receives the same
+        graph, so every shard reports the identical schedule."""
+        if self._wave_pending is None:
+            raise ValueError("resolve_apply without a pending resolve_edges")
+        txns, commit_version, hist_losers = self._wave_pending
+        self._wave_pending = None
+        levels, reordered = schedule_graph(graph)
+        verdicts = verdicts_from_schedule(graph, levels)
+        reads = [[r for r in t.read_ranges if not r.empty] for t in txns]
+        writes = [[w for w in t.write_ranges if not w.empty] for t in txns]
+        committed_writes = [
+            w for i in range(len(txns)) if levels[i] >= 0 for w in writes[i]
+        ]
+        self.last_conflicting = {}
+        for i, t in enumerate(txns):
+            if verdicts[i] != Verdict.CONFLICT or not t.report_conflicting_keys:
+                continue
+            if hist_losers[i]:
+                self.last_conflicting[i] = list(hist_losers[i])
+                continue
+            lost = [
+                r for r in reads[i]
+                if any(r.overlaps(w) for w in committed_writes)
+            ]
+            # Only losers THIS shard can witness are reported (the proxy
+            # unions the shards); a txn gated purely elsewhere reports
+            # nothing here.
+            if lost:
+                self.last_conflicting[i] = lost
+        self.history.extend((w, commit_version) for w in committed_writes)
+        self.history = [
+            (w, v) for (w, v) in self.history if v > self.oldest_version
+        ]
+        self.last_wave = levels
+        self.last_reordered = reordered
+        return verdicts
 
 
 class ReplayCheckedOracle(OracleConflictSet):
@@ -233,6 +330,30 @@ class ReplayCheckedOracle(OracleConflictSet):
         )
         return verdicts
 
+    def resolve_apply(self, graph) -> list[Verdict]:
+        """Global-wave phase 2 with inline verification of everything
+        THIS SHARD can witness, plus the graph-global cycle rule:
+
+        - serial-order replay over the shard's CLIPPED ranges — a
+          committed txn's reads must overlap no pre-batch write past its
+          read version and no earlier-ordered committed write. Every
+          overlap in the keyspace is clipped into the shard(s) owning
+          those keys, so all shards passing their local replays IS a
+          proof of global serializability;
+        - edge-respect on local edges (level(i) < level(j));
+        - cycle-only aborts on the GLOBAL graph (the shard holds the
+          OR-reduced matrix — a cross-shard cycle is checkable here even
+          though no single shard's ranges witness all its edges)."""
+        history_before = list(self.history)
+        floor_before = self.oldest_version
+        txns = self._wave_pending[0] if self._wave_pending else []
+        verdicts = super().resolve_apply(graph)
+        replay_wave_schedule(
+            txns, verdicts, self.last_wave, history_before, floor_before,
+            global_graph=graph,
+        )
+        return verdicts
+
 
 def replay_wave_schedule(
     txns: list[TxnConflictInfo],
@@ -240,6 +361,7 @@ def replay_wave_schedule(
     levels: list[int],
     history: list[tuple[KeyRange, int]],
     oldest_version: int = 0,
+    global_graph=None,
 ) -> None:
     """Sequentially replay a wave schedule and raise AssertionError on any
     serializability violation — the acceptance check behind the wave-commit
@@ -290,25 +412,43 @@ def replay_wave_schedule(
                 )
     # Cycle-only aborts: every intra-batch CONFLICT must lie on a cycle of
     # the candidate graph (candidates = txns passing the history gate).
-    cand = [
-        i for i, v in enumerate(verdicts)
-        if v != Verdict.TOO_OLD and not (
-            reads[i] and any(
-                r.overlaps(w) and v2 > txns[i].read_version
-                for r in reads[i] for (w, v2) in history
+    # Under the role-level global protocol the candidate set and the edge
+    # set are GLOBAL (``global_graph``: a txn gated on another shard is
+    # no candidate here, and a cycle may thread edges through several
+    # shards' keyspace slices) — the local recomputation below would
+    # misjudge both.
+    if global_graph is not None:
+        cand = [i for i in range(global_graph.count) if global_graph.cand[i]]
+        cset = set(cand)
+        pred = {}
+        start = 0
+        for nc, m in global_graph.chunks:
+            for j, preds in unpack_pred_rows(m, nc).items():
+                if start + j in cset:
+                    pred[start + j] = {
+                        start + i for i in preds if start + i in cset
+                    }
+            start += nc
+    else:
+        cand = [
+            i for i, v in enumerate(verdicts)
+            if v != Verdict.TOO_OLD and not (
+                reads[i] and any(
+                    r.overlaps(w) and v2 > txns[i].read_version
+                    for r in reads[i] for (w, v2) in history
+                )
             )
-        )
-    ]
-    cset = set(cand)
-    pred = {
-        j: {
-            i for i in cand
-            if i != j and any(
-                r.overlaps(w) for r in reads[i] for w in writes[j]
-            )
+        ]
+        cset = set(cand)
+        pred = {
+            j: {
+                i for i in cand
+                if i != j and any(
+                    r.overlaps(w) for r in reads[i] for w in writes[j]
+                )
+            }
+            for j in cand
         }
-        for j in cand
-    }
     for i in cand:
         if verdicts[i] != Verdict.CONFLICT:
             continue
@@ -326,7 +466,9 @@ def _on_cycle(i: int, pred: dict[int, set[int]], nodes: set[int]) -> bool:
     stack, seen = [i], set()
     while stack:
         j = stack.pop()
-        for k in pred.get(j, ()) & nodes:
+        # frozenset default: the global-graph path hands a SPARSE pred
+        # (entries only for txns with at least one predecessor bit).
+        for k in pred.get(j, frozenset()) & nodes:
             if k == i:
                 return True
             if k not in seen:

@@ -139,6 +139,9 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
         "theta": "theta",
         "readsPerTransaction": "reads_per_txn",
         "repair": "repair",
+        # hottest = mutual hot-key RMW (cycle-heavy); coldest =
+        # read-hot-write-cold chains (the wave-reorderable shape).
+        "targetPick": "target_pick",
     }),
     "WriteDuringRead": (WriteDuringReadWorkload, {
         "keyCount": "n_keys",
@@ -219,6 +222,12 @@ CLUSTER_KEY_MAP = {
     # (campaigns gate span-tree completeness under faults with it).
     "obs": "obs",
     "obsSampleEvery": "obs_sample_every",
+    # Wave commit (reorder-don't-abort resolve; with resolvers > 1 the
+    # role-level global edge-exchange protocol) and the engine behind it
+    # — campaigns gating wave counters pin engine = 'oracle-replay' so
+    # every schedule is sequentially replay-verified inline.
+    "waveCommit": "wave_commit",
+    "engine": "engine",
 }
 
 

@@ -2103,7 +2103,13 @@ class ZipfRepairWorkload(Workload):
                 else:
                     await self._run_txn(db, body)
                 self.metrics.ops += 1
+                if ctx is not None:
+                    # Campaign traffic anchor (shared with WriteStorm /
+                    # FailoverZipfRepair): actions with afterAcked land
+                    # provably mid-stream of THIS workload too.
+                    ctx.bump("acked")
 
+        ctx = getattr(cluster, "nemesis_ctx", None)
         await all_of([
             cluster.loop.spawn(client(i), name=f"zipf.client{i}")
             for i in range(self.n_clients)

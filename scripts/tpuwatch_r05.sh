@@ -150,6 +150,16 @@ while true; do
     'r.get("metric") == "wave_commit_ab" and r.get("valid")' -- \
     env OUT=WAVE_AB_r05_rec.json bash scripts/wave_ab.sh \
     || { sleep 60; continue; }
+  # Mesh wave-commit A/B (global reorder across sharded resolvers):
+  # deterministic schedule-goodput at n_resolvers in {1,2,4} — wave
+  # ratio within 5% of single-resolver, byte-identical schedules across
+  # shards (sha256-pinned) — plus variance-documented e2e sim goodputs
+  # with replay-checked serializability (the artifact's `valid` gates
+  # all of it).
+  stage ab_wave_mesh 1800 WAVE_MESH_AB_r05.json \
+    'r.get("metric") == "wave_mesh_ab" and r.get("valid")' -- \
+    env OUT=WAVE_MESH_AB_r05_rec.json bash scripts/wave_mesh_ab.sh \
+    || { sleep 60; continue; }
   # Admission A/B (admission-time early conflict detection): CPU-only
   # deterministic sim — FDB_TPU_ADMISSION off vs on on the same seeds,
   # replay-checked oracle serializability both sides, mean naive-loop

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Mesh wave-commit A/B (ISSUE 13): does scaling out resolvers give the
+# reorder-don't-abort goodput win back? One process
+# (bench.py --wave-mesh-ab → repair/wave_mesh.run_wave_mesh_ab) runs two
+# instruments over the same seeds and merges one WAVE_MESH_AB.json:
+#
+# 1. Deterministic schedule-goodput (THE GATED COMPARISON): a seeded
+#    Zipf RMW stream replayed as retry-until-commit resolve windows
+#    directly against the engines at n_resolvers ∈ {1, 2, 4} — wave arms
+#    run the role-level global edge-exchange protocol with
+#    replay-checked oracle shards; goodput = txns/windows is an exact
+#    count. Gate: every mesh ratio within 5% of the single-resolver
+#    wave/naive ratio AND byte-identical wave schedules (sha256 over
+#    every window's levels) across all resolver counts.
+# 2. End-to-end SimCluster goodput per (n_resolvers, flag, seed):
+#    variance-documented (virtual-time goodput is retry-tail dominated;
+#    per-run spread ±30-50% measured) — gated on replay-checked
+#    serializability, per-shard schedule-identical counters, and
+#    wave_batches > 0 on every shard, NOT on the 5% band.
+#
+# Honesty flags: pure simulation, CPU by design (cpu_fallback=false — no
+# TPU claimed), no wall-clock latency distribution (p99_quotable=false).
+#
+#   OUT=WAVE_MESH_AB.json scripts/wave_mesh_ab.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-WAVE_MESH_AB.json}
+LOG=${LOG:-wave_mesh_ab.log}
+
+TMP=$(mktemp /tmp/_wave_mesh_ab.XXXXXX)
+trap 'rm -f "$TMP"' EXIT
+env JAX_PLATFORMS=cpu python bench.py --wave-mesh-ab > "$TMP" 2>> "$LOG"
+rc=$?
+if [ $rc -ne 0 ]; then
+  # A failed/invalid run must not ship an artifact a done-check could
+  # mistake for the acceptance record.
+  echo "wave_mesh_ab: bench.py --wave-mesh-ab failed rc=$rc (see $LOG)" >&2
+  exit $rc
+fi
+tail -n 1 "$TMP" > "$OUT"
+cat "$OUT"

@@ -453,26 +453,35 @@ class TestRuntimePlumbing:
         reordered = proxy._assemble(batch, verdicts, 7, wave=[1, 0])
         assert [m.param2 for m in reordered[0]] == [b"B", b"A"]
 
-    def test_sim_cluster_wave_plumbing_and_multi_resolver_refusal(self):
+    def test_sim_cluster_wave_plumbing_and_capability_check(self):
+        """ISSUE 13: the blanket n_resolvers>1 refusal became a
+        CAPABILITY check — engines implementing the global edge-exchange
+        protocol (oracle, tpu) deploy sharded; the cpp skiplist (no
+        conflict graph, no protocol) still refuses outright."""
         from foundationdb_tpu.sim.cluster import SimCluster
 
         c = SimCluster(seed=3, engine="oracle", wave_commit=True)
         assert all(r.cs.wave_commit for r in c.resolvers)
-        with pytest.raises(ValueError, match="single-resolver"):
-            SimCluster(seed=3, engine="oracle", n_resolvers=2,
-                       wave_commit=True)
+        c2 = SimCluster(seed=3, engine="oracle", n_resolvers=2,
+                        wave_commit=True)
+        assert all(r.cs.wave_global_capable for r in c2.resolvers)
+        assert all(p.wave_commit for p in c2.commit_proxies)
         with pytest.raises(ValueError, match="cpp"):
             SimCluster(seed=3, engine="cpp", wave_commit=True)
 
-    def test_deployed_factory_refuses_wave_multi_resolver(self, monkeypatch):
+    def test_deployed_factory_wave_capability_check(self, monkeypatch):
         from foundationdb_tpu.server import make_conflict_set
 
         monkeypatch.setenv("FDB_TPU_WAVE_COMMIT", "1")
-        with pytest.raises(ValueError, match="single-resolver"):
-            make_conflict_set("oracle", n_resolvers=2)
+        # Capable engines construct at any resolver count (the global
+        # protocol); the cpu skiplist still refuses.
+        cs = make_conflict_set("oracle", n_resolvers=2)
+        assert cs.wave_commit and cs.wave_global_capable
         assert make_conflict_set("oracle", n_resolvers=1).wave_commit
         with pytest.raises(ValueError, match="cpu skiplist"):
             make_conflict_set("cpu", n_resolvers=1)
+        with pytest.raises(ValueError, match="cpu skiplist"):
+            make_conflict_set("cpu", n_resolvers=2)
         monkeypatch.setenv("FDB_TPU_WAVE_COMMIT", "0")
         assert make_conflict_set("oracle", n_resolvers=2).wave_commit is False
 

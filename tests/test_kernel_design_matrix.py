@@ -85,6 +85,110 @@ assert not cs.overflowed
 print("MATRIX-OK")
 """
 
+_MESH_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):
+    pass
+from foundationdb_tpu.utils import enable_compilation_cache
+enable_compilation_cache()
+import numpy as np
+from foundationdb_tpu.models import conflict_kernel as ck
+from foundationdb_tpu.models.conflict_set import TPUConflictSet
+from foundationdb_tpu.parallel.sharded_resolver import ShardedConflictSet
+from foundationdb_tpu.sim.oracle import OracleConflictSet
+from tests.test_conflict_oracle import rand_txn
+
+assert ck._PACKED == (os.environ.get("FDB_TPU_PACKED", "1") != "0")
+assert ck._RESIDENT == (
+    os.environ.get("FDB_TPU_RESIDENT", "1") != "0" and ck._PACKED
+)
+assert ck._WAVE_COMMIT == (
+    os.environ.get("FDB_TPU_WAVE_COMMIT", "0") == "1"
+)
+n_shards = int(os.environ["MESH_SHARDS"])
+reshard = os.environ.get("MESH_RESHARD") == "1"
+
+rng = np.random.default_rng(31 + n_shards)
+kw = dict(capacity=512, batch_size=16, max_read_ranges=4,
+          max_write_ranges=4, max_key_bytes=8)
+mesh = ShardedConflictSet(
+    n_shards=n_shards, auto_reshard=reshard,
+    **({"reshard_interval": 2, "reshard_skew": 1.0} if reshard else {}),
+    **kw)
+single = TPUConflictSet(**kw)
+oracle = OracleConflictSet(wave_commit=ck._WAVE_COMMIT)
+cv = 1000
+for batch_i in range(8):
+    cv += int(rng.integers(1, 40))
+    txns = [
+        rand_txn(rng, read_version=int(rng.integers(max(0, cv - 200), cv)),
+                 alphabet=256, max_len=5)
+        for _ in range(int(rng.integers(2, 17)))
+    ]
+    oldest = cv - 150
+    got = mesh.resolve(txns, cv, oldest_version=oldest)
+    want = single.resolve(txns, cv, oldest_version=oldest)
+    oracle.oldest_version = max(oracle.oldest_version, oldest)
+    worac = oracle.resolve(txns, cv)
+    assert got == want == worac, f"batch {batch_i}: {got} {want} {worac}"
+    if ck._WAVE_COMMIT:
+        assert mesh.last_wave == single.last_wave == oracle.last_wave, (
+            f"batch {batch_i} wave levels"
+        )
+if ck._WAVE_COMMIT:
+    st = mesh.exchange_stats()
+    assert st["wave_batches"] == 8 and st["tiles_occupied"] > 0, st
+assert not mesh.overflowed
+print("MESH-MATRIX-OK")
+"""
+
+
+# ISSUE-13 rows: WAVE_COMMIT=1 x n_resolvers in {2,4} x PACKED=1 x
+# RESIDENT in {0,1}, 3-way parity (mesh x single x oracle incl. wave
+# levels), plus the auto-reshard-mid-stream schedule-parity row.
+_MESH_ROWS = [
+    {"FDB_TPU_WAVE_COMMIT": "1", "FDB_TPU_RESIDENT": "1",
+     "MESH_SHARDS": "2"},
+    {"FDB_TPU_WAVE_COMMIT": "1", "FDB_TPU_RESIDENT": "0",
+     "MESH_SHARDS": "2"},
+    {"FDB_TPU_WAVE_COMMIT": "1", "FDB_TPU_RESIDENT": "1",
+     "MESH_SHARDS": "4"},
+    {"FDB_TPU_WAVE_COMMIT": "1", "FDB_TPU_RESIDENT": "0",
+     "MESH_SHARDS": "4"},
+    {"FDB_TPU_WAVE_COMMIT": "1", "FDB_TPU_RESIDENT": "1",
+     "MESH_SHARDS": "2", "MESH_RESHARD": "1"},
+]
+
+
+@pytest.mark.parametrize(
+    "flags", _MESH_ROWS,
+    ids=lambda f: ",".join(f"{k.replace('FDB_TPU_', '')}={v}"
+                           for k, v in f.items()),
+)
+def test_mesh_wave_design_rows(flags):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **flags)
+    for k in ["FDB_TPU_WAVE_COMMIT", "FDB_TPU_RESIDENT", "FDB_TPU_PACKED",
+              "MESH_RESHARD"]:
+        env.pop(k, None)
+    env.update(flags)
+    r = subprocess.run(
+        [sys.executable, "-c", _MESH_CHILD], env=env, capture_output=True,
+        text=True, timeout=600, cwd=_REPO,
+    )
+    assert r.returncode == 0, f"{flags}: {r.stderr[-2000:]}"
+    assert r.stdout.strip().splitlines()[-1] == "MESH-MATRIX-OK"
+
+
 _FLAGS = {
     "FDB_TPU_RMQ": ("sparse", "blocked"),
     "FDB_TPU_HISTORY": ("window", "batch"),
