@@ -18,8 +18,16 @@ This package makes that measurable honestly:
   SCHEDULED times, measures latency from the scheduled arrival (coordinated-
   omission correct), counts shed load explicitly, aggregates into mergeable
   log-binned histograms.
-- deploy.py    — SocketCluster: spawn/teardown of a real multi-process
-  cluster (python -m foundationdb_tpu.server per role) over TCP.
+- deploy.py    — SocketCluster: spawn/teardown AND role-level supervision
+  of a real multi-process cluster (python -m foundationdb_tpu.server per
+  role) over TCP: per-role persistent data dirs, kill/pause/restart of
+  individual roles, interposing TCP relays for socket-level partitions,
+  crash-aware leak checking (the fdbmonitor analogue).
+- chaos.py     — the deployed chaos battery: seeded real-process fault
+  scripts (SIGKILL each role class, partition-then-heal, SIGSTOP) against
+  a live open-loop workload, gated on an exact acked-commit ledger,
+  exactly-once markers, post-heal consistency, and per-stage recovery
+  MTTR (scripts/chaos_run.sh -> CHAOS.json).
 - __main__.py  — one generator process (several are aggregated by bench).
 - bench.py     — the published curves: txns/s vs proxy-process count and
   p99 commit latency vs offered load through and past saturation, plus the

@@ -20,6 +20,7 @@ FDB_TPU_OBS_SAMPLE (default 64 — sample 1-in-N transactions).
 """
 
 from foundationdb_tpu.obs.registry import (
+    CHAOS_DOCUMENTED_COUNTERS,
     DOCUMENTED_COUNTERS,
     MetricsPoller,
     MetricsRegistry,
@@ -43,6 +44,7 @@ from foundationdb_tpu.obs.span import (
 )
 
 __all__ = [
+    "CHAOS_DOCUMENTED_COUNTERS",
     "DOCUMENTED_COUNTERS",
     "MetricsPoller",
     "MetricsRegistry",

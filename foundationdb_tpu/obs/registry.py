@@ -54,6 +54,29 @@ DOCUMENTED_COUNTERS = (
     "tlog.queue_entries",
     "storage.version_lag",
     "ratekeeper.tps_limit",
+    # Recovery MTTR counters (deployed chaos subsystem): exported by BOTH
+    # controllers — runtime/cluster.py (sim) and server.py
+    # DeployedController — under identical names, zeros before the first
+    # recovery (so the audit holds on a healthy cluster too).
+    "controller.recovery_count",
+    "controller.recovery_lock_s",
+    "controller.recovery_salvage_s",
+    "controller.recovery_recruit_s",
+    "controller.recovery_total_s",
+)
+
+#: counters the deployed chaos harness (loadgen/chaos.py) contributes to
+#: ITS scrape under the `chaos` role — documented/pinned like the core
+#: set, but only expected in chaos-run scrapes (a plain cluster has no
+#: chaos harness to export them), so they ride `missing_documented`'s
+#: `extra` parameter instead of the always-on tuple.
+CHAOS_DOCUMENTED_COUNTERS = (
+    "chaos.chaos_faults_injected",
+    "chaos.chaos_kills",
+    "chaos.chaos_restarts",
+    "chaos.chaos_partitions",
+    "chaos.chaos_heals",
+    "chaos.chaos_pauses",
 )
 
 
@@ -131,11 +154,14 @@ class MetricsRegistry:
                     break
         return problems
 
-    def missing_documented(self) -> list[str]:
+    def missing_documented(self, extra: tuple = ()) -> list[str]:
         """Documented counters absent from this scrape (prefix match on
-        the aggregated keys)."""
+        the aggregated keys). `extra`: additional documented names this
+        scrape's scope must also carry (e.g. CHAOS_DOCUMENTED_COUNTERS
+        for a chaos-run scrape)."""
         agg = self.aggregated()
-        return [c for c in DOCUMENTED_COUNTERS if c not in agg]
+        return [c for c in DOCUMENTED_COUNTERS + tuple(extra)
+                if c not in agg]
 
     # -- emission ------------------------------------------------------------
 
@@ -197,6 +223,9 @@ async def scrape_sim(cluster) -> MetricsRegistry:
     if cluster.ratekeeper_ep is not None:
         probe("ratekeeper", cluster.ratekeeper_ep,
               cluster.ratekeeper_ep.get_rates())
+    ctrl_ep = getattr(cluster, "controller_ep", None)
+    if ctrl_ep is not None:
+        probe("controller", ctrl_ep, ctrl_ep.get_metrics())
     for role, inst, task in probes:
         reg.add(role, inst, await task)
 
@@ -230,6 +259,7 @@ def scrape_deployed(loop, t, spec: dict) -> MetricsRegistry:
         ("tlog", "tlog", "metrics"),
         ("storage", "storage", "metrics"),
         ("ratekeeper", "ratekeeper", "get_rates"),
+        ("controller", "controller", "get_metrics"),
     ):
         for i, addr in enumerate(spec.get(role) or []):
             plans.append((service, f"{service}{i}", addr, method))

@@ -80,6 +80,11 @@ class ClusterController:
         self.recoveries_completed = 0
         self._recovering = False
         self._deposed = False
+        # Last completed recovery's per-stage MTTR breakdown (same
+        # vocabulary as the deployed controller's recovery_log entries —
+        # server.py): surfaced via get_metrics as the documented
+        # recovery_* counters.
+        self.last_recovery: dict = {}
 
     def bootstrap(self, epoch: int = 1, recovery_version: int = 0,
                   seed_entries: list | None = None) -> None:
@@ -131,6 +136,23 @@ class ClusterController:
             "reign": self.reign,
         }
 
+    @rpc
+    async def get_metrics(self) -> dict:
+        """Registry scrape surface (obs/registry.py `controller.*`): the
+        documented recovery_* counters — count plus the last recovery's
+        per-stage MTTR breakdown, zeros before the first recovery (the
+        deployed controller exports the identical names)."""
+        last = self.last_recovery
+        return {
+            "recovery_count": self.recoveries_completed,
+            "recovery_lock_s": last.get("lock_s", 0.0),
+            "recovery_salvage_s": last.get("salvage_s", 0.0),
+            "recovery_recruit_s": last.get("recruit_s", 0.0),
+            "recovery_total_s": last.get("total_s", 0.0),
+            "recovering": self._recovering,
+            "epoch": self.generation.epoch if self.generation else 0,
+        }
+
     # -- failure detection ----------------------------------------------------
 
     async def run(self) -> None:
@@ -180,10 +202,14 @@ class ClusterController:
             if not await self._confirm_leadership():
                 return
             old = self.generation
+            t_detect = self.loop.now
             while True:
                 try:
+                    stages: dict = {}
+                    t_attempt = self.loop.now
                     self.generation = await recover(
-                        self.loop, old, self.recruiter, epoch=old.epoch + 1
+                        self.loop, old, self.recruiter, epoch=old.epoch + 1,
+                        stage_log=stages,
                     )
                     await self._publish_generation()
                     if self._deposed:
@@ -197,6 +223,21 @@ class ClusterController:
                     if retire is not None:
                         retire()
                     self.recoveries_completed += 1
+                    # The deployed controller's accrual rule (server.py
+                    # _recover): failed-attempt/wait time accrues to the
+                    # stage being retried (lock — RecoveryFailed means
+                    # locking/salvage never held), publish/retire time
+                    # to recruit, so lock+salvage+recruit == total and
+                    # the identically named counters mean the same
+                    # thing in sim and deployed scrapes.
+                    stages["lock_s"] = round(
+                        stages.get("lock_s", 0.0) + (t_attempt - t_detect),
+                        6)
+                    stages["recruit_s"] = round(
+                        self.loop.now - t_detect - stages["lock_s"]
+                        - stages.get("salvage_s", 0.0), 6)
+                    stages["total_s"] = round(self.loop.now - t_detect, 6)
+                    self.last_recovery = stages
                     return
                 except RecoveryFailed:
                     # Not enough of the old generation reachable to determine

@@ -28,6 +28,7 @@ import heapq
 import selectors
 import socket
 import struct
+import threading
 import time
 
 _SOFT_ERRNOS = (errno.EAGAIN, errno.EINPROGRESS, errno.ENOTCONN, errno.EALREADY)
@@ -38,8 +39,8 @@ from foundationdb_tpu.runtime.flow import (
     BrokenPromise, Future, Loop, Promise, rpc,
 )
 
-__all__ = ["RealLoop", "NetTransport", "RemoteEndpoint", "rpc", "rpc_methods",
-           "MAX_FRAME"]
+__all__ = ["RealLoop", "NetTransport", "RemoteEndpoint", "TcpRelay", "rpc",
+           "rpc_methods", "MAX_FRAME"]
 
 _LEN = struct.Struct("<I")
 _REQ, _RSP = 0, 1
@@ -160,6 +161,8 @@ class _Conn:
         self.wbuf = bytearray()
         self.frames_queued = 0  # coalescing ratio = frames_queued/flushes
         self.flushes = 0
+        self.got_bytes = False  # ever received data (dial-health signal)
+        self.outbound_addr: "tuple | None" = None  # set by _connect
         self.pending: dict[int, Promise] = {}  # requests sent on this conn
         self.closed = False
         self.tls = None
@@ -203,6 +206,13 @@ class _Conn:
             if not data:
                 self.close()
                 return
+            if not self.got_bytes:
+                self.got_bytes = True
+                if self.outbound_addr is not None:
+                    # The peer is demonstrably alive: reset its dial
+                    # backoff NOW (not at conn close) so a recovered
+                    # process doesn't keep paying a stale suppression.
+                    self.t._dial_backoff.pop(self.outbound_addr, None)
             if self.tls is not None:
                 self._in_bio.write(bytes(data))
                 if not self._step_tls():
@@ -379,6 +389,19 @@ class NetTransport:
         # to OUTBOUND calls from this process; installed via the admin
         # service's inject_fault RPC (server.py).
         self._fault_rules: dict[tuple, dict] = {}
+        # Reconnect backoff per remote addr: after consecutive dials
+        # that died without EVER delivering a byte (dead/partitioned
+        # peer), further dials are suppressed for a bounded jittered
+        # window — failing fast with the same BrokenPromise observable
+        # a dead connection gives. Without this, every retry loop in
+        # every client slot re-dials a dead proxy at full rate (a SYN
+        # storm against the process fdbmonitor is about to restart).
+        # addr -> [consecutive_failures, suppressed_until (loop.now)].
+        self._dial_backoff: dict[tuple, list] = {}
+        # In-flight request registrations by id(future) -> (conn, msg_id),
+        # pruned when the future completes: lets abandon_call() drop the
+        # pending-reply entry of an RPC its caller timed out on.
+        self._call_sites: dict[int, tuple] = {}
         self._tls_server_ctx = self._tls_client_ctx = None
         if tls:
             import ssl as _ssl
@@ -440,10 +463,21 @@ class NetTransport:
     def endpoint(self, addr: tuple, service: str) -> RemoteEndpoint:
         return RemoteEndpoint(self, tuple(addr), service)
 
+    #: reconnect backoff: suppression starts at the 2nd consecutive
+    #: byte-less dial failure, doubles, and is jittered + capped.
+    DIAL_BACKOFF_BASE = 0.05
+    DIAL_BACKOFF_CAP = 2.0
+
     def _connect(self, addr: tuple) -> _Conn:
         conn = self._conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
+        rule = self._dial_backoff.get(addr)
+        if rule is not None and self.loop.now < rule[1]:
+            raise BrokenPromise(
+                f"connect to {addr} suppressed for "
+                f"{rule[1] - self.loop.now:.2f}s (reconnect backoff after "
+                f"{rule[0]} failed dials)")
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setblocking(False)
         try:
@@ -452,11 +486,23 @@ class NetTransport:
             pass  # completes asynchronously; sends queue in wbuf meanwhile
         except OSError:
             sock.close()  # synchronous failure: don't leak the fd
+            self._note_dial_failed(addr)
             raise
         conn = _Conn(self, sock, server_side=False)
+        conn.outbound_addr = addr
         self._conns[addr] = conn
         self._all_conns.add(conn)
         return conn
+
+    def _note_dial_failed(self, addr: tuple) -> None:
+        fails = self._dial_backoff.get(addr, [0, 0.0])[0] + 1
+        delay = 0.0
+        if fails >= 2:
+            # Jitter BEFORE the cap: the cap is the contract's bound.
+            delay = min(self.DIAL_BACKOFF_CAP,
+                        self.DIAL_BACKOFF_BASE * (1 << min(fails - 2, 16))
+                        * (0.5 + self.loop.rng.random()))
+        self._dial_backoff[addr] = [fails, self.loop.now + delay]
 
     FAULT_DETECT_DELAY = 1.0  # dropped call → BrokenPromise after this
 
@@ -522,6 +568,10 @@ class NetTransport:
             frame = wire.dumps(msg + (kwargs,) if kwargs else msg)
             conn = self._connect(addr)
             conn.pending[msg_id] = p
+            key = id(p.future)
+            self._call_sites[key] = (conn, msg_id)
+            p.future.add_done_callback(
+                lambda _f: self._call_sites.pop(key, None))
             try:
                 conn.send_frame(frame)
             except FdbError:
@@ -533,6 +583,21 @@ class NetTransport:
             p.fail(FdbError(f"unserializable RPC argument: {e}", code=1500))
         except FdbError as e:  # incl. BrokenPromise, oversized-frame
             p.fail(e)
+
+    def abandon_call(self, fut) -> bool:
+        """Forget an in-flight request whose caller has given up on the
+        reply (server.bounded_rpc timeout over a black-holed link, where
+        the connection stays open so nothing ever fails the promise):
+        drops the conn's pending-reply registration, so an hour-long
+        partition probed at 1 Hz cannot accumulate one pending promise
+        per sweep. A reply that still arrives after heal is dropped by
+        _on_frame ('a request we gave up on')."""
+        site = self._call_sites.pop(id(fut), None)
+        if site is None:
+            return False
+        conn, msg_id = site
+        conn.pending.pop(msg_id, None)
+        return True
 
     # -- dispatch ---------------------------------------------------------
 
@@ -610,6 +675,13 @@ class NetTransport:
         for addr, c in list(self._conns.items()):
             if c is conn:
                 del self._conns[addr]
+        if conn.outbound_addr is not None:
+            if conn.got_bytes:
+                # The peer was genuinely up: a later death is news, not
+                # a dead-dial streak — reset the backoff ladder.
+                self._dial_backoff.pop(conn.outbound_addr, None)
+            else:
+                self._note_dial_failed(conn.outbound_addr)
 
     def close(self) -> None:
         self.loop.unregister(self._listener)
@@ -619,3 +691,188 @@ class NetTransport:
             pass
         for conn in list(self._all_conns):
             conn.close()
+
+
+class TcpRelay:
+    """Interposing TCP relay: the deployed chaos harness's partition
+    injector (the socket-level twin of sim/network.py's partition/clog).
+
+    The relay sits BETWEEN a role process and everyone who dials it: the
+    cluster spec advertises the relay's listen address while the role
+    binds a private port (server.py --bind), so every connection to the
+    role — clients, peers, the controller's heartbeats — crosses the
+    relay. Unlike the admin inject_fault rule (installed INSIDE the
+    victim, outbound-only, gone when the process dies), the relay lives
+    in the harness process and cuts BOTH directions of a link no matter
+    what state the role is in (running, SIGSTOPped, dead).
+
+    Modes:
+    - ``pass``      splice bytes both ways (transparent)
+    - ``drop``      black hole: connections stay OPEN but no byte moves —
+                    peers' RPCs hang exactly like a packets-vanish
+                    partition (nothing is read, so no data is lost and a
+                    later heal resumes the frame stream intact)
+    - ``cut``       connection death: every live splice is closed and new
+                    connections are accepted-then-closed (peers observe
+                    resets/EOF — the crashed-link observable)
+    - ``delay``     forward each chunk after ``delay_s`` (a clogged link)
+
+    Thread-based on purpose: the harness's event loop is busy driving
+    the workload, and a relay must keep cutting links even while that
+    loop is blocked in a long client call."""
+
+    BUF = 1 << 16
+    POLL_S = 0.05  # mode-change latency while parked in drop mode
+
+    def __init__(self, target: tuple, host: str = "127.0.0.1",
+                 port: int = 0, mode: str = "pass", delay_s: float = 0.05):
+        self.target = (target[0], int(target[1]))
+        self._mode = mode
+        self.delay_s = float(delay_s)
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(self.POLL_S)
+        self.addr = self._listener.getsockname()
+        self._pairs: set[tuple] = set()  # (client_sock, upstream_sock)
+        self._lock = threading.Lock()
+        self._closed = False
+        self.conns_accepted = 0
+        self.bytes_forwarded = 0
+        self._accepter = threading.Thread(
+            target=self._accept_loop, name=f"relay-accept:{self.addr[1]}",
+            daemon=True)
+        self._accepter.start()
+
+    # -- control (harness-facing; thread-safe) ---------------------------
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def set_mode(self, mode: str, delay_s: "float | None" = None) -> None:
+        if mode not in ("pass", "drop", "cut", "delay"):
+            raise ValueError(f"unknown relay mode {mode!r}")
+        if delay_s is not None:
+            self.delay_s = float(delay_s)
+        self._mode = mode
+        if mode == "cut":
+            self._close_pairs()
+
+    def heal(self) -> None:
+        self.set_mode("pass")
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._close_pairs()
+
+    def _close_pairs(self) -> None:
+        with self._lock:
+            pairs, self._pairs = set(self._pairs), set()
+        for a, b in pairs:
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # -- data plane ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, _peer = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            self.conns_accepted += 1
+            if self._mode == "cut":
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                upstream = socket.create_connection(self.target, timeout=5.0)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            pair = (client, upstream)
+            with self._lock:
+                self._pairs.add(pair)
+            for src, dst in ((client, upstream), (upstream, client)):
+                threading.Thread(
+                    target=self._splice, args=(pair, src, dst),
+                    name=f"relay-splice:{self.addr[1]}", daemon=True,
+                ).start()
+
+    def _send_all(self, dst: socket.socket, data: bytes) -> bool:
+        """sendall that tolerates the POLL_S socket timeout both splice
+        threads leave on the pair (a slow receiver must backpressure,
+        not kill the link) AND honors a drop installed mid-chunk: the
+        unsent remainder stalls until heal, or a partition's first
+        moment could leak up to a chunk of bytes through a thread
+        parked here. False → connection is gone."""
+        off = 0
+        while off < len(data):
+            if self._closed or self._mode == "cut":
+                return False
+            if self._mode == "drop":
+                time.sleep(self.POLL_S)
+                continue
+            try:
+                off += dst.send(data[off:])
+            except socket.timeout:
+                continue
+            except OSError:
+                return False
+        return True
+
+    def _splice(self, pair, src: socket.socket, dst: socket.socket) -> None:
+        src.settimeout(self.POLL_S)
+        try:
+            while not self._closed:
+                mode = self._mode
+                if mode == "drop":
+                    # Park WITHOUT reading: the sender's bytes stay queued
+                    # (kernel buffers, then the sender blocks) so a heal
+                    # resumes the stream with nothing lost — a relay that
+                    # read-and-discarded would desync the frame stream
+                    # the moment the partition healed.
+                    time.sleep(self.POLL_S)
+                    continue
+                try:
+                    data = src.recv(self.BUF)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not data:
+                    break
+                # Re-check AFTER the recv: a drop installed while this
+                # thread was parked in recv() must stall bytes in hand
+                # (forwarded only on heal — held, never lost), or the
+                # first ~POLL_S of every partition would leak.
+                while self._mode == "drop" and not self._closed:
+                    time.sleep(self.POLL_S)
+                if self._closed or self._mode == "cut":
+                    break
+                if self._mode == "delay":
+                    time.sleep(self.delay_s)
+                if not self._send_all(dst, data):
+                    break
+                self.bytes_forwarded += len(data)
+        finally:
+            with self._lock:
+                self._pairs.discard(pair)
+            for s in pair:
+                try:
+                    s.close()
+                except OSError:
+                    pass

@@ -43,7 +43,13 @@ class RecoveryFailed(FdbError):
     code = 1203
 
 
-async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generation:
+async def recover(loop: Loop, old: Generation, recruiter, epoch: int,
+                  stage_log: "dict | None" = None) -> Generation:
+    """`stage_log` (optional out-param): filled with the per-stage MTTR
+    durations `lock_s`/`salvage_s`/`recruit_s` — the same breakdown the
+    deployed controller records (server.py recovery_log), so sim and
+    deployed recoveries report one vocabulary."""
+    t0 = loop.now
     trace(loop).event("MasterRecoveryState", state="locking_tlogs",
                       epoch=epoch, old_tlogs=len(old.tlog_eps))
     # 1+2. Lock reachable tlogs; take the max frozen end version. Locks go
@@ -64,6 +70,7 @@ async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generat
                           epoch=epoch, reason="no_tlog_reachable")
         raise RecoveryFailed(f"epoch {epoch}: no old-generation tlog reachable")
     recovery_version, source_ep = max(locked, key=lambda e: e[0])
+    t_locked = loop.now
     trace(loop).event("MasterRecoveryState", state="salvaging", epoch=epoch,
                       recovery_version=recovery_version, locked=len(locked))
 
@@ -74,6 +81,7 @@ async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generat
         raise RecoveryFailed(
             f"epoch {epoch}: tlog died between lock and salvage"
         ) from None
+    t_salvaged = loop.now
 
     # 4. Recruit the next generation (also re-points storage servers).
     gen = recruiter.recruit_generation(
@@ -82,4 +90,8 @@ async def recover(loop: Loop, old: Generation, recruiter, epoch: int) -> Generat
     trace(loop).event("MasterRecoveryState", state="accepting_commits",
                       epoch=epoch, recovery_version=recovery_version,
                       salvaged=len(seed_entries))
+    if stage_log is not None:
+        stage_log["lock_s"] = round(t_locked - t0, 6)
+        stage_log["salvage_s"] = round(t_salvaged - t_locked, 6)
+        stage_log["recruit_s"] = round(loop.now - t_salvaged, 6)
     return gen

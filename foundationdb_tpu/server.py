@@ -390,6 +390,50 @@ def _supervise(loop: RealLoop, name: str, make_coro):
     loop.spawn(_supervised(loop, name, make_coro), name=f"supervise.{name}")
 
 
+async def bounded_rpc(loop: RealLoop, fut, timeout_s: float,
+                      transport=None):
+    """Await an RPC future for at most `timeout_s`; a timeout raises
+    TimeoutError. A BLACK-HOLED link (packets vanish, connection stays
+    up — the chaos relay's drop mode, a wedged peer, a SIGSTOPped
+    process) otherwise hangs the await forever: a dead process at least
+    closes its sockets and fails pending calls with BrokenPromise, but a
+    black-holed one fails nothing — and a controller sweep or recovery
+    lock stuck on one such link would never heal the cluster. Every
+    failure-detection and recovery RPC in DeployedController goes
+    through this bound so a hung link is indistinguishable from a dead
+    one (which is exactly how the caller must treat it). Passing the
+    NetTransport lets a timeout also ABANDON the request
+    (transport.abandon_call): without that, a long partition probed
+    every sweep accumulates one never-answered pending promise per
+    probe on the still-open connection."""
+    from foundationdb_tpu.runtime.flow import Promise
+
+    p = Promise()
+
+    async def timer():
+        await loop.sleep(timeout_s)
+        if not p.future.done():
+            p.send(None)
+
+    timer_task = loop.spawn(timer(), name="rpc.deadline")
+
+    def on_done(f):
+        if not p.future.done():
+            p.send(f)
+        # Reap the deadline timer NOW: at chaos/sweep call rates,
+        # letting every completed call's timer sleep out its full
+        # timeout parks thousands of dead coroutines on the loop.
+        timer_task.cancel()
+
+    fut.add_done_callback(on_done)
+    f = await p.future
+    if f is None:
+        if transport is not None:
+            transport.abandon_call(fut)
+        raise TimeoutError(f"rpc exceeded {timeout_s}s (hung link?)")
+    return f.result()
+
+
 class Worker:
     """Per-process recruitment surface for managed clusters (reference: the
     fdbserver worker the ClusterController recruits roles onto —
@@ -706,6 +750,12 @@ class DeployedController:
     HEARTBEAT_INTERVAL = 1.0
     RETRY_DELAY = 0.5
     BOOT_DEADLINE = 120.0
+    #: per-RPC bound on failure-detection probes (sweep, rejoin, zombie,
+    #: region-flip, probe_live): a black-holed link answers like a dead one.
+    PROBE_TIMEOUT = 2.5
+    #: per-RPC bound on recovery-path calls (lock, salvage, recruit —
+    #: salvage can carry a real payload; recruits rebuild role state).
+    RECOVERY_RPC_TIMEOUT = 15.0
 
     def __init__(self, loop: RealLoop, t: NetTransport, spec: dict,
                  data_dir: str | None):
@@ -719,6 +769,11 @@ class DeployedController:
         self.live: dict[str, list[int]] = {}
         self.recoveries_completed = 0
         self._recovering = False
+        # Per-recovery MTTR breakdown (the deployed chaos harness's
+        # primary observable): one entry per completed recovery with
+        # wall-clock detection stamp + per-stage durations
+        # (detection -> lock -> salvage -> accepting-commits).
+        self.recovery_log: list[dict] = []
         # Database flags cached from proxy describes (sweep + pre-recovery
         # probe) and re-applied at recruit_proxy — the deployed analogue
         # of the sim recruiter reading cluster.backup_active/db_locked.
@@ -808,7 +863,12 @@ class DeployedController:
     async def _retry(self, make_call, deadline: float):
         while True:
             try:
-                return await make_call()
+                # Per-attempt bound: a black-holed worker must fail the
+                # attempt (and be retried / recovery re-planned), not
+                # absorb the whole recovery into one hung await.
+                return await bounded_rpc(self.loop, make_call(),
+                                         self.RECOVERY_RPC_TIMEOUT,
+                                         transport=self.t)
             except Exception:
                 if self.loop.now > deadline:
                     raise
@@ -847,6 +907,41 @@ class DeployedController:
             "epoch": self.epoch,
             "proxy_addrs": self._addrs("proxy", self.live.get("proxy", [])),
         }
+
+    @rpc
+    async def get_metrics(self) -> dict:
+        """Registry scrape surface (obs/registry.py `controller.*`): the
+        documented recovery_* counters — recovery count plus the LAST
+        recovery's per-stage MTTR breakdown (seconds). Zeros until the
+        first recovery so the documented-counter audit holds on a
+        freshly booted cluster too."""
+        last = self.recovery_log[-1] if self.recovery_log else {}
+        return {
+            "recovery_count": self.recoveries_completed,
+            "recovery_lock_s": last.get("lock_s", 0.0),
+            "recovery_salvage_s": last.get("salvage_s", 0.0),
+            "recovery_recruit_s": last.get("recruit_s", 0.0),
+            "recovery_total_s": last.get("total_s", 0.0),
+            "recovering": self._recovering,
+            "epoch": self.epoch,
+        }
+
+    @rpc
+    async def get_recovery_log(self) -> list:
+        """Every completed recovery's MTTR entry (chaos harness: matched
+        against fault-injection wall stamps to attribute detection
+        latency per fault)."""
+        return list(self.recovery_log)
+
+    def _probe(self, role: str, i: int, method: str = "describe"):
+        """A failure-detection RPC task, time-bounded (PROBE_TIMEOUT) so
+        black-holed links count as failures instead of wedging the
+        sweep/recovery forever."""
+        fut = getattr(self._worker(role, i), method)()
+        return self.loop.spawn(
+            bounded_rpc(self.loop, fut, self.PROBE_TIMEOUT,
+                        transport=self.t),
+            name=f"probe.{role}{i}.{method}")
 
     @rpc
     async def set_excluded(self, role: str, index: int,
@@ -932,7 +1027,7 @@ class DeployedController:
         live_tlogs, live_sats, max_epoch = [], [], 0
         for i in range(len(self.spec["tlog"])):
             try:
-                d = await self._worker("tlog", i).describe()
+                d = await self._probe("tlog", i)
                 if d.get("epoch", 0) > 0:
                     live_tlogs.append(i)
                     max_epoch = max(max_epoch, d["epoch"])
@@ -940,7 +1035,7 @@ class DeployedController:
                 continue
         for i in range(len(self.spec.get("satellite_tlog") or [])):
             try:
-                d = await self._worker("satellite_tlog", i).describe()
+                d = await self._probe("satellite_tlog", i)
                 if d.get("epoch", 0) > 0:
                     live_sats.append(i)
                     max_epoch = max(max_epoch, d["epoch"])
@@ -964,10 +1059,13 @@ class DeployedController:
             return
         await self._bootstrap_resume()
 
-    async def _bootstrap_resume(self) -> None:
+    async def _bootstrap_resume(self) -> float:
         """Resume tlog chains from disk (or start blank). Only safe when no
         recruited tlog is live — callers check first (appends racing the
-        end-version snapshot would be truncated as 'unacked')."""
+        end-version snapshot would be truncated as 'unacked'). Returns
+        the monotonic stamp at the end of the disk-salvage phase
+        (tlog_resume + truncate, just before generation forming) — the
+        disk-resume recovery's salvage/recruit MTTR boundary."""
         deadline = self.loop.now + self.BOOT_DEADLINE
         chain = self._chain_tlog_idx()  # active region only: the standby's
         # disks hold retired generations and must not vote on the chain end
@@ -990,14 +1088,17 @@ class DeployedController:
             for i in chain:
                 await self._retry(
                     lambda i=i: self._tlog(i).truncate_to(minv - 1), deadline)
+            t_salvaged = self.loop.now
             await self._form_generation(
                 epoch, minv, live=self._all_live(), seed_entries=[],
                 resume=True,
             )
         else:
+            t_salvaged = self.loop.now
             await self._form_generation(
                 1, 0, live=self._all_live(), seed_entries=[], resume=True,
             )
+        return t_salvaged
 
     def _region_idx(self, role: str) -> "list[int] | None":
         """Active region's spec indices for a chain role (None when the
@@ -1154,12 +1255,11 @@ class DeployedController:
             checks.extend((role, i) for i in self.live.get(role, []))
         # All probes in flight at once: one sweep costs ONE RPC timeout
         # even with several dead/black-holed endpoints (mirrors the sim
-        # controller's parallel _sweep).
-        tasks = [
-            (role, i, self.loop.spawn(self._worker(role, i).describe(),
-                                      name=f"sweep.{role}{i}"))
-            for role, i in checks
-        ]
+        # controller's parallel _sweep). Each probe is PROBE_TIMEOUT-
+        # bounded: a black-holed link (relay drop / SIGSTOP) delivers no
+        # BrokenPromise — without the bound the sweep hangs forever and
+        # the cluster never heals.
+        tasks = [(role, i, self._probe(role, i)) for role, i in checks]
         verdict = None
         flag_answers = []
         for role, i, t in tasks:
@@ -1193,11 +1293,8 @@ class DeployedController:
                 self.live.get(role, []))
             if self._admit(role, i)  # excluded processes must not rejoin
         ]
-        tasks = [
-            (role, i, self.loop.spawn(self._worker(role, i).ping(),
-                                      name=f"sweep.rejoin.{role}{i}"))
-            for role, i in missing
-        ]
+        tasks = [(role, i, self._probe(role, i, "ping"))
+                 for role, i in missing]
         for role, i, t in tasks:
             try:
                 await t
@@ -1227,8 +1324,7 @@ class DeployedController:
             "satellite_tlog": set(self.live.get("satellite_tlog", [])),
         }
         probes = [
-            (role, i, self.loop.spawn(self._worker(role, i).describe(),
-                                      name=f"zombie.{role}{i}"))
+            (role, i, self._probe(role, i))
             for role, mem in members.items()
             for i in set(range(len(self.spec.get(role) or []))) - mem
         ]
@@ -1240,7 +1336,10 @@ class DeployedController:
             stale = d.get("epoch", 0)
             if 0 < stale < self.epoch:
                 try:
-                    if await self._worker(role, i).stand_down(stale):
+                    if await bounded_rpc(
+                            self.loop,
+                            self._worker(role, i).stand_down(stale),
+                            self.PROBE_TIMEOUT, transport=self.t):
                         print(f"[controller] stood down zombie {role}{i} "
                               f"(epoch {stale})", file=sys.stderr, flush=True)
                 except Exception:
@@ -1248,10 +1347,17 @@ class DeployedController:
 
     async def _recover(self, reason: str) -> None:
         """Lock → salvage → recruit (runtime/recovery.py's state machine,
-        driven over TCP against worker RPCs)."""
+        driven over TCP against worker RPCs). Each completed recovery
+        appends an MTTR entry to `recovery_log`: `detected_wall` (epoch
+        seconds at detection — chaos harnesses subtract their fault-
+        injection stamp to get detection latency) and the lock/salvage/
+        recruit stage durations. Stage rule: time spent in FAILED
+        attempts accrues to the stage being retried (a lock that takes
+        five tries took that long to lock)."""
         if self._recovering:
             return
         self._recovering = True
+        t_detect, w_detect = self.loop.now, self.loop.wall_now
         print(f"[controller] recovery: {reason}", file=sys.stderr, flush=True)
         await self._learn_db_flags()
         lock_failures = 0
@@ -1261,13 +1367,19 @@ class DeployedController:
                     # Lock the generation's full push set: chain tlogs AND
                     # satellite tlogs — on a region loss the satellites
                     # are the only lockable members and carry every acked
-                    # commit (that is their whole purpose).
+                    # commit (that is their whole purpose). Lock RPCs are
+                    # time-bounded: a black-holed tlog must drop out of
+                    # the lockable set, not hang the recovery.
                     locked: list[tuple[int, tuple[str, int]]] = []
                     for role in ("tlog", "satellite_tlog"):
                         for i in self.live.get(role, []):
                             try:
                                 locked.append(
-                                    (await self._push_tlog(role, i).lock(),
+                                    (await bounded_rpc(
+                                        self.loop,
+                                        self._push_tlog(role, i).lock(),
+                                        self.PROBE_TIMEOUT,
+                                        transport=self.t),
                                      (role, i)))
                             except Exception:
                                 continue
@@ -1290,17 +1402,29 @@ class DeployedController:
                             print("[controller] all tlogs restarted fresh — "
                                   "disk-resume recovery", file=sys.stderr,
                                   flush=True)
-                            await self._bootstrap_resume()
+                            # The failed lock rounds ARE this recovery's
+                            # lock stage (stage rule above) — stamping
+                            # the boundary here keeps the MTTR breakdown
+                            # from dumping them into recruit_s.
+                            t_locked = self.loop.now
+                            t_salvaged = await self._bootstrap_resume()
                             self.recoveries_completed += 1
+                            self._log_recovery(
+                                reason + " (disk-resume)", w_detect,
+                                t_detect, t_locked, t_salvaged)
                             return
                         await self.loop.sleep(self.RETRY_DELAY)
                         continue
                     if (self.regions and not chain_locked
                             and await self._maybe_flip_region()):
                         lock_failures = 0  # probe the new region's chain
+                    t_locked = self.loop.now
                     recovery_version, (src_role, src) = max(locked)
-                    seed = await self._push_tlog(
-                        src_role, src).recover_entries()
+                    seed = await bounded_rpc(
+                        self.loop,
+                        self._push_tlog(src_role, src).recover_entries(),
+                        self.RECOVERY_RPC_TIMEOUT, transport=self.t)
+                    t_salvaged = self.loop.now
                     live = await self._probe_live()
                     if (self._seq_idx() not in live["sequencer"]
                             or not live["tlog"]
@@ -1312,6 +1436,8 @@ class DeployedController:
                     await self._form_generation(
                         epoch, recovery_version, live, seed, resume=False)
                     self.recoveries_completed += 1
+                    self._log_recovery(reason, w_detect, t_detect,
+                                       t_locked, t_salvaged)
                     print(f"[controller] recovered to epoch {epoch} "
                           f"v{recovery_version} live={live} "
                           f"region={self.active_region}",
@@ -1329,6 +1455,37 @@ class DeployedController:
         """Endpoint of a push-set member (chain or satellite tlog)."""
         return self.t.endpoint(parse_addr(self.spec[role][i]), "tlog")
 
+    MAX_RECOVERY_LOG = 64  # long soaks must not grow the log unbounded
+
+    def _log_recovery(self, reason: str, w_detect: float, t_detect: float,
+                      t_locked: float, t_salvaged: float) -> None:
+        """One MTTR entry per completed recovery; stage ends are
+        monotonic-clock stamps, recruit ends NOW (the generation just
+        formed = accepting commits). Also emitted as a trace event so a
+        --trace-dir deployment gets the breakdown in its JSONL."""
+        now = self.loop.now
+        entry = {
+            "epoch": self.epoch,
+            "recovery_version": self.recovery_version,
+            "reason": reason,
+            "detected_wall": round(w_detect, 6),
+            "completed_wall": round(self.loop.wall_now, 6),
+            "lock_s": round(t_locked - t_detect, 6),
+            "salvage_s": round(t_salvaged - t_locked, 6),
+            "recruit_s": round(now - t_salvaged, 6),
+            "total_s": round(now - t_detect, 6),
+        }
+        self.recovery_log.append(entry)
+        del self.recovery_log[:-self.MAX_RECOVERY_LOG]
+        tracer = getattr(self.loop, "tracer", None)
+        if tracer is not None:
+            tracer.event("DeployedRecoveryComplete",
+                         Epoch=entry["epoch"], Reason=reason,
+                         LockS=entry["lock_s"],
+                         SalvageS=entry["salvage_s"],
+                         RecruitS=entry["recruit_s"],
+                         TotalS=entry["total_s"])
+
     async def _maybe_flip_region(self) -> bool:
         """Region failover decision (reference: ClusterController bestDC /
         region preference): flip to the standby when the ACTIVE region's
@@ -1343,8 +1500,7 @@ class DeployedController:
         reachable: list = []
         region = self.regions[self.active_region]
         probes = [
-            (role, i, self.loop.spawn(self._worker(role, i).ping(),
-                                      name=f"flip.{role}{i}"))
+            (role, i, self._probe(role, i, "ping"))
             for role in REGION_CHAIN_ROLES
             for i in region.get(role, [])
         ]
@@ -1366,7 +1522,7 @@ class DeployedController:
             alive = 0
             for i in sb.get(role, []):
                 try:
-                    await self._worker(role, i).ping()
+                    await self._probe(role, i, "ping")
                     alive += 1
                     break
                 except Exception:
@@ -1389,7 +1545,7 @@ class DeployedController:
         answers = []
         for i in range(len(self.spec["proxy"])):
             try:
-                d = await self._worker("proxy", i).describe()
+                d = await self._probe("proxy", i)
             except Exception:
                 continue
             if d.get("epoch", 0) > 0 and "backup_enabled" in d:
@@ -1409,7 +1565,7 @@ class DeployedController:
         recruited tlog."""
         for i in self._chain_tlog_idx():
             try:
-                d = await self._worker("tlog", i).describe()
+                d = await self._probe("tlog", i)
             except Exception:
                 return False
             if d.get("epoch", 0) != 0:
@@ -1425,8 +1581,7 @@ class DeployedController:
         if self.spec.get("satellite_tlog"):
             roles.append("satellite_tlog")
         tasks = [
-            (role, i, self.loop.spawn(self._worker(role, i).ping(),
-                                      name=f"probe.{role}{i}"))
+            (role, i, self._probe(role, i, "ping"))
             for role in roles
             for i in range(len(self.spec[role]))
         ]
@@ -1710,6 +1865,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--data-dir", default=None,
                     help="durable state directory (tlog disk queue, "
                          "storage sqlite); default: memory only")
+    ap.add_argument("--bind", default=None,
+                    help="host:port to BIND instead of the spec's address "
+                         "for this role — used when an interposing relay "
+                         "(chaos partition injector) owns the advertised "
+                         "address and forwards here")
     ap.add_argument("--trace-dir", default=None,
                     help="write rolling JSONL trace files here "
                          "(reference: fdbserver --logdir)")
@@ -1726,7 +1886,8 @@ def main(argv: list[str] | None = None) -> None:
             f"--index {args.index} out of range for role {args.role} "
             f"({len(addrs)} addresses in spec)"
         )
-    host, port = parse_addr(addrs[args.index])
+    host, port = parse_addr(args.bind if args.bind
+                            else addrs[args.index])
     if args.data_dir:
         os.makedirs(args.data_dir, exist_ok=True)
 

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Deployed-cluster chaos battery -> CHAOS.json (ISSUE 14).
+#
+# Boots a managed multi-process cluster over real TCP (2 proxies, 2 tlogs
+# behind interposing relays, resolver, sequencer, storage, ratekeeper,
+# controller — one OS process each, persistent per-role data dirs), drives
+# a seeded open-loop workload, and executes the seeded fault script:
+# SIGKILL + restart of each role class under load, plus (without --fast) a
+# relay black-hole partition-then-heal and a SIGSTOP/SIGCONT freeze.
+# Verification is exact: zero acked-commit loss on read-back, every
+# CommitUnknownResult resolved exactly-once-or-absent, post-heal
+# consistency check green, per-stage recovery MTTR (detection -> lock ->
+# salvage -> accepting-commits) per fault.
+#
+# Replay a record:   bash scripts/chaos_run.sh --seed <seed> [--fast]
+# (the seed reproduces the fault schedule + workload shape exactly; the
+# CHAOS.json record carries this line in its `replay` field).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-CHAOS.json}"
+SEED=20260804
+EXTRA=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --seed) SEED=$2; shift 2 ;;
+    --fast) EXTRA+=(--fast); shift ;;
+    *) EXTRA+=("$1"); shift ;;
+  esac
+done
+timeout -k 30 900 env JAX_PLATFORMS=cpu \
+  python -m foundationdb_tpu.loadgen.chaos --seed "$SEED" "${EXTRA[@]}" \
+  > "$OUT.tmp"
+rc=$?
+if [ $rc -eq 0 ] && [ -s "$OUT.tmp" ]; then
+  mv "$OUT.tmp" "$OUT"
+  echo "chaos record -> $OUT" >&2
+else
+  echo "chaos run failed rc=$rc (partial record kept as $OUT.tmp)" >&2
+fi
+exit $rc

@@ -99,6 +99,17 @@ while true; do
     'r.get("metric") == "nemesis_campaigns" and r.get("ok")' -- \
     env JAX_PLATFORMS=cpu python -m foundationdb_tpu.sim.run \
     --campaigns fast || { sleep 60; continue; }
+  # Deployed chaos battery (loadgen/chaos.py): REAL-process fault
+  # injection over real TCP — one SIGKILL + restart cycle per role class
+  # (tlog, resolver, commit proxy, sequencer) under live open-loop load,
+  # gated on zero acked-commit loss at read-back, exactly-once markers,
+  # post-heal consistency green, and per-stage recovery MTTR in the
+  # record. CPU-only by design (no TPU claimed); the full script (adds
+  # partition + SIGSTOP) runs via scripts/chaos_run.sh.
+  stage chaos 900 CHAOS_r05.json \
+    'r.get("metric") == "deployed_chaos" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.loadgen.chaos --fast \
+    || { sleep 60; continue; }
   # Observability selfcheck (obs subsystem): one-JSON-line scrape + span
   # reconciliation on a short sim run — complete span trees, the
   # e2e == sum(stages) + unattributed identity, and the metrics-name

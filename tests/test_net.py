@@ -577,3 +577,167 @@ class TestTLogRestartSemantics:
         assert t3._last_appended == 1_000_050
         versions = [e.version for e in t3._log]
         assert 30 not in versions and 1_000_050 in versions
+
+
+class TestTcpRelay:
+    """Interposing relay (deployed chaos partition injector): bytes
+    splice transparently in pass mode, vanish (connections HANG, not
+    die) in drop mode, resume intact on heal, and reset in cut mode."""
+
+    def test_pass_drop_heal_cut(self):
+        from foundationdb_tpu.runtime.net import TcpRelay
+
+        loop = RealLoop()
+        server = NetTransport(loop)
+        server.serve("echo", Echo())
+        relay = TcpRelay(server.addr)
+        client = NetTransport(loop)
+        ep = client.endpoint(relay.addr, "echo")
+
+        async def call(x, timeout):
+            task = loop.spawn(ep.echo(x), name="relay.call")
+            deadline = loop.now + timeout
+            while not task.done() and loop.now < deadline:
+                await loop.sleep(0.02)
+            return task
+
+        async def main():
+            # pass: transparent round trip through the relay
+            t1 = await call(41, 5.0)
+            assert t1.done() and t1.result() == 41
+            assert relay.bytes_forwarded > 0
+
+            # drop: the call HANGS (no BrokenPromise — packets vanish)
+            relay.set_mode("drop")
+            t2 = await call(42, 0.8)
+            assert not t2.done(), "drop mode must black-hole, not fail"
+
+            # heal: the SAME in-flight call completes — no byte was lost
+            relay.heal()
+            deadline = loop.now + 5.0
+            while not t2.done() and loop.now < deadline:
+                await loop.sleep(0.02)
+            assert t2.done() and t2.result() == 42
+
+            # cut: live connections die (pending requests fail fast)
+            t3 = await call(43, 5.0)
+            assert t3.done() and t3.result() == 43
+            relay.set_mode("cut")
+            t4 = await call(44, 5.0)
+            assert t4.done() and t4.is_error()  # reset/EOF, not a hang
+            return "ok"
+
+        try:
+            assert loop.run(main(), timeout=60) == "ok"
+        finally:
+            relay.close()
+            server.close()
+            client.close()
+
+
+class _HangService:
+    @rpc
+    async def hang(self):
+        from foundationdb_tpu.runtime.flow import Promise
+        await Promise().future  # never answers
+
+
+class TestAbandonedCall:
+    """server.bounded_rpc(transport=...) must ABANDON a timed-out
+    request: on a black-holed link the connection stays open (nothing
+    ever fails the promise), so without this every probe sweep leaves
+    one never-answered entry in conn.pending for the partition's whole
+    duration (review finding)."""
+
+    def test_timeout_drops_pending_registration(self):
+        from foundationdb_tpu.server import bounded_rpc
+
+        loop = RealLoop()
+        server = NetTransport(loop)
+        client = NetTransport(loop)
+        server.serve("hang", _HangService())
+        server.serve("echo", Echo())
+        hang_ep = client.endpoint(server.addr, "hang")
+        echo_ep = client.endpoint(server.addr, "echo")
+
+        async def main():
+            for _ in range(3):
+                with pytest.raises(TimeoutError):
+                    await bounded_rpc(loop, hang_ep.hang(), 0.05,
+                                      transport=client)
+            conn = client._conns[tuple(server.addr)]
+            assert conn.pending == {}, "timed-out probes accumulated"
+            assert client._call_sites == {}
+            # The link still works, and a COMPLETED call unregisters
+            # its site too (the map cannot grow on the happy path).
+            assert await bounded_rpc(loop, echo_ep.echo(7), 5.0,
+                                     transport=client) == 7
+            assert client._call_sites == {}
+            return True
+
+        try:
+            assert loop.run(main(), timeout=60)
+        finally:
+            client.close()
+            server.close()
+
+
+class TestReconnectBackoff:
+    """Client reconnect hardening (ISSUE 14 satellite): consecutive
+    byte-less dials to a dead peer are suppressed for a bounded jittered
+    window (failing fast with the same BrokenPromise a dead connection
+    gives), and a peer that comes back is dialled again."""
+
+    def test_dead_peer_dials_suppressed_then_recover(self):
+        import socket as _socket
+
+        # A port with nothing behind it (bound-then-closed): dials fail.
+        s = _socket.create_server(("127.0.0.1", 0))
+        addr = s.getsockname()
+        s.close()
+
+        loop = RealLoop()
+        client = NetTransport(loop)
+        ep = client.endpoint(addr, "echo")
+
+        async def fail_once():
+            try:
+                await ep.echo(1)
+                raise AssertionError("dead peer answered")
+            except FdbError as e:
+                return str(e)
+
+        async def main():
+            msgs = []
+            for _ in range(6):
+                msgs.append(await fail_once())
+                await loop.sleep(0.01)
+            return msgs
+
+        try:
+            msgs = loop.run(main(), timeout=60)
+            # After the first couple of failures the transport suppresses
+            # re-dials for a backoff window (message says so).
+            assert any("reconnect backoff" in m for m in msgs), msgs
+            assert client._dial_backoff[tuple(addr)][0] >= 2
+
+            # Peer comes back: once the (bounded, capped) window expires
+            # the next dial goes through and the backoff resets.
+            server = NetTransport(loop, host=addr[0], port=addr[1])
+            server.serve("echo", Echo())
+
+            async def recovered():
+                deadline = loop.now + 3 * NetTransport.DIAL_BACKOFF_CAP
+                while True:
+                    try:
+                        return await ep.echo(99)
+                    except FdbError:
+                        if loop.now > deadline:
+                            raise
+                        await loop.sleep(0.05)
+
+            assert loop.run(recovered(), timeout=60) == 99
+            assert tuple(addr) not in client._dial_backoff
+            server.close()
+        finally:
+            client.close()
