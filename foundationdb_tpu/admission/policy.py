@@ -95,6 +95,11 @@ class AdmissionPolicy:
     PREABORT_CEILING = 3
     #: Evidence-log bound (forensics): counters keep counting past it.
     PREABORT_LOG_CAP = 4096
+    #: Consecutive clean admits that end an "engaged" episode: the
+    #: engage/release annotations (obs flight recorder) follow episodes,
+    #: not per-txn decisions — without hysteresis a workload shaping one
+    #: txn in fifty would flap an annotation per batch.
+    RELEASE_CLEAN = 64
 
     def __init__(
         self,
@@ -131,13 +136,41 @@ class AdmissionPolicy:
             "wide_range_shaped": 0,  # sketch-driven (not per-key) shapes
             "saturation_blind": 0,  # probes skipped: filter saturated
             "preabort_ceiling": 0,  # admitted past the streak ceiling
+            # Engage/release EPISODES (see RELEASE_CLEAN): the filter is
+            # "engaged" from its first shape/pre-abort until RELEASE_CLEAN
+            # consecutive clean admits. The flight recorder turns deltas
+            # of these into admission_filter timeline annotations.
+            "engage_events": 0,
+            "release_events": 0,
         }
+        self.engaged = False
+        self._clean_streak = 0
         # Pre-abort evidence log for the honesty tests: every entry is the
         # (key, confirming write version, txn read version) triple that
         # justified a pre-abort; tests replay it against the oracle's
         # write history. Bounded at PREABORT_LOG_CAP (forensics, not
         # accounting — evidence checks must compare against the cap).
         self.preabort_log: list[tuple[bytes, int, int]] = []
+
+    # -- engage/release episode (obs annotation surface) ----------------------
+
+    def _note_intervention(self) -> None:
+        """A shape or pre-abort happened: the episode engages (or stays
+        engaged) and the clean streak resets."""
+        self._clean_streak = 0
+        if not self.engaged:
+            self.engaged = True
+            self.counters["engage_events"] += 1
+
+    def _note_clean(self) -> None:
+        """A clean admit: RELEASE_CLEAN of these in a row end the episode."""
+        if not self.engaged:
+            return
+        self._clean_streak += 1
+        if self._clean_streak >= self.RELEASE_CLEAN:
+            self.engaged = False
+            self._clean_streak = 0
+            self.counters["release_events"] += 1
 
     # -- the decision ---------------------------------------------------------
 
@@ -166,6 +199,7 @@ class AdmissionPolicy:
         if not reads:
             # Blind writes conflict with nothing — always admit.
             self.counters["admitted"] += 1
+            self._note_clean()
             return AdmissionDecision("admit", 0.0)
         self.counters["probes"] += 1
         keys, wide = [], []
@@ -180,6 +214,7 @@ class AdmissionPolicy:
                     self.counters["preaborted"] += 1
                     if len(self.preabort_log) < self.PREABORT_LOG_CAP:
                         self.preabort_log.append((k, v, read_version))
+                    self._note_intervention()
                     return AdmissionDecision("preabort", 1.0,
                                              confirm_version=v)
         # Bloom tier: likely losers shape (unless the filter is saturated
@@ -195,6 +230,7 @@ class AdmissionPolicy:
                 risk = float(hits.sum()) / len(keys)
                 if risk >= self.shape_risk:
                     self.counters["shaped"] += 1
+                    self._note_intervention()
                     return AdmissionDecision("shape", risk)
         if wide and self.hot_ranges is not None:
             score = max(
@@ -203,8 +239,10 @@ class AdmissionPolicy:
             if score >= self.SKETCH_SHAPE_SCORE:
                 self.counters["shaped"] += 1
                 self.counters["wide_range_shaped"] += 1
+                self._note_intervention()
                 return AdmissionDecision("shape", risk, wide=True)
         self.counters["admitted"] += 1
+        self._note_clean()
         return AdmissionDecision("admit", risk)
 
     def reclassify_no_shape(self, decision: AdmissionDecision) -> None:
@@ -238,6 +276,7 @@ class AdmissionPolicy:
                 self.counters["preaborted"] += 1
                 if len(self.preabort_log) < self.PREABORT_LOG_CAP:
                     self.preabort_log.append((k, v, read_version))
+                self._note_intervention()
                 return v
         return None
 
@@ -284,6 +323,7 @@ class AdmissionPolicy:
         return {
             "enabled": self.enabled,
             **self.counters,
+            "engaged": self.engaged,
             "saturation": round(self.saturation(), 4),
             "filter": self.filter.metrics(),
         }

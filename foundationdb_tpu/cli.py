@@ -140,6 +140,15 @@ latency [N]             active commit-path latency probe: run N (default
 metrics [prom]          unified metrics scrape of every role (obs
                         registry): one JSON line, or Prometheus text
                         exposition with `prom`
+doctor RING.jsonl       incident doctor over a flight-recorder ring
+                        (obs/recorder.py): re-derives the SLO anomaly
+                        windows and prints one root-cause verdict per
+                        incident — dominant commit-path stage plus the
+                        co-occurring annotations (recovery stages, chaos
+                        faults, ratekeeper limits, resolver-queue
+                        crossings, scrape gaps) — and the per-fault
+                        attribution table for chaos rings. Offline and
+                        deterministic: same ring, same report
 status                  cluster role metrics (JSON)
 help                    this text
 exit / quit             leave"""
@@ -369,6 +378,18 @@ class Shell:
 
             report = self._await(latency_probe(self.db, self.loop, n=n),
                                  timeout=120.0)
+            return json.dumps(report, indent=1, sort_keys=True)
+        if cmd == "doctor":
+            # Incident doctor (obs subsystem): offline root-cause report
+            # over a flight-recorder ring file — needs no live cluster,
+            # so a post-mortem works even after the cluster is gone.
+            if len(args) != 1:
+                return "usage: doctor RING.jsonl"
+            from foundationdb_tpu.obs.doctor import main_doctor
+
+            report = main_doctor(args[0])
+            if "error" in report:
+                return f"ERROR: {report['error']}"
             return json.dumps(report, indent=1, sort_keys=True)
         if cmd == "metrics":
             # Unified metrics scrape (obs registry): every role's

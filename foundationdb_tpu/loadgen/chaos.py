@@ -266,6 +266,11 @@ async def _open_loop_writer(loop, db, ledger: AckedLedger, pref: bytes,
 
 async def _run_events(loop, cluster: SocketCluster, events, t0: float,
                       counters: dict) -> None:
+    # Flight recorder (obs subsystem), when this run armed one: every
+    # injected fault / scripted repair is stamped as a first-class
+    # annotation on the SAME timeline the metric snapshots ride — the
+    # doctor's fault-window attribution keys off exactly these.
+    recorder = getattr(loop, "flight_recorder", None)
     for ev in events:
         dt = t0 + ev.at_s - loop.now
         if dt > 0:
@@ -301,6 +306,17 @@ async def _run_events(loop, cluster: SocketCluster, events, t0: float,
                 # Faults only: restart/resume/heal are the REPAIRS —
                 # counting them would double the published fault count.
                 counters["chaos_faults_injected"] += 1
+            if recorder is not None:
+                recorder.annotate(
+                    f"Chaos{ev.action.capitalize()}",
+                    cls=("chaos_fault"
+                         if ev.action in ("kill", "pause", "partition")
+                         else "chaos_heal"),
+                    severity=("warn"
+                              if ev.action in ("kill", "pause", "partition")
+                              else "info"),
+                    action=ev.action, target=ev.target,
+                    at_s=ev.at_s, wall=ev.stamp)
             _log(f"t+{ev.at_s:.1f}s {ev.action} {ev.target}")
         except Exception as e:  # noqa: BLE001 — record, keep the script going
             ev.error = f"{type(e).__name__}: {e}"
@@ -384,8 +400,17 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
               script: "list[ChaosEvent] | None" = None,
               duration_s: "float | None" = None,
               n_ctrs: int = 16, max_inflight: int = 256,
-              drain_s: float = 20.0) -> dict:
-    """One seeded chaos run → the CHAOS record (see module docstring)."""
+              drain_s: float = 20.0,
+              recorder_path: "str | None" = None) -> dict:
+    """One seeded chaos run → the CHAOS record (see module docstring).
+
+    ``recorder_path``: arm the obs flight recorder for this run — server
+    processes start with FDB_TPU_OBS=1 (stage spans ride commit replies),
+    the harness loop gets a SpanSink + FlightRecorder scraping the
+    cluster each second, every fault/heal is annotated on the timeline,
+    and the client-side ledger counters join the scrape as the `client`
+    role (the SLO tracker's unknown-result SLI). The ring at that path
+    is the doctor's input (obs/doctor.py, `cli doctor`)."""
     from foundationdb_tpu.loadgen.arrivals import poisson_schedule
 
     workdir = workdir or tempfile.mkdtemp(prefix="chaos_")
@@ -420,10 +445,17 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
         # rate changes the poisson schedule, so omitting it would make
         # the record claim a reproduction it doesn't perform
         # (chaos_run.sh forwards unrecognized args to the module).
+        # A recorder-armed run traces the servers (FDB_TPU_OBS=1), which
+        # is a different workload than an untraced one — the replay line
+        # must say so.
         "replay": f"bash scripts/chaos_run.sh --seed {seed}"
                   + (" --fast" if fast else "")
-                  + (f" --rate {rate:g}" if rate != 80.0 else ""),
+                  + (f" --rate {rate:g}" if rate != 80.0 else "")
+                  + (" --recorder flight_ring.jsonl" if recorder_path
+                     else ""),
     }
+    if recorder_path:
+        rec["recorder_path"] = recorder_path
     problems: list[str] = []
     cluster: "SocketCluster | None" = None
     client_t = None  # the open_client NetTransport: closed on EVERY path
@@ -433,7 +465,8 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
         # relays' listener threads start at construction).
         _log(f"seed={seed} fast={fast}: booting managed cluster in {workdir}")
         cluster = SocketCluster(
-            workdir, ratekeeper=True, data_dirs=True, **topo)
+            workdir, ratekeeper=True, data_dirs=True,
+            env=({"FDB_TPU_OBS": "1"} if recorder_path else None), **topo)
         cluster.start()
         rec["cluster"]["processes"] = len(cluster.procs)
         loop, t, db = cluster.open_client()
@@ -443,9 +476,47 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
         db.transaction_class = Transaction
         ctrl = cluster.controller_ep(t)
         schedule = poisson_schedule(rate, dur, seed=seed)
+        recorder = None
+        if recorder_path:
+            from foundationdb_tpu.obs.recorder import FlightRecorder
+            from foundationdb_tpu.obs.registry import (
+                add_span_sink,
+                scrape_deployed_async,
+            )
+            from foundationdb_tpu.obs.span import SpanSink
+            from foundationdb_tpu.server import load_spec as _spec_load
+
+            # Client-side sink: servers run FDB_TPU_OBS=1 (env above), so
+            # commit replies carry proxy stage spans and the harness
+            # assembles full trees — dense enough at 1-in-8 for per-window
+            # stage shares without distorting the workload.
+            sink = SpanSink(loop, sample_every=8)
+            chaos_spec = _spec_load(cluster.spec_path)
+
+            async def recorder_scrape():
+                reg = await scrape_deployed_async(loop, t, chaos_spec)
+                reg.add("chaos", "", dict(counters))
+                # The client's own ledger is the only honest source of
+                # the unknown-result SLI — servers cannot know which
+                # acks were lost in flight.
+                reg.add("client", "", {
+                    "commits_acked": len(ledger.acked),
+                    "commit_unknowns": len(ledger.unknown),
+                    "offered": ledger.offered,
+                    "op_timeouts": ledger.op_timeouts,
+                    "conflict_retries": ledger.conflict_retries,
+                })
+                add_span_sink(reg, sink)
+                return reg
+
+            recorder = FlightRecorder(loop, recorder_scrape, recorder_path,
+                                      interval_s=1.0)
 
         async def main():
             t0 = loop.now
+            recorder_task = (
+                loop.spawn(recorder.run(), name="chaos.recorder")
+                if recorder is not None else None)
             ev_task = loop.spawn(
                 _run_events(loop, cluster, events, t0, counters),
                 name="chaos.events")
@@ -507,6 +578,14 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
                 loop, t, load_spec(cluster.spec_path), db)
             log = await _bounded(loop, ctrl.get_recovery_log(), 5.0,
                                  "chaos.recovery_log")
+            if recorder_task is not None:
+                # One final scrape so the post-heal state is on the ring
+                # (recovery counters, healed metrics), then stop.
+                try:
+                    recorder.observe_registry(await recorder_scrape())
+                except Exception:
+                    pass
+                recorder_task.cancel()
             return st, got, consistency, log
 
         st, got, consistency, recovery_log = loop.run(
@@ -568,8 +647,24 @@ def run_chaos(seed: int = 20260804, fast: bool = False,
 
         reg = scrape_deployed(loop, t, _load(cluster.spec_path))
         reg.add("chaos", "", dict(counters))
+        extra_documented = CHAOS_DOCUMENTED_COUNTERS
+        if recorder is not None:
+            from foundationdb_tpu.obs.registry import (
+                RECORDER_DOCUMENTED_COUNTERS,
+            )
+
+            reg.add("recorder", "", recorder.metrics())
+            reg.add("slo", "", recorder.slo.metrics())
+            extra_documented = (CHAOS_DOCUMENTED_COUNTERS
+                                + RECORDER_DOCUMENTED_COUNTERS)
+            rec["recorder"] = {
+                "path": recorder_path,
+                **recorder.metrics(),
+                "slo": recorder.slo.status(),
+            }
+            recorder.close()
         audit = reg.audit()
-        missing = reg.missing_documented(extra=CHAOS_DOCUMENTED_COUNTERS)
+        missing = reg.missing_documented(extra=extra_documented)
         rec["scrape"] = {"metrics": len(reg.values),
                          "audit_problems": audit[:10],
                          "missing_documented": missing}
@@ -656,9 +751,15 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--rate", type=float, default=80.0,
                     help="open-loop offered load, txns/sec")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--recorder", default=None, metavar="RING_PATH",
+                    help="arm the obs flight recorder: servers traced "
+                         "(FDB_TPU_OBS=1), 1s metric snapshots + fault/"
+                         "heal annotations ringed to RING_PATH — feed it "
+                         "to `cli doctor` / --doctor for the root-cause "
+                         "report")
     args = ap.parse_args(argv)
     rec = run_chaos(seed=args.seed, fast=args.fast, rate=args.rate,
-                    workdir=args.workdir)
+                    workdir=args.workdir, recorder_path=args.recorder)
     print(json.dumps(rec), flush=True)
     return 0 if rec["ok"] else 1
 

@@ -242,6 +242,16 @@ async def run_open_loop(
         loop.resync()  # wall-clock loops: t0 must be NOW, not the last
         # pump iteration (a stale clock fakes schedule-wide lateness)
     t0 = loop.now
+    # Flight-recorder load-phase annotation (obs subsystem): when this
+    # loop carries a recorder, the open-loop phase boundaries land on
+    # the cluster timeline so the doctor can tell "load started/ended
+    # here" from an organic goodput change.
+    _recorder = getattr(loop, "flight_recorder", None)
+    if _recorder is not None:
+        _recorder.annotate(
+            "OpenLoopPhaseStart", cls="load_phase",
+            offered=res.offered, span_s=round(res.schedule_span_s, 3),
+            clients=n_clients)
     slots: list[deque] = [deque() for _ in range(n_clients)]
     state = {"outstanding": 0, "done_at": t0}
 
@@ -359,4 +369,9 @@ async def run_open_loop(
         # samples into each other's records.
         res.obs_dump = sink.dump()
         sink.reset()
+    if _recorder is not None:
+        _recorder.annotate(
+            "OpenLoopPhaseEnd", cls="load_phase",
+            committed=res.committed, shed=res.shed,
+            timed_out=res.timed_out, abandoned=res.abandoned)
     return res

@@ -1,6 +1,6 @@
-"""End-to-end commit-path observability (ISSUE 12 tentpole).
+"""End-to-end commit-path observability (ISSUE 12 + ISSUE 15 tentpoles).
 
-Three pieces, one subsystem:
+Six pieces, one subsystem:
 
 - ``span``: per-transaction commit-path tracing — sampled txns carry a
   trace context through the wire structs, every role stamps span
@@ -8,25 +8,58 @@ Three pieces, one subsystem:
   residue is reported as ``unattributed`` (never silently dropped).
 - ``registry``: the unified metrics scrape — every role's counters plus
   tracer/span tallies in one namespaced snapshot, emitted as Prometheus
-  text, one JSON line, or a periodic JSONL time-series.
+  text, one JSON line, or a periodic JSONL time-series with explicit
+  ``scrape_gap`` records for dead/unreachable roles.
+- ``recorder``: the cluster flight recorder — an always-on, bounded
+  on-disk ring of metric snapshots with first-class event annotations
+  on the same timeline (ratekeeper limiting transitions, recovery
+  stages, resolver-queue crossings, admission engage/release, chaos
+  fault/heal windows, reshard/repack events, scrape gaps).
+- ``slo``: rolling-baseline anomaly detection + SLO burn tracking
+  (commit p99 / goodput / unknown-result rate) computed incrementally
+  from the ring, with warm-up / insufficient-sample honesty flags —
+  exported as status JSON ``workload.slo`` and the slo_* counters.
+- ``doctor``: deterministic root-cause reports per anomaly window
+  (dominant stage + co-occurring annotations + one-line verdict), the
+  chaos fault-window attribution table, and the ``--doctor-gate`` CI
+  line; ``history`` folds the committed bench artifacts into the
+  perf-trajectory table (``--bench-history``).
 - ``selfcheck``: the CI face — ``python -m foundationdb_tpu.obs`` runs a
   short sim and verifies span completeness, the reconciliation identity,
   and the scrape audit in one JSON line; ``--ab`` measures the 1-in-64
-  sampling overhead against the <=2% gate (scripts/obs_ab.sh ->
-  OBS_AB.json).
+  sampling overhead AND the recorder-armed overhead against the <=2%
+  gate (scripts/obs_ab.sh -> OBS_AB.json).
 
 Knobs (README "Observability"): FDB_TPU_OBS (default 0),
-FDB_TPU_OBS_SAMPLE (default 64 — sample 1-in-N transactions).
+FDB_TPU_OBS_SAMPLE (default 64 — sample 1-in-N transactions),
+FDB_TPU_RECORDER (ring path — arms the flight recorder on a server.py
+controller process), FDB_TPU_RECORDER_INTERVAL (snapshot seconds,
+default 5).
 """
 
 from foundationdb_tpu.obs.registry import (
     CHAOS_DOCUMENTED_COUNTERS,
     DOCUMENTED_COUNTERS,
+    RECORDER_DOCUMENTED_COUNTERS,
     MetricsPoller,
     MetricsRegistry,
+    add_span_sink,
     scrape_deployed,
+    scrape_deployed_async,
     scrape_sim,
 )
+from foundationdb_tpu.obs.doctor import (
+    attribute_faults,
+    diagnose,
+    run_doctor_gate,
+)
+from foundationdb_tpu.obs.history import bench_history
+from foundationdb_tpu.obs.recorder import (
+    ANNOTATION_CLASSES,
+    TRACE_CATALOG,
+    FlightRecorder,
+)
+from foundationdb_tpu.obs.slo import SloTracker
 from foundationdb_tpu.obs.selfcheck import (
     latency_probe,
     run_overhead_ab,
@@ -44,21 +77,32 @@ from foundationdb_tpu.obs.span import (
 )
 
 __all__ = [
+    "ANNOTATION_CLASSES",
     "CHAOS_DOCUMENTED_COUNTERS",
     "DOCUMENTED_COUNTERS",
+    "FlightRecorder",
     "MetricsPoller",
     "MetricsRegistry",
+    "RECORDER_DOCUMENTED_COUNTERS",
     "SUB_STAGES",
+    "SloTracker",
     "SpanSink",
+    "TRACE_CATALOG",
     "TXN_STAGES",
     "TraceContext",
+    "add_span_sink",
+    "attribute_faults",
+    "bench_history",
     "check_txn_tree",
+    "diagnose",
     "latency_probe",
     "obs_env_default",
     "obs_sample_default",
+    "run_doctor_gate",
     "run_overhead_ab",
     "run_selfcheck",
     "scrape_deployed",
+    "scrape_deployed_async",
     "scrape_sim",
     "span_sink",
 ]

@@ -1,0 +1,383 @@
+"""Cluster flight recorder: event-annotated metric time-series on disk.
+
+Every surface this repo had before answered "what is true NOW" (status
+JSON, one scrape) or "what did one txn do" (span trees); the questions
+incidents actually pose — *why did p99 spike at t=12s?* — need a
+continuous timeline where metric movements and discrete cluster events
+sit on the SAME clock. The FlightRecorder is that timeline:
+
+- **Snapshots**: one per ``interval_s`` via the standard scrape contract
+  (``async () -> MetricsRegistry`` — scrape_sim / scrape_deployed_async
+  / any harness wrapper), stored per-process AND aggregated.
+- **Annotations**: first-class discrete events injected onto the same
+  timeline from three feeds:
+
+  1. *trace listener* — loop-local TraceEvents in TRACE_CATALOG
+     (ratekeeper limiting-reason transitions, recovery stage machine,
+     resolver fail-safe, region failover, commit wedges) land with their
+     exact emit time;
+  2. *derived* — transitions computed between consecutive snapshots
+     from pure counters, which is what a REMOTE recorder (scraping over
+     TCP) can see: recovery_count deltas, resolver-queue soft/hard
+     crossings (Ratekeeper RQ_SOFT/RQ_HARD), admission filter
+     engage/release episode deltas, ratekeeper limiting_reason_code
+     changes, resident-engine reshard/repack deltas. A derived class is
+     suppressed while the trace listener already covered it this
+     interval, so sim runs don't double-annotate;
+  3. *direct* — harnesses call ``annotate()`` (chaos fault/heal stamps,
+     open-loop load phases).
+
+- **Scrape gaps**: a failed role probe is an explicit ``gap`` record
+  (role, instance, reason, outage duration) — never a hole.
+- **SLO**: every snapshot feeds the SloTracker (obs/slo.py); newly
+  opened anomaly incidents ring an ``slo`` annotation, and the tracker's
+  status is served as ``workload.slo``.
+
+The on-disk form is a bounded JSONL ring: records append; when the file
+holds 2x ``max_records`` lines it is COMPACTED (atomic rewrite from the
+in-memory ring) — retention ≈ max_records × interval_s seconds, the
+knob pair README's Observability section documents. ``load()`` reads a
+ring back for obs/doctor.py.
+
+Arming: ``SimCluster(recorder_path=...)``, ``server.py`` controller role
+with ``FDB_TPU_RECORDER=<path>``, ``python -m foundationdb_tpu.obs
+--record cluster.json``, or chaos runs via ``--recorder``. The recorder
+attaches as ``loop.flight_recorder`` (the Tracer/SpanSink convention).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Callable
+
+from foundationdb_tpu.obs.slo import SloTracker
+
+#: trace-event Type -> annotation class (the loop-local feed). These are
+#: EXACT event names as emitted by the runtime — the README annotation
+#: catalog and the doctor's attribution both key off the classes.
+TRACE_CATALOG = {
+    "RkLimitReasonChanged": "ratekeeper_limit",
+    "MasterRecoveryTriggered": "recovery",
+    "MasterRecoveryState": "recovery",
+    "MasterRecoveryFailed": "recovery",
+    "DeployedRecoveryComplete": "recovery",
+    "WorkerFailureDetected": "recovery",
+    "RegionFailover": "recovery",
+    "ResolverFailSafeEngaged": "resolver_capacity",
+    "ResolverFailSafeReleased": "resolver_capacity",
+    "ResolverHistoryOverflow": "resolver_capacity",
+    "CommitBatchWedged": "commit_wedge",
+}
+
+#: every annotation class the recorder can emit (docs + doctor contract).
+ANNOTATION_CLASSES = (
+    "ratekeeper_limit",
+    "recovery",
+    "resolver_queue",
+    "resolver_capacity",
+    "admission_filter",
+    "reshard",
+    "commit_wedge",
+    "chaos_fault",
+    "chaos_heal",
+    "load_phase",
+    "slo",
+    "scrape_gap",
+)
+
+
+class FlightRecorder:
+    #: ring bound (records, snapshots + annotations + gaps combined) and
+    #: the snapshot cadence — retention ≈ max_records × interval_s.
+    MAX_RECORDS = 4096
+    INTERVAL_S = 5.0
+
+    def __init__(self, loop, scrape: Callable, path: str,
+                 interval_s: "float | None" = None,
+                 max_records: "int | None" = None,
+                 objectives: "dict | None" = None,
+                 listen_trace: bool = True):
+        self.loop = loop
+        self.scrape = scrape  # async () -> MetricsRegistry
+        self.path = path
+        self.interval_s = (self.INTERVAL_S if interval_s is None
+                           else float(interval_s))
+        self.max_records = (self.MAX_RECORDS if max_records is None
+                            else max(16, int(max_records)))
+        self.slo = SloTracker(objectives)
+        self.ring: deque[dict] = deque(maxlen=self.max_records)
+        # Re-arming over an existing ring file (a controller restart —
+        # the exact incident the recorder must survive) seeds the
+        # in-memory ring from the file tail: compaction rewrites the
+        # file FROM this deque, so starting it empty would wipe every
+        # pre-restart record at the first compaction and leave the
+        # post-mortem doctor without its pre-incident baseline.
+        for rec in self.load(path)[-self.max_records:]:
+            self.ring.append(rec)
+        self.counters = {
+            "recorder_snapshots": 0,
+            "recorder_annotations": 0,
+            "recorder_scrape_gaps": 0,
+            "recorder_compactions": 0,
+            "recorder_ring_records": 0,
+        }
+        self._seq = 0
+        self._file_lines = self._existing_lines()
+        self._armed_at = loop.now
+        self._last_ok: dict[tuple, float] = {}  # (role, inst) -> last t
+        self._prev_agg: "dict | None" = None
+        self._prev_values: dict = {}
+        self._prev_t = loop.now
+        # Per-class stamp of the last LISTENER annotation: the derived
+        # emitters skip a class the exact-time feed already covered this
+        # interval (sim would otherwise double-annotate every event).
+        self._listener_cls_t: dict[str, float] = {}
+        self._listening = False
+        tracer = getattr(loop, "tracer", None)
+        if listen_trace and tracer is not None:
+            tracer.listeners.append(self._on_trace)
+            self._listening = True
+        loop.flight_recorder = self
+
+    # -- ring I/O --------------------------------------------------------------
+
+    def _existing_lines(self) -> int:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                return sum(1 for _ in f)
+        except OSError:
+            return 0
+
+    def _write(self, rec: dict) -> None:
+        self.ring.append(rec)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._file_lines += 1
+        if self._file_lines >= 2 * self.max_records:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Atomic rewrite from the in-memory ring: the on-disk file never
+        holds more than 2x the ring bound, and a reader at any instant
+        sees either the old file or the compacted one, never a torn mix."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in self.ring:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._file_lines = len(self.ring)
+        self.counters["recorder_compactions"] += 1
+
+    @staticmethod
+    def load(path: str) -> list[dict]:
+        """Read a ring file back (doctor ingestion). A torn final line —
+        the writer died mid-append — is dropped, not fatal."""
+        out: list[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            return []
+        return out
+
+    # -- annotations -----------------------------------------------------------
+
+    def annotate(self, name: str, cls: str, t: "float | None" = None,
+                 severity: str = "info", _from_listener: bool = False,
+                 **details) -> None:
+        """Ring one annotation onto the timeline. ``details`` must be
+        JSON-able (harness callers pass plain scalars)."""
+        rec = {
+            "kind": "annotation",
+            "t": round(self.loop.now if t is None else t, 6),
+            "name": name,
+            "cls": cls,
+            "severity": severity,
+        }
+        for k, v in details.items():
+            if k not in rec:
+                rec[k] = v
+        self.counters["recorder_annotations"] += 1
+        if _from_listener:
+            self._listener_cls_t[cls] = rec["t"]
+        self._write(rec)
+
+    def _on_trace(self, rec: dict) -> None:
+        """Tracer listener: catalog events land with exact emit times."""
+        cls = TRACE_CATALOG.get(rec.get("Type"))
+        if cls is None:
+            return
+        details = {k: v for k, v in rec.items()
+                   if k not in ("Time", "Type", "Severity", "Process")}
+        details["process"] = rec.get("Process")
+        self.annotate(rec["Type"], cls, t=rec["Time"],
+                      severity=str(rec.get("Severity", "")),
+                      _from_listener=True, **details)
+
+    # -- derived annotations (pure counter plane) ------------------------------
+
+    def _derived_ok(self, cls: str) -> bool:
+        """False while the trace listener already annotated this class
+        since the previous snapshot (exact-time feed wins)."""
+        return self._listener_cls_t.get(cls, -1.0) < self._prev_t
+
+    def _derive(self, t: float, agg: dict, per_values: dict) -> None:
+        prev = self._prev_agg
+        if prev is None:
+            return
+
+        def delta(key: str) -> float:
+            return agg.get(key, 0) - prev.get(key, 0)
+
+        # Ratekeeper limiting-reason transitions.
+        if self._derived_ok("ratekeeper_limit"):
+            code0 = prev.get("ratekeeper.limiting_reason_code")
+            code1 = agg.get("ratekeeper.limiting_reason_code")
+            flaps = delta("ratekeeper.limit_transitions")
+            if code0 is not None and (code1 != code0 or flaps > 0):
+                from foundationdb_tpu.runtime.ratekeeper import LIMIT_REASONS
+
+                def reason(code):
+                    c = int(code or 0)
+                    return (LIMIT_REASONS[c] if 0 <= c < len(LIMIT_REASONS)
+                            else f"code{c}")
+
+                self.annotate(
+                    "RkLimitReasonChanged", "ratekeeper_limit", t=t,
+                    severity="warn" if reason(code1) != "none" else "info",
+                    reason=reason(code1), previous=reason(code0),
+                    transitions=int(flaps))
+        # Completed recoveries.
+        if self._derived_ok("recovery"):
+            n = delta("controller.recovery_count")
+            if n > 0:
+                self.annotate(
+                    "RecoveryCompleted", "recovery", t=t, severity="warn",
+                    recoveries=int(n),
+                    lock_s=agg.get("controller.recovery_lock_s"),
+                    salvage_s=agg.get("controller.recovery_salvage_s"),
+                    recruit_s=agg.get("controller.recovery_recruit_s"),
+                    total_s=agg.get("controller.recovery_total_s"))
+        # Resolver dispatch-queue soft/hard crossings (worst instance;
+        # thresholds are the ratekeeper's own RQ knobs).
+        from foundationdb_tpu.runtime.ratekeeper import Ratekeeper
+
+        def worst_depth(values: dict) -> int:
+            return max(
+                (int(v) for k, v in values.items()
+                 if k.split("#", 1)[0] == "resolver.queue_depth_hw"),
+                default=0)
+
+        d0, d1 = worst_depth(self._prev_values), worst_depth(per_values)
+        lvl = ("hard" if d1 >= Ratekeeper.RQ_HARD
+               else "soft" if d1 >= Ratekeeper.RQ_SOFT else "none")
+        lvl0 = ("hard" if d0 >= Ratekeeper.RQ_HARD
+                else "soft" if d0 >= Ratekeeper.RQ_SOFT else "none")
+        if lvl != lvl0:
+            name = {"hard": "ResolverQueueHard", "soft": "ResolverQueueSoft",
+                    "none": "ResolverQueueRecovered"}[lvl]
+            self.annotate(name, "resolver_queue", t=t,
+                          severity="warn" if lvl != "none" else "info",
+                          depth_hw=d1, previous_depth_hw=d0,
+                          soft=Ratekeeper.RQ_SOFT, hard=Ratekeeper.RQ_HARD)
+        # Admission filter engage/release episodes.
+        eng = delta("commit_proxy.admission.engage_events")
+        rel = delta("commit_proxy.admission.release_events")
+        if eng > 0:
+            self.annotate("AdmissionFilterEngaged", "admission_filter",
+                          t=t, severity="warn", episodes=int(eng),
+                          saturation=agg.get(
+                              "commit_proxy.admission.saturation"))
+        if rel > 0:
+            self.annotate("AdmissionFilterReleased", "admission_filter",
+                          t=t, episodes=int(rel))
+        # Resident-engine reshard / forced repack.
+        rs = delta("resolver.engine.auto_reshards")
+        if rs > 0:
+            self.annotate("EngineReshard", "reshard", t=t,
+                          reshards=int(rs),
+                          moved_shards=int(
+                              delta("resolver.engine.reshard_moved_shards")))
+        rp = delta("resolver.engine.full_repacks")
+        if rp > 0:
+            self.annotate("EngineRepack", "reshard", t=t,
+                          severity="warn", repacks=int(rp),
+                          evictions=int(delta("resolver.engine.evictions")))
+
+    # -- snapshots -------------------------------------------------------------
+
+    def _gap_records(self, reg, t: float) -> list[dict]:
+        from foundationdb_tpu.obs.registry import scrape_gap_records
+
+        return [{"kind": "gap", **r}
+                for r in scrape_gap_records(reg, t, self._last_ok,
+                                            self._armed_at)]
+
+    def observe_registry(self, reg) -> None:
+        """Process ONE scrape into the ring: recorder/slo self-metrics
+        ride the snapshot, gaps become records, derived annotations and
+        the SLO tracker run off the aggregated view. Callable directly
+        by tests/harnesses that already hold a registry."""
+        t = self.loop.now
+        self.counters["recorder_ring_records"] = len(self.ring)
+        reg.add("recorder", "", dict(self.counters))
+        reg.add("slo", "", self.slo.metrics())
+        for gap in self._gap_records(reg, t):
+            self.counters["recorder_scrape_gaps"] += 1
+            self._write(gap)
+        agg = reg.aggregated()
+        self._derive(t, agg, dict(reg.values))
+        for opened in self.slo.observe(t, agg):
+            self.annotate(opened.pop("name"), "slo", t=t, severity="warn",
+                          **opened)
+        self._write({
+            "kind": "snapshot",
+            "t": round(t, 3),
+            "seq": self._seq,
+            "metrics": agg,
+            "per_process": reg.snapshot(),
+        })
+        self._seq += 1
+        self.counters["recorder_snapshots"] += 1
+        self._prev_agg = agg
+        self._prev_values = dict(reg.values)
+        self._prev_t = t
+
+    async def run(self) -> None:
+        """The always-on loop (spawn as its own task/process)."""
+        while True:
+            await self.loop.sleep(self.interval_s)
+            reg = await self.scrape()
+            self.observe_registry(reg)
+
+    # -- lifecycle / export ----------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Documented recorder_* counters (registry plane)."""
+        out = dict(self.counters)
+        out["recorder_ring_records"] = len(self.ring)
+        return out
+
+    def close(self) -> None:
+        """Detach the trace listener and drop the loop attachment (ring
+        file stays — it IS the artifact)."""
+        tracer = getattr(self.loop, "tracer", None)
+        if self._listening and tracer is not None:
+            try:
+                tracer.listeners.remove(self._on_trace)
+            except ValueError:
+                pass
+        self._listening = False
+        if getattr(self.loop, "flight_recorder", None) is self:
+            del self.loop.flight_recorder

@@ -79,6 +79,24 @@ CHAOS_DOCUMENTED_COUNTERS = (
     "chaos.chaos_pauses",
 )
 
+#: counters the flight recorder + SLO tracker (obs/recorder.py, obs/slo.py)
+#: contribute to a RECORDER-ARMED scrape — documented/pinned like the
+#: chaos set, expected only when a recorder rides the scrape (the doctor
+#: gate and recorder selfchecks pass them via `missing_documented(extra=)`).
+RECORDER_DOCUMENTED_COUNTERS = (
+    "recorder.recorder_snapshots",
+    "recorder.recorder_annotations",
+    "recorder.recorder_scrape_gaps",
+    "recorder.recorder_compactions",
+    "recorder.recorder_ring_records",
+    "slo.slo_windows",
+    "slo.slo_anomaly_windows",
+    "slo.slo_incidents",
+    "slo.slo_burn_violations",
+    "slo.slo_insufficient_windows",
+    "slo.slo_warmed_up",
+)
+
 
 def _flatten(out: dict, prefix: str, value: Any) -> None:
     """Numbers and booleans keep their key; dicts recurse with dots;
@@ -102,10 +120,23 @@ class MetricsRegistry:
         self.collisions: list[str] = []
         self._sources: dict[str, int] = {}  # full key -> add() call seq
         self._add_seq = 0
+        # Probes that FAILED this scrape: a dead/unreachable role is an
+        # explicit (role, instance, reason) gap record, never a silent
+        # hole — MetricsPoller/FlightRecorder turn these into scrape_gap
+        # timeline records with the outage duration attached. sources_ok
+        # is the complement (who DID answer), for outage-duration
+        # bookkeeping across snapshots.
+        self.gaps: list[dict] = []
+        self.sources_ok: list[tuple[str, str]] = []
+
+    def note_gap(self, role: str, instance: str, reason: str) -> None:
+        self.gaps.append(
+            {"role": role, "instance": instance, "reason": reason})
 
     def add(self, role: str, instance: str, metrics: "dict | None") -> None:
         if not metrics:
             return
+        self.sources_ok.append((role, instance))
         self._add_seq += 1
         flat: dict[str, float] = {}
         _flatten(flat, role, metrics)
@@ -190,19 +221,48 @@ class MetricsRegistry:
         return json.dumps(doc, sort_keys=True)
 
 
+def add_span_sink(reg: MetricsRegistry, sink) -> None:
+    """Contribute a SpanSink's tallies + timeline counters to a scrape
+    (the ``obs`` role): cumulative per-stage sum/count and the raw e2e
+    histogram bins. Cumulative-counter form on purpose — the flight
+    recorder's consumers (obs/slo.py, obs/doctor.py) diff CONSECUTIVE
+    snapshots into per-window histograms, which is the only honest way
+    to quote an interval p99 from a running sink."""
+    b = sink.breakdown()
+    reg.add("obs", "", {
+        "txns_seen": b["txns_seen"],
+        "txns_sampled": b["txns_sampled"],
+        "spans": len(sink.spans),
+        "unattributed_ms": b["unattributed_ms"],
+        "stage_sum_ms": {
+            name: round(h.sum_ms, 4)
+            for name, h in sorted(sink.stage_hists.items())
+        },
+        "stage_count": {
+            name: h.count for name, h in sorted(sink.stage_hists.items())
+        },
+        "e2e_sum_ms": round(sink.e2e_hist.sum_ms, 4),
+        "e2e_count": sink.e2e_hist.count,
+        "e2e_bins": {
+            f"b{i}": n for i, n in sink.e2e_hist.to_dict()["bins"]
+        },
+    })
+
+
 async def scrape_sim(cluster) -> MetricsRegistry:
     """Scrape every role of a SimCluster over its simulated network (the
     status-JSON discipline: an unreachable role's counters are genuinely
-    invisible, never read in-process), plus tracer event counts and the
-    span sink's tallies."""
+    invisible, never read in-process — but never a silent hole either:
+    a failed probe is an explicit reg.gaps entry), plus tracer event
+    counts and the span sink's tallies."""
     reg = MetricsRegistry()
     spawn = cluster.loop.spawn
 
     async def safe(fut):
         try:
             return await fut
-        except Exception:
-            return None
+        except Exception as e:
+            return e
 
     probes: list[tuple[str, str, Any]] = []
 
@@ -227,30 +287,22 @@ async def scrape_sim(cluster) -> MetricsRegistry:
     if ctrl_ep is not None:
         probe("controller", ctrl_ep, ctrl_ep.get_metrics())
     for role, inst, task in probes:
-        reg.add(role, inst, await task)
+        m = await task
+        if isinstance(m, BaseException):
+            reg.note_gap(role, inst, type(m).__name__)
+        else:
+            reg.add(role, inst, m)
 
     tracer = getattr(cluster.loop, "tracer", None)
     if tracer is not None:
         reg.add("trace", "", {"events": dict(tracer.counts)})
     sink = getattr(cluster.loop, "span_sink", None)
     if sink is not None:
-        b = sink.breakdown()
-        reg.add("obs", "", {
-            "txns_seen": b["txns_seen"],
-            "txns_sampled": b["txns_sampled"],
-            "spans": len(sink.spans),
-            "unattributed_ms": b["unattributed_ms"],
-        })
+        add_span_sink(reg, sink)
     return reg
 
 
-def scrape_deployed(loop, t, spec: dict) -> MetricsRegistry:
-    """Scrape a deployed cluster over its TCP endpoints (the cli
-    ``status`` role table, registry-shaped). Synchronous driver: pumps
-    the caller's RealLoop per probe like cli.Shell does."""
-    from foundationdb_tpu.server import parse_addr
-
-    reg = MetricsRegistry()
+def _deployed_plans(spec: dict) -> list[tuple[str, str, str, str]]:
     plans: list[tuple[str, str, str, str]] = []
     for role, service, method in (
         ("proxy", "grv_proxy", "get_metrics"),
@@ -263,21 +315,85 @@ def scrape_deployed(loop, t, spec: dict) -> MetricsRegistry:
     ):
         for i, addr in enumerate(spec.get(role) or []):
             plans.append((service, f"{service}{i}", addr, method))
-    for service, inst, addr, method in plans:
+    return plans
+
+
+async def scrape_deployed_async(loop, t, spec: dict,
+                                timeout_s: float = 5.0) -> MetricsRegistry:
+    """Async deployed scrape: awaitable from INSIDE a running RealLoop
+    (the flight recorder's periodic task), probe RPCs time-bounded AND
+    concurrent — k black-holed roles cost ONE timeout for the whole
+    sweep, not k serial ones, so the recorder's snapshot cadence holds
+    through exactly the outages it exists to record."""
+    from foundationdb_tpu.server import bounded_rpc, parse_addr
+
+    reg = MetricsRegistry()
+
+    async def probe(service, inst, addr, method):
         ep = t.endpoint(parse_addr(addr), service)
         try:
-            m = loop.run(getattr(ep, method)(), timeout=5.0)
-        except Exception:
-            m = None
-        reg.add(service, inst, m)
+            return await bounded_rpc(loop, getattr(ep, method)(),
+                                     timeout_s, transport=t)
+        except Exception as e:  # noqa: BLE001 — a gap record, not a crash
+            return e
+
+    plans = _deployed_plans(spec)
+    tasks = [loop.spawn(probe(*plan), name=f"obs.scrape.{plan[1]}")
+             for plan in plans]
+    for (service, inst, _addr, _method), task in zip(plans, tasks):
+        m = await task
+        if isinstance(m, BaseException):
+            reg.note_gap(service, inst, type(m).__name__)
+        else:
+            reg.add(service, inst, m)
     return reg
+
+
+def scrape_deployed(loop, t, spec: dict) -> MetricsRegistry:
+    """Scrape a deployed cluster over its TCP endpoints (the cli
+    ``status`` role table, registry-shaped). Synchronous driver: pumps
+    the caller's RealLoop like cli.Shell does; the probe plan and gap
+    accounting are scrape_deployed_async's."""
+    return loop.run(scrape_deployed_async(loop, t, spec), timeout=120.0)
+
+
+def scrape_gap_records(reg: MetricsRegistry, t: float,
+                       last_ok: dict, armed_at: float) -> list[dict]:
+    """THE outage-duration bookkeeping, shared by every scrape-loop
+    surface (MetricsPoller.run, the --poll CLI drive, the
+    FlightRecorder): update the last-answered stamp of every source
+    that DID reply this scrape, then turn each failed probe into one
+    scrape_gap record carrying how long that instance has been dark
+    (since its last answer, or since the scraper armed)."""
+    for src in reg.sources_ok:
+        last_ok[src] = t
+    out = []
+    for g in reg.gaps:
+        key = (g["role"], g["instance"])
+        since = last_ok.get(key, armed_at)
+        out.append({
+            "metric": "scrape_gap",
+            "t": round(t, 3),
+            "role": g["role"],
+            "instance": g["instance"],
+            "reason": g["reason"],
+            "duration_s": round(t - since, 3),
+        })
+    return out
 
 
 class MetricsPoller:
     """Periodic JSONL time-series: append one aggregated snapshot per
     interval — the deployed-cluster "scrape loop" (point Prometheus at
     to_prometheus for pull; this is the push/file form for hosts without
-    a scraper)."""
+    a scraper).
+
+    A dead/unreachable role is never a silent hole in the series: every
+    failed probe becomes an explicit ``scrape_gap`` record on the same
+    timeline — (role, instance, reason, duration since that instance
+    last answered), one per affected probe per snapshot while the outage
+    lasts — so an offline reader can tell "role was down" from "poller
+    never looked"."""
 
     def __init__(self, loop, scrape: Callable, path: str,
                  interval_s: float = 5.0):
@@ -286,13 +402,25 @@ class MetricsPoller:
         self.path = path
         self.interval_s = interval_s
         self.snapshots_written = 0
+        self.gaps_written = 0
+        self._armed_at = loop.now
+        self._last_ok: dict[tuple, float] = {}  # (role, inst) -> last t
+
+    def gap_records(self, reg: MetricsRegistry, t: float) -> list[dict]:
+        """Turn one scrape's probe failures into timeline records (the
+        shared scrape_gap_records bookkeeping)."""
+        return scrape_gap_records(reg, t, self._last_ok, self._armed_at)
 
     async def run(self) -> None:
         while True:
             await self.loop.sleep(self.interval_s)
             reg = await self.scrape()
-            line = reg.to_json_line(
-                t=round(self.loop.now, 3), seq=self.snapshots_written)
+            now = self.loop.now
+            lines = [json.dumps(r, sort_keys=True)
+                     for r in self.gap_records(reg, now)]
+            self.gaps_written += len(lines)
+            lines.append(reg.to_json_line(
+                t=round(now, 3), seq=self.snapshots_written))
             with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
+                f.write("\n".join(lines) + "\n")
             self.snapshots_written += 1

@@ -66,11 +66,15 @@ def _drive(cluster, n_txns: int, n_clients: int = 8,
 
 
 def _new_cluster(seed: int, obs: bool, sample_every: int,
-                 admission: bool = False):
+                 admission: bool = False,
+                 recorder_path: "str | None" = None,
+                 recorder_interval_s: "float | None" = None):
     from foundationdb_tpu.sim.cluster import SimCluster
 
     return SimCluster(seed=seed, n_storages=2, engine="oracle", obs=obs,
-                      obs_sample_every=sample_every, admission=admission)
+                      obs_sample_every=sample_every, admission=admission,
+                      recorder_path=recorder_path,
+                      recorder_interval_s=recorder_interval_s)
 
 
 def run_selfcheck(seed: int = 7, txns: int = 192, sample_every: int = 4,
@@ -79,13 +83,23 @@ def run_selfcheck(seed: int = 7, txns: int = 192, sample_every: int = 4,
     """One-JSON-line self-check record (metric ``obs_selfcheck``).
     ``export_trace``: also write THIS run's sampled window as a
     Chrome-trace/Perfetto timeline — the exported file is literally the
-    checked run, not a same-seed replay."""
+    checked run, not a same-seed replay. The flight recorder rides the
+    checked run too (tmp ring, 50ms SIM cadence — a short sim run spans
+    well under a wall second of simulated time, so the deployment-default
+    5s would never tick): snapshots + SLO windows must materialize and
+    ``workload.slo`` must reach status JSON with its honesty flags."""
     import json as _json
+    import os as _os
+    import tempfile as _tempfile
 
     from foundationdb_tpu.obs.registry import scrape_sim
     from foundationdb_tpu.runtime.status import fetch_status
 
-    c = _new_cluster(seed, obs=True, sample_every=sample_every)
+    ring_fd, ring_path = _tempfile.mkstemp(prefix="obs_ring_",
+                                           suffix=".jsonl")
+    _os.close(ring_fd)
+    c = _new_cluster(seed, obs=True, sample_every=sample_every,
+                     recorder_path=ring_path, recorder_interval_s=0.05)
     _drive(c, txns)
     sink = c.loop.span_sink
     if export_trace:
@@ -125,6 +139,36 @@ def run_selfcheck(seed: int = 7, txns: int = 192, sample_every: int = 4,
     if not lb.get("enabled"):
         problems.append("status workload.latency_breakdown missing/disabled")
 
+    # Flight recorder + SLO (ISSUE 15): the ring must hold snapshots, the
+    # tracker must have evaluated windows, and workload.slo must carry
+    # its honesty flags; the recorder-armed scrape must also pass the
+    # extended documented-counter audit.
+    from foundationdb_tpu.obs.recorder import FlightRecorder
+    from foundationdb_tpu.obs.registry import RECORDER_DOCUMENTED_COUNTERS
+
+    recorder = c.flight_recorder
+    ring = FlightRecorder.load(ring_path)
+    n_snaps = sum(1 for r in ring if r.get("kind") == "snapshot")
+    if n_snaps < 2:
+        problems.append(f"flight ring holds {n_snaps} snapshots (< 2)")
+    slo = status["workload"].get("slo") or {}
+    if not slo.get("enabled"):
+        problems.append("status workload.slo missing/disabled")
+    for honesty_key in ("warmed_up", "insufficient_p99_windows", "burn"):
+        if honesty_key not in slo:
+            problems.append(f"workload.slo lacks honesty field "
+                            f"{honesty_key!r}")
+    reg_rec = c.loop.run(scrape_sim(c), timeout=600)
+    reg_rec.add("recorder", "", recorder.metrics())
+    reg_rec.add("slo", "", recorder.slo.metrics())
+    missing_rec = reg_rec.missing_documented(
+        extra=RECORDER_DOCUMENTED_COUNTERS)
+    if missing_rec:
+        problems.append(
+            f"recorder documented counters missing: {missing_rec}")
+    recorder.close()
+    _os.unlink(ring_path)
+
     return {
         "metric": "obs_selfcheck",
         "ok": not problems,
@@ -137,6 +181,9 @@ def run_selfcheck(seed: int = 7, txns: int = 192, sample_every: int = 4,
         "unattributed_frac": b["unattributed_frac"],
         "scrape_metrics": len(reg.values),
         "stages": sorted(b["stages"]),
+        "ring_snapshots": n_snaps,
+        "slo_windows": slo.get("windows"),
+        "slo_warmed_up": slo.get("warmed_up"),
     }
 
 
@@ -150,22 +197,41 @@ def span_records(seed: int, txns: int = 96, sample_every: int = 4) -> str:
 
 def run_overhead_ab(seed: int = 11, txns: int = 3072,
                     sample_every: int = 64, reps: int = 3,
-                    gate: float = OVERHEAD_GATE) -> dict:
-    """OBS_AB.json: measured throughput overhead of 1-in-N sampling on
-    the windowed closed-loop sim workload, tracing disabled vs armed."""
-    def arm(obs: bool) -> float:
-        c = _new_cluster(seed, obs=obs, sample_every=sample_every)
+                    gate: float = OVERHEAD_GATE,
+                    recorder_interval_s: float = 5.0) -> dict:
+    """OBS_AB.json: measured throughput overhead on the windowed
+    closed-loop sim workload across THREE arms, alternating per rep so
+    host drift hits all equally — tracing disabled, 1-in-N sampling, and
+    1-in-N sampling + the flight recorder armed (ring to a tmp file at
+    its default 5s cadence, the recommended deployment config). Both the
+    tracing arm and the recorder arm gate at <=2% vs off."""
+    import tempfile
+
+    def arm(obs: bool, recorder: bool = False) -> float:
+        ring = None
+        if recorder:
+            fd, ring = tempfile.mkstemp(prefix="obs_ab_ring_",
+                                        suffix=".jsonl")
+            os.close(fd)
+        c = _new_cluster(seed, obs=obs, sample_every=sample_every,
+                         recorder_path=ring,
+                         recorder_interval_s=recorder_interval_s)
         t0 = time.perf_counter()
         _drive(c, txns)
         wall = time.perf_counter() - t0
+        if ring is not None:
+            os.unlink(ring)
         return txns / wall
 
-    tps = {"off": [], "on": []}
-    for _ in range(reps):  # alternating arms: drift hits both equally
+    tps = {"off": [], "on": [], "rec": []}
+    for _ in range(reps):  # alternating arms: drift hits all equally
         tps["off"].append(arm(False))
         tps["on"].append(arm(True))
+        tps["rec"].append(arm(True, recorder=True))
     best_off, best_on = max(tps["off"]), max(tps["on"])
+    best_rec = max(tps["rec"])
     overhead = 1.0 - best_on / best_off
+    rec_overhead = 1.0 - best_rec / best_off
     try:
         load1m = round(os.getloadavg()[0], 2)
     except OSError:
@@ -177,16 +243,20 @@ def run_overhead_ab(seed: int = 11, txns: int = 3072,
         "txns_per_rep": txns,
         "reps_per_arm": reps,
         "sample_every": sample_every,
+        "recorder_interval_s": recorder_interval_s,
         "txns_per_sec_off": [round(x, 1) for x in tps["off"]],
         "txns_per_sec_on": [round(x, 1) for x in tps["on"]],
+        "txns_per_sec_recorder": [round(x, 1) for x in tps["rec"]],
         "best_off_tps": round(best_off, 1),
         "best_on_tps": round(best_on, 1),
+        "best_recorder_tps": round(best_rec, 1),
         "overhead_frac": round(overhead, 4),
+        "recorder_overhead_frac": round(rec_overhead, 4),
         "gate_frac": gate,
         # Honesty flags (repo convention): CPU-only sim, no TPU run
         # attempted or claimed; wall-clock measurement, so the host's
         # load rides along for the reader.
-        "valid": overhead <= gate,
+        "valid": overhead <= gate and rec_overhead <= gate,
         "cpu_fallback": False,
         "host": {"loadavg_1m": load1m,
                  "cores": (len(os.sched_getaffinity(0))

@@ -1,0 +1,333 @@
+"""SLO burn tracking + rolling-baseline anomaly detection (obs subsystem).
+
+Consumes the flight recorder's snapshot stream (obs/recorder.py)
+INCREMENTALLY: each pair of consecutive metric snapshots defines one
+*window*, and every SLI is computed from counter deltas over that window
+— never from cumulative run totals, which average an incident away:
+
+- ``goodput_tps``      Δ commit_proxy.txns_committed / Δt
+- ``commit_p99_ms``    p99 of the window's e2e latency histogram,
+                       obtained by DIFFING the sink's cumulative
+                       log-binned histogram between the two snapshots
+                       (obs.e2e_bins.* — the only honest interval p99 a
+                       running sink admits); quotable only at
+                       >= MIN_P99_SAMPLES samples in the window
+- ``unknown_frac``     Δ client.commit_unknowns / Δ client-side commit
+                       outcomes, when a client-side harness (chaos,
+                       open-loop) contributes the ``client`` role;
+                       quotable only at >= MIN_UNKNOWN_OUTCOMES outcomes
+                       in the window
+
+Honesty is structural, not advisory: every window carries
+``p99_quotable``; no anomaly is ever claimed before WARMUP_WINDOWS
+baseline windows exist (``warmed_up`` rides status JSON and the slo_*
+counters); insufficient-sample windows are counted, not silently used.
+
+Anomaly rule (per SLI): the window value must deviate from the rolling
+baseline mean by BOTH k·σ and a relative guard (σ of a quiet sim is ~0,
+so k·σ alone would fire on noise; the relative guard alone would miss
+slow degradations on noisy hosts). Baselines accumulate only
+NON-anomalous windows so an incident cannot poison the reference it is
+judged against. Contiguous anomalous windows merge into one *incident*
+— the unit obs/doctor.py attributes a root cause to.
+
+SLO burn: each objective (absolute bound, e.g. commit p99 <= 500ms) is
+checked per window; the burn rate is the violating-window fraction over
+the configured error budget (burn_rate > 1 == burning hotter than the
+budget allows). Exported into status JSON ``workload.slo`` and, via
+``metrics()``, as the documented ``slo_*`` counters on the Prometheus /
+registry plane.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from foundationdb_tpu.loadgen.harness import LatencyHistogram
+
+#: default absolute objectives (override per deployment via the recorder);
+#: None disables an objective. goodput has no universal floor — its SLO
+#: is the relative anomaly path unless the operator supplies one.
+DEFAULT_OBJECTIVES = {
+    "commit_p99_ms": 1000.0,
+    "goodput_min_tps": None,
+    "unknown_frac_max": 0.01,
+}
+
+
+def p99_from_bins(bins: "dict[int, int]", q: float = 99.0) -> float:
+    """Percentile over a sparse {bin index: count} histogram in
+    LatencyHistogram's shared bin space (conservative upper-edge rule,
+    same as LatencyHistogram.percentile; overflow bin reports the top
+    edge — the diffed interval histogram has no exact max)."""
+    total = sum(bins.values())
+    if total <= 0:
+        return 0.0
+    target = -(-total * q // 100)  # ceil
+    edges = LatencyHistogram._EDGES
+    cum = 0
+    for i in sorted(bins):
+        cum += bins[i]
+        if cum >= target:
+            if i >= len(edges):
+                return round(float(edges[-1]), 4)
+            return round(float(edges[i]), 4)
+    return round(float(edges[-1]), 4)
+
+
+class SloTracker:
+    #: baseline windows required before ANY anomaly may be claimed.
+    WARMUP_WINDOWS = 5
+    #: rolling baseline length (non-anomalous windows).
+    BASELINE_WINDOW = 60
+    #: e2e samples a window needs for its p99 to be quotable.
+    MIN_P99_SAMPLES = 20
+    #: client-side outcomes a window needs for its unknown-result rate
+    #: to be quotable (1 unknown among 3 outcomes is 33% by arithmetic
+    #: and noise by any honest reading).
+    MIN_UNKNOWN_OUTCOMES = 20
+    #: k·σ deviation gate.
+    K_SIGMA = 4.0
+    #: relative guards: goodput must fall below (1-0.5)·mean, p99 must
+    #: exceed (1+1.0)·mean — BOTH this and k·σ must hold.
+    REL_GOODPUT = 0.5
+    REL_P99 = 1.0
+    #: SLO error budget: tolerated violating-window fraction.
+    ERROR_BUDGET_FRAC = 0.01
+    #: bounded memories (long soaks must not grow state).
+    MAX_INCIDENTS = 64
+    MAX_WINDOWS = 512
+
+    def __init__(self, objectives: "dict | None" = None):
+        self.objectives = dict(DEFAULT_OBJECTIVES)
+        if objectives:
+            self.objectives.update(objectives)
+        self._prev: "tuple[float, dict] | None" = None  # (t, aggregated)
+        self._baseline: dict[str, deque] = {
+            "goodput_tps": deque(maxlen=self.BASELINE_WINDOW),
+            "commit_p99_ms": deque(maxlen=self.BASELINE_WINDOW),
+        }
+        self.windows: deque[dict] = deque(maxlen=self.MAX_WINDOWS)
+        self.incidents: list[dict] = []
+        self._open_incidents: dict[str, dict] = {}  # sli -> incident
+        self.counters = {
+            "slo_windows": 0,
+            "slo_anomaly_windows": 0,
+            "slo_incidents": 0,
+            "slo_burn_violations": 0,
+            "slo_insufficient_windows": 0,
+            "slo_warmed_up": 0,
+        }
+        self._burn: dict[str, dict] = {}  # objective -> {violating, windows}
+
+    # -- baseline helpers ------------------------------------------------------
+
+    @staticmethod
+    def _mean_std(values) -> tuple[float, float]:
+        n = len(values)
+        if n == 0:
+            return 0.0, 0.0
+        mean = sum(values) / n
+        var = sum((v - mean) ** 2 for v in values) / n
+        return mean, var ** 0.5
+
+    @property
+    def warmed_up(self) -> bool:
+        return len(self._baseline["goodput_tps"]) >= self.WARMUP_WINDOWS
+
+    # -- ingest ----------------------------------------------------------------
+
+    @staticmethod
+    def _e2e_bins(agg: dict) -> "dict[int, int]":
+        pref = "obs.e2e_bins.b"
+        return {int(k[len(pref):]): int(v) for k, v in agg.items()
+                if k.startswith(pref)}
+
+    def observe(self, t: float, agg: dict) -> list[dict]:
+        """One snapshot's aggregated metrics. Returns the anomaly
+        annotations this window OPENED (the recorder rings them onto the
+        timeline); window/burn/incident state updates internally."""
+        prev = self._prev
+        self._prev = (t, dict(agg))
+        if prev is None:
+            return []
+        t0, agg0 = prev
+        dt = t - t0
+        if dt <= 0:
+            return []
+        self.counters["slo_windows"] += 1
+
+        win: dict = {"t0": round(t0, 3), "t1": round(t, 3),
+                     "dt_s": round(dt, 3)}
+        # goodput from the committed-txn counter delta (re-baselined on
+        # counter regression — a recovery swapped the proxy generation).
+        c0 = agg0.get("commit_proxy.txns_committed", 0)
+        c1 = agg.get("commit_proxy.txns_committed", 0)
+        win["goodput_tps"] = round(max(0, c1 - c0) / dt, 2)
+        # interval p99 from the cumulative e2e histogram diff.
+        b0, b1 = self._e2e_bins(agg0), self._e2e_bins(agg)
+        dbins = {i: n - b0.get(i, 0) for i, n in b1.items()
+                 if n - b0.get(i, 0) > 0}
+        n_samples = sum(dbins.values())
+        win["e2e_samples"] = n_samples
+        win["p99_quotable"] = n_samples >= self.MIN_P99_SAMPLES
+        win["commit_p99_ms"] = (p99_from_bins(dbins)
+                                if win["p99_quotable"] else None)
+        if not win["p99_quotable"]:
+            self.counters["slo_insufficient_windows"] += 1
+        # unknown-result rate, when a client-side harness reports it —
+        # quotable only at MIN_UNKNOWN_OUTCOMES outcomes in the window
+        # (below the floor the SLI is None, mirroring p99_quotable, and
+        # neither the anomaly path nor burn accounting consumes it).
+        u0, u1 = (agg0.get("client.commit_unknowns"),
+                  agg.get("client.commit_unknowns"))
+        if u0 is not None and u1 is not None:
+            a0 = agg0.get("client.commits_acked", 0)
+            a1 = agg.get("client.commits_acked", 0)
+            outcomes = max(0, (u1 - u0)) + max(0, (a1 - a0))
+            win["client_outcomes"] = outcomes
+            win["unknown_frac"] = (
+                round(max(0, u1 - u0) / outcomes, 4)
+                if outcomes >= self.MIN_UNKNOWN_OUTCOMES else None)
+        else:
+            win["client_outcomes"] = None
+            win["unknown_frac"] = None
+
+        anomalies = self._judge(win)
+        win["anomalous"] = sorted(anomalies)
+        self.windows.append(win)
+        self._account_burn(win)
+        return self._update_incidents(win, anomalies)
+
+    # -- anomaly judgement -----------------------------------------------------
+
+    def _judge(self, win: dict) -> dict[str, dict]:
+        """SLI -> {observed, baseline_mean} for every SLI anomalous in
+        this window. Never fires before warm-up; only non-anomalous
+        values feed the baselines."""
+        out: dict[str, dict] = {}
+        warmed = self.warmed_up
+        self.counters["slo_warmed_up"] = int(warmed)
+
+        g = win["goodput_tps"]
+        mean, std = self._mean_std(self._baseline["goodput_tps"])
+        if (warmed and g < mean * (1 - self.REL_GOODPUT)
+                and g < mean - self.K_SIGMA * std):
+            out["goodput_tps"] = {"observed": g,
+                                  "baseline_mean": round(mean, 2)}
+        else:
+            self._baseline["goodput_tps"].append(g)
+
+        if win["p99_quotable"]:
+            p = win["commit_p99_ms"]
+            bl = self._baseline["commit_p99_ms"]
+            mean, std = self._mean_std(bl)
+            if (warmed and len(bl) >= self.WARMUP_WINDOWS
+                    and p > mean * (1 + self.REL_P99)
+                    and p > mean + self.K_SIGMA * std):
+                out["commit_p99_ms"] = {"observed": p,
+                                        "baseline_mean": round(mean, 3)}
+            else:
+                bl.append(p)
+
+        u = win["unknown_frac"]
+        bound = self.objectives.get("unknown_frac_max")
+        if warmed and u is not None and bound is not None and u > bound:
+            # Absolute bound, but the warm-up gate still applies: "no
+            # anomaly before WARMUP_WINDOWS" is the module's structural
+            # promise, for every SLI.
+            out["unknown_frac"] = {"observed": u, "baseline_mean": bound}
+        return out
+
+    # -- burn ------------------------------------------------------------------
+
+    def _account_burn(self, win: dict) -> None:
+        checks = []
+        bound = self.objectives.get("commit_p99_ms")
+        if bound is not None and win["p99_quotable"]:
+            checks.append(("commit_p99_ms", win["commit_p99_ms"] > bound))
+        floor = self.objectives.get("goodput_min_tps")
+        if floor is not None:
+            checks.append(("goodput_min_tps", win["goodput_tps"] < floor))
+        cap = self.objectives.get("unknown_frac_max")
+        if cap is not None and win["unknown_frac"] is not None:
+            checks.append(("unknown_frac_max", win["unknown_frac"] > cap))
+        for name, violated in checks:
+            b = self._burn.setdefault(name, {"violating": 0, "windows": 0})
+            b["windows"] += 1
+            if violated:
+                b["violating"] += 1
+                self.counters["slo_burn_violations"] += 1
+
+    # -- incidents -------------------------------------------------------------
+
+    def _update_incidents(self, win: dict,
+                          anomalies: dict[str, dict]) -> list[dict]:
+        """Merge contiguous anomalous windows into incidents; returns
+        annotation payloads for NEWLY opened incidents."""
+        opened: list[dict] = []
+        if anomalies:
+            self.counters["slo_anomaly_windows"] += 1
+        for sli, info in anomalies.items():
+            inc = self._open_incidents.get(sli)
+            if inc is None:
+                inc = {"sli": sli, "t0": win["t0"], "t1": win["t1"],
+                       "observed": info["observed"],
+                       "baseline_mean": info["baseline_mean"],
+                       "windows": 1}
+                self._open_incidents[sli] = inc
+                self.incidents.append(inc)
+                del self.incidents[:-self.MAX_INCIDENTS]
+                self.counters["slo_incidents"] += 1
+                opened.append({"name": "SloAnomalyDetected", "sli": sli,
+                               **info, "t0": win["t0"]})
+            else:
+                inc["t1"] = win["t1"]
+                inc["windows"] += 1
+                # Keep the WORST observation as the incident headline.
+                worse = (info["observed"] < inc["observed"]
+                         if sli == "goodput_tps"
+                         else info["observed"] > inc["observed"])
+                if worse:
+                    inc["observed"] = info["observed"]
+        for sli in list(self._open_incidents):
+            if sli not in anomalies:
+                del self._open_incidents[sli]  # incident closed
+        return opened
+
+    # -- export ----------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``workload.slo`` status-JSON document (honesty flags are
+        first-class: warm-up state, per-window p99 quotability, and the
+        insufficient-sample count are always present)."""
+        last = self.windows[-1] if self.windows else None
+        burn = {}
+        for name, b in self._burn.items():
+            frac = b["violating"] / b["windows"] if b["windows"] else 0.0
+            burn[name] = {
+                "objective": self.objectives.get(name),
+                "windows": b["windows"],
+                "violating": b["violating"],
+                "violating_frac": round(frac, 4),
+                "budget_frac": self.ERROR_BUDGET_FRAC,
+                "burn_rate": round(frac / self.ERROR_BUDGET_FRAC, 2),
+            }
+        return {
+            "enabled": True,
+            "warmed_up": self.warmed_up,
+            "warmup_windows": self.WARMUP_WINDOWS,
+            "windows": self.counters["slo_windows"],
+            "anomaly_windows": self.counters["slo_anomaly_windows"],
+            "insufficient_p99_windows":
+                self.counters["slo_insufficient_windows"],
+            "current": last,
+            "objectives": dict(self.objectives),
+            "burn": burn,
+            "incidents": self.incidents[-8:],
+            "open_incidents": sorted(self._open_incidents),
+        }
+
+    def metrics(self) -> dict:
+        """The documented slo_* counters (registry/Prometheus plane)."""
+        return dict(self.counters)

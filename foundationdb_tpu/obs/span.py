@@ -205,7 +205,8 @@ class SpanSink:
         """Histogram-only stage sample (batch-level sub-stages)."""
         self._hist(name).record_n(dur_s * 1e3, n)
 
-    def stage_tick(self, name: str, dur_s: float, n: int = 1) -> None:
+    def stage_tick(self, name: str, dur_s: float, n: int = 1,
+                   version: "int | None" = None) -> None:
         """Sampled sub-stage record: 1-in-sample_every per stage NAME,
         counter-based (deterministic). The population sub-stages
         (grv_proxy_queue, tlog_fsync, per-batch resolver stages) ride the
@@ -213,11 +214,21 @@ class SpanSink:
         recording they alone cost ~10% throughput, which would fail the
         subsystem's own overhead gate. They are distribution detail, not
         part of the reconciliation identity, so sampling them like the
-        txn spans keeps the gate honest and the histograms statistical."""
+        txn spans keeps the gate honest and the histograms statistical.
+
+        ``version``: also ring a batch-level span record for the sampled
+        tick (tid None, the batch's commit version attached) so the
+        Chrome-trace/Perfetto export shows the sub-stage on the emitting
+        role's track — the mesh wave stages (wave_exchange/wave_level)
+        pass it so the sharded protocol's comms/level cost is visible on
+        the timeline, not only in the flat tallies."""
         c = self._stage_ticks.get(name, 0) + 1
         if c >= self.sample_every:
             self._stage_ticks[name] = 0
             self.record_stage(name, dur_s, n)
+            if version is not None:
+                self.add_span(None, name, self.loop.now - dur_s, dur_s,
+                              version=version)
         else:
             self._stage_ticks[name] = c
 

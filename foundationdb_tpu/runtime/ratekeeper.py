@@ -18,6 +18,21 @@ from foundationdb_tpu.runtime.flow import Loop, all_of, rpc
 from foundationdb_tpu.runtime.sequencer import VERSIONS_PER_SECOND
 from foundationdb_tpu.runtime.trace import Severity, trace
 
+#: Every limiting reason _scale can report, in a FIXED order: get_rates
+#: exports the current reason as ``limiting_reason_code`` (an index into
+#: this tuple) so the signal survives the numbers-only metrics plane —
+#: the obs flight recorder decodes transitions back to names from the
+#: same tuple (obs/recorder.py annotation catalog).
+LIMIT_REASONS = (
+    "none",
+    "storage_lag",
+    "durability_lag",
+    "storage_queue",
+    "tlog_queue",
+    "resolver_queue",
+    "admission_filter",
+)
+
 
 class Ratekeeper:
     POLL_INTERVAL = 0.1
@@ -83,6 +98,12 @@ class Ratekeeper:
         self.worst_resolver_occupancy = 0.0
         self.worst_admission_saturation = 0.0
         self.limiting_reason = "none"
+        # Limiting-reason transition count: a remote scraper (the flight
+        # recorder polling over TCP) sees only numbers, so a reason that
+        # engaged AND released between two polls would be invisible from
+        # the code alone — the counter delta says "something transitioned
+        # here" even when the endpoints look identical.
+        self.limit_transitions = 0
         # Per-tag tps quotas (reference: TagThrottleApi manual throttles in
         # \xff\x02/throttle/): enforced by the GRV proxies' per-tag buckets.
         # The recruiter may pass a SHARED dict so operator quotas survive
@@ -253,6 +274,7 @@ class Ratekeeper:
                 worst, reason = s, name
         if frac == 1.0:
             if reason != self.limiting_reason:
+                self.limit_transitions += 1
                 trace(self.loop).event(
                     "RkLimitReasonChanged",
                     Severity.INFO if reason == "none" else Severity.WARN,
@@ -283,6 +305,12 @@ class Ratekeeper:
             "tps_limit_share": self.tps_limit / n_pollers,
             "batch_tps_limit_share": self.batch_tps_limit / n_pollers,
             "limiting_reason": self.limiting_reason,
+            # Numeric twin of limiting_reason (index into LIMIT_REASONS)
+            # plus the transition counter: the flight recorder's remote
+            # scrape keeps numbers only, and these two carry the reason
+            # and its flapping through that plane.
+            "limiting_reason_code": LIMIT_REASONS.index(self.limiting_reason),
+            "limit_transitions": self.limit_transitions,
             "worst_storage_lag": self.worst_lag,
             "worst_durability_lag": self.worst_durability_lag,
             "worst_storage_queue_bytes": self.worst_storage_queue,

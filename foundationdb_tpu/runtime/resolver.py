@@ -336,7 +336,7 @@ class Resolver:
             # the resolver's device_dispatch umbrella (SUB_STAGES).
             sink.stage_tick("wave_exchange",
                             self.loop.now - pend["t_edges_done"],
-                            n=max(1, len(txns)))
+                            n=max(1, len(txns)), version=version)
         if self.dispatch_cost_s:
             await self.loop.sleep(self.dispatch_cost_s)
         clock = stage_clock(self.loop) if sink is not None else None
@@ -353,7 +353,7 @@ class Resolver:
         if sink is not None:
             dur = clock() - t0 + self.dispatch_cost_s
             n = max(1, len(txns))
-            sink.stage_tick("wave_level", dur, n=n)
+            sink.stage_tick("wave_level", dur, n=n, version=version)
             sink.stage_tick("device_dispatch", dur, n=n)
         self._replies[version] = reply
         self._trim_replies()
@@ -724,4 +724,25 @@ class Resolver:
                 self.admission_filter.metrics()
                 if self.admission_filter is not None else None
             ),
+            # Engine topology/capacity events (resident/mesh engines; all
+            # zero for oracle and cpp): density reshards and forced full
+            # repacks surface here so the flight recorder can annotate
+            # them on the cluster timeline (pure-counter plane — the
+            # recorder turns deltas into `reshard` annotations).
+            "engine": {
+                "auto_reshards": getattr(self.cs, "auto_reshards", 0),
+                "reshard_moved_shards": getattr(
+                    self.cs, "reshard_moved_shards", 0),
+                "full_repacks": self._engine_dict_stat("full_repacks"),
+                "evictions": self._engine_dict_stat("evictions"),
+            },
         }
+
+    def _engine_dict_stat(self, key: str) -> int:
+        """A resident-dictionary stat counter (TPUConflictSet.dict_stats
+        property), 0 for engines without one / non-resident mode."""
+        try:
+            stats = getattr(self.cs, "dict_stats", None) or {}
+        except Exception:
+            return 0
+        return int(stats.get(key, 0) or 0)

@@ -236,6 +236,16 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         sink.breakdown() if sink is not None else {"enabled": False}
     )
 
+    # SLO burn + anomaly state (obs flight recorder, obs/slo.py): the
+    # rolling-baseline tracker's document, honesty flags (warm-up,
+    # insufficient-sample counts) included — or an explicit disabled
+    # marker when no recorder is armed on this loop.
+    recorder = getattr(cluster.loop, "flight_recorder", None)
+    doc["workload"]["slo"] = (
+        recorder.slo.status() if recorder is not None
+        else {"enabled": False}
+    )
+
     # Trace rollup (reference: status surfaces recent TraceEvent errors and
     # event counts from the cluster's trace logs).
     tracer = getattr(cluster.loop, "tracer", None)

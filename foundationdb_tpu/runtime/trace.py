@@ -115,6 +115,11 @@ class Tracer:
         self.max_files = max_files
         self.ring: deque[dict] = deque(maxlen=ring_size)
         self.counts: Counter[str] = Counter()
+        # Event listeners (obs flight recorder): called with every emitted
+        # record AFTER it enters the ring. A listener is an observer, not
+        # a sink — exceptions are swallowed so a broken observer can never
+        # take the tracing backbone (and with it the role hot path) down.
+        self.listeners: list = []
         self._file: TextIO | None = None
         self._file_bytes = 0
         self._file_seq = 0
@@ -145,6 +150,11 @@ class Tracer:
             rec[f"Detail_{k}" if k in _RESERVED else k] = v
         self.counts[ev.type] += 1
         self.ring.append(rec)
+        for fn in self.listeners:
+            try:
+                fn(rec)
+            except Exception:
+                pass  # observers must never break the emitting role
         if self.trace_dir is not None:
             self._write(rec)
 

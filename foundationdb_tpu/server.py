@@ -1969,6 +1969,25 @@ def main(argv: list[str] | None = None) -> None:
 
     admin = _Admin()
     t.serve("admin", admin)
+    # Flight recorder (obs subsystem, FDB_TPU_RECORDER=<ring path>): the
+    # controller process doubles as the cluster's always-on recorder —
+    # periodic deployed scrapes with explicit scrape_gap records, derived
+    # annotations, and SLO tracking onto a bounded on-disk ring
+    # (obs/recorder.py; `cli doctor` / --doctor read it back). Controller
+    # only: it is the one role whose lifetime spans recoveries of the
+    # others, and a recorder that dies with its subject records nothing.
+    recorder = None
+    if args.role == "controller" and os.environ.get("FDB_TPU_RECORDER"):
+        from foundationdb_tpu.obs.recorder import FlightRecorder
+        from foundationdb_tpu.obs.registry import scrape_deployed_async
+
+        recorder = FlightRecorder(
+            loop, lambda: scrape_deployed_async(loop, t, spec),
+            os.environ["FDB_TPU_RECORDER"],
+            interval_s=float(
+                os.environ.get("FDB_TPU_RECORDER_INTERVAL") or 5.0),
+        )
+        loop.spawn(recorder.run(), name="controller.flight_recorder")
     tracer.event("ProgramStart", Role=args.role, Index=args.index,
                  Address=f"{t.addr[0]}:{t.addr[1]}")
     print(f"ready {args.role}{args.index} on {t.addr[0]}:{t.addr[1]}",
@@ -1983,6 +2002,8 @@ def main(argv: list[str] | None = None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if recorder is not None:
+            recorder.close()  # ring file stays — it IS the artifact
         tracer.close()
         t.close()
 

@@ -113,6 +113,8 @@ class SimCluster:
         admission_opts: dict | None = None,
         obs: bool | None = None,
         obs_sample_every: int | None = None,
+        recorder_path: str | None = None,
+        recorder_interval_s: float | None = None,
     ):
         """``multi_region`` (reference: DatabaseConfiguration regions —
         fdbclient/DatabaseConfiguration.cpp — and DataDistribution region
@@ -170,6 +172,25 @@ class SimCluster:
         self.obs = obs_env_default() if obs is None else bool(obs)
         if self.obs and not hasattr(self.loop, "span_sink"):
             SpanSink(self.loop, sample_every=obs_sample_every)
+        # Flight recorder (obs subsystem): event-annotated metric
+        # time-series ring on disk + SLO tracking, armed per cluster via
+        # recorder_path. Spawned on its own sim process so kills /
+        # partitions of cluster roles never take the recorder with them
+        # (it is the thing that must survive the incident).
+        self.flight_recorder = None
+        if recorder_path is not None:
+            from foundationdb_tpu.obs.recorder import FlightRecorder
+            from foundationdb_tpu.obs.registry import scrape_sim
+
+            self.flight_recorder = FlightRecorder(
+                self.loop, lambda: scrape_sim(self), recorder_path,
+                interval_s=recorder_interval_s,
+            )
+            self.loop.spawn(
+                self.flight_recorder.run(),
+                process=process_prefix + "flight_recorder",
+                name="flight_recorder.run",
+            )
         # Namespace for loop-global process names: two clusters on one
         # Loop (a DR pair) must not both own a "tlog0" (kills would
         # cross clusters). Applied by SimNetwork at host()/kill() and

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Incident-doctor CI gate -> DOCTOR.json (ISSUE 15).
+#
+# Re-runs the seeded mini-chaos script (loadgen/chaos.py --fast: SIGKILL
+# + restart of each role class under live open-loop load) with the obs
+# flight recorder ARMED: server processes trace commit-path stages
+# (FDB_TPU_OBS=1), the harness rings 1s metric snapshots + fault/heal
+# annotations + client-ledger counters to an on-disk ring, and then
+# obs/doctor.py ingests the ring and must attribute EVERY injected fault
+# window to its expected annotation class (kill/partition/pause ->
+# recovery) — plus the ring audit (snapshots present, documented
+# recorder_*/slo_* counters in the scrape, SLO windows evaluated) and
+# the chaos battery's own zero-loss/exactly-once gates (a doctor verdict
+# about a broken run proves nothing). One JSON line, exact gates.
+#
+# Replay:   bash scripts/doctor_run.sh --seed <seed>
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-DOCTOR.json}"
+timeout -k 30 900 env JAX_PLATFORMS=cpu \
+  python -m foundationdb_tpu.obs --doctor-gate "$@" \
+  > "$OUT.tmp"
+rc=$?
+if [ $rc -eq 0 ] && [ -s "$OUT.tmp" ]; then
+  mv "$OUT.tmp" "$OUT"
+  echo "doctor gate record -> $OUT" >&2
+else
+  echo "doctor gate failed rc=$rc (partial record kept as $OUT.tmp)" >&2
+fi
+exit $rc

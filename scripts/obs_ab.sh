@@ -1,10 +1,13 @@
 #!/bin/bash
 # Observability sampling-overhead A/B (the obs subsystem's
 # off-by-default-cheap acceptance): the SAME closed-loop sim workload is
-# wall-clocked with tracing disabled vs armed at 1-in-64 sampling
-# (FDB_TPU_OBS_SAMPLE default), alternating arms, best-of-N throughput
-# per arm, and OBS_AB.json records the measured throughput overhead
-# against the <=2% gate.
+# wall-clocked across THREE arms, alternating per rep so host drift hits
+# all equally — tracing disabled, 1-in-64 sampling (FDB_TPU_OBS_SAMPLE
+# default), and 1-in-64 sampling + the flight recorder armed (tmp ring
+# at its default 5s cadence, the recommended deployment config) —
+# best-of-N throughput per arm. OBS_AB.json records both measured
+# overheads (overhead_frac, recorder_overhead_frac); BOTH gate at <=2%
+# (`valid` requires both).
 #
 # Pure simulation on the CPU by design (no TPU run attempted or
 # claimed — cpu_fallback:false means exactly that, as in every sim A/B
@@ -18,11 +21,13 @@ cd "$(dirname "$0")/.."
 TXNS=${TXNS:-3072}
 SEED=${SEED:-11}
 SAMPLE=${SAMPLE:-64}
+REPS=${REPS:-3}
 OUT=${OUT:-OBS_AB.json}
 LOG=${LOG:-obs_ab.log}
 
 env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs --ab \
     --txns "$TXNS" --seed "$SEED" --sample-every "$SAMPLE" \
+    --reps "$REPS" \
     > "$OUT.tmp" 2>> "$LOG"
 rc=$?
 # rc 1 = gate missed (record still printed, valid:false); >1 = harness

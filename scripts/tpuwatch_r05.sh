@@ -110,6 +110,25 @@ while true; do
     'r.get("metric") == "deployed_chaos" and r.get("ok")' -- \
     env JAX_PLATFORMS=cpu python -m foundationdb_tpu.loadgen.chaos --fast \
     || { sleep 60; continue; }
+  # Incident-doctor gate (obs flight recorder): the seeded mini-chaos
+  # script re-runs with the recorder armed (servers traced, 1s metric
+  # snapshots + fault/heal annotations ringed), then the doctor must
+  # attribute EVERY injected fault window to its expected annotation
+  # class on the ring timeline, with the documented recorder_*/slo_*
+  # counters audited in the scrape — one JSON line, exact gates.
+  # CPU-only real-process run (no TPU claimed).
+  stage doctor 900 DOCTOR_r05.json \
+    'r.get("metric") == "doctor_gate" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs --doctor-gate \
+    || { sleep 60; continue; }
+  # Perf-trajectory drift check (obs/history.py): fold every committed
+  # BENCH_*/ *_AB.json artifact into the time-ordered regression table —
+  # valid:false records listed with reasons but REFUSED as ratio
+  # endpoints — so each future round gets a drift line for free.
+  stage bench_history 300 BENCH_HISTORY_r05.json \
+    'r.get("metric") == "bench_history" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs --bench-history \
+    || { sleep 60; continue; }
   # Observability selfcheck (obs subsystem): one-JSON-line scrape + span
   # reconciliation on a short sim run — complete span trees, the
   # e2e == sum(stages) + unattributed identity, and the metrics-name

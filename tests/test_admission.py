@@ -164,6 +164,33 @@ class TestAdmissionPolicy:
         assert d.action == "admit"
         assert pol.counters["preabort_ceiling"] == 1
 
+    def test_engage_release_episodes_have_hysteresis(self):
+        """The obs flight recorder annotates admission engage/release
+        EPISODES from these counter deltas: first intervention engages,
+        only RELEASE_CLEAN consecutive clean admits release — a workload
+        shaping one txn in fifty must not flap an episode per batch."""
+        f = _mk_filter()
+        pol = AdmissionPolicy(filter=f, enabled=True)
+        f.record([b"hot"], 100)
+        assert pol.counters["engage_events"] == 0 and not pol.engaged
+        assert pol.decide([single_key_range(b"hot")], 0).action == "preabort"
+        assert pol.counters["engage_events"] == 1 and pol.engaged
+        # A second intervention does NOT count a second episode...
+        assert pol.decide([single_key_range(b"hot")], 0).action == "preabort"
+        assert pol.counters["engage_events"] == 1
+        # ...and a below-threshold clean streak does not release, even
+        # when an intervention interrupts it midway (streak resets).
+        for _ in range(AdmissionPolicy.RELEASE_CLEAN - 1):
+            assert pol.decide([single_key_range(b"cold")], 0).action == \
+                "admit"
+        assert pol.engaged and pol.counters["release_events"] == 0
+        pol.decide([single_key_range(b"hot")], 0)  # streak resets
+        for _ in range(AdmissionPolicy.RELEASE_CLEAN):
+            pol.decide([single_key_range(b"cold")], 0)
+        assert not pol.engaged
+        assert pol.counters["release_events"] == 1
+        assert pol.metrics()["engaged"] == 0  # rides the scrape plane
+
     def test_wide_ranges_never_preabort(self):
         """Un-enumerable range reads fall back to sketch shaping only."""
         f = _mk_filter()

@@ -348,9 +348,12 @@ class TestDeployedChaosMini:
             ChaosEvent(7.0, "partition", "tlog1", mode="drop"),
             ChaosEvent(10.5, "heal", "tlog1"),
         ]
+        ring_path = str(tmp_path / "flight_ring.jsonl")
         rec = run_chaos(seed=11, rate=40.0, workdir=str(tmp_path),
-                        script=script, duration_s=13.0, drain_s=15.0)
+                        script=script, duration_s=13.0, drain_s=15.0,
+                        recorder_path=ring_path)
         assert rec["ok"], rec["problems"]
+        self._check_flight_ring(rec, ring_path)
         led = rec["ledger"]
         assert led["acked"] > 50
         assert led["acked_lost_count"] == 0
@@ -364,6 +367,35 @@ class TestDeployedChaosMini:
         assert kill["mttr_total_s"] is not None
         assert rec["scrape"]["missing_documented"] == []
         assert rec["scrape"]["audit_problems"] == []
+
+    def _check_flight_ring(self, rec, ring_path):
+        """The recorder-armed half of the cycle (ISSUE 15): the REAL
+        ring from the run above must carry snapshots + the fault/heal
+        stamps, and the doctor must attribute the kill window to a
+        recovery — the acceptance criterion on a real-process timeline,
+        not a synthetic one (those live in test_flight_recorder.py)."""
+        from foundationdb_tpu.obs.doctor import diagnose
+        from foundationdb_tpu.obs.recorder import FlightRecorder
+
+        assert rec["recorder"]["recorder_snapshots"] >= 5
+        assert rec["recorder"]["slo"]["windows"] >= 4
+        records = FlightRecorder.load(ring_path)
+        anns = [r for r in records if r.get("kind") == "annotation"]
+        assert {a["cls"] for a in anns} >= {"chaos_fault", "chaos_heal"}
+        stamps = [(a["action"], a["target"]) for a in anns
+                  if a["cls"] in ("chaos_fault", "chaos_heal")]
+        assert stamps == [("kill", "tlog0"), ("restart", "tlog0"),
+                          ("partition", "tlog1"), ("heal", "tlog1")]
+        report = diagnose(records)
+        faults = {(f["action"], f["target"]): f for f in report["faults"]}
+        assert set(faults) == {("kill", "tlog0"), ("partition", "tlog1")}
+        kill_f = faults[("kill", "tlog0")]
+        assert kill_f["expected_class"] == "recovery"
+        assert kill_f["attributed"], kill_f
+        # The chaos ledger's client counters reached the SLO plane.
+        snaps = [r for r in records if r.get("kind") == "snapshot"]
+        assert "client.commits_acked" in snaps[-1]["metrics"]
+        assert "chaos.chaos_faults_injected" in snaps[-1]["metrics"]
 
 
 class TestChaosCounterNames:

@@ -366,6 +366,34 @@ class TestRuntimeProtocol:
         for stage in ("wave_exchange", "wave_level", "device_dispatch"):
             assert stage in hists and hists[stage].count > 0, stage
 
+    def test_chrome_trace_export_carries_wave_substages(self):
+        """The export SHAPE, not just the flat tallies: sampled
+        wave_exchange/wave_level ticks must appear as complete ("X")
+        Chrome-trace events on the emitting RESOLVER's track, stamped
+        with the batch's commit version — that is what makes the mesh
+        protocol's comms/level cost visible on a Perfetto timeline."""
+        c, _m = run_wave_cluster(seed=9, obs=True)
+        doc = c.loop.span_sink.to_chrome_trace()
+        by_name: dict = {}
+        for e in doc["traceEvents"]:
+            by_name.setdefault(e["name"], []).append(e)
+        processes = doc["metadata"]["processes"]
+        for stage in ("wave_exchange", "wave_level"):
+            evs = by_name.get(stage)
+            assert evs, f"{stage} missing from the chrome export"
+            for e in evs:
+                assert e["ph"] == "X"
+                assert e["ts"] >= 0 and e["dur"] >= 0
+                # Batch-level record: no txn id, the commit version
+                # identifies the window instead.
+                assert e["args"].get("tid") is None
+                assert e["args"]["version"] > 0
+                assert "resolver" in processes[str(e["pid"])]
+        # (Txn-level span export shape is pinned in test_obs.py — at the
+        # default 1-in-64 sampling this short run samples no full txn,
+        # which is exactly why the batch-level records must self-identify
+        # by commit version.)
+
     def test_empty_window_fast_path(self):
         """Idle heartbeat batches advance the chain in ONE round trip."""
         from foundationdb_tpu.runtime.flow import Loop
