@@ -112,6 +112,38 @@ class RYWTransaction(Transaction):
                 self._overlay[key] = ("value", base)
         return base
 
+    async def get_multi(self, keys, snapshot: bool = False) -> list:
+        """Batched get with the same overlay-over-snapshot semantics as
+        get(): locally-known keys resolve without a storage read (and
+        without a conflict range); only the remainder rides the batched
+        fetch."""
+        if getattr(self, "ryw_disabled", False):
+            return await super().get_multi(keys, snapshot)
+        keys = list(keys)
+        out: list = [None] * len(keys)
+        need: list[int] = []
+        for j, key in enumerate(keys):
+            kind, entry = self._overlay.get(key, (None, None))
+            if kind == "value":
+                out[j] = entry
+            elif kind == "unreadable":
+                raise _unreadable()
+            elif self._covered_by_clear(key):
+                out[j] = None
+            else:
+                need.append(j)
+        if need:
+            bases = await super().get_multi([keys[j] for j in need], snapshot)
+            for j, base in zip(need, bases):
+                kind, entry = self._overlay.get(keys[j], (None, None))
+                if kind == "ops":
+                    for op, param in entry:
+                        base = apply_atomic(op, base, param)
+                    if not snapshot:
+                        self._overlay[keys[j]] = ("value", base)
+                out[j] = base
+        return out
+
     def _merge(
         self, base: dict[bytes, bytes], lo: bytes, hi: bytes, reverse: bool
     ) -> list[tuple[bytes, bytes]]:

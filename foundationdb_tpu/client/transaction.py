@@ -233,6 +233,66 @@ class Database:
                 await self.loop.sleep(0.05)
         raise ProcessKilled(f"no reachable storage replica for {key[:16]!r}")
 
+    async def read_keys(self, keys: list[bytes], version: int,
+                        token: str | None = None) -> list:
+        """Batched point reads: keys group per owning team and each group
+        rides ONE get_multi RPC (the storage side answers the whole group
+        from one coalesced probe — reads/). Failover discipline matches
+        read_key: team members in failure-demoted order, shard-map refresh
+        and re-group on wrong_shard_server. Results are positional."""
+        keys = list(keys)
+        out: list = [None] * len(keys)
+        remaining = list(range(len(keys)))
+        for _ in range(self.MAX_SHARD_RETRIES):
+            groups: dict[tuple, list[int]] = {}
+            for i in remaining:
+                team = tuple(self.storage_map.team_for_key(keys[i]))
+                groups.setdefault(team, []).append(i)
+            retry: list[int] = []
+            last_future = None
+            unreachable = False
+            for team, idxs in groups.items():
+                sub = [keys[i] for i in idxs]
+                try:
+                    vals = await self.first_of_team(
+                        list(team),
+                        lambda tag, sub=sub: self.storage_eps[tag].get_multi(
+                            sub, version, token=token),
+                    )
+                    for i, v in zip(idxs, vals):
+                        out[i] = v
+                except WrongShardServer:
+                    retry.extend(idxs)
+                except FutureVersion as e:
+                    last_future = e
+                except ProcessKilled:
+                    unreachable = True
+                    retry.extend(idxs)
+            if last_future is not None and not retry:
+                raise last_future
+            if not retry:
+                return out
+            remaining = retry
+            self.refresh_shard_map()
+            if unreachable:
+                await self.loop.sleep(0.05)  # whole team down: brief pause
+        raise ProcessKilled("no reachable storage replica for batched read")
+
+    async def watch_key(self, key: bytes, value, token: str | None = None):
+        """Arm a watch on the key's current owner. wrong_shard_server —
+        at arm time (stale map) or later when the armed shard moves away
+        (storage cancel_range fails the watch) — propagates to the watch
+        future as a retryable error: the CALLER re-arms, re-reading the
+        value first, which is the reference contract. A transparent
+        re-arm loop here would leave the future silently parked across
+        moves and could not distinguish the two cases anyway."""
+        tag = self.storage_map.tag_for_key(key)
+        try:
+            return await self.storage_eps[tag].watch(key, value, token=token)
+        except WrongShardServer:
+            self.refresh_shard_map()  # next arm lands on the new owner
+            raise
+
     async def read_range(
         self, begin: bytes, end: bytes, version: int,
         limit: int, reverse: bool, token: str | None = None,
@@ -553,6 +613,27 @@ class Transaction:
             self.read_ranges.append(single_key_range(key))
         return value
 
+    async def get_multi(self, keys, snapshot: bool = False) -> list:
+        """Batched point reads: one round trip per owning team instead of
+        one per key (Database.read_keys → storage get_multi → the
+        coalesced probe). Positional results; conflict-range accounting
+        identical to the same sequence of get() calls."""
+        self._check_timeout()
+        keys = list(keys)
+        if any(k.startswith(SPECIAL_KEY_PREFIX) for k in keys):
+            # Special keys are client-synthesized — no batched path.
+            return [await self.get(k, snapshot) for k in keys]
+        for key in keys:
+            _check_key(key)
+        if not keys:
+            return []
+        version = await self.get_read_version()
+        values = await self._fetch_keys(keys, version)
+        if not snapshot:
+            for key in keys:
+                self.read_ranges.append(single_key_range(key))
+        return values
+
     # Storage-fetch seams: the repair engine's transaction subclass
     # (repair/engine.py RepairableTransaction) overrides these to serve
     # replayed reads from its recorded cache — conflict-range accounting
@@ -561,6 +642,15 @@ class Transaction:
     async def _fetch_key(self, key: bytes, version: int) -> bytes | None:
         return await self.db.read_key(key, version,
                                       token=self.authorization_token)
+
+    async def _fetch_keys(self, keys: list[bytes], version: int) -> list:
+        # A subclass that re-points the single-key seam (repair's replayed
+        # reads) keeps batched reads consistent automatically: route
+        # through ITS _fetch_key rather than bypassing the override.
+        if type(self)._fetch_key is not Transaction._fetch_key:
+            return [await self._fetch_key(k, version) for k in keys]
+        return await self.db.read_keys(keys, version,
+                                       token=self.authorization_token)
 
     async def _fetch_range(
         self, begin: bytes, end: bytes, version: int, limit: int,
@@ -947,8 +1037,14 @@ class Transaction:
 
     def _arm_watches(self) -> None:
         for (key, value), slot in zip(self._pending_watches, self._watch_futures):
-            ep = self.db.storage_eps[self.db.storage_map.tag_for_key(key)]
-            fut = ep.watch(key, value, token=self.authorization_token)
+            # Database.watch_key re-routes on wrong_shard_server (the
+            # shard may have moved between read and commit) — the seed
+            # armed directly on the possibly-stale location.
+            fut = self.db.loop.spawn(
+                self.db.watch_key(key, value,
+                                  token=self.authorization_token),
+                name="watch_arm",
+            )
             fut.add_done_callback(
                 lambda f, s=slot: s._finish(f._state, f._value)
             )

@@ -112,6 +112,35 @@ def _u64_unique_sorted(u: np.ndarray, rows: np.ndarray):
     return us[keep], rows[order][keep]
 
 
+def pack_rank_dictionary(flat: np.ndarray, pad_rows: int | None = None):
+    """THE shared pack/dictionary entry point: dedup+sort a flat [n, W]
+    packed-key stack into a sorted-unique dictionary plus int32 ranks.
+
+    Both the resolver's batch pack (:meth:`TPUConflictSet._pack_dict`) and
+    the read plane (:mod:`foundationdb_tpu.reads`) rewrite their key sets
+    through this one definition, so rank semantics (equal keys share a
+    rank; ranks are exact order isomorphisms) cannot drift between roles.
+
+    Returns ``(dict_keys, ranks)`` where ``dict_keys`` is ``[pad_rows, W]``
+    (default ``n + 1``) with every row past the unique keys +inf
+    (``INT32_MAX`` — kernels park masked slots there), and ``ranks`` is the
+    int32 rank of each input row in the sorted dictionary."""
+    n, w = flat.shape
+    if pad_rows is None:
+        pad_rows = n + 1
+    _, first, inverse = np.unique(
+        row_sort_keys(flat), return_index=True, return_inverse=True
+    )
+    if len(first) >= pad_rows:
+        raise ValueError(
+            f"{len(first)} unique keys need >= {len(first) + 1} dictionary "
+            f"rows (one +inf pad), got pad_rows={pad_rows}"
+        )
+    dict_keys = np.full((pad_rows, w), INT32_MAX, np.int32)
+    dict_keys[: len(first)] = flat[first]
+    return dict_keys, inverse.astype(np.int32)
+
+
 class _RepackPlan(NamedTuple):
     """A pack that overflowed the resident dictionary, deferred to the
     dispatch thread (the repack needs EXACT device liveness — a sync the
@@ -519,13 +548,7 @@ class TPUConflictSet:
             np.asarray(bt.write_begin).reshape(-1, w),
             np.asarray(bt.write_end).reshape(-1, w),
         ])
-        _, first, inverse = np.unique(
-            row_sort_keys(flat), return_index=True, return_inverse=True
-        )
-        n = flat.shape[0]
-        dict_keys = np.full((n + 1, w), INT32_MAX, np.int32)
-        dict_keys[: len(first)] = flat[first]
-        inv = inverse.astype(np.int32)
+        dict_keys, inv = pack_rank_dictionary(flat)
         n_r, n_q = b * r, b * q
         return ck.PackedBatch(
             dict_keys=dict_keys,

@@ -66,6 +66,7 @@ from foundationdb_tpu.obs.selfcheck import (
     run_selfcheck,
 )
 from foundationdb_tpu.obs.span import (
+    READ_STAGES,
     SUB_STAGES,
     TXN_STAGES,
     SpanSink,
@@ -83,6 +84,7 @@ __all__ = [
     "FlightRecorder",
     "MetricsPoller",
     "MetricsRegistry",
+    "READ_STAGES",
     "RECORDER_DOCUMENTED_COUNTERS",
     "SUB_STAGES",
     "SloTracker",

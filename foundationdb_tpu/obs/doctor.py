@@ -81,6 +81,22 @@ def _stage_sums(snap: dict) -> tuple[dict[str, float], float]:
             float(m.get("obs.e2e_sum_ms", 0.0)))
 
 
+def _read_stage_sums(snap: dict) -> tuple[dict[str, float], float]:
+    """({stage: cumulative sum_ms}, total read-plane sum_ms) of one
+    snapshot. READ_STAGES live OUTSIDE the txn reconciliation identity
+    (reads never enter the commit pipeline), so they get their own
+    denominator: the total time the read plane itself burned. That keeps
+    a read storm from being hidden by (or polluting) the commit-path
+    shares above."""
+    from foundationdb_tpu.obs.span import READ_STAGES
+
+    pref = "obs.stage_sum_ms."
+    m = snap.get("metrics") or {}
+    sums = {k[len(pref):]: float(v) for k, v in m.items()
+            if k.startswith(pref) and k[len(pref):] in READ_STAGES}
+    return sums, sum(sums.values())
+
+
 def _snap_at(snaps: list[dict], t: float, after: bool) -> "dict | None":
     """Last snapshot at/before t (after=False) or first at/after t."""
     if after:
@@ -137,6 +153,49 @@ def dominant_stage(snaps: list[dict], t0: float, t1: float) -> "dict | None":
     }
 
 
+def dominant_read_stage(snaps: list[dict], t0: float, t1: float) -> "dict | None":
+    """Read-plane twin of dominant_stage: the READ_STAGES member whose
+    share of the read plane's own time GREW most inside [t0, t1]. None
+    when the window saw no read-plane latency — either the read path
+    ran unbatched (stages never tick) or nothing was read. A read storm
+    shows up here (read_dispatch / watch_sweep dominating) even when the
+    commit-path attribution above is quiet."""
+    if not snaps:
+        return None
+    first = snaps[0]
+    a = _snap_at(snaps, t0, after=False)
+    b = _snap_at(snaps, t1, after=True)
+    if a is None or b is None or b["t"] <= a["t"]:
+        return None
+    sums_a, tot_a = _read_stage_sums(a)
+    sums_b, tot_b = _read_stage_sums(b)
+    sums_f, tot_f = _read_stage_sums(first)
+    d_tot = tot_b - tot_a
+    base_tot = tot_a - tot_f
+    if d_tot <= 0:
+        return None
+
+    def shares(sums_hi, sums_lo, denom):
+        if denom <= 0:
+            return {}
+        return {s: max(0.0, sums_hi.get(s, 0.0) - sums_lo.get(s, 0.0))
+                / denom for s in set(sums_hi) | set(sums_lo)}
+
+    during = shares(sums_b, sums_a, d_tot)
+    before = shares(sums_a, sums_f, base_tot)
+    if not during:
+        return None
+    best = max(during, key=lambda s: during[s] - before.get(s, 0.0))
+    return {
+        "stage": best,
+        "share_during": round(during[best], 4),
+        "share_before": round(before.get(best, 0.0), 4),
+        "share_growth": round(during[best] - before.get(best, 0.0), 4),
+        "window_read_ms": round(d_tot, 3),
+        "baseline_windows": bool(base_tot > 0),
+    }
+
+
 # -- annotations in a window ---------------------------------------------------
 
 
@@ -178,6 +237,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
         co = annotations_in(anns, t0, t1, slack_s)
         co_gaps = [g for g in gaps if t0 - slack_s <= g["t"] <= t1 + slack_s]
         stage = dominant_stage(snaps, t0, t1)
+        read_stage = dominant_read_stage(snaps, t0, t1)
         verdict = {
             "window": [t0, t1],
             "sli": inc["sli"],
@@ -185,6 +245,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
             "baseline_mean": inc["baseline_mean"],
             "windows": inc["windows"],
             "dominant_stage": stage,
+            "dominant_read_stage": read_stage,
             "annotations": co,
             "annotation_classes": sorted(
                 {a.get("cls") for a in co}
@@ -196,6 +257,11 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
             f"({stage['share_before']:.0%}->{stage['share_during']:.0%})"
             if stage else "no stage attribution (tracing not armed or no "
                           "sampled txns in window)")
+        if read_stage:
+            stage_txt += (
+                f"; read plane: {read_stage['stage']} "
+                f"({read_stage['share_before']:.0%}->"
+                f"{read_stage['share_during']:.0%})")
         co_txt = ("; co-occurring: "
                   + ", ".join(_ann_brief(a) for a in co[:6])
                   if co else "; no co-occurring annotations")

@@ -53,6 +53,17 @@ DOCUMENTED_COUNTERS = (
     "tlog.queue_bytes",
     "tlog.queue_entries",
     "storage.version_lag",
+    # Read plane + watch registry (foundationdb_tpu/reads/): exported by
+    # every storage server, zeros while idle, so a healthy scrape always
+    # carries them.
+    "storage.watch_count",
+    "storage.too_many_watches",
+    "storage.watch_fires",
+    "storage.reads.dispatches",
+    "storage.reads.served",
+    "storage.reads.queue_depth",
+    "storage.reads.occupancy",
+    "storage.reads.per_dispatch",
     "ratekeeper.tps_limit",
     # Recovery MTTR counters (deployed chaos subsystem): exported by BOTH
     # controllers — runtime/cluster.py (sim) and server.py

@@ -106,6 +106,19 @@ SUB_STAGES = (
     "tlog_fsync",
 )
 
+#: Read-plane batch-level stages (foundationdb_tpu/reads/): stamped via
+#: stage_tick by the storage-side coalescer and the per-version watch
+#: sweep. Like SUB_STAGES they never sum into the TXN identity (reads are
+#: not commits), but they ride the same histograms/span export, so `cli
+#: latency`, the flight recorder, and the doctor's attribution see the
+#: read plane next to the commit path.
+READ_STAGES = (
+    "read_coalesce",
+    "read_pack",
+    "read_dispatch",
+    "watch_sweep",
+)
+
 
 def obs_env_default() -> bool:
     """FDB_TPU_OBS env default (validated via the kernel flags' shared

@@ -1,0 +1,41 @@
+"""Device-vectorized read plane: batched reads + packed watch fan-out.
+
+The commit path got its speed from one discipline — key sets become packed
+tensors, one kernel answers the whole batch (models/conflict_set.py). This
+package applies the same discipline to the OTHER half of the storage
+server's job, which the seed still ran as scalar actors:
+
+- :mod:`~foundationdb_tpu.reads.read_set` — ``TPUReadSet``: a resident
+  sorted mirror of the versioned map's key universe (the read-plane
+  analogue of ``TPUConflictSet``'s resident dictionary). One probe —
+  ``ops/lex.searchsorted_words_2sided_fp`` on device, the u64-column
+  binary search on host — resolves every point lookup and range boundary
+  of a dispatch at once; values gather host-side from the per-key version
+  chains, byte-identical to the scalar ``VersionedMap.at`` oracle.
+- :mod:`~foundationdb_tpu.reads.coalescer` — ``ReadCoalescer``: the
+  storage-side deadline coalescer (the ``sched/`` brain, reused verbatim)
+  that gathers concurrent get / multi-get / get_range requests into one
+  probe dispatch.
+- :mod:`~foundationdb_tpu.reads.watches` — ``WatchIndex``: watch
+  registrations as a resident packed key set, matched once per committed
+  version against that version's written keys; fired indices gather back
+  to promises host-side. A million idle watches cost one probe per
+  version instead of a million dict pops, and shard-move cancellation is
+  O(log n + hits) instead of the seed's O(all watches) scan.
+
+Env knobs (every arm is byte-identical; knobs trade host/device work only):
+
+- ``FDB_TPU_READS_DEVICE=0|1`` — probe on the jax device (default 0: the
+  vectorized host path; the sim and tier-1 tests run host).
+- ``FDB_TPU_PACKED_WATCHES=0|1|device`` — watch sweep arm (default 1:
+  packed numpy probe; ``0`` is the dict-lookup host oracle, ``device``
+  probes via the jitted kernel).
+- ``FDB_TPU_READ_BATCH=0|1`` — route scalar ``get``/``get_range`` RPCs
+  through the coalescer too (default 0; ``get_multi`` always batches).
+- ``FDB_TPU_READ_BUDGET_MS`` — coalescer latency budget (virtual ms,
+  default 0.25; ``0`` = immediate dispatch of whatever is queued).
+"""
+
+from foundationdb_tpu.reads.coalescer import ReadCoalescer  # noqa: F401
+from foundationdb_tpu.reads.read_set import TPUReadSet  # noqa: F401
+from foundationdb_tpu.reads.watches import WatchIndex  # noqa: F401

@@ -1,0 +1,400 @@
+"""READS_AB: the batched read plane vs the per-key actor baseline, plus
+packed watch-sweep scaling — one honesty-flagged JSON record.
+
+Two claims, measured on the SAME storage server and op streams:
+
+1. **Batched multi-get/range throughput**: YCSB-B/C read streams (Zipf
+   point batches + short scans) driven by concurrent closed-loop
+   clients. Baseline arm = one `ss.get` actor round-trip per key (the
+   per-key actor path every fdb client pays today); batched arm = one
+   `ss.get_multi` per op, which the deadline coalescer merges across
+   clients into single packed interval-probe dispatches. Gate:
+   throughput >= 3x at batched p99 no worse than baseline p99. Every
+   arm's bytes are compared against the sequential oracle
+   (`TPUReadSet.oracle_get/oracle_range`) — parity is a validity gate,
+   not a footnote.
+
+2. **Watch-sweep sublinearity**: per-committed-version sweep time of the
+   packed registry at n_watches in {1e3, 1e5, 1e6} with a fixed write
+   batch per version. The packed sweep probes the sorted set per
+   WRITTEN key (O(w log n)), so the gate is sweep(1e5..1e6) <= 2x
+   sweep(1e3). Fire-set parity across arms 0/1/device vs the
+   final-value oracle rides along.
+
+Honesty flags: `valid` (every gate AND every parity check), `cpu_fallback`
+(no TPU backend — the device arm ran on jax-cpu), `p99_quotable` (enough
+samples per arm), `co_corrected` (False: closed-loop clients, latencies
+are service times and subject to coordinated omission; throughput is
+wall-clock and unaffected).
+"""
+
+from __future__ import annotations
+
+import json
+from time import perf_counter
+
+from foundationdb_tpu.core.mutations import Mutation, MutationType as M
+from foundationdb_tpu.runtime.flow import Loop, Promise, all_of
+from foundationdb_tpu.runtime.storage import StorageServer
+from foundationdb_tpu.sim.network import SimNetwork
+from foundationdb_tpu.reads.read_set import TPUReadSet
+from foundationdb_tpu.reads.watches import WatchIndex
+
+
+def _backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — no jax is a legal host-only config
+        return "none"
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+# -- op-stream generation ------------------------------------------------------
+
+
+def _key(i: int) -> bytes:
+    return b"ycsb/%08d" % i
+
+
+def _build_store(loop: Loop, n_keys: int, update_versions: int,
+                 rng) -> StorageServer:
+    """Load n_keys rows, then apply `update_versions` committed versions
+    of Zipf-skewed value updates (the YCSB-B write mix as version
+    history: chains get DEEP on hot keys, the key set never changes, so
+    the read mirror packs exactly once)."""
+    ss = StorageServer(loop, tag=0, tlog_ep=None)
+    ss._apply(1, [Mutation(M.SET_VALUE, _key(i), b"init%08d" % i)
+                  for i in range(n_keys)])
+    for v in range(2, 2 + update_versions):
+        hot = sorted({min(int(rng.paretovariate(1.5)) - 1, n_keys - 1)
+                      for _ in range(32)})
+        ss._apply(v, [Mutation(M.SET_VALUE, _key(i), b"u%08d.%08d" % (v, i))
+                      for i in hot])
+    return ss
+
+
+def _build_stream(rng, n_ops: int, n_keys: int, batch: int,
+                  scan_fraction: float, version: int) -> list[tuple]:
+    """Pre-generated versioned read ops, identical for both arms (MVCC
+    reads at a pinned version are deterministic regardless of client
+    interleaving — byte parity across arms is therefore exact)."""
+    ops: list[tuple] = []
+    for _ in range(n_ops):
+        if rng.random() < scan_fraction:
+            lo = min(int(rng.paretovariate(1.5)) - 1, n_keys - 1)
+            span = 1 + rng.randrange(16)
+            ops.append(("range", _key(lo), _key(lo + span), span, version))
+        else:
+            # Log-uniform hot head (YCSB zipfian shape) WITHOUT collapsing
+            # every draw onto key 0 — multi-get batches keep real width.
+            picks = sorted({int(n_keys ** rng.random()) - 1
+                            for _ in range(batch)})
+            ops.append(("points", [_key(i) for i in picks], version))
+    return ops
+
+
+async def _run_arm(loop: Loop, ss: StorageServer, ep, stream: list[tuple],
+                   n_clients: int, batched: bool):
+    """Drive the shared op stream with n_clients concurrent closed-loop
+    clients THROUGH the RPC endpoint — the baseline pays one actor
+    round-trip per key (what every per-key client pays today), the
+    batched arm one per op. Returns (results, sorted ms, elapsed_s)."""
+    results: list = [None] * len(stream)
+    lats: list[float] = []
+    nxt = [0]
+    ss._batch_scalar_reads = batched  # route scans through the coalescer
+    t0 = perf_counter()
+
+    async def client(cid: int):
+        while True:
+            i = nxt[0]
+            if i >= len(stream):
+                return
+            nxt[0] += 1
+            op = stream[i]
+            s = perf_counter()
+            if op[0] == "points":
+                _, keys, ver = op
+                if batched:
+                    rows = await ep.get_multi(keys, ver)
+                else:
+                    rows = [await ep.get(k, ver) for k in keys]
+            else:
+                _, b, e, lim, ver = op
+                rows = await ep.get_range(b, e, ver, limit=lim)
+            lats.append(perf_counter() - s)
+            results[i] = rows
+
+    await all_of([loop.spawn(client(i), name=f"reads_ab.c{i}")
+                  for i in range(n_clients)])
+    elapsed = perf_counter() - t0
+    return results, sorted(l * 1000.0 for l in lats), elapsed
+
+
+def _oracle_results(read_set: TPUReadSet, stream: list[tuple]) -> list:
+    out = []
+    for op in stream:
+        if op[0] == "points":
+            _, keys, ver = op
+            out.append([read_set.oracle_get(k, ver) for k in keys])
+        else:
+            _, b, e, lim, ver = op
+            out.append(read_set.oracle_range(b, e, lim, False, ver))
+    return out
+
+
+def _stream_reads(stream: list[tuple]) -> int:
+    return sum(len(op[1]) if op[0] == "points" else 1 for op in stream)
+
+
+def bench_reads(mode: str = "ycsb_b", n_keys: int = 4096, n_ops: int = 2000,
+                batch: int = 16, n_clients: int = 24, seed: int = 0,
+                device_parity: bool = True, reps: int = 3) -> dict:
+    """One YCSB mode through both arms + oracle + (optionally) the
+    device read engine for parity/timing. Arms alternate for `reps`
+    rounds and each quotes its best round (obs_ab precedent: wall-clock
+    on a shared host is noisy; best-of-N is the stable estimator, and
+    BOTH arms get the same treatment). Parity is checked on EVERY
+    round."""
+    loop = Loop(seed=seed)
+    rng = loop.rng
+    update_versions = 64 if mode == "ycsb_b" else 0
+    ss = _build_store(loop, n_keys, update_versions, rng)
+    # Storage-side window budget sized to the sim RPC latency (default
+    # 0.25 virtual ms is tuned for intra-process reads; here arrivals
+    # spread across the 0.2-2ms virtual network hop).
+    ss._reads.brain.budget_ms = 2.0
+    net = SimNetwork(loop)
+    ep = net.host("ss0", "ss", ss)
+    version = ss._version
+    scan_fraction = 0.2
+    stream = _build_stream(rng, n_ops, n_keys, batch, scan_fraction, version)
+    total_reads = _stream_reads(stream)
+
+    oracle = _oracle_results(ss.read_set, stream)
+    base = batchd = None
+    parity = True
+    for _ in range(max(1, reps)):
+        b = loop.run(_run_arm(loop, ss, ep, stream, n_clients, batched=False),
+                     timeout=3_600_000)
+        m = loop.run(_run_arm(loop, ss, ep, stream, n_clients, batched=True),
+                     timeout=3_600_000)
+        parity = parity and (b[0] == m[0] == oracle)
+        if base is None or b[2] < base[2]:
+            base = b
+        if batchd is None or m[2] < batchd[2]:
+            batchd = m
+
+    dev = None
+    if device_parity:
+        t = perf_counter()
+        dset = TPUReadSet(ss.map, device=True)
+        dres = _oracle_shaped_engine(dset, stream)
+        dev = {
+            "parity": dres == oracle,
+            "elapsed_s": round(perf_counter() - t, 4),
+            "uploads": dset.stats["uploads"],
+        }
+
+    def arm_rec(results, lats_ms, elapsed):
+        return {
+            "reads_per_sec": round(total_reads / elapsed, 1) if elapsed else 0,
+            "ops": len(results),
+            "reads": total_reads,
+            "elapsed_s": round(elapsed, 4),
+            "p50_ms": round(_pctl(lats_ms, 0.50), 4),
+            "p99_ms": round(_pctl(lats_ms, 0.99), 4),
+        }
+
+    b_rec = arm_rec(*base)
+    m_rec = arm_rec(*batchd)
+    b_rec["best_of"] = m_rec["best_of"] = max(1, reps)
+    m_rec["dispatches"] = ss._reads.stats["dispatches"]
+    m_rec["reads_per_dispatch"] = round(ss._reads.reads_per_dispatch, 2)
+    ratio = (m_rec["reads_per_sec"] / b_rec["reads_per_sec"]
+             if b_rec["reads_per_sec"] else 0.0)
+    return {
+        "mode": mode,
+        "keys": n_keys,
+        "ops": n_ops,
+        "batch": batch,
+        "clients": n_clients,
+        "update_versions": update_versions,
+        "per_key": b_rec,
+        "batched": m_rec,
+        "throughput_ratio": round(ratio, 2),
+        "p99_equal_or_better": m_rec["p99_ms"] <= b_rec["p99_ms"],
+        "read_parity": parity,
+        "device": dev,
+    }
+
+
+def _oracle_shaped_engine(read_set: TPUReadSet, stream: list[tuple]) -> list:
+    """The same stream through a TPUReadSet engine directly (one probe
+    per op) — used for the device-arm parity check."""
+    out = []
+    for op in stream:
+        if op[0] == "points":
+            _, keys, ver = op
+            out.append(read_set.get_points(keys, ver))
+        else:
+            _, b, e, lim, ver = op
+            out.append(read_set.get_ranges([(b, e, lim, False, ver)])[0])
+    return out
+
+
+# -- watch sweep scaling -------------------------------------------------------
+
+
+def _wkey(i: int) -> bytes:
+    return b"w/%08d" % i
+
+
+def bench_watch_sweep(sizes=(1_000, 100_000, 1_000_000), writes_per_version=64,
+                      rounds=21, arm: str = "1") -> dict:
+    """Per-version sweep time vs registry size, fixed write batch. The
+    written keys EXIST in the set but carry the expected value, so no
+    watch fires and the resident set stays intact across rounds (the
+    steady state a watch-heavy cluster lives in)."""
+    out: dict[str, float] = {}
+    reg: dict[str, int] = {}
+    for n in sizes:
+        idx = WatchIndex(arm=arm)
+        t = perf_counter()
+        for i in range(n):
+            idx.add(_wkey(i), b"expect", Promise())
+        reg[str(n)] = round(perf_counter() - t, 4)
+        written = [(_wkey(i * (n // writes_per_version or 1)), b"expect")
+                   for i in range(writes_per_version)]
+        idx.sweep(1, written)  # warm-up: consolidation + pack land here
+        times = []
+        for r in range(rounds):
+            t = perf_counter()
+            idx.sweep(2 + r, written)
+            times.append(perf_counter() - t)
+        times.sort()
+        out[str(n)] = round(times[len(times) // 2] * 1000.0, 4)
+    lo, hi = str(sizes[0]), str(sizes[-1])
+    return {
+        "arm": arm,
+        "writes_per_version": writes_per_version,
+        "sweep_ms": out,
+        "register_s": reg,
+        "sublinear": bool(out[hi] <= 2.0 * max(out[lo], 1e-3)),
+    }
+
+
+def bench_watch_parity(n_keys: int = 300, versions: int = 40,
+                       seed: int = 7) -> bool:
+    """Randomized fire-set parity: identical write streams through arms
+    0 / 1 / device must fire the identical (key, version) sets, equal to
+    the final-value sequential oracle."""
+    import random
+
+    rng = random.Random(seed)
+    keys = [_wkey(i) for i in range(n_keys)]
+    stream = []
+    for v in range(1, versions + 1):
+        stream.append((v, [(rng.choice(keys),
+                            b"new%d" % rng.randrange(4)
+                            if rng.random() < 0.8 else None)
+                           for _ in range(rng.randrange(1, 12))]))
+
+    def run(arm: str):
+        idx = WatchIndex(arm=arm)
+        fired: list[tuple[bytes, int]] = []
+
+        def hook(k):
+            p = Promise()
+            p.future.add_done_callback(lambda f, k=k: fired.append((k, f._value)))
+            return p
+
+        for k in keys:
+            idx.add(k, b"expect", hook(k))
+        for v, written in stream:
+            idx.sweep(v, written)
+        return sorted(fired)
+
+    # Oracle: first version whose FINAL value for the key != expect.
+    want = []
+    alive = {k: b"expect" for k in keys}
+    for v, written in stream:
+        final = {}
+        for k, val in written:
+            final[k] = val
+        for k, val in final.items():
+            if k in alive and val != alive[k]:
+                want.append((k, v))
+                del alive[k]
+    want.sort()
+    return run("0") == run("1") == run("device") == want
+
+
+# -- the record ----------------------------------------------------------------
+
+
+def run_ab(n_keys: int = 4096, n_ops: int = 2000, batch: int = 16,
+           n_clients: int = 24, seed: int = 0,
+           watch_sizes=(1_000, 100_000, 1_000_000)) -> dict:
+    backend = _backend()
+    modes = {m: bench_reads(m, n_keys=n_keys, n_ops=n_ops, batch=batch,
+                            n_clients=n_clients, seed=seed)
+             for m in ("ycsb_b", "ycsb_c")}
+    sweep = bench_watch_sweep(sizes=watch_sizes)
+    watch_parity = bench_watch_parity()
+    ratios = [m["throughput_ratio"] for m in modes.values()]
+    parity_all = (all(m["read_parity"] for m in modes.values())
+                  and all((m["device"] or {}).get("parity", True)
+                          for m in modes.values())
+                  and watch_parity)
+    p99_quotable = all(m["per_key"]["ops"] >= 1000 for m in modes.values())
+    gates = {
+        "throughput_3x": min(ratios) >= 3.0,
+        "p99_equal_or_better": all(m["p99_equal_or_better"]
+                                   for m in modes.values()),
+        "watch_sublinear": sweep["sublinear"],
+        "parity": parity_all,
+    }
+    return {
+        "metric": "reads_ab",
+        "backend": backend,
+        "cpu_fallback": backend != "tpu",
+        "co_corrected": False,  # closed-loop clients; see module docstring
+        "p99_quotable": p99_quotable,
+        "modes": modes,
+        "throughput_ratio_min": min(ratios),
+        "watch_sweep": sweep,
+        "watch_parity": watch_parity,
+        "gates": gates,
+        "valid": all(gates.values()),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="foundationdb_tpu.reads.bench")
+    ap.add_argument("--ops", type=int, default=2000)
+    ap.add_argument("--keys", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--clients", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--watch-sizes", type=str, default="1000,100000,1000000")
+    args = ap.parse_args(argv)
+    sizes = tuple(int(s) for s in args.watch_sizes.split(",") if s)
+    rec = run_ab(n_keys=args.keys, n_ops=args.ops, batch=args.batch,
+                 n_clients=args.clients, seed=args.seed, watch_sizes=sizes)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,231 @@
+"""Packed watch registry: fan-out as one probe per committed version.
+
+The seed kept watches in ``dict[key] -> [(expect, promise)]`` and popped
+the dict inside every ``_write`` — per-mutation actor bookkeeping, plus an
+O(all-watches) linear scan to cancel a moved shard's watches. Here the
+registry is a sorted resident key set (packed rows viewed memcmp-order,
+the resident-dictionary economics of models/conflict_set.py): each committed version's written keys
+are packed once and probed against the watch keys in one vectorized
+search, and fired indices gather back to promises host-side.
+
+Semantics (the reference watch contract, storageserver.actor.cpp):
+
+- a watch armed with ``expect`` fires with the triggering version once the
+  key's value is observed ``!= expect``;
+- fires may be SPURIOUS (e.g. on an applied-but-unacked write that
+  recovery later rolls back — the client must re-read); NOT firing while
+  the value still equals ``expect`` is always correct. The per-version
+  sweep compares the version's FINAL value per key, so a same-version
+  A→B→A rewrite does not fire — allowed under the contract, and identical
+  across every arm (host / packed / device), which is what the parity
+  tests pin.
+
+Cancellation on shard moves (``cancel_range``) is a bisect over the sorted
+key index plus a scan of the hits and the small unconsolidated tail:
+O(log n + hits) where the seed scanned every armed watch.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from foundationdb_tpu.core.keypack import KeyCodec, row_sort_keys
+
+_ARMS = ("0", "1", "device")
+
+
+def watch_arm_default() -> str:
+    """FDB_TPU_PACKED_WATCHES: 0 = dict-lookup host oracle, 1 = packed
+    numpy probe (default), device = jitted kernel probe."""
+    from foundationdb_tpu.core.types import env_choice
+
+    return env_choice("FDB_TPU_PACKED_WATCHES", "1", _ARMS)
+
+
+class WatchIndex:
+    """Armed watches: promise book-keeping plus a lazily-consolidated
+    sorted key index for packed sweeps and O(log n + hits) range cancel.
+
+    The consolidated index may lag the dict (adds append to a pending
+    tail, fires/cancels leave tombstoned rows); every lookup therefore
+    checks membership back through ``_by_key``, the single source of
+    truth. Consolidation merges the sorted pending tail in O(n + p) and
+    is amortized over the adds that created it."""
+
+    def __init__(self, arm: str | None = None, codec: KeyCodec | None = None):
+        self.arm = watch_arm_default() if arm is None else str(arm)
+        if self.arm not in _ARMS:
+            raise ValueError(f"watch arm {self.arm!r}: want one of {_ARMS}")
+        self.codec = codec or KeyCodec()
+        self._by_key: dict[bytes, list[tuple[bytes | None, object]]] = {}
+        self._count = 0
+        # Consolidated sorted index + pending tail (packed/device arms;
+        # the host arm still maintains it for cancel_range).
+        self._sorted: list[bytes] = []
+        self._void = row_sort_keys(
+            np.zeros((0, self.codec.width), np.int32))
+        self._pending: list[bytes] = []
+        self._dead = 0  # tombstoned rows in _sorted (keys no longer armed)
+        self._dev_rows = None  # device-resident [n, W] rows (arm="device")
+        self.stats = {
+            "registered": 0, "fired": 0, "cancelled": 0, "sweeps": 0,
+            "swept_writes": 0, "probed": 0, "cancel_scanned": 0,
+            "consolidations": 0, "uploads": 0,
+        }
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    # -- registration --------------------------------------------------------
+
+    def add(self, key: bytes, expect: bytes | None, promise) -> None:
+        """Arm one watch (the caller enforces MAX_WATCHES on `count`)."""
+        entries = self._by_key.get(key)
+        if entries is None:
+            self._by_key[key] = [(expect, promise)]
+            self._pending.append(key)
+        else:
+            entries.append((expect, promise))
+        self._count += 1
+        self.stats["registered"] += 1
+
+    # -- index maintenance ---------------------------------------------------
+
+    def _consolidate(self) -> None:
+        if self._pending:
+            news = sorted(set(self._pending))
+            self._pending = []
+            if news:
+                merged: list[bytes] = []
+                i = j = 0
+                a, b = self._sorted, news
+                while i < len(a) and j < len(b):
+                    if a[i] <= b[j]:
+                        if a[i] == b[j]:
+                            j += 1
+                        merged.append(a[i])
+                        i += 1
+                    else:
+                        merged.append(b[j])
+                        j += 1
+                merged.extend(a[i:])
+                merged.extend(b[j:])
+                self._sorted = merged
+                self._rebuild_packed()
+        if self._dead > max(64, len(self._sorted) // 2):
+            # Tombstone-heavy index: drop dead rows so probes stay tight.
+            self._sorted = [k for k in self._sorted if k in self._by_key]
+            self._dead = 0
+            self._rebuild_packed()
+
+    def _rebuild_packed(self) -> None:
+        self.stats["consolidations"] += 1
+        if self.arm == "0":
+            return  # host arm: the sorted byte list alone serves cancels
+        rows = (self.codec.pack(self._sorted, mode="begin") if self._sorted
+                else np.zeros((0, self.codec.width), np.int32))
+        # memcmp-order void view: one native searchsorted per sweep side.
+        self._void = row_sort_keys(rows)
+        if self.arm == "device":
+            import jax.numpy as jnp
+
+            self._dev_rows = jnp.asarray(rows)
+            self.stats["uploads"] += 1
+
+    def _candidate_keys(self, written_keys: list[bytes]) -> list[bytes]:
+        """Armed keys among `written_keys` — the probe under A/B test.
+        Every arm must return the same set (parity-pinned)."""
+        if self.arm == "0":
+            return [k for k in written_keys if k in self._by_key]
+        self._consolidate()
+        out: list[bytes] = []
+        n = len(self._sorted)
+        if n:
+            q = self.codec.pack(written_keys, mode="begin")
+            self.stats["probed"] += len(written_keys)
+            if self.arm == "device":
+                from foundationdb_tpu.ops.lex import searchsorted_words_2sided_fp
+
+                lo, hi = searchsorted_words_2sided_fp(self._dev_rows, q)
+                lo, hi = np.asarray(lo), np.asarray(hi)
+            else:
+                qv = row_sort_keys(np.ascontiguousarray(q))
+                lo = np.searchsorted(self._void, qv, side="left")
+                hi = np.searchsorted(self._void, qv, side="right")
+            for j, k in enumerate(written_keys):
+                for i in range(int(lo[j]), int(hi[j])):
+                    # Packed rows truncate at max_key_bytes: confirm the
+                    # candidate run by exact bytes (runs are length 1
+                    # outside pathological shared-prefix keyspaces).
+                    if self._sorted[i] == k and k in self._by_key:
+                        out.append(k)
+                        break
+        return out
+
+    # -- the per-version sweep ----------------------------------------------
+
+    def sweep(self, version: int, written: list[tuple[bytes, bytes | None]]) -> int:
+        """Match one committed version's written keys (key → FINAL value at
+        that version) against the armed set; fire promises whose expected
+        value differs. Returns the number fired."""
+        if not written or not self._count:
+            return 0
+        self.stats["sweeps"] += 1
+        self.stats["swept_writes"] += len(written)
+        final: dict[bytes, bytes | None] = {}
+        for k, v in written:
+            final[k] = v  # last write in the version wins
+        fired = 0
+        for key in self._candidate_keys(list(final)):
+            entries = self._by_key.get(key)
+            if not entries:
+                continue
+            value = final[key]
+            keep = [(e, p) for e, p in entries if value == e]
+            for _e, p in entries:
+                if value != _e:
+                    p.send(version)
+                    fired += 1
+            if keep:
+                self._by_key[key] = keep
+            else:
+                del self._by_key[key]
+                self._dead += 1
+        self._count -= fired
+        self.stats["fired"] += fired
+        return fired
+
+    # -- shard-move cancellation ---------------------------------------------
+
+    def cancel_range(self, begin: bytes, end: bytes):
+        """Disarm every watch in [begin, end): bisect the sorted index,
+        scan only the hit run plus the pending tail. Returns the
+        disarmed ``(key, expect, promise)`` entries (the storage server
+        fails them with wrong_shard_server)."""
+        # NOT _consolidate(): a cancel must stay O(log n + hits) even
+        # right after a burst of adds — the pending tail is scanned
+        # linearly instead (it is bounded by adds since the last sweep).
+        hits: list[bytes] = []
+        lo = bisect.bisect_left(self._sorted, begin)
+        hi = bisect.bisect_left(self._sorted, end)
+        self.stats["cancel_scanned"] += (hi - lo) + len(self._pending)
+        seen = set()
+        for k in self._sorted[lo:hi]:
+            if k in self._by_key and k not in seen:
+                hits.append(k)
+                seen.add(k)
+        for k in self._pending:
+            if begin <= k < end and k in self._by_key and k not in seen:
+                hits.append(k)
+                seen.add(k)
+        out = []
+        for k in hits:
+            for expect, p in self._by_key.pop(k):
+                out.append((k, expect, p))
+        self._count -= len(out)
+        self._dead += len(hits)
+        self.stats["cancelled"] += len(out)
+        return out

@@ -110,6 +110,20 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
                 "grv_defer_ticks": 0,
                 "filter_recorded": 0,
             },
+            # Read plane (reads subsystem): batched-read coalescer and
+            # watch-registry totals summed over the storage servers;
+            # queue_depth/occupancy are the WORST instance (the binding
+            # backpressure signal, like resolver_queue).
+            "reads": {
+                "dispatches": 0,
+                "served": 0,
+                "per_dispatch": 0.0,
+                "queue_depth": 0,
+                "occupancy": 0.0,
+                "watch_count": 0,
+                "watch_fires": 0,
+                "too_many_watches": 0,
+            },
             # Replica byte-parity audit (consistency subsystem): summary
             # of the most recent ConsistencyChecker run against this
             # cluster, or never_run.
@@ -208,7 +222,20 @@ async def fetch_status(cluster, _retries: int = 3) -> dict:
         }
         if m:
             max_lag = max(max_lag, m["version_lag"])
+            rd = doc["workload"]["reads"]
+            mr = m.get("reads") or {}
+            rd["dispatches"] += mr.get("dispatches", 0)
+            rd["served"] += mr.get("served", 0)
+            rd["queue_depth"] = max(rd["queue_depth"],
+                                    mr.get("queue_depth", 0))
+            rd["occupancy"] = max(rd["occupancy"], mr.get("occupancy", 0.0))
+            rd["watch_count"] += m.get("watch_count", 0)
+            rd["watch_fires"] += m.get("watch_fires", 0)
+            rd["too_many_watches"] += m.get("too_many_watches", 0)
     doc["qos"]["worst_storage_version_lag"] = max_lag
+    rd = doc["workload"]["reads"]
+    if rd["dispatches"]:
+        rd["per_dispatch"] = round(rd["served"] / rd["dispatches"], 2)
 
     if rate_t is not None:
         rates = await rate_t

@@ -29,6 +29,9 @@ from foundationdb_tpu.core.errors import (
     WrongShardServer,
 )
 from foundationdb_tpu.core.mutations import ATOMIC_OPS, Mutation, MutationType, apply_atomic
+from foundationdb_tpu.reads.coalescer import ReadCoalescer
+from foundationdb_tpu.reads.read_set import TPUReadSet
+from foundationdb_tpu.reads.watches import WatchIndex
 from foundationdb_tpu.runtime.flow import BrokenPromise, Loop, Promise, any_of, rpc
 from foundationdb_tpu.runtime.sequencer import MVCC_WINDOW_VERSIONS
 from foundationdb_tpu.runtime.tlog import TLog
@@ -41,6 +44,11 @@ class VersionedMap:
     def __init__(self) -> None:
         self._keys: list[bytes] = []  # sorted; includes tombstoned keys
         self._chains: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        # Bumped whenever the KEY SET changes (insert/purge/rollback/GC
+        # removal) — the read plane's resident mirror (reads/read_set.py)
+        # rebuilds on a seq mismatch; value updates mutate chains in
+        # place and cost the mirror nothing.
+        self.struct_seq = 0
 
     def latest(self, key: bytes) -> bytes | None:
         chain = self._chains.get(key)
@@ -60,6 +68,7 @@ class VersionedMap:
         if chain is None:
             self._chains[key] = [(version, value)]
             bisect.insort(self._keys, key)
+            self.struct_seq += 1
         elif chain[-1][0] == version:
             chain[-1] = (version, value)
         else:
@@ -78,6 +87,8 @@ class VersionedMap:
             del self._chains[k]
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
+        if hi > lo:
+            self.struct_seq += 1
         del self._keys[lo:hi]
 
     def rollback(self, version: int) -> None:
@@ -90,6 +101,8 @@ class VersionedMap:
                 del chain[i:]
             if not chain:
                 dead.append(key)
+        if dead:
+            self.struct_seq += 1
         for key in dead:
             del self._chains[key]
             i = bisect.bisect_left(self._keys, key)
@@ -105,6 +118,8 @@ class VersionedMap:
                 del chain[:i]
             if len(chain) == 1 and chain[0][1] is None and chain[0][0] <= floor:
                 dead.append(key)
+        if dead:
+            self.struct_seq += 1
         for key in dead:
             del self._chains[key]
             i = bisect.bisect_left(self._keys, key)
@@ -219,8 +234,20 @@ class StorageServer:
         self.oldest_version = 0  # MVCC window floor
         self.known_committed = 0  # acked-on-all-tlogs bound, off peek replies
         self._version_waiters: list[tuple[int, Promise]] = []
-        self._watches: dict[bytes, list[tuple[bytes | None, Promise]]] = {}
-        self._watch_count = 0
+        # Read plane (reads/): the resident key-universe mirror + deadline
+        # coalescer serve get_multi (and, under FDB_TPU_READ_BATCH=1, the
+        # scalar get/get_range RPCs too); the packed watch registry
+        # replaces the seed's per-key dict + per-write pops.
+        self.read_set = TPUReadSet(self.map)
+        self._reads = ReadCoalescer(loop, self.read_set)
+        self.watches = WatchIndex()
+        self._watch_pending: list[tuple[bytes, int, bytes | None]] = []
+        self._too_many_watches = 0
+        from foundationdb_tpu.core.types import env_choice
+
+        self._batch_scalar_reads = (
+            env_choice("FDB_TPU_READ_BATCH", "0", ("0", "1")) == "1"
+        )
         self._feeds: dict[bytes, ChangeFeed] = {}
         self._running = False
         # Shard serving state (data distribution). None = serve everything
@@ -344,6 +371,7 @@ class StorageServer:
         for m in mutations:
             self._apply_one(m, version)
         self._advance(version)
+        self._sweep_watches()
 
     def _advance(self, version: int) -> None:
         self._version = version
@@ -363,14 +391,38 @@ class StorageServer:
         self.map.write(key, version, value)
         if self.kvstore is not None:
             self._dirty.add(key)
-        watchers = self._watches.pop(key, None)
-        if watchers:
-            keep = []
-            for expect, p in watchers:
-                (p.send(version) if value != expect else keep.append((expect, p)))
-            self._watch_count -= len(watchers) - len(keep)
-            if keep:
-                self._watches[key] = keep
+        if self.watches.count:
+            # Deferred to the per-version sweep (_sweep_watches): one
+            # packed probe per applied version instead of a dict pop per
+            # write. Same task step, no await between — promises resolve
+            # indistinguishably from the seed's inline fire.
+            self._watch_pending.append((key, version, value))
+
+    def _sweep_watches(self) -> None:
+        """Fire watches for every version applied since the last sweep:
+        each version's written keys (FINAL value per key) probe the packed
+        registry once (reads/watches.py). Runs at APPLY time — before
+        durability acks — which is what preserves the reference's
+        spurious-fire-on-rollback contract (see recover_to)."""
+        if not self._watch_pending:
+            return
+        pend, self._watch_pending = self._watch_pending, []
+        from time import perf_counter
+
+        from foundationdb_tpu.obs.span import span_sink
+
+        sink = span_sink(self.loop)
+        t0 = perf_counter() if sink is not None else 0.0
+        i = 0
+        while i < len(pend):  # group by version (ascending by construction)
+            v = pend[i][1]
+            group: list[tuple[bytes, bytes | None]] = []
+            while i < len(pend) and pend[i][1] == v:
+                group.append((pend[i][0], pend[i][2]))
+                i += 1
+            self.watches.sweep(v, group)
+        if sink is not None:
+            sink.stage_tick("watch_sweep", perf_counter() - t0, len(pend))
 
     def _gc(self) -> None:
         self.map.gc(self.oldest_version)
@@ -613,6 +665,7 @@ class StorageServer:
             for version, m in f.buffer:  # sync block through snap_version set
                 if version > snap_version:
                     self._apply_one(m, version)
+            self._sweep_watches()
             # Keep the state registered until the pull loop passes
             # snap_version: it must DROP re-deliveries at versions the
             # snapshot already covers (our pull cursor may still be behind
@@ -700,11 +753,10 @@ class StorageServer:
         self.served = out
         # Fail in-flight watches for the range: proxies stop tagging us, so
         # the triggering write would never arrive here — the client gets a
-        # retryable error and re-arms on the new owner.
-        for key in [k for k in self._watches if begin <= k < end]:
-            for _expect, p in self._watches.pop(key):
-                self._watch_count -= 1
-                p.fail(WrongShardServer(f"shard with {key[:16]!r} moved away"))
+        # retryable error and re-arms on the new owner. O(log n + hits)
+        # via the sorted watch index (the seed scanned every armed watch).
+        for key, _expect, p in self.watches.cancel_range(begin, end):
+            p.fail(WrongShardServer(f"shard with {key[:16]!r} moved away"))
 
     def _check_serving(self, begin: bytes, end: bytes, version: int) -> None:
         """Reads must land on shards we own at `version`. Spatial gaps →
@@ -808,7 +860,25 @@ class StorageServer:
         self._check_read_authz(key, key + b"\x00", token)
         await self._check_version(version)
         self._check_serving(key, key + b"\x00", version)
+        if self._batch_scalar_reads:
+            return (await self._reads.submit_points([key], version))[0]
         return self.map.at(key, version)
+
+    @rpc
+    async def get_multi(self, keys: list[bytes], version: int,
+                        token: str | None = None) -> list[bytes | None]:
+        """Batched point reads: all keys resolve through ONE coalesced
+        probe dispatch (reads/) instead of per-key actor hops. Results are
+        positional (None = absent), byte-identical to a sequence of get()
+        calls at the same version."""
+        for k in keys:
+            self._check_read_authz(k, k + b"\x00", token)
+        await self._check_version(version)
+        for k in keys:
+            self._check_serving(k, k + b"\x00", version)
+        if not keys:
+            return []
+        return await self._reads.submit_points(keys, version)
 
     @rpc
     async def system_snapshot(
@@ -864,6 +934,9 @@ class StorageServer:
         else:
             await self._check_version(version)
         self._check_serving(begin, end, version)
+        if self._batch_scalar_reads:
+            return await self._reads.submit_range(
+                begin, end, limit, reverse, version)
         keys = self.map.range_keys(begin, end)
         if reverse:
             keys = reversed(keys)
@@ -900,11 +973,11 @@ class StorageServer:
         current = self.map.latest(key)
         if current != value:
             return self._version
-        if self._watch_count >= self.MAX_WATCHES:
+        if self.watches.count >= self.MAX_WATCHES:
+            self._too_many_watches += 1
             raise TooManyWatches(f"{self.MAX_WATCHES} watches already armed")
         p = Promise()
-        self._watches.setdefault(key, []).append((value, p))
-        self._watch_count += 1
+        self.watches.add(key, value, p)
         return await p.future
 
     # -- change feeds (reference: storageserver.actor.cpp change feeds) ------
@@ -1008,6 +1081,7 @@ class StorageServer:
             for k in self._dirty:
                 v = self.map.latest(k)
                 queue_bytes += len(k) + (len(v) if v is not None else 0)
+        rc = self._reads
         return {
             "tag": self.tag,
             "durable_version": (
@@ -1020,4 +1094,16 @@ class StorageServer:
             ),
             "queue_bytes": queue_bytes,
             "keys": len(self.map._keys),
+            # Read plane + watch registry (reads/): zeros while idle so
+            # the DOCUMENTED_COUNTERS audit sees them in every scrape.
+            "watch_count": self.watches.count,
+            "too_many_watches": self._too_many_watches,
+            "watch_fires": self.watches.stats["fired"],
+            "reads": {
+                "dispatches": rc.stats["dispatches"],
+                "served": rc.stats["point_reads"] + rc.stats["range_reads"],
+                "queue_depth": rc.queue_depth,
+                "occupancy": round(rc.occupancy, 4),
+                "per_dispatch": round(rc.reads_per_dispatch, 2),
+            },
         }

@@ -51,7 +51,9 @@ from foundationdb_tpu.sim.workloads import (
     TaskBucketWorkload,
     TenantWorkload,
     VersionStampWorkload,
+    YCSBWorkload,
     WatchesWorkload,
+    WatchFanOutWorkload,
     WorkloadMetrics,
     WriteDuringReadWorkload,
     ZipfRepairWorkload,
@@ -95,6 +97,18 @@ WORKLOAD_REGISTRY: dict[str, tuple[type, dict[str, str]]] = {
     "Watches": (WatchesWorkload, {
         "keyCount": "n_keys",
         "rounds": "n_rounds",
+    }),
+    "WatchFanOut": (WatchFanOutWorkload, {
+        "keyCount": "n_keys",
+        "watchersPerKey": "watchers_per_key",
+    }),
+    "YCSB": (YCSBWorkload, {
+        "variant": "variant",
+        "keyCount": "n_keys",
+        "transactionCount": "n_txns",
+        "clientCount": "n_clients",
+        "batchSize": "batch",
+        "scanFraction": "scan_fraction",
     }),
     "VersionStamp": (VersionStampWorkload, {
         "transactionCount": "n_txns",

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 from foundationdb_tpu.core.errors import FdbError
 from foundationdb_tpu.core.mutations import MutationType
 from foundationdb_tpu.core.types import strinc
-from foundationdb_tpu.runtime.flow import all_of
+from foundationdb_tpu.runtime.flow import Promise, all_of
 
 
 class WorkloadFailed(FdbError):
@@ -2471,3 +2471,197 @@ class TaskBucketWorkload(Workload):
                 f"taskbucket accounting broken: counter {count}, "
                 f"{markers} markers != {self.n_tasks} tasks — a task was "
                 f"lost or double-applied")
+
+
+class YCSBWorkload(Workload):
+    """YCSB core workloads B (95/5 read/update) and C (read-only) on a
+    preloaded Zipf-skewed row set, driving the BATCHED read plane: each
+    read op is one multi-key `tr.get_multi` (a single get_multi RPC per
+    storage team), and a fraction are short range scans. Updates mutate
+    EXISTING rows only — no inserts — so the storage read mirror's key
+    set stays stable (value updates don't force a repack; see
+    foundationdb_tpu/reads/). Checks: get_multi parity against
+    sequential per-key gets on the final state, plus read-your-committed
+    for every acked update."""
+
+    name = "ycsb"
+
+    def __init__(self, seed: int = 0, variant: str = "B", n_keys: int = 64,
+                 n_txns: int = 40, n_clients: int = 4, batch: int = 8,
+                 scan_fraction: float = 0.2):
+        super().__init__(seed)
+        if variant not in ("B", "C"):
+            raise ValueError(f"YCSB variant {variant!r}: only B/C modeled")
+        self.variant = variant
+        self.n_keys = n_keys
+        self.n_txns = n_txns
+        self.n_clients = n_clients
+        self.batch = batch
+        self.scan_fraction = scan_fraction
+        self.update_fraction = 0.05 if variant == "B" else 0.0
+        self._acked: dict[bytes, bytes] = {}
+
+    def _key(self, i: int) -> bytes:
+        return b"ycsb/%06d" % i
+
+    def _pick(self, rng) -> int:
+        # Zipf-ish hot set, same shape as RandomReadWriteWorkload.
+        return min(int(rng.paretovariate(1.5)) - 1, self.n_keys - 1)
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_keys):
+                tr.set(self._key(i), b"init%06d" % i)
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        rng = cluster.loop.rng
+        counter = [0]
+        counts = self._split(self.n_txns, self.n_clients)
+
+        async def client(cid: int):
+            for _ in range(counts[cid]):
+                roll = rng.random()
+                if roll < self.update_fraction:
+                    k = self._key(self._pick(rng))
+                    counter[0] += 1
+                    val = b"u%08d" % counter[0]
+
+                    async def body(tr, k=k, val=val):
+                        await tr.get(k)
+                        tr.set(k, val)
+
+                    await self._run_txn(db, body)
+                    self._acked[k] = val
+                elif roll < self.update_fraction + self.scan_fraction:
+                    lo = self._pick(rng)
+                    span = 1 + rng.randrange(8)
+
+                    async def body(tr, lo=lo, span=span):
+                        return await tr.get_range(
+                            self._key(lo), self._key(lo + span), limit=span)
+
+                    await self._run_txn(db, body)
+                else:
+                    picks = sorted({self._pick(rng)
+                                    for _ in range(self.batch)})
+
+                    async def body(tr, picks=picks):
+                        rows = await tr.get_multi(
+                            [self._key(i) for i in picks])
+                        if any(r is None for r in rows):
+                            raise WorkloadFailed("ycsb: preloaded row gone")
+                        return rows
+
+                    await self._run_txn(db, body)
+                self.metrics.ops += 1
+
+        await all_of([
+            cluster.loop.spawn(client(i), name=f"ycsb.client{i}")
+            for i in range(self.n_clients)
+        ])
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            keys = [self._key(i) for i in range(self.n_keys)]
+            batched = await tr.get_multi(keys, snapshot=True)
+            for k, got in zip(keys, batched):
+                single = await tr.get(k, snapshot=True)
+                if got != single:
+                    raise WorkloadFailed(
+                        f"ycsb: get_multi({k!r})={got!r} != get={single!r}")
+            # Read-your-committed: C never writes; B's acked updates must
+            # survive (a later acked update to the same key supersedes).
+            for k, val in self._acked.items():
+                cur = batched[keys.index(k)]
+                if cur is None:
+                    raise WorkloadFailed(f"ycsb: acked update to {k!r} lost")
+
+        await self._run_txn(db, body)
+
+
+class WatchFanOutWorkload(Workload):
+    """Many watches, few writes: `watchers_per_key` clients arm a watch
+    on each of `n_keys` keys (fan-out = product), then one mutation wave
+    touches every watched key. Every armed watch must fire (the packed
+    registry must not LOSE a fire under fan-out; spurious fires remain
+    legal per the reference contract). Exercises the packed watch
+    registry's one-sweep-per-version match against a large resident
+    set — the cost the reads/ subsystem makes sublinear."""
+
+    name = "watch_fanout"
+
+    def __init__(self, seed: int = 0, n_keys: int = 8,
+                 watchers_per_key: int = 4):
+        super().__init__(seed)
+        self.n_keys = n_keys
+        self.watchers_per_key = watchers_per_key
+
+    def _key(self, i: int) -> bytes:
+        return b"wfan/%05d" % i
+
+    async def setup(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_keys):
+                tr.set(self._key(i), b"v0")
+
+        await self._run_txn(db, body)
+
+    async def run(self, db, cluster) -> None:
+        MAX_REARMS = 200  # a wedged watch must fail, not hang the sim
+        armed = [0]
+        fired = [0]
+        all_armed = Promise()
+
+        async def watcher(i: int, w: int):
+            for attempt in range(MAX_REARMS):
+                try:
+                    async def arm(tr):
+                        return await tr.watch(self._key(i))
+
+                    slot = await self._run_txn(db, arm)
+                    armed[0] += 1
+                    if armed[0] == self.n_keys * self.watchers_per_key:
+                        all_armed.send(None)
+                    await slot
+                    fired[0] += 1
+                    self.metrics.ops += 1
+                    return
+                except FdbError as e:
+                    if not e.retryable:
+                        raise
+                    # Value may already differ from the armed snapshot —
+                    # that immediate fire path raises nothing; only
+                    # retryable transport errors land here.
+                    await cluster.loop.sleep(0.05)
+            raise WorkloadFailed(f"watch fan-out {i}/{w}: re-arms exhausted")
+
+        async def mutator():
+            await all_armed.future
+            async def body(tr):
+                for i in range(self.n_keys):
+                    tr.set(self._key(i), b"v1")
+
+            await self._run_txn(db, body)
+
+        tasks = [
+            cluster.loop.spawn(watcher(i, w), name=f"wfan.w{i}.{w}")
+            for i in range(self.n_keys)
+            for w in range(self.watchers_per_key)
+        ]
+        tasks.append(cluster.loop.spawn(mutator(), name="wfan.mutator"))
+        await all_of(tasks)
+        want = self.n_keys * self.watchers_per_key
+        if fired[0] != want:
+            raise WorkloadFailed(
+                f"watch fan-out: {fired[0]}/{want} watches fired")
+        self.metrics.extra["fan_out"] = want
+
+    async def check(self, db) -> None:
+        async def body(tr):
+            for i in range(self.n_keys):
+                if await tr.get(self._key(i)) != b"v1":
+                    raise WorkloadFailed("watch fan-out: wave write lost")
+
+        await self._run_txn(db, body)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Read-plane A/B: batched multi-get/range dispatches (reads/ deadline
+# coalescer + packed interval probe) vs the per-key actor baseline, plus
+# packed watch-sweep scaling — one honesty-flagged JSON record.
+#
+# The quoted numbers are the ISSUE-16 acceptance set: batched read
+# throughput >= 3x the per-key actor baseline on YCSB-B/C at batched p99
+# no worse than baseline, watch sweep at 1e5-1e6 armed watches <= 2x the
+# 1e3 sweep per committed version, and byte-identical results + watch
+# fire sets vs the sequential oracle on EVERY arm (the record's own
+# `valid` gates all of it). Honesty flags ride along exactly like the
+# other A/B artifacts: valid / cpu_fallback / p99_quotable /
+# co_corrected (false: closed-loop clients).
+#
+#   OPS=2000 OUT=READS_AB.json scripts/reads_ab.sh
+set -u
+cd "$(dirname "$0")/.."
+OPS=${OPS:-2000}
+KEYS=${KEYS:-4096}
+BATCH=${BATCH:-16}
+CLIENTS=${CLIENTS:-24}
+SEED=${SEED:-0}
+WATCH_SIZES=${WATCH_SIZES:-1000,100000,1000000}
+OUT=${OUT:-READS_AB.json}
+LOG=${LOG:-reads_ab.log}
+
+python -m foundationdb_tpu.reads --ab \
+    --ops "$OPS" --keys "$KEYS" --batch "$BATCH" --clients "$CLIENTS" \
+    --seed "$SEED" --watch-sizes "$WATCH_SIZES" \
+    > /tmp/_reads_ab.json 2>> "$LOG" || true
+
+python - "$OUT" <<'PYEOF'
+import json
+import sys
+
+try:
+    rec = json.loads(open("/tmp/_reads_ab.json").read().strip().splitlines()[-1])
+except Exception:
+    rec = {"metric": "reads_ab", "valid": False, "error": "bench produced no record"}
+open(sys.argv[1], "w").write(json.dumps(rec) + "\n")
+print(json.dumps(rec))
+PYEOF

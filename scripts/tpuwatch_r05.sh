@@ -137,6 +137,21 @@ while true; do
     'r.get("metric") == "obs_selfcheck" and r.get("ok")' -- \
     env JAX_PLATFORMS=cpu python -m foundationdb_tpu.obs \
     || { sleep 60; continue; }
+  # Read-plane selfcheck (reads subsystem): batched point/range reads vs
+  # the sequential oracle on host + device arms, watch fire-set parity
+  # across arms, and get_multi RPC parity — one JSON line, CPU-only sim.
+  stage reads 600 READS_r05.json \
+    'r.get("metric") == "reads_selfcheck" and r.get("ok")' -- \
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.reads \
+    || { sleep 60; continue; }
+  # Read-plane A/B (reads subsystem): batched multi-get/range dispatches
+  # vs the per-key actor baseline on YCSB-B/C (>=3x at equal p99), packed
+  # watch-sweep sublinearity at 1e3..1e6 armed watches, byte parity on
+  # every arm — the record's own `valid` gates all of it.
+  stage ab_reads 1200 READS_AB_r05.json \
+    'r.get("metric") == "reads_ab" and r.get("valid")' -- \
+    env OUT=READS_AB_r05_rec.json bash scripts/reads_ab.sh \
+    || { sleep 60; continue; }
   # Sampling-overhead gate (obs subsystem): tracing off vs 1-in-64 on
   # the same sim workload, wall-clocked — the <=2% acceptance with the
   # standard honesty flags.
