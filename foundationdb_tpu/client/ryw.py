@@ -121,7 +121,12 @@ class RYWTransaction(Transaction):
             return await super().get_multi(keys, snapshot)
         keys = list(keys)
         out: list = [None] * len(keys)
-        need: list[int] = []
+        # Unique key -> every position wanting it: a duplicated key must
+        # fetch once and fan the SAME resolved value out to all positions
+        # (per-position folding would rewrite an "ops" overlay to "value"
+        # on the first occurrence and hand later occurrences the raw
+        # storage base — two different values in one result).
+        need: dict[bytes, list[int]] = {}
         for j, key in enumerate(keys):
             kind, entry = self._overlay.get(key, (None, None))
             if kind == "value":
@@ -131,17 +136,19 @@ class RYWTransaction(Transaction):
             elif self._covered_by_clear(key):
                 out[j] = None
             else:
-                need.append(j)
+                need.setdefault(key, []).append(j)
         if need:
-            bases = await super().get_multi([keys[j] for j in need], snapshot)
-            for j, base in zip(need, bases):
-                kind, entry = self._overlay.get(keys[j], (None, None))
+            uniq = list(need)
+            bases = await super().get_multi(uniq, snapshot)
+            for key, base in zip(uniq, bases):
+                kind, entry = self._overlay.get(key, (None, None))
                 if kind == "ops":
                     for op, param in entry:
                         base = apply_atomic(op, base, param)
                     if not snapshot:
-                        self._overlay[keys[j]] = ("value", base)
-                out[j] = base
+                        self._overlay[key] = ("value", base)
+                for j in need[key]:
+                    out[j] = base
         return out
 
     def _merge(

@@ -249,6 +249,7 @@ class Database:
                 team = tuple(self.storage_map.team_for_key(keys[i]))
                 groups.setdefault(team, []).append(i)
             retry: list[int] = []
+            future_idxs: list[int] = []
             last_future = None
             unreachable = False
             for team, idxs in groups.items():
@@ -265,11 +266,18 @@ class Database:
                     retry.extend(idxs)
                 except FutureVersion as e:
                     last_future = e
+                    future_idxs.extend(idxs)
                 except ProcessKilled:
                     unreachable = True
                     retry.extend(idxs)
             if last_future is not None and not retry:
+                # No group needs a re-route: whole-team lag is terminal
+                # here, exactly as in read_key.
                 raise last_future
+            # Lagging-team keys ride the retry loop with the re-routed
+            # groups (the map refresh may land them on a caught-up team);
+            # they must NEVER fall out of `remaining` as a spurious None.
+            retry.extend(future_idxs)
             if not retry:
                 return out
             remaining = retry

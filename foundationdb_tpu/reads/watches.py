@@ -138,9 +138,13 @@ class WatchIndex:
     def _candidate_keys(self, written_keys: list[bytes]) -> list[bytes]:
         """Armed keys among `written_keys` — the probe under A/B test.
         Every arm must return the same set (parity-pinned)."""
+        # Every arm consolidates: the host arm skips packing
+        # (_rebuild_packed early-returns) but must still fold the pending
+        # tail into _sorted, or cancel_range's "bounded pending tail"
+        # scan degrades to O(all adds ever).
+        self._consolidate()
         if self.arm == "0":
             return [k for k in written_keys if k in self._by_key]
-        self._consolidate()
         out: list[bytes] = []
         n = len(self._sorted)
         if n:
@@ -213,19 +217,33 @@ class WatchIndex:
         hi = bisect.bisect_left(self._sorted, end)
         self.stats["cancel_scanned"] += (hi - lo) + len(self._pending)
         seen = set()
+        dead_rows = 0
         for k in self._sorted[lo:hi]:
             if k in self._by_key and k not in seen:
                 hits.append(k)
                 seen.add(k)
+                dead_rows += 1  # this row in _sorted becomes a tombstone
+        pend_in_range = False
         for k in self._pending:
-            if begin <= k < end and k in self._by_key and k not in seen:
-                hits.append(k)
-                seen.add(k)
+            if begin <= k < end:
+                # Pending-tail hits have no row in _sorted — they are NOT
+                # tombstones, so they must not inflate _dead.
+                pend_in_range = True
+                if k in self._by_key and k not in seen:
+                    hits.append(k)
+                    seen.add(k)
+        if pend_in_range:
+            # Drop cancelled keys from the tail: left behind, a later
+            # _consolidate would merge them into _sorted as tombstones
+            # _dead never counted, drifting the prune heuristic.
+            self._pending = [
+                k for k in self._pending if not (begin <= k < end)
+            ]
         out = []
         for k in hits:
             for expect, p in self._by_key.pop(k):
                 out.append((k, expect, p))
         self._count -= len(out)
-        self._dead += len(hits)
+        self._dead += dead_rows
         self.stats["cancelled"] += len(out)
         return out

@@ -861,7 +861,14 @@ class StorageServer:
         await self._check_version(version)
         self._check_serving(key, key + b"\x00", version)
         if self._batch_scalar_reads:
-            return (await self._reads.submit_points([key], version))[0]
+            val = (await self._reads.submit_points([key], version))[0]
+            # Re-validate after the coalescer's deadline wait: a shard
+            # handoff landing during the await purges the key, and the
+            # dispatch would answer "absent" from the post-move map
+            # instead of wrong_shard_server (the seed's scalar path had
+            # no await between this check and map.at).
+            self._check_serving(key, key + b"\x00", version)
+            return val
         return self.map.at(key, version)
 
     @rpc
@@ -878,7 +885,13 @@ class StorageServer:
             self._check_serving(k, k + b"\x00", version)
         if not keys:
             return []
-        return await self._reads.submit_points(keys, version)
+        vals = await self._reads.submit_points(keys, version)
+        # Re-validate post-await: see get() — a handoff during the
+        # coalescer wait must fail the read, not serve purged keys as
+        # absent.
+        for k in keys:
+            self._check_serving(k, k + b"\x00", version)
+        return vals
 
     @rpc
     async def system_snapshot(
@@ -935,8 +948,11 @@ class StorageServer:
             await self._check_version(version)
         self._check_serving(begin, end, version)
         if self._batch_scalar_reads:
-            return await self._reads.submit_range(
+            rows = await self._reads.submit_range(
                 begin, end, limit, reverse, version)
+            # Re-validate post-await: see get().
+            self._check_serving(begin, end, version)
+            return rows
         keys = self.map.range_keys(begin, end)
         if reverse:
             keys = reversed(keys)

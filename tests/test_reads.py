@@ -14,7 +14,11 @@ import random
 
 import pytest
 
-from foundationdb_tpu.core.errors import TooManyWatches, WrongShardServer
+from foundationdb_tpu.core.errors import (
+    FutureVersion,
+    TooManyWatches,
+    WrongShardServer,
+)
 from foundationdb_tpu.core.mutations import Mutation, MutationType as M
 from foundationdb_tpu.reads.coalescer import ReadBrain
 from foundationdb_tpu.reads.read_set import TPUReadSet
@@ -269,6 +273,44 @@ class TestWatchIndex:
         assert len(out) == 10
         assert idx.stats["cancel_scanned"] <= 30  # tail-bounded, not 2030
 
+    def test_host_arm_consolidates_pending_on_sweep(self):
+        """Review fix: the host arm must fold the pending tail into the
+        sorted index on sweep too, or cancel_range's tail scan degrades
+        to O(all adds ever)."""
+        log: list = []
+        idx = WatchIndex(arm="0")
+        for i in range(1000):
+            idx.add(b"hk/%04d" % i, None, _P(i, log))
+        idx.sweep(1, [(b"zz-absent", b"x")])
+        assert not idx._pending
+        assert len(idx._sorted) == 1000
+        idx.stats["cancel_scanned"] = 0
+        out = idx.cancel_range(b"hk/0100", b"hk/0110")
+        assert len(out) == 10
+        assert idx.stats["cancel_scanned"] == 10  # hits only, not 1000
+
+    def test_cancel_range_accounting_over_pending_tail(self):
+        """Review fix: pending-tail cancels have no _sorted rows — they
+        must not inflate the tombstone count, and the cancelled keys must
+        not linger in _pending to be merged later as uncounted rows."""
+        log: list = []
+        idx = WatchIndex(arm="1")
+        for i in range(100):
+            idx.add(b"pk/%03d" % i, None, _P(i, log))
+        idx.sweep(1, [(b"zz-absent", b"x")])  # consolidates 0..99
+        for i in range(100, 120):
+            idx.add(b"pk/%03d" % i, None, _P(i, log))  # pending tail
+        out = idx.cancel_range(b"pk/100", b"pk/120")
+        assert len(out) == 20
+        assert idx._dead == 0  # no _sorted row died
+        assert all(not (b"pk/100" <= k < b"pk/120") for k in idx._pending)
+        idx._consolidate()  # must not resurrect cancelled keys
+        assert all(not (b"pk/100" <= k < b"pk/120") for k in idx._sorted)
+        # Consolidated-row cancels count exactly the rows tombstoned.
+        out2 = idx.cancel_range(b"pk/000", b"pk/010")
+        assert len(out2) == 10
+        assert idx._dead == 10
+
     def test_shard_move_fails_in_range_watches_only(self):
         loop, ss = make_ss()
         ss.init_served([(b"", b"\xff")])
@@ -408,6 +450,28 @@ class TestClientGetMulti:
 
         assert c.loop.run(main(), timeout=300) == [b"pending", None]
 
+    def test_ryw_get_multi_duplicate_key_with_atomic_overlay(self):
+        """Review fix: a key listed twice with a pending atomic-op
+        overlay must resolve to the SAME folded value at every position
+        (the first fold rewrites the overlay to "value"; the second
+        occurrence used to get the raw storage base)."""
+        c, db = self._db(5)
+
+        async def main():
+            tr = db.transaction()
+            tr.set(b"ctr", (5).to_bytes(8, "little"))
+            await tr.commit()
+            tr2 = db.transaction()
+            tr2.atomic_op(M.ADD, b"ctr", (1).to_bytes(8, "little"))
+            got = await tr2.get_multi([b"ctr", b"x", b"ctr"])
+            single = await tr2.get(b"ctr")
+            return got, single
+
+        got, single = c.loop.run(main(), timeout=300)
+        want = (6).to_bytes(8, "little")
+        assert got == [want, None, want]
+        assert single == want
+
     def test_status_json_reads_section(self):
         from foundationdb_tpu.runtime.status import fetch_status
 
@@ -430,6 +494,74 @@ class TestClientGetMulti:
         for k in ("queue_depth", "occupancy", "watch_count",
                   "watch_fires", "too_many_watches"):
             assert k in rd
+
+
+# ---------------------------------------------------------------------------
+# Database.read_keys failover discipline
+# ---------------------------------------------------------------------------
+
+
+class _LaggingEp:
+    """get_multi raises FutureVersion `behind` times, then serves."""
+
+    def __init__(self, behind):
+        self.behind = behind
+
+    async def get_multi(self, keys, version, token=None):
+        if self.behind > 0:
+            self.behind -= 1
+            raise FutureVersion("replica behind")
+        return [b"v:" + k for k in keys]
+
+
+class _MovedOnceEp:
+    """get_multi raises WrongShardServer once, then serves."""
+
+    def __init__(self):
+        self.moved = False
+
+    async def get_multi(self, keys, version, token=None):
+        if not self.moved:
+            self.moved = True
+            raise WrongShardServer("shard moved")
+        return [b"v:" + k for k in keys]
+
+
+class _SplitMap:
+    """Keys below b'm' team {0}, the rest team {1}."""
+
+    def team_for_key(self, key):
+        return [0] if key < b"m" else [1]
+
+
+class TestReadKeysFailover:
+    """Review fix: a lagging team's keys must retry or raise — NEVER
+    fall out of the loop as a spurious None while another group's
+    wrong_shard_server retry keeps the iteration going."""
+
+    def _db(self, eps):
+        from foundationdb_tpu.client.transaction import Database
+
+        loop = Loop(seed=0)
+        return loop, Database(loop, [], [], _SplitMap(), eps)
+
+    def test_transient_lag_rides_the_retry_loop(self):
+        loop, db = self._db([_LaggingEp(behind=1), _MovedOnceEp()])
+
+        async def main():
+            return await db.read_keys([b"a", b"z"], version=5)
+
+        assert loop.run(main(), timeout=10) == [b"v:a", b"v:z"]
+
+    def test_persistent_lag_raises_not_spurious_none(self):
+        loop, db = self._db([_LaggingEp(behind=10_000), _MovedOnceEp()])
+
+        async def main():
+            with pytest.raises(FutureVersion):
+                await db.read_keys([b"a", b"z"], version=5)
+            return "ok"
+
+        assert loop.run(main(), timeout=10) == "ok"
 
 
 # ---------------------------------------------------------------------------
