@@ -443,7 +443,18 @@ def run_tpu_wire(
             best_dt = dt
             best_lat = lat_ms
             conflicts = int(sum(int((v == 1).sum()) for v in verdicts))
+            import hashlib
+
             extras = {
+                # Byte-exact replay gate: the full verdict stream hashed
+                # in window order. Two arms on the same seeds (e.g.
+                # pipeline_ab's serial vs speculative) must produce
+                # IDENTICAL digests — stronger than the conflict-count
+                # parity vs the CPU skiplist, which could mask
+                # compensating flips.
+                "verdicts_sha256": hashlib.sha256(
+                    np.stack([np.asarray(v) for v in verdicts]).tobytes()
+                ).hexdigest(),
                 "host_pack_s": round(sum(pack_ms) / 1e3, 4),
                 "host_pack_ms_per_window": round(
                     sum(pack_ms) / max(1, n_windows), 3
@@ -459,6 +470,11 @@ def run_tpu_wire(
                 ),
                 "dictionary": cs.dict_stats,
             }
+            if getattr(cs, "spec", False):
+                # Mis-speculation accounting rides in the record so the
+                # AB harness (and ratekeeper dashboards) can quote the
+                # repair rate next to the throughput claim.
+                extras["spec"] = cs.spec_metrics()
             if n_resolvers > 1 and getattr(cs, "wave_commit", False):
                 # Mesh wave commit: the realized-graph exchange account
                 # (occupied predecessor tiles vs the dense all_gather) —
@@ -1212,6 +1228,22 @@ def roofline_estimate(mode: ModeConfig, capacity: int,
     est["resident_bytes_ratio"] = round(
         pk["bytes_per_batch"] / max(res["bytes_per_batch"], 1), 2
     )
+    # Buffer-donation audit (ISSUE 17 satellite): every state-mutating jit
+    # in conflict_kernel (_resolve*, _advance*, _paint_many*) donates
+    # argnum 0, so XLA aliases the history arrays in place instead of
+    # materializing a copy per dispatch. The modeled saving is one full
+    # state copy per dispatch: keys [capacity, W] int32 + versions + used
+    # scalarized as (W + 2) words. Speculation's counter-term is the
+    # explicit rollback snapshot (_snapshot_jit) each speculated window
+    # takes — the SAME size, paid only on the speculative arm, and only
+    # once per window regardless of depth.
+    n_words = (KEY_BYTES + 3) // 4
+    state_bytes = capacity * (n_words + 2) * 4
+    est["donation"] = {
+        "donate_argnums_state": True,
+        "hbm_bytes_saved_per_dispatch": state_bytes,
+        "spec_snapshot_bytes": state_bytes,
+    }
     if n_shards > 1:
         # Mesh wave-commit exchange term (ISSUE 13): the predecessor-tile
         # OR-reduce that rebuilds the global conflict graph across the
@@ -1645,6 +1677,9 @@ def main() -> None:
                          "power-of-two depths are warm-compiled upfront)")
     ap.add_argument("--no-adaptive", action="store_true",
                     help="skip the adaptive-dispatch pass")
+    ap.add_argument("--theta", type=float, default=None,
+                    help="override the mode's Zipf skew (0 = uniform keys "
+                         "at the same txn shape; only with --mode)")
     ap.add_argument("--smoke", action="store_true",
                     help="minimal validity run: one repeat, no latency "
                          "probe / profiler / adaptive pass / sweeps "
@@ -1780,6 +1815,13 @@ def main() -> None:
         args.txns = min(args.txns, 131_072)
     single = args.mode is not None or args.resolvers > 1
     headline_mode = MODES[args.mode or "ycsb"]
+    if args.theta is not None:
+        # Skew override for A/B harnesses that need the SAME txn shape at
+        # a different key distribution (e.g. pipeline_ab's uniform arm:
+        # ycsb reads/writes at theta 0).
+        from dataclasses import replace as _dc_replace
+
+        headline_mode = _dc_replace(headline_mode, theta=args.theta)
 
     result = {
         "metric": "resolved_txns_per_sec_per_chip",

@@ -131,6 +131,19 @@ _WAVE_COMMIT = _env_choice("FDB_TPU_WAVE_COMMIT", "0", ("0", "1")) == "1"
 # the flag is inert. Same import-once rule as the flags above.
 _RESIDENT = (_env_choice("FDB_TPU_RESIDENT", "1", ("0", "1")) == "1") and _PACKED
 
+# Speculative pipelined resolve: "0" (default — windows resolve strictly
+# in order, the A/B baseline) | "1" (window N+1 dispatches against window
+# N's PENDING write sets: N's accepted-so-far writes are painted as if
+# committed while N's verdicts are still in flight / unconfirmed by the
+# upper layer; a host-side reconcile ring confirms or repairs when the
+# verdicts land — see conflict_set.TPUConflictSet.spec_dispatch_window).
+# Requires the packed kernel (the dependency probe runs over the batch
+# dictionary); inert under FDB_TPU_PACKED=0, mirroring _RESIDENT's
+# gating. Same import-once rule as the flags above.
+_SPEC_RESOLVE = (
+    _env_choice("FDB_TPU_SPEC_RESOLVE", "0", ("0", "1")) == "1"
+) and _PACKED
+
 # Verdict encoding (core.types.Verdict values, as device int8).
 V_COMMITTED = 0
 V_CONFLICT = 1
@@ -2595,3 +2608,215 @@ def _phase_merge_hist_res_jit(res, new_oldest):
     hist = res.hist
     nb = _merge_delta(hist.base, hist.delta, new_oldest)
     return nb, sparse_table(nb.versions)
+
+
+# ---------------------------------------------------------------------------
+# Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE=1): the host
+# dispatches window N+1 against window N's OPTIMISTICALLY painted state
+# (the resolve programs above paint accepted-so-far writes in the same
+# program that decides them) while N's verdicts are still in flight —
+# i.e. unconfirmed by the upper layer (tlog durability, wave apply,
+# ratekeeper). The kernel side of the reconcile is three programs:
+#
+# - _snapshot_jit: fresh device buffers for the pre-window state, taken
+#   right before a speculative dispatch. The resolve entry points donate
+#   their state argument (argnum 0), so the ACTIVE state never
+#   double-buffers; the snapshot is the explicit, depth-bounded HBM cost
+#   of speculation (one state copy per in-flight window), and rolling
+#   back a mis-speculated window is a host pointer swap.
+# - paint-only entry points (_paint{,_many}{_hist}{_packed|_res}_jit):
+#   re-advance a rolled-back state with a FORCED accept mask (the
+#   speculative accepts ∩ the upper layer's confirmation) — the same
+#   merge/GC/paint pipeline as the resolve bodies, minus the verdict
+#   decision the upper layer already overrode.
+# - the verdict-dependency mask (_spec_mark_rejected / _spec_dep_*): did
+#   ANY read of a younger in-flight window overlap a write the older
+#   window's confirmation rejected? Rejected writes are painted into a
+#   small scratch step function at +inf version; a younger window whose
+#   probe comes back clean provably kept its speculative verdicts (its
+#   reads never saw a rejected boundary; its floor and intra-window graph
+#   are unchanged), so reconcile re-paints it instead of re-resolving.
+#   A dirty (or scratch-overflowed) probe sends the whole window through
+#   the repair path: re-resolve against the corrected history — only
+#   genuinely-conflicted txns flip.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _snapshot_jit(tree):
+    """Device copy of an arbitrary state pytree (NOT donated — the live
+    state keeps executing; see the speculation ring in conflict_set)."""
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+def paint_batch_packed(state: ConflictState, pb: PackedBatch, accepted,
+                       commit_version, new_oldest) -> ConflictState:
+    """Paint-only advance: apply a host-forced accept mask to the flat
+    packed history — resolve_batch_packed minus the verdict decision."""
+    floor = jnp.maximum(state.oldest, new_oldest)
+    return _paint_and_compact_packed(state, pb, accepted, commit_version,
+                                     floor)
+
+
+def paint_many_packed(state, pbs, accepted, commit_versions, new_oldests):
+    def body(st, xs):
+        pb, acc, cv, old = xs
+        return paint_batch_packed(st, pb, acc, cv, old), None
+
+    state, _ = jax.lax.scan(
+        body, state, (pbs, accepted, commit_versions, new_oldests)
+    )
+    return state
+
+
+def paint_batch_hist_packed(hist: HistState, pb: PackedBatch, accepted,
+                            commit_version, new_oldest) -> HistState:
+    """Two-level edition: same demand-driven merge as the resolve body (a
+    forced paint must respect delta capacity exactly like a decided one)."""
+    floor, _ = too_old_mask_packed(hist.delta, pb, new_oldest)
+    demand = 2 * jnp.sum(
+        (pb.write_mask & (pb.write_begin < pb.write_end)).astype(jnp.int32)
+    )
+    hist = _maybe_merge(hist, demand, floor)
+    base_h, base_st, delta = hist
+    delta = _paint_and_compact_packed(delta, pb, accepted, commit_version,
+                                      floor)
+    return HistState(base_h, base_st, delta)
+
+
+def paint_many_hist_packed(hist, pbs, accepted, commit_versions, new_oldests):
+    def body(h, xs):
+        pb, acc, cv, old = xs
+        return paint_batch_hist_packed(h, pb, acc, cv, old), None
+
+    hist, _ = jax.lax.scan(
+        body, hist, (pbs, accepted, commit_versions, new_oldests)
+    )
+    return hist
+
+
+def _paint_core_res(hist, rbk: RankBatch, accepted, commit_version,
+                    new_oldest):
+    if isinstance(hist, HistState):
+        floor, _ = too_old_mask_packed(hist.delta, rbk, new_oldest)
+        demand = 2 * jnp.sum(
+            (rbk.write_mask & (rbk.write_begin < rbk.write_end)).astype(
+                jnp.int32
+            )
+        )
+        hist = _maybe_merge(hist, demand, floor)
+        base_h, base_st, delta = hist
+        delta = _paint_and_compact_res(delta, rbk, accepted, commit_version,
+                                       floor)
+        return HistState(base_h, base_st, delta)
+    floor = jnp.maximum(hist.oldest, new_oldest)
+    return _paint_and_compact_res(hist, rbk, accepted, commit_version, floor)
+
+
+def paint_batch_res(res: ResState, rb: ResidentBatch, accepted,
+                    commit_version, new_oldest) -> ResState:
+    """Resident edition: the dictionary delta re-applies exactly as the
+    resolve body would (a rolled-back snapshot predates this window's
+    insert, so the replayed merge reproduces the original rank space)."""
+    res = apply_delta(res, rb.delta_keys)
+    return res._replace(
+        hist=_paint_core_res(res.hist, rb.ranks, accepted, commit_version,
+                             new_oldest)
+    )
+
+
+def paint_many_res(res, rb, accepted, commit_versions, new_oldests):
+    res = apply_delta(res, rb.delta_keys)
+
+    def body(h, xs):
+        rbk, acc, cv, old = xs
+        return _paint_core_res(h, rbk, acc, cv, old), None
+
+    hist, _ = jax.lax.scan(
+        body, res.hist, (rb.ranks, accepted, commit_versions, new_oldests)
+    )
+    return res._replace(hist=hist)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_packed_jit(state, pb, accepted, commit_version, new_oldest):
+    return paint_batch_packed(state, pb, accepted, commit_version, new_oldest)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_many_packed_jit(state, pbs, accepted, commit_versions,
+                           new_oldests):
+    return paint_many_packed(state, pbs, accepted, commit_versions,
+                             new_oldests)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_hist_packed_jit(hist, pb, accepted, commit_version, new_oldest):
+    return paint_batch_hist_packed(hist, pb, accepted, commit_version,
+                                   new_oldest)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_many_hist_packed_jit(hist, pbs, accepted, commit_versions,
+                                new_oldests):
+    return paint_many_hist_packed(hist, pbs, accepted, commit_versions,
+                                  new_oldests)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_res_jit(res, rb, accepted, commit_version, new_oldest):
+    return paint_batch_res(res, rb, accepted, commit_version, new_oldest)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paint_many_res_jit(res, rb, accepted, commit_versions, new_oldests):
+    return paint_many_res(res, rb, accepted, commit_versions, new_oldests)
+
+
+# Hist/flat distinction rides the ResState pytree (see the resident alias
+# block above) — same totality trick for the paint entry names.
+_paint_hist_res_jit = _paint_res_jit
+_paint_many_hist_res_jit = _paint_many_res_jit
+
+
+# -- verdict-dependency mask -------------------------------------------------
+# Scratch = a small flat ConflictState holding ONLY the rejected writes of
+# the reconciling window, painted at +inf version so any overlapping read
+# trips the probe regardless of its read version. Works for flat AND
+# two-level non-resident engines (the scratch is its own flat state; only
+# the batches' dictionaries are probed). Resident engines skip the probe
+# (their ranks live in per-window coordinate systems) and repair
+# pessimistically — see conflict_set._spec_dep_windows.
+
+_SPEC_DEP_VERSION = INT32_MAX - 1
+
+
+def _spec_mark_rejected(scratch: ConflictState, pbs: PackedBatch,
+                        rejected) -> ConflictState:
+    def body(st, xs):
+        pb, rej = xs
+        st = _paint_and_compact_packed(
+            st, pb, rej, jnp.int32(_SPEC_DEP_VERSION), jnp.int32(0)
+        )
+        return st, None
+
+    scratch, _ = jax.lax.scan(body, scratch, (pbs, rejected))
+    return scratch
+
+
+def _spec_dep_window(scratch: ConflictState, pbs: PackedBatch):
+    def body(acc, pb):
+        return acc | jnp.any(_history_conflict_ranges_packed(scratch, pb)), None
+
+    dep, _ = jax.lax.scan(body, jnp.bool_(False), pbs)
+    return dep | scratch.overflow
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _spec_mark_rejected_jit(scratch, pbs, rejected):
+    return _spec_mark_rejected(scratch, pbs, rejected)
+
+
+@jax.jit  # scratch NOT donated: one marked scratch probes every younger window
+def _spec_dep_window_jit(scratch, pbs):
+    return _spec_dep_window(scratch, pbs)

@@ -19,6 +19,7 @@ import os
 import struct
 import threading
 from time import perf_counter as _perf_counter
+from collections import deque
 from typing import Callable, NamedTuple
 
 import numpy as np
@@ -346,6 +347,27 @@ class PreparedWindow(NamedTuple):
     rebase_delta: int  # deferred device rebase; applied before dispatch
 
 
+class _SpecPending(NamedTuple):
+    """One speculatively dispatched window awaiting reconcile.
+
+    ``snapshot`` is a fresh device copy of the engine state taken RIGHT
+    BEFORE this window's dispatch (the resolve entry points donate their
+    state argument, so the live state never double-buffers — the
+    snapshot is the explicit, spec_depth-bounded HBM cost of
+    speculation). Rolling a mis-speculated window back is a host pointer
+    swap to this snapshot followed by a paint-only re-advance with the
+    confirmed accept mask."""
+
+    seq: int
+    snapshot: object  # device state BEFORE dispatch (rollback target)
+    batch: object  # device-format batch (PackedBatch / ResidentBatch)
+    cvs_rel: np.ndarray
+    olds_rel: np.ndarray
+    count: int
+    verdicts: object  # device verdicts int8 [k, B] (still in flight)
+    levels: object  # device wave levels int32 [k, B] or None
+
+
 class TPUConflictSet:
     """Drop-in conflict engine: resolve(txns, commit_version) → verdicts."""
 
@@ -362,6 +384,8 @@ class TPUConflictSet:
         resident: bool | None = None,
         dict_capacity: int | None = None,
         dict_delta_slots: int | None = None,
+        spec_resolve: bool | None = None,
+        spec_depth: int = 2,
     ):
         self.codec = KeyCodec(max_key_bytes)
         # Resident-dictionary mode (FDB_TPU_RESIDENT default; requires the
@@ -372,6 +396,36 @@ class TPUConflictSet:
         self.resident = (
             ck._RESIDENT if resident is None else bool(resident)
         ) and ck._PACKED
+        # Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE default;
+        # requires the packed kernel — the reconcile dependency probe runs
+        # over the batch dictionary): dispatches run against the
+        # OPTIMISTICALLY advanced state while earlier windows' verdicts are
+        # still unconfirmed by the upper layer; a bounded reconcile ring
+        # (spec_depth in-flight windows, one device-state snapshot each)
+        # confirms or rolls back + repairs. Same per-engine override shape
+        # as resident/wave_commit; inert under FDB_TPU_PACKED=0.
+        self.spec = (
+            ck._SPEC_RESOLVE if spec_resolve is None else bool(spec_resolve)
+        ) and ck._PACKED
+        self.spec_depth = max(1, int(spec_depth))
+        self._spec_ring: deque[_SpecPending] = deque()
+        self._spec_seq = 0
+        self._spec_done: dict[int, tuple] = {}
+        self._spec_stats = {
+            "spec_dispatched": 0,  # windows dispatched speculatively
+            "spec_confirmed": 0,   # reconciled with zero rollback
+            "spec_repaired": 0,    # reconciled through rollback + repair
+            "spec_flipped": 0,     # younger-window verdicts changed by repair
+            "chain_rolls": 0,      # optimistic chain rolled to reconciled state
+        }
+        # Upper-layer confirmation hook: called at reconcile time as
+        # hook(seq, verdicts[k, count]) -> bool[k, count] confirmation mask
+        # (False = this txn's speculative outcome is revoked — tlog
+        # failure, ratekeeper revoke, chaos injection) or None = confirm
+        # all. Default None = every window confirms (the production fast
+        # path; revocation is the exception speculation bets against).
+        self.spec_confirm_hook: Callable | None = None
+        self._nat_win: bool | None = None  # lazy kp_pack_window gate
         self.dict_capacity = int(
             dict_capacity
             or int(os.environ.get("FDB_TPU_DICT_CAPACITY", "0"))
@@ -522,6 +576,12 @@ class TPUConflictSet:
             two = ("_hist" if hist else "") + fmt + "_jit"
             self._wave_edges_fn = getattr(ck, "_wave_edges" + two)
             self._wave_apply_fn = getattr(ck, "_wave_apply" + two)
+        if self.spec:
+            # Paint-only re-advance entry points for the reconcile path
+            # (no _wave variant: a forced accept mask has no levels to
+            # compute — wave engines paint with levels >= 0).
+            pfx = ("_hist" if hist else "") + fmt + "_jit"
+            self._paint_many_fn = getattr(ck, "_paint_many" + pfx)
 
     def _pack_dict(self, bt: ck.BatchTensors) -> ck.PackedBatch:
         """Dedup+sort ALL batch endpoint keys once per dispatch (host
@@ -861,6 +921,7 @@ class TPUConflictSet:
         conflicting read ranges per txn index, the same surface the oracle
         provides."""
         can_report = getattr(self, "_resolve_report_fn", None) is not None
+        self._spec_drain_serial()
         self._begin_resolve(commit_version, oldest_version)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
@@ -935,6 +996,7 @@ class TPUConflictSet:
             raise ValueError("malformed resolver wire batch")
         if count is None:
             count = counted
+        self._spec_drain_serial()
         self._begin_resolve(commit_version, oldest_version)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
@@ -1048,41 +1110,121 @@ class TPUConflictSet:
                 np.int32,
             )
 
-            batches = self._empty_batch(k)
-            offset = 0
-            for i in range(k):
-                offset = lib.kp_pack_batch(
-                    _u8(buf), buf.size, offset, count,
-                    self.batch_size, self.max_read_ranges,
-                    self.max_write_ranges,
-                    self.codec.n_words, self.base_version,
-                    _i32(batches.read_begin[i]), _i32(batches.read_end[i]),
-                    _u8(batches.read_mask[i]),
-                    _i32(batches.write_begin[i]), _i32(batches.write_end[i]),
-                    _u8(batches.write_mask[i]),
-                    _i32(batches.read_version[i]), _u8(batches.txn_mask[i]),
-                )
-                if offset < 0:
-                    raise ValueError("malformed resolver wire batch")
+            if self._native_window_pack:
+                # Fused C pass: wire walk + padding + the per-batch
+                # dictionary dedup/sort/rank emission that _pack_dict pays
+                # in numpy — the host half of the speculative pipeline,
+                # sized so packing N+2 never stalls the device on N+1.
+                dev_batch = self._pack_window_native(buf, k, count)
+            else:
+                batches = self._empty_batch(k)
+                offset = 0
+                for i in range(k):
+                    offset = lib.kp_pack_batch(
+                        _u8(buf), buf.size, offset, count,
+                        self.batch_size, self.max_read_ranges,
+                        self.max_write_ranges,
+                        self.codec.n_words, self.base_version,
+                        _i32(batches.read_begin[i]), _i32(batches.read_end[i]),
+                        _u8(batches.read_mask[i]),
+                        _i32(batches.write_begin[i]), _i32(batches.write_end[i]),
+                        _u8(batches.write_mask[i]),
+                        _i32(batches.read_version[i]), _u8(batches.txn_mask[i]),
+                    )
+                    if offset < 0:
+                        raise ValueError("malformed resolver wire batch")
+                # The deferred-repack packer variant: a resident-dictionary
+                # overflow on the packing thread becomes a _RepackPlan
+                # executed by dispatch_window (which may sync device
+                # state), not an inline repack here.
+                dev_batch = self._dev_batch_deferred(batches)
         except BaseException:
             self.base_version, self.oldest_version, self._last_commit = snap
             raise
-        # The deferred-repack packer variant: a resident-dictionary
-        # overflow on the packing thread becomes a _RepackPlan executed by
-        # dispatch_window (which may sync device state), not an inline
-        # repack here.
         return PreparedWindow(
-            batch=self._dev_batch_deferred(batches),
+            batch=dev_batch,
             cvs_rel=cvs_rel,
             olds_rel=olds_rel,
             count=count,
             rebase_delta=rebase_delta,
         )
 
+    @property
+    def _native_window_pack(self) -> bool:
+        """Use the fused native window packer (kp_pack_window)? Gated to
+        the speculative non-resident packed path — the arm whose pipeline
+        the fused pack exists to feed (the resident path already replaced
+        _pack_dict with the mirror; serial stays the honest A/B baseline).
+        FDB_TPU_NATIVE_WINDOW_PACK=0 forces the numpy packer for parity
+        tests; a stale prebuilt .so without the symbol degrades silently."""
+        if self._nat_win is None:
+            self._nat_win = (
+                self.spec
+                and not self.resident
+                and os.environ.get("FDB_TPU_NATIVE_WINDOW_PACK", "1") != "0"
+                and hasattr(_keypack_lib(), "kp_pack_window")
+            )
+        return self._nat_win
+
+    def _pack_window_native(self, buf: np.ndarray, k: int,
+                            count: int) -> ck.PackedBatch:
+        """One kp_pack_window call → the window's PackedBatch (rank layout
+        bit-identical to _pack_dict over kp_pack_batch output)."""
+        lib = _keypack_lib()
+        b, r, q = self.batch_size, self.max_read_ranges, self.max_write_ranges
+        w = self.codec.width
+        n = 2 * b * (r + q)
+        bt = self._empty_batch(k)
+        dict_keys = np.full((k, n + 1, w), INT32_MAX, np.int32)
+        rb_rank = np.empty((k, b, r), np.int32)
+        re_rank = np.empty((k, b, r), np.int32)
+        wb_rank = np.empty((k, b, q), np.int32)
+        we_rank = np.empty((k, b, q), np.int32)
+        off = lib.kp_pack_window(
+            _u8(buf), buf.size, 0, k, count, b, r, q,
+            self.codec.n_words, self.base_version,
+            _i32(bt.read_begin), _i32(bt.read_end), _u8(bt.read_mask),
+            _i32(bt.write_begin), _i32(bt.write_end), _u8(bt.write_mask),
+            _i32(bt.read_version), _u8(bt.txn_mask),
+            _i32(dict_keys), _i32(rb_rank), _i32(re_rank),
+            _i32(wb_rank), _i32(we_rank),
+        )
+        if off < 0:
+            raise ValueError("malformed resolver wire batch")
+        return ck.PackedBatch(
+            dict_keys=dict_keys,
+            read_begin=rb_rank,
+            read_end=re_rank,
+            read_mask=bt.read_mask,
+            write_begin=wb_rank,
+            write_end=we_rank,
+            write_mask=bt.write_mask,
+            read_version=bt.read_version,
+            txn_mask=bt.txn_mask,
+        )
+
     def dispatch_window(self, prepared: PreparedWindow) -> Callable[[], np.ndarray]:
         """Device half of the window path: thread state through the scan
         program. Must run on the dispatching thread, in the same order the
-        windows were packed."""
+        windows were packed.
+
+        Speculative engines route through the reconcile ring: the dispatch
+        happens immediately against the optimistically advanced state, and
+        the returned collector reconciles (in FIFO order) before
+        materializing verdicts — callers like the bench loop and
+        PipelinedWindowRunner see the same collector contract either way."""
+        if self.spec:
+            seq = self.spec_dispatch_window(prepared)
+
+            def collect_spec() -> np.ndarray:
+                while seq not in self._spec_done:
+                    self.reconcile_window()
+                verdicts, levels = self._spec_done.pop(seq)
+                if self.wave_commit:
+                    self.last_wave_window = levels
+                return verdicts
+
+            return collect_spec
         if prepared.rebase_delta:
             self.state = self._rebase_fn(
                 self.state, np.int32(min(prepared.rebase_delta, 2**31 - 1))
@@ -1108,6 +1250,271 @@ class TPUConflictSet:
             # serialize by commit version); publish int32 [k, count].
             self.last_wave_window = np.asarray(levels)[:, : prepared.count]
             return np.asarray(verdicts)[:, : prepared.count]
+
+        return collect
+
+    # -- speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE=1) ---------------
+    #
+    # The resolve programs above paint accepted writes in the SAME device
+    # program that decides them, so by the time window N's verdicts are
+    # materialized on the host — let alone confirmed durable by the upper
+    # layer (tlog push, ratekeeper) — the device state has already
+    # advanced optimistically. Serial mode serializes anyway: it waits
+    # for N's collector before dispatching N+1. Speculative mode
+    # dispatches N+1 immediately and keeps a bounded FIFO ring of
+    # unconfirmed windows; when N's confirmation lands (or the ring
+    # fills), reconcile either confirms (the overwhelmingly common case —
+    # drop N's snapshot, done) or rolls the state back to N's snapshot,
+    # re-paints N with only the confirmed accepts, and repairs every
+    # younger in-flight window against the corrected history. A
+    # dependency probe (reads of the younger window vs N's rejected
+    # writes, probed through the packed batch dictionary) distinguishes
+    # windows whose verdicts provably survived (paint-only re-advance)
+    # from windows that must re-resolve (the repair path — only
+    # genuinely-conflicted txns flip). Serializability is therefore
+    # preserved by construction; the A/B harness additionally replays
+    # both arms through a fresh serial engine and compares verdict bytes.
+
+    def spec_dispatch_window(self, prepared: PreparedWindow) -> int:
+        """Dispatch a packed window speculatively; returns its reconcile
+        sequence id. Must run on the dispatching thread, in pack order
+        (same contract as dispatch_window)."""
+        if not self.spec:
+            raise ValueError("speculative resolve is off for this engine "
+                             "(FDB_TPU_SPEC_RESOLVE=1 / spec_resolve=True)")
+        while len(self._spec_ring) >= self.spec_depth:
+            self.reconcile_window()
+        if prepared.rebase_delta:
+            # Pending snapshots are in pre-rebase version coordinates —
+            # a rebase under them would corrupt every rollback target.
+            # Rebases are ~once per 2^30 versions; draining first is free.
+            self.reconcile_all()
+            self.state = self._rebase_fn(
+                self.state, np.int32(min(prepared.rebase_delta, 2**31 - 1))
+            )
+        batch = prepared.batch
+        if isinstance(batch, _RepackPlan):
+            # A resident-dictionary repack rebuilds the rank space from
+            # exact device liveness — not a rollback-able operation, and
+            # the liveness sync must not see unconfirmed writes. Drain.
+            self.reconcile_all()
+            batch = self._repack_and_rank(batch)
+        snap = ck._snapshot_jit(self.state)
+        out = self._resolve_many_fn(
+            self.state, batch, prepared.cvs_rel, prepared.olds_rel
+        )
+        verdicts, levels, self.state = (
+            out if self.wave_commit else (out[0], None, out[1])
+        )
+        seq = self._spec_seq
+        self._spec_seq += 1
+        self._spec_ring.append(_SpecPending(
+            seq=seq, snapshot=snap, batch=batch,
+            cvs_rel=prepared.cvs_rel, olds_rel=prepared.olds_rel,
+            count=prepared.count, verdicts=verdicts, levels=levels,
+        ))
+        self._spec_stats["spec_dispatched"] += 1
+        return seq
+
+    def _spec_accept_mask(self, batch, verdicts, levels) -> np.ndarray:
+        """bool [k, B]: which txns this dispatch ACCEPTED (i.e. painted).
+        Wave engines: committed at some wave (levels >= 0 — padding is
+        excluded by construction). Plain engines: verdict COMMITTED ∧
+        txn_mask (padded slots get verdict 0 from assemble_verdicts and
+        MUST be masked out)."""
+        if levels is not None:
+            return np.asarray(levels) >= 0
+        txn_mask = (batch.ranks.txn_mask if isinstance(batch, ck.ResidentBatch)
+                    else batch.txn_mask)
+        return (np.asarray(verdicts) == 0) & np.asarray(txn_mask)
+
+    def reconcile_window(self, confirmed: np.ndarray | None = None) -> np.ndarray:
+        """Reconcile the OLDEST in-flight window against its upper-layer
+        confirmation; returns its verdicts int8 [k, count] (also stashed
+        for the window's dispatch collector).
+
+        ``confirmed`` is a bool [k, count] mask (False = the upper layer
+        revoked this txn's speculative outcome); None consults
+        ``spec_confirm_hook``, and a None hook confirms everything. The
+        window's own verdicts are returned UNCHANGED — an upper-layer
+        revocation is an upper-layer abort, not a resolver verdict; what
+        reconcile repairs is the HISTORY (revoked writes un-painted) and
+        every younger window that speculated on it."""
+        p = self._spec_ring.popleft()
+        verdicts_np = np.asarray(p.verdicts)[:, : p.count]
+        levels_np = (None if p.levels is None
+                     else np.asarray(p.levels)[:, : p.count])
+        spec_acc = self._spec_accept_mask(p.batch, p.verdicts, p.levels)
+        k, b = spec_acc.shape
+        if confirmed is None and self.spec_confirm_hook is not None:
+            confirmed = self.spec_confirm_hook(p.seq, verdicts_np)
+        if confirmed is None:
+            rejected = np.zeros((k, b), bool)
+        else:
+            conf = np.zeros((k, b), bool)
+            conf[:, : p.count] = np.asarray(confirmed, bool)[:, : p.count]
+            rejected = spec_acc & ~conf
+        if not rejected.any():
+            self._spec_stats["spec_confirmed"] += 1
+            self._spec_done[p.seq] = (verdicts_np, levels_np)
+            return verdicts_np  # snapshot drops here — state already right
+
+        # -- mis-speculation: rollback + repair --------------------------
+        self._spec_stats["spec_repaired"] += 1
+        self._spec_stats["chain_rolls"] += 1
+        # 1) Roll the live state back to before this window (pointer swap
+        #    to the snapshot; it becomes the live state and is donated by
+        #    the paint below, so no extra buffer lingers).
+        self.state = p.snapshot
+        # 2) Re-advance with ONLY the confirmed accepts: a paint-only pass
+        #    with a host-forced mask — the same merge/GC/paint pipeline,
+        #    minus the verdict decision the upper layer overrode.
+        self.state = self._paint_many_fn(
+            self.state, p.batch, spec_acc & ~rejected,
+            p.cvs_rel, p.olds_rel,
+        )
+        # 3) Repair every younger in-flight window against the corrected
+        #    history, in dispatch order. The dependency probe says which
+        #    ones provably kept their verdicts (reads never touched a
+        #    rejected write → paint-only re-advance) and which must
+        #    re-resolve (the repair path; only genuinely-conflicted txns
+        #    flip).
+        younger = list(self._spec_ring)
+        self._spec_ring.clear()
+        deps = self._spec_dep_windows(p.batch, rejected, younger)
+        for y, dep in zip(younger, deps):
+            snap = ck._snapshot_jit(self.state)
+            if dep:
+                out = self._resolve_many_fn(
+                    self.state, y.batch, y.cvs_rel, y.olds_rel
+                )
+                nv, nl, self.state = (
+                    out if self.wave_commit else (out[0], None, out[1])
+                )
+                old_acc = self._spec_accept_mask(y.batch, y.verdicts, y.levels)
+                new_acc = self._spec_accept_mask(y.batch, nv, nl)
+                self._spec_stats["spec_flipped"] += int(
+                    (old_acc != new_acc)[:, : y.count].sum()
+                )
+                y = y._replace(snapshot=snap, verdicts=nv, levels=nl)
+            else:
+                acc = self._spec_accept_mask(y.batch, y.verdicts, y.levels)
+                self.state = self._paint_many_fn(
+                    self.state, y.batch, acc, y.cvs_rel, y.olds_rel
+                )
+                y = y._replace(snapshot=snap)
+            self._spec_ring.append(y)
+        self._spec_done[p.seq] = (verdicts_np, levels_np)
+        return verdicts_np
+
+    def _spec_dep_windows(self, batch, rejected: np.ndarray,
+                          younger: list[_SpecPending]) -> list[bool]:
+        """Per younger window: did ANY of its reads overlap a write the
+        reconciling window's confirmation rejected? Rejected writes are
+        painted into a small scratch step function at +inf version, then
+        each younger window's batch dictionary probes it — a clean probe
+        proves the window's verdicts survived (its floor and intra-window
+        graph are unchanged, and no read saw a rejected boundary).
+        Resident engines skip the probe (batch ranks live in per-window
+        coordinate systems the scratch can't share) and repair
+        pessimistically — still exact, just never paint-only."""
+        if not younger:
+            return []
+        if self.resident:
+            return [True] * len(younger)
+        k, b = rejected.shape
+        cap = min(self.capacity, 2 * k * b * self.max_write_ranges + 2)
+        scratch = ck.init_state(cap, self.codec.width, self.codec.min_key)
+        scratch = ck._spec_mark_rejected_jit(scratch, batch, rejected)
+        return [
+            bool(np.asarray(ck._spec_dep_window_jit(scratch, y.batch)))
+            for y in younger
+        ]
+
+    def reconcile_all(self) -> None:
+        """Drain the in-flight ring (confirmations via spec_confirm_hook).
+        Serial entry points and non-rollback-able device ops (rebase,
+        resident repack) call this before touching state."""
+        while self._spec_ring:
+            self.reconcile_window()
+
+    def _spec_drain_serial(self) -> None:
+        """Guard for serial-path entry points on a speculative engine:
+        in-flight windows must confirm/repair before state is read or
+        advanced outside the ring."""
+        if self._spec_ring:
+            self.reconcile_all()
+
+    def spec_metrics(self) -> dict:
+        """Counters for the obs plane (resolver.get_metrics mirrors these;
+        ratekeeper clamps speculation depth on the repair rate)."""
+        out = dict(self._spec_stats)
+        out["spec_depth"] = len(self._spec_ring)
+        return out
+
+    def spec_resolve_async(self, txns, commit_version: int,
+                           oldest_version: int | None = None):
+        """Object-path speculative dispatch (the resolver role's seam):
+        one chunk lifted to a k=1 window through the same ring. Returns a
+        collector yielding list[Verdict], or None when this batch can't
+        speculate (oversized → chunking serializes anyway; a reporting txn
+        needs the report program) — the caller falls back to the serial
+        path after reconcile_all().
+
+        Admission-filter feeding is skipped under speculation (the filter
+        is advisory recency state; feeding optimistic accepts could
+        poison it on revocation)."""
+        if (not self.spec or len(txns) > self.batch_size
+                or any(t.report_conflicting_keys for t in txns)):
+            return None
+        while len(self._spec_ring) >= self.spec_depth:
+            self.reconcile_window()
+        delta = self._begin_resolve(commit_version, oldest_version,
+                                    defer_rebase=True)
+        if delta:
+            self.reconcile_all()
+            self.state = self._rebase_fn(
+                self.state, np.int32(min(delta, 2**31 - 1))
+            )
+        cv_rel = np.asarray([self._rel(commit_version)], np.int32)
+        old_rel = np.asarray([self._rel(self.oldest_version)], np.int32)
+        batch = self._pack(txns)
+        self._adm_stash = None
+        dev = self._dev_batch_deferred(batch)
+        if isinstance(dev, _RepackPlan):
+            self.reconcile_all()
+            dev = self._repack_and_rank(dev)
+        if isinstance(dev, ck.ResidentBatch):
+            # k=1 lift: the scan axis goes on the ranks; the key delta is
+            # per-window (merged once) exactly as the window packer emits.
+            dev = dev._replace(ranks=type(dev.ranks)(
+                *(np.asarray(f)[None] for f in dev.ranks)
+            ))
+        else:
+            dev = type(dev)(*(np.asarray(f)[None] for f in dev))
+        snap = ck._snapshot_jit(self.state)
+        out = self._resolve_many_fn(self.state, dev, cv_rel, old_rel)
+        verdicts, levels, self.state = (
+            out if self.wave_commit else (out[0], None, out[1])
+        )
+        seq = self._spec_seq
+        self._spec_seq += 1
+        self._spec_ring.append(_SpecPending(
+            seq=seq, snapshot=snap, batch=dev, cvs_rel=cv_rel,
+            olds_rel=old_rel, count=len(txns), verdicts=verdicts,
+            levels=levels,
+        ))
+        self._spec_stats["spec_dispatched"] += 1
+
+        def collect() -> list[Verdict]:
+            while seq not in self._spec_done:
+                self.reconcile_window()
+            v, lv = self._spec_done.pop(seq)
+            if self.wave_commit and lv is not None:
+                row = lv[0]
+                self.last_wave = [int(x) for x in row]
+                self.last_reordered = int((row > 0).sum())
+            return [Verdict(int(x)) for x in v[0]]
 
         return collect
 
@@ -1162,6 +1569,7 @@ class TPUConflictSet:
                 f"engine chunk ({self.batch_size}): one exchange carries "
                 "one schedule domain"
             )
+        self._spec_drain_serial()
         self._begin_resolve(commit_version, oldest_version)
         cv = np.int32(self._rel(commit_version))
         oldest = np.int32(self._rel(self.oldest_version))
@@ -1449,6 +1857,7 @@ class TPUConflictSet:
         Resolver's fail-safe mode drain and exit. The window-history
         engine forces a merge here (the lazy base would otherwise hold
         expired segments until the next organic merge)."""
+        self._spec_drain_serial()
         self._begin_resolve(commit_version, oldest_version)
         if self.admission_filter is not None:
             self.admission_filter.advance(commit_version)  # age the banks
@@ -1629,6 +2038,14 @@ def _keypack_lib():
         ]
         lib.kp_count_txns.restype = i64
         lib.kp_count_txns.argtypes = [u8p, i64, i64]
+        if hasattr(lib, "kp_pack_window"):  # absent only in a stale .so
+            lib.kp_pack_window.restype = i64
+            lib.kp_pack_window.argtypes = [
+                u8p, i64, i64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, i64,
+                i32p, i32p, u8p, i32p, i32p, u8p, i32p, u8p,
+                i32p, i32p, i32p, i32p, i32p,
+            ]
         _KP_LIB = lib
     return _KP_LIB
 

@@ -196,6 +196,42 @@ def dominant_read_stage(snaps: list[dict], t0: float, t1: float) -> "dict | None
     }
 
 
+def misspec_storm(snaps: list[dict], t0: float, t1: float,
+                  threshold: float = 0.5) -> "dict | None":
+    """Mis-speculation storm detector (FDB_TPU_SPEC_RESOLVE): what share
+    of the windows speculated inside [t0, t1] rolled back through the
+    repair path, from the resolvers' cumulative ``spec_dispatched`` /
+    ``spec_repaired`` counters in the ring snapshots. Returns None when
+    nothing speculated in the window (serial engine, or the ratekeeper's
+    depth clamp already shut speculation off) — an honesty signal, like
+    dominant_stage's. ``storm`` trips at ``threshold``, matching the
+    coalescer's MISSPEC_CLAMP: past it every other window re-resolves,
+    so speculation is adding snapshot+repair work, not hiding latency."""
+    if not snaps:
+        return None
+    a = _snap_at(snaps, t0, after=False)
+    b = _snap_at(snaps, t1, after=True)
+    if a is None or b is None or b["t"] <= a["t"]:
+        return None
+
+    def sums(snap: dict, leaf: str) -> float:
+        m = snap.get("metrics") or {}
+        return sum(float(v) for k, v in m.items()
+                   if k.startswith("resolver.") and k.endswith("." + leaf))
+
+    disp = sums(b, "spec_dispatched") - sums(a, "spec_dispatched")
+    rep = sums(b, "spec_repaired") - sums(a, "spec_repaired")
+    if disp <= 0:
+        return None
+    rate = max(0.0, rep) / disp
+    return {
+        "spec_dispatched": int(disp),
+        "spec_repaired": int(rep),
+        "misspec_rate": round(rate, 4),
+        "storm": bool(rate >= threshold),
+    }
+
+
 # -- annotations in a window ---------------------------------------------------
 
 
@@ -238,6 +274,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
         co_gaps = [g for g in gaps if t0 - slack_s <= g["t"] <= t1 + slack_s]
         stage = dominant_stage(snaps, t0, t1)
         read_stage = dominant_read_stage(snaps, t0, t1)
+        misspec = misspec_storm(snaps, t0, t1)
         verdict = {
             "window": [t0, t1],
             "sli": inc["sli"],
@@ -246,6 +283,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
             "windows": inc["windows"],
             "dominant_stage": stage,
             "dominant_read_stage": read_stage,
+            "misspec": misspec,
             "annotations": co,
             "annotation_classes": sorted(
                 {a.get("cls") for a in co}
@@ -262,6 +300,10 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
                 f"; read plane: {read_stage['stage']} "
                 f"({read_stage['share_before']:.0%}->"
                 f"{read_stage['share_during']:.0%})")
+        if misspec and misspec["storm"]:
+            stage_txt += (
+                f"; mis-speculation storm ({misspec['misspec_rate']:.0%} of "
+                f"{misspec['spec_dispatched']} speculated windows repaired)")
         co_txt = ("; co-occurring: "
                   + ", ".join(_ann_brief(a) for a in co[:6])
                   if co else "; no co-occurring annotations")

@@ -49,6 +49,16 @@ DOCUMENTED_COUNTERS = (
     "commit_proxy.wave_exchanges",
     "resolver.txns_rejected_fail_safe",
     "resolver.overflow_events",
+    # Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE): exported
+    # unconditionally (zeros on serial engines) so dashboards can alert
+    # on the mis-speculation rate (repaired/dispatched) without a flag
+    # check, and the ratekeeper's depth clamp is auditable from the
+    # scrape alone.
+    "resolver.spec_dispatched",
+    "resolver.spec_confirmed",
+    "resolver.spec_repaired",
+    "resolver.spec_depth",
+    "resolver.chain_rolls",
     "resolver.queue.depth",
     "tlog.queue_bytes",
     "tlog.queue_entries",

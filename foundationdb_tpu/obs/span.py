@@ -69,6 +69,14 @@ reconciliation identity):
                       comms cost the sharded schedule pays per window
     wave_level        resolver: global wave commit only — the phase-2
                       leveling + paint (interior of device_dispatch)
+    spec_resolve      resolver: speculative dispatch only
+                      (FDB_TPU_SPEC_RESOLVE) — window N+1's resolve
+                      dispatched against N's optimistic paint (interior
+                      of device_dispatch, the phase-A half)
+    reconcile         resolver: speculative dispatch only — collect +
+                      reconcile through the engine ring, including any
+                      rollback/repair re-resolves (interior of
+                      device_dispatch, the phase-B half)
     tlog_fsync        tlog: chain-ordered push -> durable ack
 """
 
@@ -103,6 +111,8 @@ SUB_STAGES = (
     "device_dispatch",
     "wave_exchange",
     "wave_level",
+    "spec_resolve",
+    "reconcile",
     "tlog_fsync",
 )
 

@@ -463,41 +463,186 @@ class Resolver:
             # real kernel's dispatch wall time.
             await self.loop.sleep(self.dispatch_cost_s * len(group))
         clock = stage_clock(self.loop) if sink is not None else None
+        if getattr(self.cs, "spec", False):
+            # Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE=1): the
+            # engine's reconcile ring lets window N+1's resolve dispatch
+            # against N's optimistic paint while N's verdicts are still
+            # unconfirmed — phase A below dispatches the whole group,
+            # phase B reconciles in version order.
+            self._dispatch_group_spec(group, sink, clock)
+            return
         for entry in group:
+            self._serial_entry(entry, sink, clock)
+
+    def _serial_entry(self, entry: _QueuedBatch, sink, clock) -> None:
+        """One batch through the synchronous engine path: resolve, price
+        the sub-stages, cache + deliver the reply. Shared by the serial
+        group loop and the speculative path's fallback (reporting batches,
+        fail-safe, oversize windows the ring cannot take)."""
+        t_eng = clock() if sink is not None else 0.0
+        if sink is not None and hasattr(self.cs, "last_host_pack_s"):
+            # Clear the stamp so a batch that never packs (fail-safe
+            # rejection, overflow) can't re-record the PREVIOUS
+            # batch's pack time — fail-safe engages exactly under
+            # overload, when the attribution is being read.
+            self.cs.last_host_pack_s = None
+        try:
+            reply = self._resolve_entry(entry)
+        except BaseException as e:  # noqa: BLE001 — fail the RPC waiter
+            self._fail_entry(entry, e)
+            return
+        if sink is not None:
+            n = max(1, len(entry.txns))
+            eng_s = (clock() - t_eng) + self.dispatch_cost_s
+            pack_s = getattr(self.cs, "last_host_pack_s", None)
+            if pack_s is not None:
+                # DISJOINT attribution: the engine bracket above
+                # includes the synchronous host pack — carve it out
+                # so host_pack + device_dispatch sums to the
+                # interior, never above it.
+                sink.stage_tick("host_pack", pack_s, n=n)
+                eng_s = max(0.0, eng_s - pack_s)
+            # Engine execution (synchronous: perf-clocked on real
+            # loops, 0 virtual seconds in sim by construction) plus
+            # the modeled dispatch cost this batch's share paid.
+            sink.stage_tick("device_dispatch", eng_s, n=n)
+        self._send_entry(entry, reply)
+
+    def _send_entry(self, entry: _QueuedBatch, reply) -> None:
+        self._replies[entry.version] = reply
+        self._trim_replies()
+        self._pending.pop(entry.version, None)
+        entry.reply.send(reply)
+
+    def _fail_entry(self, entry: _QueuedBatch, e: BaseException) -> None:
+        self._replies[entry.version] = e
+        self._trim_replies()
+        self._pending.pop(entry.version, None)
+        entry.reply.fail(e)
+
+    # -- speculative dispatch (FDB_TPU_SPEC_RESOLVE) --------------------------
+
+    def _dispatch_group_spec(self, group: list[_QueuedBatch], sink,
+                             clock) -> None:
+        """Two-phase group dispatch over a speculative engine.
+
+        Phase A walks the group in version order handing each batch to
+        ``cs.spec_resolve_async`` — the engine snapshots, resolves against
+        the optimistically painted state, and parks the window on its
+        reconcile ring without forcing the device. Phase B (``_drain_spec``)
+        collects in the same order; each collect reconciles the ring
+        through that window, so a window whose speculation depended on a
+        revoked write re-resolves through the engine's repair path before
+        its verdicts are ever visible here.
+
+        Batches the ring cannot take (fail-safe, reporting opt-ins,
+        oversize) drain the ring FIRST and then resolve serially, so reply
+        delivery order always equals version order and the serial path
+        never observes a half-reconciled state.
+
+        The capacity fail-safe changes shape under speculation. Phase A
+        checks the cached headroom from the LAST reconcile (reading the
+        device here would sync the pipeline away); the cache cannot be
+        conservatively pre-charged per in-flight window because the
+        engine's headroom is capped at its delta capacity (≈ one batch's
+        worst-case growth — the in-program merge recovers it every batch),
+        so stacking charges would veto all depth > 1. Correctness instead
+        rests on reconcile-time detection: verdicts only become visible at
+        drain, AFTER ``_post_resolve_check`` has read the device's sticky
+        overflow flag — a window that resolved against possibly-truncated
+        history is rejected wholesale there, and the unsafe window rejects
+        everything younger until the MVCC floor passes the overflow.
+        Spurious conflicts, never missed ones — the same guarantee as the
+        serial path, detected one phase later."""
+        pending: list[tuple[_QueuedBatch, object]] = []
+        for entry in group:
+            version, txns = entry.version, entry.txns
+            oldest = entry.oldest_version
+            if oldest is None:
+                oldest = max(0, version - MVCC_WINDOW_VERSIONS)
             t_eng = clock() if sink is not None else 0.0
             if sink is not None and hasattr(self.cs, "last_host_pack_s"):
-                # Clear the stamp so a batch that never packs (fail-safe
-                # rejection, overflow) can't re-record the PREVIOUS
-                # batch's pack time — fail-safe engages exactly under
-                # overload, when the attribution is being read.
                 self.cs.last_host_pack_s = None
-            try:
-                reply = self._resolve_entry(entry)
-            except BaseException as e:  # noqa: BLE001 — fail the RPC waiter
-                self._replies[entry.version] = e
-                self._trim_replies()
-                self._pending.pop(entry.version, None)
-                entry.reply.fail(e)
+            coll = None
+            if not self._should_fail_safe(len(txns), version, oldest):
+                try:
+                    coll = self.cs.spec_resolve_async(txns, version, oldest)
+                except BaseException as e:  # noqa: BLE001
+                    self._drain_spec(pending, sink, clock)
+                    self._fail_entry(entry, e)
+                    continue
+            if coll is None:
+                # Serial fallback. The engine drains its own ring before a
+                # serial resolve, but draining HERE delivers the pending
+                # replies first — reply order stays version order.
+                self._drain_spec(pending, sink, clock)
+                self._serial_entry(entry, sink, clock)
                 continue
             if sink is not None:
-                n = max(1, len(entry.txns))
+                n = max(1, len(txns))
                 eng_s = (clock() - t_eng) + self.dispatch_cost_s
                 pack_s = getattr(self.cs, "last_host_pack_s", None)
                 if pack_s is not None:
-                    # DISJOINT attribution: the engine bracket above
-                    # includes the synchronous host pack — carve it out
-                    # so host_pack + device_dispatch sums to the
-                    # interior, never above it.
                     sink.stage_tick("host_pack", pack_s, n=n)
                     eng_s = max(0.0, eng_s - pack_s)
-                # Engine execution (synchronous: perf-clocked on real
-                # loops, 0 virtual seconds in sim by construction) plus
-                # the modeled dispatch cost this batch's share paid.
+                # Interior of device_dispatch: the speculative dispatch
+                # half (reconcile is ticked at collect). Sub-stage
+                # sibling of wave_level — both price within the engine
+                # bracket without double-counting the stage itself.
+                sink.stage_tick("spec_resolve", eng_s, n=n, version=version)
                 sink.stage_tick("device_dispatch", eng_s, n=n)
-            self._replies[entry.version] = reply
-            self._trim_replies()
-            self._pending.pop(entry.version, None)
-            entry.reply.send(reply)
+            pending.append((entry, coll))
+        self._drain_spec(pending, sink, clock)
+
+    def _drain_spec(self, pending: list, sink, clock) -> None:
+        """Phase B: collect speculated windows in version order. Repairs
+        happen inside the engine's reconcile; this side prices the wait
+        (``reconcile`` sub-stage), applies the overflow fail-safe to
+        windows now known to have resolved against possibly-truncated
+        history, and feeds the per-window repair outcome to the
+        coalescer's mis-speculation EWMA (the ratekeeper-facing clamp)."""
+        while pending:
+            entry, coll = pending.pop(0)
+            version, txns = entry.version, entry.txns
+            oldest = entry.oldest_version
+            if oldest is None:
+                oldest = max(0, version - MVCC_WINDOW_VERSIONS)
+            rep0 = self._spec_repaired()
+            t0 = clock() if sink is not None else 0.0
+            try:
+                verdicts = coll()
+            except BaseException as e:  # noqa: BLE001
+                self._fail_entry(entry, e)
+                continue
+            fail_safe = False
+            wave = getattr(self.cs, "last_wave", None)
+            overflow = self._post_resolve_check(version)
+            if overflow or (self._unsafe_until is not None
+                            and oldest <= self._unsafe_until):
+                # True overflow surfaced while this (or an older in-ring)
+                # window was in flight: every window that resolved before
+                # the flag was observed may have missed conflicts against
+                # truncated history — reject wholesale, same contract as
+                # the chunked serial path.
+                verdicts = [Verdict.CONFLICT] * len(txns)
+                self.txns_rejected_fail_safe += len(txns)
+                fail_safe = True
+                wave = None
+            coal = getattr(self.sched, "coalescer", None)
+            if coal is not None and hasattr(coal, "note_misspec"):
+                coal.note_misspec(self._spec_repaired() > rep0)
+            reply = self._finish_entry(version, txns, verdicts, fail_safe,
+                                       wave)
+            if sink is not None:
+                n = max(1, len(txns))
+                rec_s = clock() - t0
+                sink.stage_tick("reconcile", rec_s, n=n, version=version)
+                sink.stage_tick("device_dispatch", rec_s, n=n)
+            self._send_entry(entry, reply)
+
+    def _spec_repaired(self) -> int:
+        fn = getattr(self.cs, "spec_metrics", None)
+        return int(fn()["spec_repaired"]) if fn is not None else 0
 
     def _trim_replies(self) -> None:
         if len(self._replies) > self.REPLY_CACHE_SIZE:
@@ -539,6 +684,18 @@ class Resolver:
                 # for a rejected batch would skew the attribution
                 # counters below and invite a caller to reorder it.
                 wave = None
+        return self._finish_entry(version, txns, verdicts, fail_safe, wave)
+
+    def _finish_entry(
+        self, version: int, txns: list, verdicts: list[Verdict],
+        fail_safe: bool, wave: "list[int] | None",
+    ) -> tuple[
+        list[Verdict], dict[int, list[tuple[bytes, bytes]]], bool,
+        "list[int] | None",
+    ]:
+        """Post-verdict bookkeeping shared by the serial and speculative
+        paths: conflicting-range reporting, hot-range and admission feeds,
+        wave attribution, throughput counters. Returns the reply tuple."""
         # Conflicting read ranges for txns that asked (reference: the
         # reply's conflictingKRIndices). Engines that track exact ranges
         # (oracle) report them; others degrade to the conservative
@@ -706,6 +863,19 @@ class Resolver:
             # protocol (resolve_edges/resolve_apply) — per-shard, so a
             # sharded deployment's status shows every shard exchanging.
             "wave_batches": self.wave_batches,
+            # Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE; all
+            # zero on serial engines): dispatched/confirmed/repaired
+            # window counts, verdicts flipped by repair re-resolves,
+            # version-chain rollbacks, and the CURRENT ring depth — the
+            # repaired/dispatched ratio is the mis-speculation rate the
+            # ratekeeper clamps speculation depth on (see
+            # AdaptiveCoalescer.effective_spec_depth).
+            "spec_dispatched": self._spec_stat("spec_dispatched"),
+            "spec_confirmed": self._spec_stat("spec_confirmed"),
+            "spec_repaired": self._spec_stat("spec_repaired"),
+            "spec_flipped": self._spec_stat("spec_flipped"),
+            "chain_rolls": self._spec_stat("chain_rolls"),
+            "spec_depth": self._spec_stat("spec_depth"),
             "history_headroom": self._headroom,
             "hot_ranges": self.hot_ranges.top(),
             "conflict_losses": self.hot_ranges.losses_recorded,
@@ -737,6 +907,14 @@ class Resolver:
                 "evictions": self._engine_dict_stat("evictions"),
             },
         }
+
+    def _spec_stat(self, key: str) -> int:
+        """An engine speculation counter (TPUConflictSet.spec_metrics),
+        0 for serial engines / speculation off."""
+        fn = getattr(self.cs, "spec_metrics", None)
+        if fn is None:
+            return 0
+        return int(fn().get(key, 0))
 
     def _engine_dict_stat(self, key: str) -> int:
         """A resident-dictionary stat counter (TPUConflictSet.dict_stats

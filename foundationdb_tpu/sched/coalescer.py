@@ -100,9 +100,13 @@ class AdaptiveCoalescer:
     SERVICE_FRAC = 0.5  # dispatch time may use this fraction of the budget
     ARRIVAL_DECAY = 0.85
 
+    MISSPEC_DECAY = 0.8      # EWMA over per-window repair observations
+    MISSPEC_CLAMP = 0.5      # repair rate above which speculation is OFF
+
     def __init__(self, budget_ms: float = 50.0, max_window: int = 32,
                  min_window: int = 1, service_frac: float = SERVICE_FRAC,
-                 cost: DispatchCostModel | None = None):
+                 cost: DispatchCostModel | None = None,
+                 spec_depth: int = 0):
         self.budget_ms = max(0.0, budget_ms)
         self.max_window = max(min_window, max_window)
         self.min_window = max(1, min_window)
@@ -111,6 +115,17 @@ class AdaptiveCoalescer:
         self._depths = quantized_depths(self.max_window)
         self._interarrival_ms: float | None = None
         self._last_arrival_ms: float | None = None
+        # Speculation-depth awareness (FDB_TPU_SPEC_RESOLVE): spec_depth
+        # in-flight windows overlap device execution with host pack +
+        # reconcile, so the effective amortized service rate improves — but
+        # every mis-speculated window pays its dispatch AGAIN through the
+        # repair path. The mis-speculation EWMA prices that: the effective
+        # pipeline depth degrades toward serial as the repair rate rises,
+        # and above MISSPEC_CLAMP the ratekeeper-facing answer is 0
+        # (speculation off — pathological contention means every window
+        # re-resolves and speculation only adds snapshot traffic).
+        self.spec_depth = max(0, int(spec_depth))
+        self._misspec_rate = 0.0
 
     # -- observations --------------------------------------------------------
 
@@ -127,6 +142,33 @@ class AdaptiveCoalescer:
     def observe_dispatch(self, depth: int, dt_ms: float) -> None:
         self.cost.observe(depth, dt_ms)
 
+    def note_misspec(self, repaired: bool | float) -> None:
+        """Fold one reconciled window into the mis-speculation EWMA
+        (True/1.0 = it rolled back through the repair path)."""
+        a = self.MISSPEC_DECAY
+        self._misspec_rate = a * self._misspec_rate + (1 - a) * float(repaired)
+
+    @property
+    def misspec_rate(self) -> float:
+        return self._misspec_rate
+
+    def effective_spec_depth(self) -> int:
+        """Speculation depth after the mis-speculation clamp: the
+        configured depth while repairs are rare, degrading to 1 as the
+        repair EWMA climbs, 0 (= serial) above MISSPEC_CLAMP. Ratekeeper
+        and the resolver read this to clamp the engine ring."""
+        if self.spec_depth <= 0:
+            return 0
+        if self._misspec_rate >= self.MISSPEC_CLAMP:
+            return 0
+        # Each repaired window re-dispatches once: a repair rate m inflates
+        # dispatch cost by ~(1+m), eroding the pipeline win linearly.
+        # Rounded, not truncated: the EWMA decays asymptotically, so
+        # truncation would pin a recovered pipeline one below its
+        # configured depth forever.
+        scaled = self.spec_depth * (1.0 - self._misspec_rate / self.MISSPEC_CLAMP)
+        return max(1, min(self.spec_depth, int(round(scaled))))
+
     # -- policy --------------------------------------------------------------
 
     def target_depth(self) -> int:
@@ -142,9 +184,14 @@ class AdaptiveCoalescer:
         if ia is not None and ia > 0:
             # Smallest depth whose amortized service rate keeps up with the
             # arrival rate; none ⇒ saturated ⇒ max depth (throughput mode).
+            # Under speculation each mis-speculated window re-dispatches
+            # through the repair path, inflating amortized cost by
+            # (1 + misspec_rate) — serial engines never observe repairs,
+            # so the factor is exactly 1 there.
+            infl = 1.0 + self._misspec_rate
             keep_d = self.max_window
             for d in self._depths:
-                if self.cost.predict(d) <= d * ia:
+                if self.cost.predict(d) * infl <= d * ia:
                     keep_d = d
                     break
         return min(self.max_window, max(lat_d, keep_d))

@@ -16,6 +16,18 @@ overlap dispatch(N)'s device execution but never another pack.
 ``threaded=False`` degrades to inline packing with identical results —
 that is the mode deterministic tests use, and the parity the threaded mode
 is tested against.
+
+Speculative pipelined resolve (FDB_TPU_SPEC_RESOLVE=1) composes here with
+no structural change: ``dispatch_window`` on a speculative engine routes
+through the engine's reconcile ring (dispatch N+1 runs against the
+optimistically advanced state while N's verdicts are unconfirmed; the
+collector reconciles in FIFO order), so the runner's three stages become a
+genuine three-deep pipeline — pack N+2 on the worker thread (the fused
+native kp_pack_window pass), speculatively resolve N+1 on the device,
+reconcile N at collect. The reconcile ring lives in the ENGINE, not the
+runner, because it must also guard the serial entry points (rebase,
+resident repack, object-path resolves) that never pass through a runner.
+``spec_metrics()`` exposes the engine's speculation counters per runner.
 """
 
 from __future__ import annotations
@@ -132,6 +144,16 @@ class PipelinedWindowRunner:
     def in_flight(self) -> int:
         """Windows dispatched to the device but not yet collected."""
         return len(self._pending)
+
+    def spec_metrics(self) -> dict:
+        """The engine's speculation counters (all-zero for serial engines),
+        for harnesses that report per-runner mis-speculation rates."""
+        fn = getattr(self._cs, "spec_metrics", None)
+        if fn is None:
+            return {"spec_dispatched": 0, "spec_confirmed": 0,
+                    "spec_repaired": 0, "spec_flipped": 0,
+                    "chain_rolls": 0, "spec_depth": 0}
+        return fn()
 
     def collect_next(self):
         """Force the oldest outstanding window's verdicts (device sync).

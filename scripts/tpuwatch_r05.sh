@@ -187,6 +187,17 @@ while true; do
     'r.get("metric") == "resident_ab_dictionary" and r.get("host_pack_ratio")' -- \
     env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=RESIDENT_AB_r05_rec.json \
     bash scripts/resident_ab.sh || { sleep 60; continue; }
+  # Speculative-pipelined-resolve A/B (FDB_TPU_SPEC_RESOLVE): serial vs
+  # speculative dispatch on the same seeds, Zipf-0.99 + uniform streams,
+  # byte-exact replay-checked serializability (verdicts_sha256 equal
+  # across arms) and the mis-speculation rate in every record — the
+  # done-check gates on the record being structurally complete rather
+  # than `valid`, which additionally demands the 1.3x ratio a single-core
+  # CPU-fallback host cannot honestly show.
+  stage ab_pipeline 2000 PIPELINE_AB_r05.json \
+    'r.get("metric") == "pipeline_ab_spec_resolve" and r.get("streams") and r.get("serializability_replay_ok")' -- \
+    env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=PIPELINE_AB_r05_rec.json \
+    bash scripts/pipeline_ab.sh || { sleep 60; continue; }
   # Wave-commit A/B (reorder-don't-abort): CPU-only deterministic sim —
   # FDB_TPU_WAVE_COMMIT=0 vs 1 on the same seeds, replay-checked oracle
   # serializability, goodput ratio strictly above the repair-only
