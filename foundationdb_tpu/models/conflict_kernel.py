@@ -2023,6 +2023,57 @@ def apply_delta(res: ResState, delta_keys: jax.Array) -> ResState:
     return jax.lax.cond(any_new, do, lambda r: r, res)
 
 
+def _dict_evict(dict_keys, n_keys, evict_ranks):
+    """Remove E sorted-unique resident ranks from the dictionary — the
+    exact inverse of _dict_insert (the tiered engine's DEMOTION delta).
+
+    evict_ranks: int32 [E] strictly increasing ranks, INT32_MAX padded.
+    Returns (new_dict_keys, new_n_keys, shift) where shift[r] <= 0 is the
+    rank-rebase table for SURVIVING ranks (r becomes r + shift[r]). The
+    host guarantees no evicted rank is referenced by device history or
+    shard bounds (exact-liveness selection), so the off-by-one a demoted
+    rank itself would take through the table is never observed. Same
+    scatter-free merge-path construction as _dict_insert: kept row j
+    reads source j + t where t = |{i : e_i - i <= j}| (e_i - i is
+    nondecreasing for strictly increasing e_i)."""
+    d1, _w = dict_keys.shape
+    e_cap = evict_ranks.shape[0]
+    real = evict_ranks != INT32_MAX
+    n_ev = jnp.sum(real.astype(jnp.int32))
+    i = jnp.arange(e_cap, dtype=jnp.int32)
+    adj = jnp.where(real, evict_ranks - i, INT32_MAX)
+    j = jnp.arange(d1, dtype=jnp.int32)
+    t = jnp.searchsorted(adj, j, side="right").astype(jnp.int32)
+    out = dict_keys[jnp.clip(j + t, 0, d1 - 1)]
+    new_n = n_keys - n_ev
+    out = jnp.where((j < new_n)[:, None], out, INT32_MAX)
+    # Surviving rank r has no evicted rank equal to it, so the <= count
+    # IS the strictly-below count — negate it for the shared shifters.
+    shift = -jnp.searchsorted(evict_ranks, j, side="right").astype(jnp.int32)
+    return out, new_n, shift
+
+
+def apply_evict(res: ResState, evict_ranks: jax.Array) -> ResState:
+    """Fold a demotion delta into the resident state: remove the evicted
+    ranks from the dictionary and rank-rebase the history + shard bounds
+    DOWN past the removed positions — the mirror image of apply_delta.
+    The empty-delta case (no victims survived selection) skips the
+    compaction via lax.cond, like apply_delta's steady state."""
+    any_ev = jnp.any(evict_ranks != INT32_MAX)
+
+    def do(res):
+        nd, nn, shift = _dict_evict(res.dict_keys, res.n_keys, evict_ranks)
+        return ResState(
+            dict_keys=nd,
+            n_keys=nn,
+            hist=_shift_hist(res.hist, shift),
+            shard_lo=_shift_rank_vec(res.shard_lo, shift),
+            shard_hi=_shift_rank_vec(res.shard_hi, shift),
+        )
+
+    return jax.lax.cond(any_ev, do, lambda r: r, res)
+
+
 def apply_dict_remap(res: ResState, new_dict, new_n, remap) -> ResState:
     """Full-repack tail: swap in the host-rebuilt dictionary and remap
     every device-held rank through ``remap`` (old rank -> new rank; exact
@@ -2322,6 +2373,15 @@ def _advance_hist_res_jit(res, commit_version, new_oldest):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _repack_res_jit(res, new_dict, new_n, remap):
     return apply_dict_remap(res, new_dict, new_n, remap)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _evict_res_jit(res, evict_ranks):
+    """Demotion delta for the tiered dictionary: drop cold ranks from the
+    hot tier and rebase ranks down. Elementwise over history rows like
+    _rebase_res_jit / _repack_res_jit, so the mesh engine runs it on the
+    per-device state under jit unchanged (dict replicated, hist sharded)."""
+    return apply_evict(res, evict_ranks)
 
 
 # ---------------------------------------------------------------------------

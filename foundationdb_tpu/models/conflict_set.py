@@ -158,6 +158,23 @@ class _RepackPlan(NamedTuple):
     cv: int
 
 
+class _DemotePlan(NamedTuple):
+    """A pack whose merged key count crossed the tiered engine's hot-tier
+    watermark, deferred to the dispatch thread exactly like _RepackPlan:
+    victim selection needs EXACT device liveness (a sync the packing
+    thread must not perform while windows are in flight). The mirror gate
+    is held until dispatch demotes and re-packs; unlike a repack, the
+    device traffic is a tiny int32 rank vector, not the whole dictionary."""
+
+    bt: object  # the raw BatchTensors (key space)
+    qu: np.ndarray  # [n, U] endpoint u64 keys, flat pack order
+    is_pad: np.ndarray  # [n] all-inf rows (masked slots / +inf ends)
+    new_u64: np.ndarray  # sorted-unique delta keys (misses + promotions)
+    new_rows: np.ndarray  # their int32 rows
+    dims: tuple  # (lead, b, r, q, w)
+    cv: int
+
+
 _HASH_C1 = np.uint64(0x9E3779B97F4A7C15)
 _HASH_C2 = np.uint64(0xFF51AFD7ED558CCD)
 
@@ -177,10 +194,18 @@ class _ResidentMirror:
     the rank space."""
 
     def __init__(self, rows: np.ndarray, capacity: int, delta_slots: int,
-                 frag_threshold: float):
+                 frag_threshold: float, tiered: bool = False):
         self.capacity = int(capacity)
         self.delta_slots = int(delta_slots)
         self.frag_threshold = float(frag_threshold)
+        # Tiered mode (FDB_TPU_DICT_HOT_CAPACITY): the ID space is
+        # promoted from "mirror" to authoritative COLD STORE. Ids of
+        # demoted keys keep their tab entries, u64 rows and last-used
+        # versions; only the sorted (rank-space) view shrinks. probe()
+        # therefore still finds cold keys — the pack path routes those
+        # hits through the normal never-seen-key delta (a PROMOTION).
+        self.tiered = bool(tiered)
+        self._n_ids = 0
         rows = np.asarray(rows, np.int32).copy()
         u64 = _rows_to_u64(rows)
         t = 16
@@ -195,6 +220,7 @@ class _ResidentMirror:
         # path); used_sorted() materializes the rank-space view on the
         # rare repack/reshard paths that need it.
         self.last_used_by_id = np.zeros(self.capacity + 1, np.int64)
+        self.hot_by_id = np.zeros(self.capacity + 1, bool)
         self.reset(u64, rows, np.zeros(len(rows), np.int64),
                    np.ones(len(rows), bool))
         self.lock = threading.RLock()
@@ -213,11 +239,23 @@ class _ResidentMirror:
             "evictions": 0,
             "full_repacks": 0,
             "repack_stalls": 0,
+            # Tiered-dictionary economics (zero when tiering is off):
+            "demotions": 0,        # keys moved hot -> cold via _dict_evict
+            "promotions": 0,       # cold keys re-entered through the delta
+            "demotion_stalls": 0,  # packs deferred behind a _DemotePlan
+            "demotion_bytes": 0,   # device bytes shipped by evict deltas
+            "demotion_events": 0,  # _demote_now calls that evicted > 0
         }
 
     @property
     def n(self) -> int:
         return len(self.u64)
+
+    @property
+    def cold_n(self) -> int:
+        """Keys resident only in the host cold tier (0 when untired —
+        every id is then in the sorted hot view)."""
+        return self._n_ids - self.n
 
     def _hash(self, u64: np.ndarray) -> np.ndarray:
         h = u64[:, 0] * _HASH_C1
@@ -228,9 +266,32 @@ class _ResidentMirror:
         )
 
     def reset(self, u64, rows, last_used, pinned) -> None:
-        """Rebuild every structure from a fresh sorted key set (repack and
-        reshard path; the delta path uses incremental insert_new)."""
+        """Rebuild the sorted view from a fresh sorted key set (repack and
+        reshard path; the delta path uses incremental insert_new).
+
+        Untired: the ID space rebuilds too (ids == sorted positions).
+        Tiered: the ID space is the cold store and SURVIVES — existing
+        keys keep their stable ids, keys leaving the hot view demote
+        instead of vanishing, genuinely new keys allocate fresh ids — so
+        a full repack or scoped reshard never forgets the cold tier."""
         n = len(u64)
+        if self.tiered and self._n_ids:
+            ids = self.probe(u64)
+            alloc = np.flatnonzero(ids < 0)
+            self._ensure_ids(self._n_ids + len(alloc))
+            fresh = self._n_ids + np.arange(len(alloc), dtype=np.int64)
+            ids[alloc] = fresh
+            self.u64_by_id[fresh] = u64[alloc]
+            self._n_ids += len(alloc)
+            self.u64, self.rows = u64, rows
+            self.pinned = pinned
+            self.hot_by_id[: self._n_ids] = False
+            self.hot_by_id[ids] = True
+            self.last_used_by_id[ids] = last_used
+            self.id_at = ids
+            self.rank_of_id[ids] = np.arange(n)
+            self._tab_insert(fresh)
+            return
         self.u64, self.rows = u64, rows
         self.pinned = pinned
         self._n_ids = n
@@ -238,14 +299,66 @@ class _ResidentMirror:
         self.last_used_by_id[:n] = last_used  # ids == sorted pos at reset
         self.id_at = np.arange(n, dtype=np.int64)  # sorted pos -> id
         self.rank_of_id[:n] = np.arange(n)
+        self.hot_by_id[:] = False
+        self.hot_by_id[:n] = True
         self.tab[:] = -1
         self._tab_insert(np.arange(n, dtype=np.int64))
+
+    def _ensure_ids(self, need: int) -> None:
+        """Grow the ID-space arrays (and rehash the probe table when its
+        <=1/4 load bound would break) so the cold tier scales with the key
+        UNIVERSE while the sorted hot view stays at hot capacity."""
+        cur = len(self.u64_by_id)
+        if need > cur:
+            new = cur
+            while new < need:
+                new <<= 1
+            grow = new - cur
+            self.u64_by_id = np.concatenate(
+                [self.u64_by_id,
+                 np.zeros((grow, self.u64_by_id.shape[1]), np.uint64)]
+            )
+            self.rank_of_id = np.concatenate(
+                [self.rank_of_id, np.zeros(grow, np.int64)]
+            )
+            self.last_used_by_id = np.concatenate(
+                [self.last_used_by_id, np.zeros(grow, np.int64)]
+            )
+            self.hot_by_id = np.concatenate(
+                [self.hot_by_id, np.zeros(grow, bool)]
+            )
+        if need * 4 > self._mask + 1:
+            t = int(self._mask + 1)
+            while need * 4 > t:
+                t <<= 1
+            self._mask = np.int64(t - 1)
+            self.tab = np.full(t, -1, np.int64)
+            self._tab_insert(np.arange(self._n_ids, dtype=np.int64))
+
+    def demote(self, ranks: np.ndarray) -> np.ndarray:
+        """Drop sorted-view rows at the given rank positions (the host
+        half of the _dict_evict delta). Their ids stay in the cold store —
+        tab entry, u64 row and last-used version intact — so a later
+        probe() still finds them and promotion re-enters them through the
+        normal delta with the SAME stable id. Returns the demoted ids."""
+        ids = self.id_at[ranks]
+        self.u64 = np.delete(self.u64, ranks, axis=0)
+        self.rows = np.delete(self.rows, ranks, axis=0)
+        self.pinned = np.delete(self.pinned, ranks)
+        self.id_at = np.delete(self.id_at, ranks)
+        self.rank_of_id[self.id_at] = np.arange(len(self.id_at))
+        self.hot_by_id[ids] = False
+        self.stats["demotions"] += len(ids)
+        return ids
 
     def probe(self, qu: np.ndarray, active: "np.ndarray | None" = None):
         """ids int64 [n] (-1 = absent) for each query key row."""
         n = len(qu)
         ids = np.full(n, -1, np.int64)
-        if n == 0 or self.n == 0:
+        # Guard on the ID space, not the sorted view: under tiering the
+        # hot view can be empty while cold ids remain probe-able (untired
+        # the two counts are always equal).
+        if n == 0 or self._n_ids == 0:
             return ids
         idxs = (np.flatnonzero(active) if active is not None
                 else np.arange(n, dtype=np.int64))
@@ -281,20 +394,43 @@ class _ResidentMirror:
         """Rank-space view of the last-used versions (repack/reshard)."""
         return self.last_used_by_id[self.id_at]
 
-    def insert_new(self, new_u64, new_rows, cv: int) -> np.ndarray:
-        """Incremental sorted insert of never-seen keys; returns their ids."""
+    def insert_new(self, new_u64, new_rows, cv: int,
+                   ids: "np.ndarray | None" = None) -> np.ndarray:
+        """Incremental sorted insert of delta keys; returns their ids.
+
+        ``ids`` (tiered promotion path): per-row existing cold id, or -1
+        for a genuinely new key. Cold keys re-enter the sorted view with
+        their stable id (tab/u64/last-used rows already present); only
+        the -1 rows allocate. Untired callers omit it — every delta key
+        is then never-seen and allocates append-only, exactly as before."""
         m = len(new_u64)
         ins = _u64_searchsorted(self.u64, new_u64, "left")
         self.u64 = np.insert(self.u64, ins, new_u64, axis=0)
         self.rows = np.insert(self.rows, ins, new_rows, axis=0)
         self.pinned = np.insert(self.pinned, ins, False)
-        new_ids = self._n_ids + np.arange(m, dtype=np.int64)
-        self.u64_by_id[new_ids] = new_u64
+        if ids is None:
+            new_ids = self._n_ids + np.arange(m, dtype=np.int64)
+            self.u64_by_id[new_ids] = new_u64
+            self.last_used_by_id[new_ids] = cv
+            self._n_ids += m
+            self.id_at = np.insert(self.id_at, ins, new_ids)
+            self.rank_of_id[self.id_at] = np.arange(len(self.id_at))
+            self.hot_by_id[new_ids] = True
+            self._tab_insert(new_ids)
+            return new_ids
+        alloc = np.flatnonzero(ids < 0)
+        self._ensure_ids(self._n_ids + len(alloc))
+        new_ids = np.asarray(ids, np.int64).copy()
+        fresh = self._n_ids + np.arange(len(alloc), dtype=np.int64)
+        new_ids[alloc] = fresh
+        self.u64_by_id[fresh] = new_u64[alloc]
         self.last_used_by_id[new_ids] = cv
-        self._n_ids += m
+        self._n_ids += len(alloc)
         self.id_at = np.insert(self.id_at, ins, new_ids)
         self.rank_of_id[self.id_at] = np.arange(len(self.id_at))
-        self._tab_insert(new_ids)
+        self.hot_by_id[new_ids] = True
+        self.stats["promotions"] += m - len(alloc)
+        self._tab_insert(fresh)
         return new_ids
 
     def _tab_insert(self, ids: np.ndarray) -> None:
@@ -321,7 +457,13 @@ class _ResidentMirror:
     def frag_due(self, floor_version: int) -> bool:
         """Opportunistic-repack trigger: the dictionary is mostly full AND
         mostly stale (keys unused since the MVCC floor) — reclaim early
-        instead of stalling the pipeline on a forced overflow repack."""
+        instead of stalling the pipeline on a forced overflow repack.
+        Tiered engines reclaim stale keys through DEMOTION deltas instead
+        (stale == the demotion victim set), so the trigger is off there:
+        a stale-but-device-live key can't be reclaimed by a repack either,
+        and firing on it would repack repeatedly for zero freed rows."""
+        if self.tiered:
+            return False
         if self.n <= self.capacity // 2:
             return False
         stale = int(
@@ -384,6 +526,8 @@ class TPUConflictSet:
         resident: bool | None = None,
         dict_capacity: int | None = None,
         dict_delta_slots: int | None = None,
+        dict_hot_capacity: int | None = None,
+        dict_demote_batch: int | None = None,
         spec_resolve: bool | None = None,
         spec_depth: int = 2,
     ):
@@ -441,6 +585,40 @@ class TPUConflictSet:
                                                + max_write_ranges)))
         )
         self._dict_frag = float(os.environ.get("FDB_TPU_DICT_FRAG", "0.75"))
+        # Two-tier dictionary (FDB_TPU_DICT_HOT_CAPACITY > 0, resident
+        # engines only): the device dictionary becomes the HOT tier at
+        # this capacity and the mirror's ID space the authoritative host
+        # COLD store. Crossing the hot watermark demotes rank-contiguous
+        # victim batches through _dict_evict (the inverse of the insert
+        # delta) instead of full-repacking, so capacity follows the hot
+        # set, not the key universe. 0/None = untired (bit-identical to
+        # the pre-tiering engine).
+        hot = int(
+            dict_hot_capacity
+            if dict_hot_capacity is not None
+            else int(os.environ.get("FDB_TPU_DICT_HOT_CAPACITY", "0") or 0)
+        )
+        self.tiered = bool(hot > 0) and self.resident
+        if self.tiered:
+            self.dict_capacity = hot
+            self.dict_delta_slots = min(
+                self.dict_delta_slots, max(1, hot // 2)
+            )
+            # Static evict-delta width (jit shape): one batch per
+            # _evict_res_jit call, looped when the victim set is larger.
+            self._demote_slots = int(
+                dict_demote_batch
+                or int(os.environ.get("FDB_TPU_DICT_DEMOTE_BATCH", "0") or 0)
+                or self.dict_delta_slots
+            )
+            # Demotion fires when the post-merge key count would leave
+            # less than one delta's headroom in the hot tier.
+            self._demote_watermark = max(
+                1, self.dict_capacity - self.dict_delta_slots
+            )
+        else:
+            self._demote_slots = 0
+            self._demote_watermark = 0
         # Wave-commit mode (reorder-don't-abort; conflict_kernel phase 2b):
         # None = the FDB_TPU_WAVE_COMMIT env default. Both modes' entry
         # points are distinct compiled programs, so engines of either mode
@@ -533,6 +711,7 @@ class TPUConflictSet:
             self._mirror = _ResidentMirror(
                 self.codec.min_key[None, :], self.dict_capacity,
                 self.dict_delta_slots, self._dict_frag,
+                tiered=self.tiered,
             )
             self.state = ck.init_res(
                 self._mirror.rows, self.dict_capacity, self.capacity,
@@ -544,6 +723,7 @@ class TPUConflictSet:
             )
             self._rebase_fn = ck._rebase_res_jit
             self._repack_fn = ck._repack_res_jit
+            self._evict_fn = ck._evict_res_jit
         else:
             self._dev_batch = self._pack_dict if ck._PACKED else (lambda bt: bt)
             self._dev_batch_deferred = self._dev_batch
@@ -723,7 +903,17 @@ class TPUConflictSet:
             is_pad &= qu[:, j] == pad[j]
         ids = mir.probe(qu, ~is_pad)
         found = ids >= 0
-        miss = ~found & ~is_pad
+        if self.tiered:
+            # Cold-tier hits (probe found a demoted id) re-enter through
+            # the SAME never-seen-key delta: a promotion is just a delta
+            # row whose id already exists. Only hot hits skip the delta.
+            hot_hit = np.zeros(len(ids), bool)
+            f = np.flatnonzero(found)
+            hot_hit[f] = mir.hot_by_id[ids[f]]
+            miss = ~hot_hit & ~is_pad
+        else:
+            hot_hit = found
+            miss = ~found & ~is_pad
         mi = np.flatnonzero(miss)
         if mi.size:
             new_u64, new_rows = _u64_unique_sorted(qu[mi], flat[mi])
@@ -734,7 +924,7 @@ class TPUConflictSet:
         cv = self._last_commit
         need_repack = (
             m > self.dict_delta_slots
-            or mir.n + m > mir.capacity
+            or (not self.tiered and mir.n + m > mir.capacity)
             or mir.frag_due(self.oldest_version)
         )
         if need_repack:
@@ -745,22 +935,46 @@ class TPUConflictSet:
             return self._repack_and_rank(
                 _RepackPlan(bt, qu, is_pad, new_u64, new_rows, dims, cv)
             )
+        if self.tiered and mir.n + m > self._demote_watermark:
+            if defer_repack:
+                # Same deferral contract as _RepackPlan: victim selection
+                # needs the exact-liveness device sync, so the packing
+                # thread hands the window to dispatch with the gate held.
+                mir.gate.clear()
+                mir.stats["demotion_stalls"] += 1
+                return _DemotePlan(bt, qu, is_pad, new_u64, new_rows, dims, cv)
+            self._demote_now(m, protect=(qu, is_pad))
+            if mir.n + m > mir.capacity:
+                # Demotion could not free enough room (victims all
+                # pinned, device-live or recent): the honest full-repack
+                # fallback — the thrash pathology obs/doctor flags.
+                return self._repack_and_rank(
+                    _RepackPlan(bt, qu, is_pad, new_u64, new_rows, dims, cv)
+                )
         with mir.lock:
-            mir.touch(ids[found], cv)
+            mir.touch(ids[hot_hit], cv)
             if m:
-                new_ids = mir.insert_new(new_u64, new_rows, cv)
-                # Every miss is in the new set: its index there is its id.
-                ids[mi] = new_ids[
-                    _u64_searchsorted(new_u64, qu[mi], "left")
-                ]
+                pos = _u64_searchsorted(new_u64, qu[mi], "left")
+                if self.tiered:
+                    # Every miss is in the new set: its index there maps
+                    # it to its existing cold id (promotion) or -1 (new).
+                    row_ids = np.full(m, -1, np.int64)
+                    row_ids[pos] = ids[mi]
+                    new_ids = mir.insert_new(new_u64, new_rows, cv,
+                                             ids=row_ids)
+                else:
+                    # Every miss is in the new set: its index there is its
+                    # id.
+                    new_ids = mir.insert_new(new_u64, new_rows, cv)
+                ids[mi] = new_ids[pos]
             # Post-merge rank = current sorted position of the id.
             ranks = mir.rank_of_id[np.maximum(ids, 0)].astype(np.int32)
             ranks[is_pad | (ids < 0)] = INT32_MAX
             st = mir.stats
             st["dispatches"] += 1
             st["endpoints"] += int((~is_pad).sum())
-            st["endpoint_hits"] += int(found.sum())
-            fid = ids[found]
+            st["endpoint_hits"] += int(hot_hit.sum())
+            fid = ids[hot_hit]
             uniq_found = (
                 int(np.bincount(fid, minlength=1).astype(bool).sum())
                 if fid.size else 0
@@ -876,6 +1090,85 @@ class TPUConflictSet:
             np.zeros((0, plan.dims[-1]), np.int32),
         )
 
+    def _demote_now(self, incoming: int, protect=None) -> int:
+        """Demote cold hot-tier keys to the host cold store (dispatch
+        thread only — selection needs the exact-liveness device sync).
+
+        Victim policy, in exclusion order: pinned min/bound keys never
+        move; ranks the device history still references (exact
+        _device_live_ranks) stay — evicting one would skew every younger
+        rank through the shift table; keys used inside the in-flight MVCC
+        window (last_used >= oldest_version) stay; the current dispatch's
+        keys (``protect`` = its probed u64 set) stay; and when an
+        admission filter is attached, keys its recency banks report
+        maybe-written since the floor stay. Survivors demote
+        oldest-last-used first, shipped as static-width _evict_res_jit
+        rank deltas (a few KiB) — never a full repack. Returns the count
+        actually demoted (0 = nothing safely evictable)."""
+        mir = self._mirror
+        with mir.lock:
+            used = mir.used_sorted()
+            cand = ~mir.pinned & (used < self.oldest_version)
+            cand[self._device_live_ranks()] = False
+            if protect is not None:
+                qu, is_pad = protect
+                pids = mir.probe(qu, ~is_pad)
+                pf = pids[pids >= 0]
+                hot = pf[mir.hot_by_id[pf]]
+                cand[mir.rank_of_id[hot]] = False
+            if self.admission_filter is not None:
+                idx = np.flatnonzero(cand)
+                if idx.size:
+                    from foundationdb_tpu.admission.filter import (
+                        u64_cols_fingerprint,
+                    )
+                    recent = np.asarray(
+                        self.admission_filter.probe_u64(
+                            u64_cols_fingerprint(mir.u64[idx]),
+                            self.oldest_version,
+                        )
+                    )
+                    cand[idx[recent]] = False
+            idx = np.flatnonzero(cand)
+            if not idx.size:
+                return 0
+            # Free past the watermark plus half a batch of hysteresis so
+            # the next few windows' deltas fit without demoting again.
+            over = mir.n + incoming - self._demote_watermark
+            want = min(idx.size,
+                       max(over, 0) + max(1, self._demote_slots // 2))
+            victims = idx[np.argsort(used[idx], kind="stable")[:want]]
+            order = np.sort(victims)
+            done = 0
+            while done < len(order):
+                # Chunks ascend, so every previously evicted rank sits
+                # below this chunk: the device-rank adjustment is exactly
+                # the count already gone.
+                chunk = order[done : done + self._demote_slots] - done
+                ev = np.full(self._demote_slots, INT32_MAX, np.int32)
+                ev[: len(chunk)] = chunk.astype(np.int32)
+                self.state = self._evict_fn(self.state, ev)
+                mir.stats["demotion_bytes"] += 4 * self._demote_slots
+                done += len(chunk)
+            mir.demote(order)
+            mir.stats["demotion_events"] += 1
+            return len(order)
+
+    def _demote_and_rank(self, plan: _DemotePlan) -> ck.ResidentBatch:
+        """Execute a deferred demotion on the dispatch thread (every
+        earlier window has dispatched, so liveness is exact — the same
+        ordering argument as the deferred _RepackPlan), reopen the gate,
+        then re-pack the stalled window inline: the inline path
+        re-derives hits/promotions against the post-demotion mirror and
+        itself escalates to a full repack if demotion could not free
+        enough room."""
+        try:
+            self._demote_now(len(plan.new_u64),
+                             protect=(plan.qu, plan.is_pad))
+        finally:
+            self._mirror.gate.set()
+        return self._pack_resident(plan.bt)
+
     @property
     def dict_stats(self) -> dict | None:
         """Dictionary-economics counters (None unless resident): unique
@@ -891,6 +1184,19 @@ class TPUConflictSet:
             delta_slots=self.dict_delta_slots,
             unique_keys_per_dispatch=round(s["unique_keys"] / d, 1),
             delta_hit_rate=round(s["endpoint_hits"] / e, 4),
+            # Tier economics (inert zeros when tiering is off):
+            tiered=self.tiered,
+            dict_hot_occupancy=round(
+                self._mirror.n / max(1, self._mirror.capacity), 4
+            ),
+            cold_tier_keys=self._mirror.cold_n,
+            demotion_bytes_per_dispatch=round(s["demotion_bytes"] / d, 1),
+            # What ONE full repack ships host->device (the packed dict
+            # rows + the rank-shift table) — the per-event counterfactual
+            # the demotion delta replaces. The A/B multiplies this by
+            # demotion_events to price the no-evict design.
+            full_repack_ship_bytes=(self._mirror.capacity + 1) * 4
+            * (self._mirror.rows.shape[1] + 1),
         )
         return s
 
@@ -1236,6 +1542,10 @@ class TPUConflictSet:
             # and the rank remap lands between window N-1 and N — the same
             # position it holds in the mirror's history.
             batch = self._repack_and_rank(batch)
+        elif isinstance(batch, _DemotePlan):
+            # Deferred tiered demotion: same exactness argument, but the
+            # device traffic is an evict rank vector, not a dictionary.
+            batch = self._demote_and_rank(batch)
         out = self._resolve_many_fn(
             self.state, batch, prepared.cvs_rel, prepared.olds_rel
         )
@@ -1299,6 +1609,12 @@ class TPUConflictSet:
             # the liveness sync must not see unconfirmed writes. Drain.
             self.reconcile_all()
             batch = self._repack_and_rank(batch)
+        elif isinstance(batch, _DemotePlan):
+            # Demotion shares the repack's constraints: the liveness sync
+            # must not see unconfirmed speculative paints, and evicting a
+            # rank is not rollback-able (snapshots hold pre-evict ranks).
+            self.reconcile_all()
+            batch = self._demote_and_rank(batch)
         snap = ck._snapshot_jit(self.state)
         out = self._resolve_many_fn(
             self.state, batch, prepared.cvs_rel, prepared.olds_rel
@@ -1484,6 +1800,9 @@ class TPUConflictSet:
         if isinstance(dev, _RepackPlan):
             self.reconcile_all()
             dev = self._repack_and_rank(dev)
+        elif isinstance(dev, _DemotePlan):
+            self.reconcile_all()
+            dev = self._demote_and_rank(dev)
         if isinstance(dev, ck.ResidentBatch):
             # k=1 lift: the scan axis goes on the ranks; the key delta is
             # per-window (merged once) exactly as the window packer emits.

@@ -232,6 +232,48 @@ def misspec_storm(snaps: list[dict], t0: float, t1: float,
     }
 
 
+def dict_thrash(snaps: list[dict], t0: float, t1: float,
+                threshold: float = 0.5,
+                min_events: int = 64) -> "dict | None":
+    """Tiered-dictionary thrash detector (FDB_TPU_DICT_HOT_CAPACITY):
+    inside [t0, t1], did promotions keep pace with demotions? A hot set
+    that FITS the HBM tier demotes cold keys that stay cold (promotion
+    rate ~ 0); promotion rate ≈ demotion rate means the engine keeps
+    re-promoting what it just demoted — the hot working set exceeds the
+    hot tier, and every round trip ships delta rows for keys that should
+    have stayed resident. From the resolvers' cumulative
+    ``engine.demotions`` / ``engine.promotions`` counters in the ring
+    snapshots. Returns None when nothing demoted in the window (tiering
+    off, or the tier is simply big enough) — the honesty signal, like
+    misspec_storm's. ``thrash`` trips when both flows are material
+    (>= min_events demotions) and the smaller flow is at least
+    ``threshold`` of the larger."""
+    if not snaps:
+        return None
+    a = _snap_at(snaps, t0, after=False)
+    b = _snap_at(snaps, t1, after=True)
+    if a is None or b is None or b["t"] <= a["t"]:
+        return None
+
+    def sums(snap: dict, leaf: str) -> float:
+        m = snap.get("metrics") or {}
+        return sum(float(v) for k, v in m.items()
+                   if k.startswith("resolver.") and k.endswith("." + leaf))
+
+    dem = sums(b, "demotions") - sums(a, "demotions")
+    pro = sums(b, "promotions") - sums(a, "promotions")
+    if dem <= 0:
+        return None
+    rate = max(0.0, pro) / dem
+    return {
+        "demotions": int(dem),
+        "promotions": int(pro),
+        "promotion_rate": round(rate, 4),
+        "thrash": bool(dem >= min_events and min(dem, max(pro, 0.0))
+                       >= threshold * max(dem, pro)),
+    }
+
+
 # -- annotations in a window ---------------------------------------------------
 
 
@@ -275,6 +317,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
         stage = dominant_stage(snaps, t0, t1)
         read_stage = dominant_read_stage(snaps, t0, t1)
         misspec = misspec_storm(snaps, t0, t1)
+        thrash = dict_thrash(snaps, t0, t1)
         verdict = {
             "window": [t0, t1],
             "sli": inc["sli"],
@@ -284,6 +327,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
             "dominant_stage": stage,
             "dominant_read_stage": read_stage,
             "misspec": misspec,
+            "dict_thrash": thrash,
             "annotations": co,
             "annotation_classes": sorted(
                 {a.get("cls") for a in co}
@@ -304,6 +348,11 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
             stage_txt += (
                 f"; mis-speculation storm ({misspec['misspec_rate']:.0%} of "
                 f"{misspec['spec_dispatched']} speculated windows repaired)")
+        if thrash and thrash["thrash"]:
+            stage_txt += (
+                f"; dictionary thrash ({thrash['promotions']} promotions vs "
+                f"{thrash['demotions']} demotions — hot set exceeds the "
+                f"HBM tier)")
         co_txt = ("; co-occurring: "
                   + ", ".join(_ann_brief(a) for a in co[:6])
                   if co else "; no co-occurring annotations")

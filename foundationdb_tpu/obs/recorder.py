@@ -314,6 +314,19 @@ class FlightRecorder:
             self.annotate("EngineRepack", "reshard", t=t,
                           severity="warn", repacks=int(rp),
                           evictions=int(delta("resolver.engine.evictions")))
+        # Tiered-dictionary demotion traffic (FDB_TPU_DICT_HOT_CAPACITY;
+        # the counter is always exported, so the delta is honestly zero
+        # when tiering is off). Demotions are the tier working as
+        # designed — info severity; sustained promotion≈demotion churn is
+        # the doctor's dict_thrash verdict, not a per-scrape annotation.
+        dm = delta("resolver.engine.demotions")
+        if dm > 0:
+            self.annotate("EngineDemotion", "reshard", t=t,
+                          demotions=int(dm),
+                          promotions=int(
+                              delta("resolver.engine.promotions")),
+                          cold_tier_keys=int(agg.get(
+                              "resolver.engine.cold_tier_keys", 0)))
 
     # -- snapshots -------------------------------------------------------------
 

@@ -60,6 +60,15 @@ DOCUMENTED_COUNTERS = (
     "resolver.spec_depth",
     "resolver.chain_rolls",
     "resolver.queue.depth",
+    # Tiered-dictionary economics (FDB_TPU_DICT_HOT_CAPACITY): exported
+    # unconditionally by Resolver.get_metrics (zeros when tiering is off
+    # or the engine is not resident) so the doctor's dict_thrash detector
+    # and dashboards read one stable namespace.
+    "resolver.engine.demotions",
+    "resolver.engine.promotions",
+    "resolver.engine.cold_tier_keys",
+    "resolver.engine.dict_hot_occupancy",
+    "resolver.engine.demotion_bytes_per_dispatch",
     "tlog.queue_bytes",
     "tlog.queue_entries",
     "storage.version_lag",

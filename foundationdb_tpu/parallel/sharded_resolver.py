@@ -593,7 +593,7 @@ class ShardedConflictSet(TPUConflictSet):
         # self._lo rows are sorted unique (row 0 = packed b"").
         self._mirror = _ResidentMirror(
             self._lo, self.dict_capacity, self.dict_delta_slots,
-            self._dict_frag,
+            self._dict_frag, tiered=self.tiered,
         )
         self._dev_batch = lambda bt: self._pack_resident(bt)
         self._dev_batch_deferred = lambda bt: self._pack_resident(
@@ -657,10 +657,14 @@ class ShardedConflictSet(TPUConflictSet):
         self._resolve_many_fn = (
             self._strip_exchange(resolve_many) if wave else resolve_many
         )
-        # Rebase/repack touch versions/ranks elementwise — the plain
-        # resident entry points shard transparently under jit.
+        # Rebase/repack/evict touch versions/ranks elementwise — the plain
+        # resident entry points shard transparently under jit (the evict
+        # shift table derives from the replicated dictionary, so every
+        # device applies the identical demotion delta and the rank space
+        # stays coherent across shards by construction).
         self._rebase_fn = ck._rebase_res_jit
         self._repack_fn = ck._repack_res_jit
+        self._evict_fn = ck._evict_res_jit
         self._resolve_report_fn = None
 
     def shard_occupancy(self) -> list[int]:

@@ -905,6 +905,18 @@ class Resolver:
                     self.cs, "reshard_moved_shards", 0),
                 "full_repacks": self._engine_dict_stat("full_repacks"),
                 "evictions": self._engine_dict_stat("evictions"),
+                # Tiered-dictionary economics (all zero when tiering is
+                # off — FDB_TPU_DICT_HOT_CAPACITY unset — or the engine
+                # is not resident): obs/doctor's dict_thrash detector
+                # reads the promotion/demotion pair; the recorder
+                # annotates their deltas like reshard/repack deltas.
+                "demotions": self._engine_dict_stat("demotions"),
+                "promotions": self._engine_dict_stat("promotions"),
+                "cold_tier_keys": self._engine_dict_stat("cold_tier_keys"),
+                "dict_hot_occupancy": self._engine_dict_fstat(
+                    "dict_hot_occupancy"),
+                "demotion_bytes_per_dispatch": self._engine_dict_fstat(
+                    "demotion_bytes_per_dispatch"),
             },
         }
 
@@ -924,3 +936,12 @@ class Resolver:
         except Exception:
             return 0
         return int(stats.get(key, 0) or 0)
+
+    def _engine_dict_fstat(self, key: str) -> float:
+        """Float-valued dict_stats gauge (occupancy/bytes-per-dispatch),
+        0.0 for engines without one / non-resident mode."""
+        try:
+            stats = getattr(self.cs, "dict_stats", None) or {}
+        except Exception:
+            return 0.0
+        return float(stats.get(key, 0) or 0)

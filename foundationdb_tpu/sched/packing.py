@@ -80,7 +80,8 @@ class PipelinedWindowRunner:
     def _put_draining(self, item) -> None:
         """Blocking put on the bounded request queue that can never
         deadlock with a deferred resident repack: if the pack worker is
-        parked on the mirror gate (a _RepackPlan awaiting dispatch), the
+        parked on the mirror gate (a _RepackPlan or tiered-dictionary
+        _DemotePlan awaiting dispatch), the
         queue stops draining — so while the put is full-blocked, keep
         dispatching ready windows from THIS (the dispatch) thread, which
         executes the plan, reopens the gate, and unblocks the worker."""
@@ -100,8 +101,9 @@ class PipelinedWindowRunner:
         if self._threaded:
             self._put_draining((wire, list(commit_versions), count))
         else:
-            # A deferred resident-dictionary repack (conflict_set
-            # _RepackPlan) parks the mirror gate until its window
+            # A deferred resident-dictionary repack or tiered demotion
+            # (conflict_set _RepackPlan / _DemotePlan) parks the mirror
+            # gate until its window
             # DISPATCHES; packing inline on this same thread would
             # deadlock on the gate, so drain the ready windows first —
             # dispatching them is exactly what the threaded mode's main

@@ -187,6 +187,19 @@ while true; do
     'r.get("metric") == "resident_ab_dictionary" and r.get("host_pack_ratio")' -- \
     env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=RESIDENT_AB_r05_rec.json \
     bash scripts/resident_ab.sh || { sleep 60; continue; }
+  # Tiered-dictionary A/B (two-tier HBM/host dictionary, rank-stable
+  # spill): tiered vs single-tier at the SAME hot capacity on a keyspace
+  # 100x the hot tier — Zipf-0.99 + shifting-hotspot streams, zero
+  # hot-path full repacks, byte-identical verdicts across arms, and the
+  # demotion-delta vs full-repack-counterfactual bytes ratio. The
+  # done-check gates on structural completeness (metric + per-stream
+  # parity/zero-repack gates present) rather than `valid`, which also
+  # demands all-arm wall-clock validity a CPU-fallback host cannot
+  # honestly show (PIPELINE_AB/OPENLOOP_AB precedent).
+  stage ab_tiered 2400 TIERED_AB_r05.json \
+    'r.get("metric") == "tiered_ab_dictionary" and len(r.get("streams") or []) == 2 and all(s.get("gates") for s in r["streams"]) and r.get("gates_pass")' -- \
+    env FDB_TPU_ALLOW_CPU=0 TXNS=262144 OUT=TIERED_AB_r05_rec.json \
+    bash scripts/tiered_ab.sh || { sleep 60; continue; }
   # Speculative-pipelined-resolve A/B (FDB_TPU_SPEC_RESOLVE): serial vs
   # speculative dispatch on the same seeds, Zipf-0.99 + uniform streams,
   # byte-exact replay-checked serializability (verdicts_sha256 equal

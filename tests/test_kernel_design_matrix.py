@@ -337,6 +337,126 @@ def test_mesh_wave_design_rows(flags):
     assert r.stdout.strip().splitlines()[-1] == "MESH-MATRIX-OK"
 
 
+_TIERED_CHILD = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except (ImportError, AttributeError):
+    pass
+from foundationdb_tpu.utils import enable_compilation_cache
+enable_compilation_cache()
+import numpy as np
+from foundationdb_tpu.core.types import KeyRange, TxnConflictInfo
+from foundationdb_tpu.models import conflict_kernel as ck
+from foundationdb_tpu.models.conflict_set import (
+    TPUConflictSet, encode_resolve_batch,
+)
+from foundationdb_tpu.sim.oracle import OracleConflictSet
+
+wave = os.environ.get("FDB_TPU_WAVE_COMMIT", "0") == "1"
+spec = os.environ.get("FDB_TPU_SPEC_RESOLVE", "0") == "1"
+assert ck._WAVE_COMMIT == wave and ck._SPEC_RESOLVE == (spec and ck._PACKED)
+
+KW = dict(capacity=512, batch_size=16, max_read_ranges=4,
+          max_write_ranges=4, max_key_bytes=8, window_versions=100)
+TIER = dict(dict_hot_capacity=384, dict_delta_slots=128)
+rng = np.random.default_rng(17)
+
+
+def txn(center, rv):
+    ks = [b"k%05d" % (center + int(rng.integers(0, 40))) for _ in range(3)]
+    return TxnConflictInfo(
+        read_version=rv,
+        read_ranges=[KeyRange(k, k + b"\x00") for k in ks[:2]],
+        write_ranges=[KeyRange(ks[2], ks[2] + b"\x00")],
+    )
+
+
+if spec:
+    # Wire-window speculative path: tiered+spec vs untiered serial. The
+    # _DemotePlan handler must reconcile the ring BEFORE evicting (spec
+    # snapshots hold pre-evict ranks).
+    cs_t = TPUConflictSet(spec_resolve=True, spec_depth=2, **TIER, **KW)
+    cs_u = TPUConflictSet(**KW)
+    cv, bidx = 0, 0
+    for _ in range(20):
+        wire, cvs = b"", []
+        for _ in range(2):
+            cv += 10
+            center = 0 if bidx >= 30 else (bidx // 5) * 150
+            wire += encode_resolve_batch(
+                [txn(center, max(0, cv - 60)) for _ in range(16)])
+            cvs.append(cv)
+            bidx += 1
+        got = np.asarray(cs_t.resolve_wire_window_async(wire, cvs, 16)())
+        want = np.asarray(cs_u.resolve_wire_window_async(wire, cvs, 16)())
+        assert np.array_equal(got, want)
+else:
+    cs_t = TPUConflictSet(**TIER, **KW)
+    cs_u = TPUConflictSet(**KW)
+    oracle = OracleConflictSet(wave_commit=wave)
+    cv = 1000
+    for step in range(55):
+        cv += 10
+        center = 0 if step >= 40 else (step // 5) * 150
+        txns = [txn(center, max(0, cv - 60)) for _ in range(12)]
+        oldest = cv - 100
+        got = cs_t.resolve(txns, cv, oldest_version=oldest)
+        want_u = cs_u.resolve(txns, cv, oldest_version=oldest)
+        oracle.oldest_version = max(oracle.oldest_version, oldest)
+        want = oracle.resolve(txns, cv)
+        assert got == want_u == want, f"step {step}"
+        if wave:
+            assert cs_t.last_wave == cs_u.last_wave == oracle.last_wave, (
+                f"step {step} wave levels"
+            )
+st = cs_t.dict_stats
+assert st["tiered"] and st["demotions"] > 0, st
+assert st["full_repacks"] == 0, st
+assert not cs_t.overflowed
+print("TIERED-MATRIX-OK")
+"""
+
+
+# ISSUE-18 rows: the tiered dictionary (a per-engine knob, not an
+# import-once kernel flag) crossed with the import-once designs it must
+# stay invisible to — wave commit's level schedule and speculative
+# resolve's snapshot/repair ring. Each child runs the shifting-hotspot
+# regime and asserts parity PLUS the tier economics (demotions > 0,
+# zero hot-path full repacks).
+# Subprocess rows are ~12s each (fresh JAX import + compile), so they
+# ride the slow tier like the other heavy matrix variants; tier-1 keeps
+# the in-process tiered gates (tests/test_tiered_dict.py).
+_TIERED_ROWS = [
+    pytest.param({"FDB_TPU_WAVE_COMMIT": "1"}, marks=pytest.mark.slow),
+    pytest.param({"FDB_TPU_SPEC_RESOLVE": "1"}, marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize(
+    "flags", _TIERED_ROWS,
+    ids=lambda f: "TIERED," + ",".join(
+        f"{k.replace('FDB_TPU_', '')}={v}" for k, v in f.items()),
+)
+def test_tiered_design_rows(flags):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ["FDB_TPU_WAVE_COMMIT", "FDB_TPU_SPEC_RESOLVE",
+              "FDB_TPU_RESIDENT", "FDB_TPU_PACKED",
+              "FDB_TPU_DICT_HOT_CAPACITY"]:
+        env.pop(k, None)
+    env.update(flags)
+    r = subprocess.run(
+        [sys.executable, "-c", _TIERED_CHILD], env=env, capture_output=True,
+        text=True, timeout=600, cwd=_REPO,
+    )
+    assert r.returncode == 0, f"{flags}: {r.stderr[-2000:]}"
+    assert r.stdout.strip().splitlines()[-1] == "TIERED-MATRIX-OK"
+
+
 _FLAGS = {
     "FDB_TPU_RMQ": ("sparse", "blocked"),
     "FDB_TPU_HISTORY": ("window", "batch"),
