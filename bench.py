@@ -1799,6 +1799,14 @@ def main() -> None:
                     help="required sustainable-tps ratio across counts")
     ap.add_argument("--ol-no-overload", action="store_true",
                     help="skip the ratekeeper overload/recovery run")
+    ap.add_argument("--autoscale-ab", action="store_true",
+                    help="run the elastic-autoscale A/B (autoscale/): "
+                         "closed-loop recruit/retire vs frozen fleet on "
+                         "the same seeded flash-crowd schedule plus the "
+                         "oscillation hysteresis gate, and print the "
+                         "AUTOSCALE_AB record (CPU sim twin; no TPU)")
+    ap.add_argument("--autoscale-fast", action="store_true",
+                    help="CI-sized autoscale A/B schedules")
     ap.add_argument("--admission-ab", action="store_true",
                     help="run the admission-subsystem A/B goodput harness "
                          "(FDB_TPU_ADMISSION off vs on, same seeds, "
@@ -1827,6 +1835,18 @@ def main() -> None:
                          "edge bitsets OR-reduced at the commit proxy — "
                          "scripts/wave_mesh_ab.sh sweeps {1,2,4})")
     args = ap.parse_args()
+    if args.autoscale_ab:
+        # Deterministic sim twin: CPU by design (control-plane A/B, no
+        # device work anywhere in the measured path).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from foundationdb_tpu.autoscale.ab import run_autoscale_ab
+
+        print(json.dumps(run_autoscale_ab(seed=args.seed,
+                                          fast=args.autoscale_fast)),
+              flush=True)
+        # rc-0 even when valid:false: the record's own flags are the
+        # evidence; nonzero rc stays reserved for harness errors.
+        sys.exit(0)
     if args.open_loop:
         # Real-socket control-plane harness: subprocess cluster + CPU
         # resolve engine by design — pin CPU so importing the client

@@ -1,0 +1,45 @@
+"""Closed-loop elastic autoscaler: SLO-driven recruit/retire with
+reshard-on-scale and hysteresis gates.
+
+The subsystem watches the cluster through the standard metrics-scrape
+contract (obs/registry) — ratekeeper limiting reason, resolver queue
+depth, admission saturation, per-proxy GRV queue — and recruits or
+retires commit proxies and resolvers live:
+
+- **policy.py** — deterministic hysteresis policy: separated up/down
+  thresholds, consecutive-window confirmation, per-role cooldowns,
+  down-only-when-calm. Oscillating load with a period inside the
+  cooldown provably cannot thrash (the AB pins the bound).
+- **controller.py** — the control loops: the sim ``Autoscaler`` applies
+  decisions via scale-via-recovery (resolver scale = scoped mesh
+  reshard; proxy retire = ratekeeper lease reset), stamping every
+  decision on the flight-recorder timeline with staged
+  detect/recruit/relief timings; ``deployed_scale`` moves real-process
+  fleets through the supervisor's ``configure`` RPC.
+- **ab.py** — the gated A/B (``AUTOSCALE_AB.json``): zero acked-commit
+  loss + exactly-once across every scale transition, per-event
+  time-to-relief, doctor attribution, hysteresis bound.
+
+``python -m foundationdb_tpu.autoscale`` runs the one-line selfcheck;
+``--ab`` emits the full AB record.
+"""
+
+from foundationdb_tpu.autoscale.controller import (
+    Autoscaler,
+    arm,
+    deployed_scale,
+)
+from foundationdb_tpu.autoscale.policy import (
+    AutoscalePolicy,
+    ScaleDecision,
+    read_signals,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "ScaleDecision",
+    "arm",
+    "deployed_scale",
+    "read_signals",
+]

@@ -1,0 +1,41 @@
+"""CLI: one-JSON-line selfcheck (default) or the full gated AB.
+
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.autoscale
+    env JAX_PLATFORMS=cpu python -m foundationdb_tpu.autoscale --ab
+
+Selfcheck exits non-zero when a gate fails; ``--ab`` always exits 0
+with the verdict in the record's ``valid``/``gates`` fields (the
+openloop precedent: rc is reserved for harness errors, so a watch
+stage can still commit an honest failing record).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="python -m foundationdb_tpu.autoscale")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the full autoscale-vs-fixed AB + "
+                         "oscillation gate (AUTOSCALE_AB.json record)")
+    ap.add_argument("--seed", type=int, default=20260807)
+    ap.add_argument("--fast", action="store_true",
+                    help="shorter schedules (CI-sized)")
+    args = ap.parse_args()
+
+    from foundationdb_tpu.autoscale.ab import run_autoscale_ab, selfcheck
+
+    if args.ab:
+        rec = run_autoscale_ab(seed=args.seed, fast=args.fast)
+        print(json.dumps(rec))
+        return 0
+    rec = selfcheck(seed=args.seed)
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""Closed-loop elastic autoscaler: scrape → policy → recruit/retire.
+
+``Autoscaler`` is the sim control loop: it scrapes the cluster through
+the standard contract (obs/registry.scrape_sim — the identical
+aggregated view the flight recorder rings), feeds the hysteresis policy
+(policy.py), and applies confirmed decisions by mutating the cluster's
+fleet targets (``SimCluster.n_proxies`` / ``n_resolvers``) and driving
+a generation change through ``ClusterController.request_recovery`` —
+scale-via-recovery, the same recruit path every failure heal takes, so
+a resolver count change IS a scoped mesh reshard (the new generation
+re-derives the resolver map) and proxy retirement naturally resets the
+ratekeeper leases (each generation gets a fresh ratekeeper sharing the
+same quota dict).
+
+Every applied decision lands on the flight-recorder timeline as a
+first-class ``AutoscaleRecruit``/``AutoscaleRetire`` annotation
+(cls="autoscale") carrying the triggering signal, the fleet transition,
+and the relief contract (`metric` + `clear_below`) the doctor's
+``scale_relief`` attribution re-checks from ring snapshots. Each event
+records the staged time-to-relief breakdown the AB gates on:
+
+- ``detect_s``  — first over-threshold window → confirmed decision
+  (the policy's consecutive-window confirmation cost);
+- ``recruit_s`` — decision → generation change complete (epoch bumped,
+  controller idle);
+- ``relief_s``  — recruit complete → triggering signal reads clear in
+  the scrape for ``RELIEF_CONFIRM`` consecutive windows (a freshly
+  recruited generation starts with empty queues, so one quiet scrape
+  right after the recovery proves nothing).
+
+The deployed twin is ``deployed_scale``: against real processes the
+fleet target moves via the PR 13 supervisor's ``configure`` RPC — the
+controller recruits the role onto a spec process (spawn → recruit RPC →
+ratekeeper lease share appears on the new proxy's first get_rates
+poll), and retirement drains through ``Worker.stand_down`` /
+``recruit_proxy``, which now release the outgoing GRV proxy's budget
+lease explicitly (``Ratekeeper.release_lease``) instead of waiting out
+the live-poller TTL.
+"""
+
+from __future__ import annotations
+
+from foundationdb_tpu.autoscale.policy import AutoscalePolicy, ScaleDecision
+
+#: consecutive cleared scrapes before a scale event counts as relieved.
+RELIEF_CONFIRM = 2
+
+#: generation-change wait bound per applied decision (sim seconds).
+RECRUIT_DEADLINE_S = 60.0
+
+
+class Autoscaler:
+    """Sim-side closed loop. Construct with a running ``SimCluster``
+    (attaches itself as ``cluster.autoscaler`` so scrape_sim exports the
+    ``autoscale.*`` counters), then spawn ``run()`` on the cluster loop:
+
+        scaler = Autoscaler(cluster)
+        cluster.loop.spawn(scaler.run(), process="autoscaler",
+                           name="autoscale.run")
+    """
+
+    POLL_S = 0.5
+
+    def __init__(self, cluster, policy: "AutoscalePolicy | None" = None,
+                 poll_s: "float | None" = None) -> None:
+        self.cluster = cluster
+        self.loop = cluster.loop
+        self.policy = policy or AutoscalePolicy()
+        self.poll_s = float(poll_s or self.POLL_S)
+        self.events: list[dict] = []  # applied decisions, staged timings
+        self._pending_relief: list[dict] = []
+        self._relief_streak: dict[int, int] = {}  # id(event) -> streak
+        cluster.autoscaler = self
+
+    # -- scrape-contract surface ------------------------------------------
+
+    def fleet(self) -> dict:
+        return {"proxy": self.cluster.n_proxies,
+                "resolver": self.cluster.n_resolvers}
+
+    def metrics(self) -> dict:
+        m = self.policy.metrics()
+        m["autoscale_events_total"] = len(self.events)
+        return m
+
+    def _annotate(self, name: str, **details) -> None:
+        rec = getattr(self.cluster, "flight_recorder", None)
+        if rec is not None:
+            rec.annotate(name, "autoscale", severity="warn", **details)
+
+    # -- the loop ----------------------------------------------------------
+
+    async def run(self) -> None:
+        from foundationdb_tpu.obs.registry import scrape_sim
+
+        while True:
+            await self.loop.sleep(self.poll_s)
+            ctrl = getattr(self.cluster, "controller", None)
+            if ctrl is None or ctrl._recovering:
+                continue  # never stack decisions on an in-flight recovery
+            reg = await scrape_sim(self.cluster)
+            agg = reg.aggregated()
+            t = self.loop.now
+            self._check_relief(t, agg)
+            decision = self.policy.observe(t, agg, self.fleet())
+            if decision is not None:
+                await self._apply(decision, t)
+
+    async def _apply(self, d: ScaleDecision, t_decide: float) -> None:
+        ctrl = self.cluster.controller
+        epoch0 = ctrl.generation.epoch
+        if d.role == "proxy":
+            self.cluster.n_proxies = d.to_n
+        else:
+            self.cluster.n_resolvers = d.to_n
+        name = "AutoscaleRecruit" if d.direction == "up" else "AutoscaleRetire"
+        self._annotate(
+            name, role=d.role, from_n=d.from_n, to_n=d.to_n,
+            signal=d.signal, value=round(d.value, 4),
+            metric=d.metric or None, clear_below=d.clear_below,
+            clear_above=d.clear_above,
+        )
+        await ctrl.request_recovery(
+            epoch0, f"autoscale {d.direction}: {d.role} {d.from_n}->"
+                    f"{d.to_n} on {d.signal}={d.value:.1f}")
+        deadline = self.loop.now + RECRUIT_DEADLINE_S
+        while ((ctrl.generation.epoch <= epoch0 or ctrl._recovering)
+               and self.loop.now < deadline):
+            await self.loop.sleep(0.1)
+        t_done = self.loop.now
+        ev = {
+            "name": name,
+            "role": d.role,
+            "direction": d.direction,
+            "from_n": d.from_n,
+            "to_n": d.to_n,
+            "signal": d.signal,
+            "value": round(d.value, 4),
+            "metric": d.metric or None,
+            "clear_below": d.clear_below,
+            "clear_above": d.clear_above,
+            "epoch": ctrl.generation.epoch,
+            "recruited": ctrl.generation.epoch > epoch0,
+            "t_detect": round(d.t_detect, 3),
+            "t_decide": round(t_decide, 3),
+            "t_recruit_done": round(t_done, 3),
+            "detect_s": round(t_decide - d.t_detect, 3),
+            "recruit_s": round(t_done - t_decide, 3),
+            "relief_s": None,
+            "time_to_relief": None,
+            "relieved": False if d.clear_below is not None else None,
+        }
+        self.events.append(ev)
+        if d.clear_below is not None:
+            self._pending_relief.append(ev)
+        else:
+            # Slack-triggered scale-down: no limiting signal to clear —
+            # drain-complete (the generation change) IS the relief.
+            ev["relief_s"] = 0.0
+            ev["time_to_relief"] = round(t_done - d.t_detect, 3)
+
+    def _check_relief(self, t: float, agg: dict) -> None:
+        still: list[dict] = []
+        for ev in self._pending_relief:
+            v = agg.get(ev["metric"])
+            cleared = (
+                t >= ev["t_recruit_done"] + self.poll_s
+                and v is not None
+                and ((float(v) > ev["clear_below"]) if ev["clear_above"]
+                     else (float(v) < ev["clear_below"]))
+            )
+            key = id(ev)
+            streak = self._relief_streak.get(key, 0) + 1 if cleared else 0
+            self._relief_streak[key] = streak
+            if streak < RELIEF_CONFIRM:
+                still.append(ev)
+                continue
+            del self._relief_streak[key]
+            ev["relieved"] = True
+            ev["relief_s"] = round(t - ev["t_recruit_done"], 3)
+            ev["time_to_relief"] = round(t - ev["t_detect"], 3)
+            self._annotate(
+                "AutoscaleRelief", role=ev["role"], signal=ev["signal"],
+                value=float(v), event_t=ev["t_decide"],
+                relief_s=ev["relief_s"],
+            )
+        self._pending_relief = still
+
+
+def arm(cluster, policy: "AutoscalePolicy | None" = None,
+        poll_s: "float | None" = None) -> Autoscaler:
+    """Attach an autoscaler to a SimCluster and spawn its control loop
+    on a dedicated sim process (like the flight recorder: chaos against
+    cluster roles must never take the control plane down with them)."""
+    scaler = Autoscaler(cluster, policy=policy, poll_s=poll_s)
+    cluster.loop.spawn(scaler.run(),
+                       process=cluster.process_prefix + "autoscaler",
+                       name="autoscale.run")
+    return scaler
+
+
+async def deployed_scale(controller_ep, role: str, to_n: int) -> dict:
+    """Deployed actuator: move the fleet target for a chain role on a
+    managed real-process cluster (loadgen/deploy.py supervisor). The
+    controller's ``configure`` persists the desired count and drives the
+    generation change that recruits/retires the role processes; retired
+    GRV proxies release their ratekeeper lease explicitly on the way
+    out (Worker._release_grv_lease), and resolver count changes reshard
+    the mesh for the new generation."""
+    if role not in ("proxy", "resolver", "tlog"):
+        raise ValueError(f"cannot autoscale role {role!r}")
+    return await controller_ep.configure({role: int(to_n)})

@@ -1,0 +1,275 @@
+"""Hysteresis scale policy: deterministic decisions from scrape signals.
+
+The policy is a pure consumer of the standard metrics-scrape contract
+(obs/registry.py aggregated view) — the same dict the flight recorder
+snapshots into the ring, so every threshold the policy acts on is
+replayable from the ring after the fact (the doctor's `scale_relief`
+attribution depends on exactly this).
+
+Signals (see ``read_signals``):
+
+====================  ==========================================  =========
+signal                aggregated-scrape key                       scales
+====================  ==========================================  =========
+resolver_queue        ratekeeper.worst_resolver_queue             resolver
+resolver_occupancy    ratekeeper.resolver_dispatch_occupancy      resolver
+limiting_reason_code  ratekeeper.limiting_reason_code             resolver
+grv_queue_per_proxy   grv_proxy.queued + grv_proxy.batch_queued   proxy
+admission_saturation  ratekeeper.admission_saturation             proxy
+====================  ==========================================  =========
+
+Queue depth and dispatch occupancy are complementary resolver signals:
+the commit pipeline self-clocks (a proxy holds few batches in flight),
+so a saturated resolver shows a SHALLOW queue at high occupancy — depth
+alone would sleep through exactly the overload that scaling fixes.
+Occupancy is also the signal that provably responds to recruitment: a
+resolver's dispatch work is proportional to the key-range fragments it
+owns, so adding a resolver splits the load where depth may not move.
+
+Hysteresis discipline (mirrors SloTracker's anomaly discipline —
+warm-up + consecutive-window confirmation, never single-sample edges):
+
+- **separated thresholds**: the scale-up trigger sits well above the
+  scale-down trigger (e.g. resolver queue >= 16 up, <= 2 down), so a
+  signal hovering between them drives NO decisions at all;
+- **consecutive-window confirmation**: a direction must hold for
+  ``confirm_up`` (resp. ``confirm_down``) consecutive observe() windows
+  before it can fire — one spiky scrape is not a capacity change, and
+  scale-down demands a LONGER streak than scale-up (shedding capacity
+  is the riskier direction);
+- **cooldown windows**: after any applied decision for a role, further
+  decisions for that role are suppressed for ``cooldown_up_s`` /
+  ``cooldown_down_s`` — an oscillating load whose period sits inside
+  the cooldown provably cannot thrash the fleet (the AB's oscillation
+  gate pins the resulting bound on scale-event count);
+- **down only when calm everywhere**: scale-down candidates are
+  suppressed outright while ANY scale-up pressure exists — mixed
+  pressure means the system is NOT overprovisioned.
+
+Every suppression is counted (``suppressed_confirm`` /
+``suppressed_cooldown`` / ``suppressed_bounds``) and exported as
+``autoscale_*`` counters so a quiet fleet is distinguishable from an
+unarmed one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from foundationdb_tpu.runtime.ratekeeper import LIMIT_REASONS
+
+#: roles the policy may scale (chain roles with a recruit path).
+ROLES = ("proxy", "resolver")
+
+_CODE_RESOLVER_QUEUE = LIMIT_REASONS.index("resolver_queue")
+_CODE_ADMISSION = LIMIT_REASONS.index("admission_filter")
+
+
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One confirmed, cooldown-cleared, bounds-checked fleet change.
+
+    ``metric``/``clear_below`` name the aggregated-scrape key the
+    decision fired on and the value below which the triggering signal
+    counts as CLEARED — the relief contract the flight-recorder
+    annotation carries and the doctor re-checks from ring snapshots.
+    Slack-triggered scale-downs carry ``clear_below=None``: there is no
+    limiting signal left to clear, drain-complete is the relief.
+    """
+
+    role: str  # "proxy" | "resolver"
+    direction: str  # "up" | "down"
+    from_n: int
+    to_n: int
+    signal: str
+    value: float
+    metric: str
+    clear_below: "float | None"
+    clear_above: bool  # True: relief is the metric RISING past clear_below
+    t_detect: float  # first window of the confirming streak
+
+
+def read_signals(agg: dict, fleet: dict) -> dict:
+    """Policy inputs from one aggregated scrape (missing keys read as
+    quiet — a partial scrape must never manufacture pressure)."""
+    n_proxies = max(1, int(fleet.get("proxy", 1)))
+    queued = (float(agg.get("grv_proxy.queued", 0.0) or 0.0)
+              + float(agg.get("grv_proxy.batch_queued", 0.0) or 0.0))
+    return {
+        "resolver_queue": float(
+            agg.get("ratekeeper.worst_resolver_queue", 0.0) or 0.0),
+        "resolver_occupancy": float(
+            agg.get("ratekeeper.resolver_dispatch_occupancy", 0.0) or 0.0),
+        "limiting_reason_code": int(
+            agg.get("ratekeeper.limiting_reason_code", 0) or 0),
+        "grv_queue_per_proxy": queued / n_proxies,
+        "admission_saturation": float(
+            agg.get("ratekeeper.admission_saturation", 0.0) or 0.0),
+    }
+
+
+class AutoscalePolicy:
+    """Deterministic hysteresis policy (module docstring). Stateful
+    across ``observe()`` calls (streaks + cooldown stamps) but pure of
+    any cluster handle — the same policy object drives the sim and
+    deployed control loops."""
+
+    def __init__(self, *,
+                 min_fleet: "dict | None" = None,
+                 max_fleet: "dict | None" = None,
+                 confirm_up: int = 2,
+                 confirm_down: int = 6,
+                 cooldown_up_s: float = 4.0,
+                 cooldown_down_s: float = 12.0,
+                 resolver_q_up: float = 16.0,
+                 resolver_q_down: float = 2.0,
+                 resolver_occ_up: float = 0.85,
+                 resolver_occ_clear: float = 0.80,
+                 resolver_occ_down: float = 0.30,
+                 proxy_q_up: float = 64.0,
+                 proxy_q_down: float = 2.0,
+                 admission_sat_up: float = 0.75) -> None:
+        assert confirm_down >= confirm_up >= 1
+        assert cooldown_down_s >= cooldown_up_s >= 0.0
+        assert resolver_q_up > resolver_q_down >= 0.0
+        assert resolver_occ_up >= resolver_occ_clear > resolver_occ_down >= 0.0
+        assert proxy_q_up > proxy_q_down >= 0.0
+        self.min_fleet = dict(min_fleet or {r: 1 for r in ROLES})
+        self.max_fleet = dict(max_fleet or {r: 4 for r in ROLES})
+        self.confirm_up = int(confirm_up)
+        self.confirm_down = int(confirm_down)
+        self.cooldown_up_s = float(cooldown_up_s)
+        self.cooldown_down_s = float(cooldown_down_s)
+        self.resolver_q_up = float(resolver_q_up)
+        self.resolver_q_down = float(resolver_q_down)
+        self.resolver_occ_up = float(resolver_occ_up)
+        self.resolver_occ_clear = float(resolver_occ_clear)
+        self.resolver_occ_down = float(resolver_occ_down)
+        self.proxy_q_up = float(proxy_q_up)
+        self.proxy_q_down = float(proxy_q_down)
+        self.admission_sat_up = float(admission_sat_up)
+        self._streak: dict[tuple, int] = {}
+        self._streak_t0: dict[tuple, float] = {}
+        self._last_scale: dict[str, float] = {}
+        self.windows_observed = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.suppressed_confirm = 0
+        self.suppressed_cooldown = 0
+        self.suppressed_bounds = 0
+
+    # -- streak bookkeeping ------------------------------------------------
+
+    def _press(self, key: tuple, t: float, pressed: bool) -> int:
+        if not pressed:
+            self._streak[key] = 0
+            return 0
+        if self._streak.get(key, 0) == 0:
+            self._streak_t0[key] = t
+        self._streak[key] = self._streak.get(key, 0) + 1
+        return self._streak[key]
+
+    def _cooldown_ok(self, role: str, t: float, direction: str) -> bool:
+        last = self._last_scale.get(role)
+        if last is None:
+            return True
+        window = (self.cooldown_up_s if direction == "up"
+                  else self.cooldown_down_s)
+        return (t - last) >= window
+
+    # -- the decision ------------------------------------------------------
+
+    def observe(self, t: float, agg: dict,
+                fleet: dict) -> "ScaleDecision | None":
+        """One control window: feed the scrape, get at most ONE decision
+        (the control loop applies it and re-observes — capacity moves
+        one step per window by construction)."""
+        sig = read_signals(agg, fleet)
+        self.windows_observed += 1
+        rq, gq = sig["resolver_queue"], sig["grv_queue_per_proxy"]
+        occ = sig["resolver_occupancy"]
+        sat, code = sig["admission_saturation"], sig["limiting_reason_code"]
+        res_q_up = rq >= self.resolver_q_up or code == _CODE_RESOLVER_QUEUE
+        res_up = res_q_up or occ >= self.resolver_occ_up
+        prox_up = (gq >= self.proxy_q_up or sat >= self.admission_sat_up
+                   or code == _CODE_ADMISSION)
+        res_down = (not res_up and rq <= self.resolver_q_down
+                    and occ <= self.resolver_occ_down)
+        prox_down = (not prox_up and gq <= self.proxy_q_down
+                     and sat < self.admission_sat_up / 2)
+        any_up = res_up or prox_up
+        # Priority: resolver pressure outranks proxy pressure (it sits
+        # deeper in the pipeline — a starved resolver backs commits up
+        # into every proxy), ups outrank downs, downs need global calm.
+        # Queue depth outranks occupancy within the resolver signal: an
+        # actually-deep queue is the stronger evidence.
+        candidates = (
+            ("resolver", "up", res_up,
+             *(("resolver_queue", rq,
+                "ratekeeper.worst_resolver_queue",
+                self.resolver_q_down, False) if res_q_up else
+               ("resolver_occupancy", occ,
+                "ratekeeper.resolver_dispatch_occupancy",
+                self.resolver_occ_clear, False))),
+            ("proxy", "up", prox_up,
+             "admission_saturation" if sat >= self.admission_sat_up
+             else "grv_queue", sat if sat >= self.admission_sat_up else gq,
+             "grv_proxy.queued", None, False),
+            ("resolver", "down", res_down and not any_up,
+             "resolver_queue_slack", rq, "", None, False),
+            ("proxy", "down", prox_down and not any_up,
+             "grv_queue_slack", gq, "", None, False),
+        )
+        decision = None
+        for role, direction, pressed, signal, value, metric, clear, \
+                above in candidates:
+            streak = self._press((role, direction), t, pressed)
+            if not pressed or decision is not None:
+                continue
+            need = (self.confirm_up if direction == "up"
+                    else self.confirm_down)
+            if streak < need:
+                self.suppressed_confirm += 1
+                continue
+            if not self._cooldown_ok(role, t, direction):
+                self.suppressed_cooldown += 1
+                continue
+            from_n = int(fleet[role])
+            to_n = from_n + (1 if direction == "up" else -1)
+            if not (self.min_fleet[role] <= to_n <= self.max_fleet[role]):
+                self.suppressed_bounds += 1
+                continue
+            clear_below = clear
+            if role == "proxy" and direction == "up":
+                # Aggregated GRV queue is summed across instances: the
+                # calm threshold scales with the NEW fleet size.
+                clear_below = self.proxy_q_down * to_n
+            decision = ScaleDecision(
+                role=role, direction=direction, from_n=from_n, to_n=to_n,
+                signal=signal, value=float(value),
+                metric=metric or "", clear_below=clear_below,
+                clear_above=above,
+                t_detect=self._streak_t0.get((role, direction), t),
+            )
+        if decision is not None:
+            self._last_scale[decision.role] = t
+            for d in ("up", "down"):
+                self._streak[(decision.role, d)] = 0
+            if decision.direction == "up":
+                self.scale_ups += 1
+            else:
+                self.scale_downs += 1
+        return decision
+
+    def metrics(self) -> dict:
+        """The documented ``autoscale_*`` counter set (AUTOSCALE_
+        DOCUMENTED_COUNTERS in obs/registry.py — events_total is added
+        by the control loop that owns the event list)."""
+        return {
+            "autoscale_windows_observed": self.windows_observed,
+            "autoscale_scale_ups": self.scale_ups,
+            "autoscale_scale_downs": self.scale_downs,
+            "autoscale_suppressed_confirm": self.suppressed_confirm,
+            "autoscale_suppressed_cooldown": self.suppressed_cooldown,
+            "autoscale_suppressed_bounds": self.suppressed_bounds,
+        }

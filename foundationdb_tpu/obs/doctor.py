@@ -274,6 +274,65 @@ def dict_thrash(snaps: list[dict], t0: float, t1: float,
     }
 
 
+def scale_relief(records: list[dict], slack_s: float = SLACK_S,
+                 grace_s: float = 60.0) -> "list | None":
+    """Autoscale attribution (autoscale/): per scale event on the ring
+    (`AutoscaleRecruit`/`AutoscaleRetire` annotations, cls="autoscale"),
+    did the TRIGGERING signal clear after the fleet changed? The
+    annotation carries the aggregated-scrape key it fired on (`metric`)
+    and the policy's clear threshold (`clear_below`); relief is the
+    first ring snapshot after the event where that key reads below the
+    threshold (`above=True` events clear upward — a goodput floor).
+    Returns None when the ring holds NO autoscale annotations — the
+    autoscaler was unarmed, and claiming "no scale events needed relief"
+    would be vacuously true (the honesty signal, like dominant_stage's).
+    Scale-downs triggered by slack (no `clear_below`) attribute on the
+    signal alone: there is no limiting signal left to clear."""
+    snaps, anns, _gaps = split_ring(records)
+    armed = [a for a in anns if a.get("cls") == "autoscale"]
+    if not armed:
+        return None
+    # Relief confirmations ("AutoscaleRelief") prove the loop was armed
+    # but are not scale events themselves — attributing them would be
+    # vacuous double-counting.
+    events = [a for a in armed
+              if a.get("name") in ("AutoscaleRecruit", "AutoscaleRetire")]
+    out = []
+    for e in events:
+        t0 = e["t"]
+        metric, clear = e.get("metric"), e.get("clear_below")
+        above = bool(e.get("clear_above", False))
+        relieved_at = None
+        if metric is not None and clear is not None:
+            for s in snaps:
+                if s["t"] <= t0 or s["t"] > t0 + grace_s:
+                    continue
+                v = (s.get("metrics") or {}).get(metric)
+                if v is None:
+                    continue
+                if (float(v) > float(clear)) if above \
+                        else (float(v) < float(clear)):
+                    relieved_at = s["t"]
+                    break
+        needs_clear = metric is not None and clear is not None
+        out.append({
+            "name": e.get("name"),
+            "role": e.get("role"),
+            "signal": e.get("signal"),
+            "from_n": e.get("from_n"),
+            "to_n": e.get("to_n"),
+            "t": t0,
+            "metric": metric,
+            "clear_below": clear,
+            "relieved": (relieved_at is not None) if needs_clear else None,
+            "relief_s": (round(relieved_at - t0, 3)
+                         if relieved_at is not None else None),
+            "attributed": bool(e.get("signal")) and (
+                relieved_at is not None if needs_clear else True),
+        })
+    return out
+
+
 # -- annotations in a window ---------------------------------------------------
 
 
@@ -374,6 +433,7 @@ def diagnose(records: list[dict], objectives: "dict | None" = None,
         "slo": tracker.status(),
         "incidents": incidents,
         "faults": attribute_faults(records, slack_s=slack_s),
+        "scale_events": scale_relief(records, slack_s=slack_s),
     }
 
 

@@ -84,6 +84,7 @@ ANNOTATION_CLASSES = (
     "chaos_heal",
     "load_phase",
     "slo",
+    "autoscale",
     "scrape_gap",
 )
 

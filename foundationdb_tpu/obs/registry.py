@@ -127,6 +127,20 @@ RECORDER_DOCUMENTED_COUNTERS = (
     "slo.slo_warmed_up",
 )
 
+#: counters the elastic autoscaler (autoscale/) contributes to a scrape
+#: when its control loop is ARMED — scoped like the chaos/recorder sets
+#: (a plain cluster has no autoscaler riding the scrape), so autoscale
+#: runs pass them via `missing_documented(extra=)`.
+AUTOSCALE_DOCUMENTED_COUNTERS = (
+    "autoscale.autoscale_windows_observed",
+    "autoscale.autoscale_scale_ups",
+    "autoscale.autoscale_scale_downs",
+    "autoscale.autoscale_suppressed_cooldown",
+    "autoscale.autoscale_suppressed_confirm",
+    "autoscale.autoscale_suppressed_bounds",
+    "autoscale.autoscale_events_total",
+)
+
 
 def _flatten(out: dict, prefix: str, value: Any) -> None:
     """Numbers and booleans keep their key; dicts recurse with dots;
@@ -316,6 +330,11 @@ async def scrape_sim(cluster) -> MetricsRegistry:
     ctrl_ep = getattr(cluster, "controller_ep", None)
     if ctrl_ep is not None:
         probe("controller", ctrl_ep, ctrl_ep.get_metrics())
+    # Autoscaler rides the scrape in-process when armed (control loop,
+    # not a cluster role — it has no endpoint of its own).
+    scaler = getattr(cluster, "autoscaler", None)
+    if scaler is not None:
+        reg.add("autoscale", "", scaler.metrics())
     for role, inst, task in probes:
         m = await task
         if isinstance(m, BaseException):

@@ -265,6 +265,15 @@ class GrvProxy:
             raise ProcessKilled(
                 f"grv epoch {self.epoch} unconfirmed: {failed}") from failed
 
+    async def release_lease(self) -> bool:
+        """Deliberate-retirement half of the budget lease (autoscale /
+        stand-down path): return this proxy's ratekeeper share NOW rather
+        than letting it age out over the live-poller TTL. Safe to call
+        when unwired (no ratekeeper) or when the lease already expired."""
+        if self.ratekeeper is None:
+            return False
+        return bool(await self.ratekeeper.release_lease(self.poller_id))
+
     async def _rate_poller(self) -> None:
         if self.ratekeeper is None:
             return

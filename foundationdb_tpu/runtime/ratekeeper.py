@@ -146,6 +146,15 @@ class Ratekeeper:
         else:
             self.tag_quotas[tag] = float(tps)
 
+    @rpc
+    async def release_lease(self, poller_id: str) -> bool:
+        """Retire-side half of the per-proxy budget lease: a deliberately
+        retired GRV proxy hands its share back immediately, so the
+        surviving proxies see the whole budget on their next get_rates
+        poll instead of waiting out POLLER_TTL. Crash retirement still
+        falls back to the TTL ageing path."""
+        return self._pollers.pop(poller_id, None) is not None
+
     async def run(self) -> None:
         while True:
             try:
@@ -174,8 +183,17 @@ class Ratekeeper:
                         (m.get("queue_depth_hw", m.get("queue_depth", 0))
                          for m in rmetrics), default=0
                     )
+                    # Windowed occupancy, not the lifetime ratio: the
+                    # control loops downstream (autoscale) need "is the
+                    # dispatcher saturated NOW" — the lifetime average
+                    # rises asymptotically and never forgets a past
+                    # overload (see ResolveScheduler.
+                    # dispatch_occupancy_recent).
                     self.worst_resolver_occupancy = max(
-                        ((m.get("queue") or {}).get("dispatch_occupancy", 0.0)
+                        ((m.get("queue") or {}).get(
+                            "dispatch_occupancy_recent",
+                            (m.get("queue") or {}).get(
+                                "dispatch_occupancy", 0.0))
                          for m in rmetrics),
                         default=0.0,
                     )

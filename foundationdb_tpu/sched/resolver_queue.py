@@ -56,6 +56,9 @@ class ResolveScheduler:
         # seed 0: true depth 25, ratekeeper saw 8). Non-destructive, so
         # status JSON and the ratekeeper can both read it.
         self._hw_buckets: deque[tuple[float, int]] = deque()
+        # Recent busy spans for the windowed occupancy (autoscale's
+        # control signal — see dispatch_occupancy_recent).
+        self._occ_spans: deque[tuple[float, float]] = deque()
 
     def attach(self, dispatch_fn: Callable[[list], Awaitable[None]]) -> None:
         """dispatch_fn(entries) resolves a consecutive group in order."""
@@ -102,12 +105,35 @@ class ResolveScheduler:
             return 0.0
         return min(1.0, self._busy_s / elapsed)
 
+    OCC_WINDOW_S = 2.0
+
+    def _note_busy(self, t0: float, t1: float) -> None:
+        if t1 > t0:
+            self._occ_spans.append((t0, t1))
+
+    def dispatch_occupancy_recent(self) -> float:
+        """Busy fraction over the last OCC_WINDOW_S — the control-loop
+        view of dispatch saturation. The lifetime average above answers
+        "was this resolver ever the bottleneck"; a controller needs
+        "is it the bottleneck NOW", which the lifetime ratio approaches
+        asymptotically on the way up and remembers forever on the way
+        down (elastic-autoscale find: a saturated resolver took ~10s of
+        sustained overload to cross a 0.85 lifetime threshold, and a
+        drained one held it long after the crowd left)."""
+        horizon = self.loop.now - self.OCC_WINDOW_S
+        while self._occ_spans and self._occ_spans[0][1] <= horizon:
+            self._occ_spans.popleft()
+        busy = sum(t1 - max(t0, horizon) for t0, t1 in self._occ_spans)
+        return min(1.0, busy / self.OCC_WINDOW_S)
+
     def metrics(self) -> dict:
         return {
             "depth": self.queue_depth,
             "depth_hw": self.depth_high_water(),
             "oldest_age_s": round(self.oldest_age_s(), 6),
             "dispatch_occupancy": round(self.dispatch_occupancy(), 4),
+            "dispatch_occupancy_recent": round(
+                self.dispatch_occupancy_recent(), 4),
             "windows_dispatched": self.windows_dispatched,
             "batches_dispatched": self.batches_dispatched,
             "target_depth": self.coalescer.target_depth(),
@@ -156,6 +182,7 @@ class ResolveScheduler:
                 await self._dispatch_fn(group)
                 dt = self.loop.now - t0
                 self._busy_s += dt
+                self._note_busy(t0, self.loop.now)
                 self.coalescer.observe_dispatch(k, dt * 1e3)
                 self.windows_dispatched += 1
                 self.batches_dispatched += k

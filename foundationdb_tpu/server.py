@@ -494,6 +494,7 @@ class Worker:
             return False
         self._cancel_runs()
         if self.role == "proxy":
+            self._release_grv_lease()
             self._fail_commit_queue("proxy stood down: generation retired")
             self._fail_grv_queue("proxy stood down: generation retired")
             self.t.unserve("commit_proxy")
@@ -521,6 +522,25 @@ class Worker:
         for _req, p in cp._queue.drain():  # every lane (sched/lanes.py)
             p.fail(ProcessKilled(reason))
         self._commit_proxy = None
+
+    def _release_grv_lease(self) -> None:
+        """Deliberate retirement returns the outgoing GRV proxy's
+        ratekeeper budget share NOW (Ratekeeper.release_lease) so the
+        survivors see the whole budget within one get_rates poll, instead
+        of the share aging out over the live-poller TTL. Fire-and-forget:
+        retirement must never block on a possibly-dead ratekeeper — the
+        TTL path stays the crash fallback."""
+        g = getattr(self, "_grv_proxy", None)
+        if g is None or g.ratekeeper is None:
+            return
+
+        async def _release(grv):
+            try:
+                await grv.release_lease()
+            except Exception:
+                pass  # unreachable ratekeeper: TTL ageing covers it
+
+        self.loop.spawn(_release(g), name="grv.release_lease")
 
     def _fail_grv_queue(self, reason: str) -> None:
         """The GRV twin of _fail_commit_queue (same parked-request
@@ -654,6 +674,7 @@ class Worker:
         from foundationdb_tpu.runtime.grv_proxy import GrvProxy
 
         self._cancel_runs()
+        self._release_grv_lease()
         self._fail_commit_queue("proxy retired by recovery")
         self._fail_grv_queue("proxy retired by recovery")
         seq_ep = self.t.endpoint(

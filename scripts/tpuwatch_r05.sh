@@ -250,6 +250,16 @@ while true; do
     'r.get("metric") == "open_loop_scaleout" and r.get("scaling_curve") and r.get("latency_curve") and r.get("past_saturation_observed") and (r.get("overload") or {}).get("engaged") and (r.get("overload") or {}).get("recovered")' -- \
     env OUT=OPENLOOP_AB_r05_rec.json bash scripts/openloop_ab.sh \
     || { sleep 60; continue; }
+  # Elastic-autoscale A/B (autoscale subsystem): closed-loop recruiter
+  # vs frozen fleet on the same seeded flash-crowd schedule, plus the
+  # oscillation hysteresis gate. CPU sim twin by design (cpu_fallback
+  # true in-record); done-check gates on STRUCTURAL completeness (scale
+  # events with staged relief + both ledgers exact + oscillation bound
+  # present) — the arm-vs-arm ratios are reported, never gated.
+  stage ab_autoscale 1800 AUTOSCALE_AB_r05.json \
+    'r.get("metric") == "autoscale_ab" and r.get("scale_events") and (r.get("oscillation") or {}).get("bound") is not None and r.get("gates", {}).get("zero_acked_loss") and r.get("gates", {}).get("exactly_once")' -- \
+    env OUT=AUTOSCALE_AB_r05_rec.json bash scripts/autoscale_ab.sh \
+    || { sleep 60; continue; }
   python scripts/rank_ab.py > RANK_r05.txt 2>&1 && say "rank written"
   rm -f /tmp/tpu_window_open
   say "heal sequence COMPLETE — idle re-probe every 30 min"

@@ -1,0 +1,464 @@
+"""Elastic-autoscale battery (autoscale/ subsystem, PR 20).
+
+Exact gates, chaos-style — never liveness-only:
+
+- flash-crowd scale-up recruits live with ZERO acked-commit loss and
+  exactly-once unknown-result resolution (the chaos ledger identity);
+- scale-down drains exactly (same identity across the retire);
+- oscillating load with a period inside the policy cooldown stays
+  within the provable hysteresis event bound;
+- resolver recruit is a scoped mesh reshard: scripted conflict verdicts
+  are byte-identical (sha256) across the scale event vs a fixed fleet;
+- Ratekeeper.release_lease returns a retired proxy's budget share
+  within ONE get_rates poll (satellite: no POLLER_TTL wait);
+- the `autoscale_*` counters stay inside the documented-name audit and
+  the flight-recorder accepts the `autoscale` annotation class;
+- ≥2-process real-TCP recruit/retire smoke through the supervisor's
+  configure RPC, gated by the PR 13 leak check at shutdown.
+"""
+
+import hashlib
+
+import pytest
+
+from foundationdb_tpu.autoscale.ab import hysteresis_bound, run_arm
+from foundationdb_tpu.autoscale.policy import AutoscalePolicy
+
+
+def _agg(rq=0.0, occ=0.0, gq=0.0, sat=0.0, code=0):
+    return {
+        "ratekeeper.worst_resolver_queue": rq,
+        "ratekeeper.resolver_dispatch_occupancy": occ,
+        "ratekeeper.limiting_reason_code": code,
+        "grv_proxy.queued": gq,
+        "grv_proxy.batch_queued": 0.0,
+        "ratekeeper.admission_saturation": sat,
+    }
+
+
+class TestPolicyHysteresis:
+    """Pure-unit hysteresis discipline: decisions are a function of the
+    scrape stream alone, and every suppression is counted."""
+
+    def test_confirmation_then_cooldown(self):
+        p = AutoscalePolicy(confirm_up=2, cooldown_up_s=4.0)
+        fleet = {"proxy": 1, "resolver": 1}
+        # One spiky window is NOT a capacity change.
+        assert p.observe(0.0, _agg(occ=0.95), fleet) is None
+        d = p.observe(0.5, _agg(occ=0.95), fleet)
+        assert d is not None and (d.role, d.direction) == ("resolver", "up")
+        assert d.from_n == 1 and d.to_n == 2
+        assert d.signal == "resolver_occupancy"
+        assert d.metric == "ratekeeper.resolver_dispatch_occupancy"
+        assert d.t_detect == 0.0  # first window of the confirming streak
+        fleet = {"proxy": 1, "resolver": 2}
+        # Sustained pressure inside the cooldown cannot fire again...
+        for t in (1.0, 1.5, 2.0, 2.5, 3.0, 3.5, 4.0):
+            assert p.observe(t, _agg(occ=0.95), fleet) is None
+        assert p.suppressed_cooldown > 0
+        # ...and fires exactly once more the moment the cooldown clears.
+        d2 = p.observe(4.5, _agg(occ=0.95), fleet)
+        assert d2 is not None and d2.to_n == 3
+        assert p.scale_ups == 2
+
+    def test_dead_band_between_thresholds(self):
+        """A signal hovering BETWEEN the separated thresholds drives no
+        decisions at all — in either direction."""
+        p = AutoscalePolicy()
+        fleet = {"proxy": 1, "resolver": 2}
+        for i in range(50):
+            assert p.observe(i * 0.5, _agg(rq=8.0, occ=0.5), fleet) is None
+        assert p.scale_ups == 0 and p.scale_downs == 0
+
+    def test_down_requires_global_calm(self):
+        """Resolver slack + proxy pressure = NOT overprovisioned."""
+        p = AutoscalePolicy(confirm_down=2)
+        fleet = {"proxy": 1, "resolver": 2}
+        for i in range(10):
+            d = p.observe(i * 0.5, _agg(rq=0.0, occ=0.0, sat=0.9), fleet)
+            if d is not None:
+                assert d.direction == "up"  # proxy up may fire; never down
+                fleet = {"proxy": d.to_n, "resolver": 2}
+        assert p.scale_downs == 0
+
+    def test_bounds_suppression(self):
+        p = AutoscalePolicy(max_fleet={"proxy": 1, "resolver": 2})
+        fleet = {"proxy": 1, "resolver": 2}
+        for i in range(6):
+            assert p.observe(i * 0.5, _agg(occ=0.95), fleet) is None
+        assert p.suppressed_bounds > 0
+
+    def test_counters_are_the_documented_set(self):
+        from foundationdb_tpu.obs.registry import (
+            AUTOSCALE_DOCUMENTED_COUNTERS,
+        )
+        p = AutoscalePolicy()
+        m = p.metrics()
+        m["autoscale_events_total"] = 0  # the control loop adds this one
+        assert {f"autoscale.{k}" for k in m} == set(
+            AUTOSCALE_DOCUMENTED_COUNTERS)
+
+
+class TestLeaseRelease:
+    """Satellite: explicit budget-lease release on deliberate retirement
+    — the admission budget is whole within ONE get_rates poll, never a
+    POLLER_TTL wait."""
+
+    def test_release_returns_budget_within_one_poll(self):
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        c = SimCluster(seed=7, n_proxies=2, n_tlogs=1, n_storages=1,
+                       ratekeeper=True)
+        rk = c.ratekeeper_ep
+
+        async def main():
+            # The cluster's real GRV proxies hold their own leases
+            # (RATE_POLL_INTERVAL well inside POLLER_TTL) — count
+            # relative to that steady base, never absolutely.
+            await c.loop.sleep(1.0)
+            base = (await rk.get_rates())["grv_pollers"]
+            await rk.get_rates("retiree-a")
+            r2 = await rk.get_rates("retiree-b")
+            assert r2["grv_pollers"] == base + 2
+            assert r2["tps_limit_share"] == pytest.approx(
+                r2["tps_limit"] / (base + 2))
+            # Deliberate retirement hands the share back NOW.
+            assert await rk.release_lease("retiree-b") is True
+            # Strictly less than POLLER_TTL later: the TTL ageing path
+            # cannot be what made the budget whole again.
+            await c.loop.sleep(0.05)
+            r3 = await rk.get_rates("retiree-a")
+            assert r3["grv_pollers"] == base + 1
+            assert r3["tps_limit_share"] == pytest.approx(
+                r3["tps_limit"] / (base + 1))
+            # Releasing an unknown/expired lease is a no-op, not an error.
+            assert await rk.release_lease("retiree-b") is False
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+    def test_grv_proxy_release_helper(self):
+        """GrvProxy.release_lease releases its OWN poller id (the
+        stand-down path server.py drives on deliberate retirement)."""
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        c = SimCluster(seed=9, n_proxies=1, n_tlogs=1, n_storages=1,
+                       ratekeeper=True)
+        g = c.grv_proxies[0]
+
+        async def main():
+            await c.loop.sleep(0.5)  # the proxy's rate poller leases
+            assert await g.release_lease() is True
+            return "ok"
+
+        assert c.loop.run(main(), timeout=60) == "ok"
+
+
+class TestScaleTransitionsExact:
+    """Sim-twin scale events under live load: the chaos ledger identity
+    must hold across every recruit/retire, and every event must be
+    doctor-attributed from ring snapshots alone."""
+
+    POLICY = {"max_fleet": {"proxy": 3, "resolver": 3}}
+
+    def test_flash_crowd_scale_up_zero_acked_loss(self, tmp_path):
+        a = run_arm(20260807, "3:8,6:28,5:8", autoscale=True,
+                    workdir=str(tmp_path), name="up", policy_kw=self.POLICY)
+        events = a["scale_events"]
+        assert any(e["direction"] == "up" and e["recruited"]
+                   for e in events), events
+        led = a["ledger"]
+        assert led["zero_acked_loss"], led
+        assert led["exactly_once_ok"], led
+        assert not led["nonretryable_errors"], led
+        # Staged time-to-relief recorded per event; doctor attribution
+        # reproduces every event from the ring.
+        assert all(e["time_to_relief"] is not None for e in events)
+        assert a["events_attributed"], a["doctor_scale_events"]
+        # The autoscale counters rode the standard scrape contract.
+        assert a["ledger"]["scrape"]["missing_documented"] == []
+        assert a["ledger"]["scrape"]["audit_problems"] == []
+
+    def test_scale_down_drain_exact(self, tmp_path):
+        """Start overprovisioned under calm load: the retire must drain
+        exactly — nothing acked is lost, nothing resolves twice."""
+        a = run_arm(31, "12:8", autoscale=True, workdir=str(tmp_path),
+                    name="down", n_resolvers=2,
+                    policy_kw={**self.POLICY, "confirm_down": 4,
+                               "cooldown_up_s": 2.0, "cooldown_down_s": 4.0})
+        downs = [e for e in a["scale_events"] if e["direction"] == "down"]
+        assert downs and downs[0]["role"] == "resolver", a["scale_events"]
+        assert a["fleet_final"]["resolver"] == 1
+        led = a["ledger"]
+        assert led["zero_acked_loss"] and led["exactly_once_ok"], led
+        assert a["events_attributed"]
+
+    def test_oscillation_within_hysteresis_bound(self, tmp_path):
+        """Load period inside the cooldown: the fleet provably cannot
+        follow the oscillation (a follower emits one event per period =
+        8 here; the hysteresis gates bound it far lower)."""
+        profile = ",".join("2:28,2:8" for _ in range(4))  # 16 s, 4 periods
+        a = run_arm(32, profile, autoscale=True, workdir=str(tmp_path),
+                    name="osc", policy_kw=self.POLICY)
+        bound = hysteresis_bound(self.POLICY, 16.0 + 10.0 + 6.0)
+        n = len(a["scale_events"])
+        assert n <= bound < 8, (n, bound)
+        led = a["ledger"]
+        assert led["zero_acked_loss"] and led["exactly_once_ok"], led
+
+
+class TestReshardParity:
+    """Resolver recruit = scoped mesh reshard: conflict verdicts for a
+    scripted probe sequence must be byte-identical across a live scale
+    event vs the same probes on a fixed fleet."""
+
+    N_PROBES = 12
+
+    async def _probes(self, c, db, scale_at: "int | None") -> str:
+        from foundationdb_tpu.core.errors import (
+            FdbError,
+            NotCommitted,
+            ProcessKilled,
+        )
+
+        async def committed(tr) -> str:
+            try:
+                await tr.commit()
+                return "C"
+            except NotCommitted:
+                return "A"
+
+        async def seeded(key: bytes) -> None:
+            deadline = c.loop.now + 30.0
+            while True:
+                tr = db.transaction()
+                try:
+                    tr.set(key, b"0")
+                    await tr.commit()
+                    return
+                except FdbError as e:
+                    if not e.retryable or c.loop.now > deadline:
+                        raise
+                    if isinstance(e, ProcessKilled):
+                        try:
+                            await db.refresh_client_info()
+                        except Exception:
+                            pass
+                    await c.loop.sleep(0.05)
+
+        verdicts = []
+        for i in range(self.N_PROBES):
+            if i == scale_at:
+                ctrl = c.controller
+                e0 = ctrl.generation.epoch
+                c.n_resolvers = 2
+                await ctrl.request_recovery(e0, "test: autoscale reshard")
+                while ctrl.generation.epoch <= e0 or ctrl._recovering:
+                    await c.loop.sleep(0.05)
+            # Raw leading byte spreads probes across BOTH halves of a
+            # 2-way resolver split — the reshard must actually matter.
+            key = bytes([(i * 21) % 250]) + b"rp/%02d" % i
+            await seeded(key)
+            # Same-read-version write-write conflict: loser must abort.
+            t1, t2 = db.transaction(), db.transaction()
+            await t1.get(key)
+            await t2.get(key)
+            t1.set(key, b"a%02d" % i)
+            t2.set(key, b"b%02d" % i)
+            verdicts.append(await committed(t1))
+            verdicts.append(await committed(t2))
+            # Disjoint pair: both must commit (no false conflicts from
+            # the wider mesh).
+            t3, t4 = db.transaction(), db.transaction()
+            k3, k4 = key + b"/x", key + b"/y"
+            await t3.get(k3)
+            await t4.get(k4)
+            t3.set(k3, b"x")
+            t4.set(k4, b"y")
+            verdicts.append(await committed(t3))
+            verdicts.append(await committed(t4))
+        return "".join(verdicts)
+
+    def _run(self, seed: int, n_resolvers: int, scale_at: "int | None"):
+        from foundationdb_tpu.client.ryw import open_database
+        from foundationdb_tpu.sim.cluster import SimCluster
+
+        c = SimCluster(seed=seed, n_proxies=1, n_resolvers=n_resolvers,
+                       n_tlogs=1, n_storages=2, ratekeeper=False)
+        db = open_database(c)
+        return c.loop.run(self._probes(c, db, scale_at), timeout=300)
+
+    def test_verdicts_identical_across_scale_event(self):
+        scaled = self._run(5, 1, scale_at=self.N_PROBES // 2)
+        fixed_small = self._run(5, 1, scale_at=None)
+        fixed_big = self._run(5, 2, scale_at=None)
+        assert len(scaled) == 4 * self.N_PROBES
+        # Every probe triple: winner commits, same-version loser aborts,
+        # disjoint pair commits — and the whole string is byte-identical
+        # whether the mesh resharded mid-sequence or never.
+        assert scaled == "CACC" * self.N_PROBES
+        h = hashlib.sha256(scaled.encode()).hexdigest()
+        assert h == hashlib.sha256(fixed_small.encode()).hexdigest()
+        assert h == hashlib.sha256(fixed_big.encode()).hexdigest()
+
+
+class TestAutoscaleObservability:
+    """Satellite: counter names inside the documented audit; annotation
+    class registered; doctor honest-None when unarmed."""
+
+    def test_registry_audit_covers_autoscale_counters(self):
+        from foundationdb_tpu.obs.registry import (
+            AUTOSCALE_DOCUMENTED_COUNTERS,
+            MetricsRegistry,
+        )
+
+        assert all(c.startswith("autoscale.autoscale_")
+                   for c in AUTOSCALE_DOCUMENTED_COUNTERS)
+        reg = MetricsRegistry()
+        reg.add("autoscale", "", {k.split(".", 1)[1]: 0
+                                  for k in AUTOSCALE_DOCUMENTED_COUNTERS})
+        assert reg.audit() == []
+        # autoscale.* counters are autoscale-scope: absent from the core
+        # set, demanded via `extra`.
+        missing_core = reg.missing_documented()
+        assert not any(c.startswith("autoscale.") for c in missing_core)
+        assert reg.missing_documented(
+            extra=AUTOSCALE_DOCUMENTED_COUNTERS) == missing_core
+
+    def test_annotation_class_registered(self):
+        from foundationdb_tpu.obs.recorder import ANNOTATION_CLASSES
+
+        assert "autoscale" in ANNOTATION_CLASSES
+
+    def test_doctor_none_when_unarmed(self):
+        """No autoscale annotations on the ring → scale_relief answers
+        None (unarmed), never a vacuous empty list."""
+        from foundationdb_tpu.obs.doctor import scale_relief
+
+        records = [
+            {"kind": "snapshot", "t": 1.0, "metrics": {"x": 1.0}},
+            {"kind": "annotation", "t": 2.0, "cls": "fault",
+             "name": "ChaosKill"},
+        ]
+        assert scale_relief(records) is None
+
+    def test_doctor_attributes_recruit_from_ring(self):
+        from foundationdb_tpu.obs.doctor import scale_relief
+
+        records = [
+            {"kind": "snapshot", "t": 1.0,
+             "metrics": {"ratekeeper.resolver_dispatch_occupancy": 0.95}},
+            {"kind": "annotation", "t": 1.5, "cls": "autoscale",
+             "name": "AutoscaleRecruit", "role": "resolver",
+             "signal": "resolver_occupancy",
+             "metric": "ratekeeper.resolver_dispatch_occupancy",
+             "clear_below": 0.8, "from_n": 1, "to_n": 2},
+            {"kind": "snapshot", "t": 2.5,
+             "metrics": {"ratekeeper.resolver_dispatch_occupancy": 0.4}},
+            # Relief confirmations are armed-evidence, not events.
+            {"kind": "annotation", "t": 3.0, "cls": "autoscale",
+             "name": "AutoscaleRelief", "role": "resolver",
+             "signal": "resolver_occupancy"},
+        ]
+        out = scale_relief(records)
+        assert out is not None and len(out) == 1
+        ev = out[0]
+        assert ev["name"] == "AutoscaleRecruit"
+        assert ev["relieved"] is True and ev["attributed"] is True
+        assert ev["relief_s"] == pytest.approx(1.0)
+
+
+class TestDeployedRecruitRetire:
+    """Real-TCP smoke (≥2 processes per the chain): retire a commit
+    proxy through the supervisor's configure RPC, recruit it back, and
+    every acked write across both transitions reads back — gated by the
+    PR 13 leak check at shutdown."""
+
+    def test_configure_proxy_down_up_no_acked_loss(self, tmp_path):
+        from foundationdb_tpu.autoscale.controller import deployed_scale
+        from foundationdb_tpu.core.errors import (
+            CommitUnknownResult,
+            FdbError,
+        )
+        from foundationdb_tpu.loadgen.deploy import SocketCluster
+
+        cluster = SocketCluster(str(tmp_path), proxies=2, tlogs=1,
+                                storages=1, resolvers=1,
+                                ratekeeper=True, managed=True)
+        cluster.start()
+        try:
+            loop, t, db = cluster.open_client()
+            from foundationdb_tpu.client.transaction import Transaction
+
+            db.transaction_class = Transaction
+            ctrl = cluster.controller_ep(t)
+            acked: dict[bytes, bytes] = {}
+
+            async def put(i: int) -> None:
+                k, v = b"as/%04d" % i, b"v%04d" % i
+                deadline = loop.now + 60.0
+                while True:
+                    tr = db.transaction()
+                    try:
+                        tr.set(k, v)
+                        await tr.commit()
+                        acked[k] = v
+                        return
+                    except CommitUnknownResult:
+                        pass  # idempotent blind write: resubmit
+                    except FdbError as e:
+                        if not e.retryable or loop.now > deadline:
+                            raise
+                        try:
+                            await db.refresh_client_info()
+                        except Exception:
+                            pass
+                    await loop.sleep(0.2)
+
+            async def settle(epoch0: int, deadline_s: float = 90.0) -> None:
+                # configure() spawns the recovery: wait for the epoch to
+                # actually move past the pre-scale generation, then for
+                # the recovery to finish.
+                deadline = loop.now + deadline_s
+                while loop.now < deadline:
+                    try:
+                        st = await ctrl.get_status()
+                        if (st["epoch"] > epoch0
+                                and not st.get("recovering")):
+                            return
+                    except Exception:
+                        pass
+                    await loop.sleep(0.5)
+                raise AssertionError("controller never settled")
+
+            async def main() -> str:
+                for i in range(6):
+                    await put(i)
+                # Retire one commit proxy live (drain via generation
+                # change; the outgoing GRV proxy releases its lease).
+                e0 = (await ctrl.get_status())["epoch"]
+                out = await deployed_scale(ctrl, "proxy", 1)
+                assert out["configured"]["proxy"] == 1
+                await settle(e0)
+                for i in range(6, 12):
+                    await put(i)
+                # Recruit it back.
+                e1 = (await ctrl.get_status())["epoch"]
+                out = await deployed_scale(ctrl, "proxy", 2)
+                assert out["configured"]["proxy"] == 2
+                await settle(e1)
+                for i in range(12, 18):
+                    await put(i)
+                # Exact read-back of every acked write, one snapshot.
+                tr = db.transaction()
+                rows = dict(await tr.get_range(b"as/", b"as/\xff",
+                                               snapshot=True))
+                lost = [k for k, v in acked.items() if rows.get(k) != v]
+                assert not lost, f"acked writes lost: {lost}"
+                assert len(acked) == 18
+                return "ok"
+
+            assert loop.run(main(), timeout=300) == "ok"
+        finally:
+            # PR 13 gate: shutdown() raises on leaked sockets/processes.
+            cluster.shutdown()
